@@ -1,0 +1,27 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A from-scratch JAX/XLA/Pallas re-design with the capabilities of
+Deeplearning4j (reference: /root/reference, v0.8.1-SNAPSHOT):
+
+- a JSON-round-trippable network configuration DSL
+  (ref: deeplearning4j-nn/.../nn/conf/NeuralNetConfiguration.java)
+- sequential (``MultiLayerNetwork``) and DAG (``ComputationGraph``) containers
+  (ref: nn/multilayer/MultiLayerNetwork.java, nn/graph/ComputationGraph.java)
+- a full layer zoo, updaters, listeners, evaluation, checkpointing,
+  gradient checks, Keras import, NLP/graph-embedding tools, and
+  data-parallel training over a ``jax.sharding.Mesh``.
+
+Unlike the reference (hand-written per-layer forward/backward over libnd4j
+kernels), layers here are pure functions composed into one jitted training
+step; backprop is ``jax.grad``; scale-out is XLA collectives over ICI/DCN
+rather than parameter averaging through threads/Aeron/Spark.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.nn.conf import (  # noqa: F401
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+    InputType,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401

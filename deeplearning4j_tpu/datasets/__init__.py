@@ -1,0 +1,14 @@
+"""Data pipeline (ref: deeplearning4j-nn/.../datasets/iterator/ +
+deeplearning4j-core/.../datasets/)."""
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet  # noqa: F401
+from deeplearning4j_tpu.datasets.iterator import (  # noqa: F401
+    DataSetIterator,
+    ListDataSetIterator,
+    AsyncDataSetIterator,
+    SamplingDataSetIterator,
+    MultipleEpochsIterator,
+    ExistingDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator  # noqa: F401
+from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator  # noqa: F401

@@ -1,0 +1,256 @@
+"""DataSet iterators.
+
+Mirrors the reference's iterator stack: the ``DataSetIterator`` contract
+(ND4J interface), ``AsyncDataSetIterator`` (background prefetch thread +
+BlockingQueue — ref: deeplearning4j-nn/.../datasets/iterator/
+AsyncDataSetIterator.java:33-75), and the adapters under
+datasets/iterator/ (ListDataSetIterator, SamplingDataSetIterator,
+MultipleEpochsIterator, ExistingDataSetIterator).
+
+On TPU the async iterator's job is keeping the host→device feed ahead of the
+step; ``fit()`` wraps any iterator in AsyncDataSetIterator exactly as
+MultiLayerNetwork.fit does (ref: MultiLayerNetwork.java:951).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Iterator contract (ref: ND4J DataSetIterator interface)."""
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> DataSet:
+        raise NotImplementedError
+
+    def batch_size(self) -> int:
+        raise NotImplementedError
+
+    def total_examples(self) -> Optional[int]:
+        return None
+
+    def async_supported(self) -> bool:
+        return True
+
+    # Python iteration protocol
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        while self.has_next():
+            yield self.next()
+
+    def __next__(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate over a pre-built list of minibatches
+    (ref: datasets/iterator/impl/ListDataSetIterator.java)."""
+
+    def __init__(self, batches: List[DataSet]):
+        self._batches = list(batches)
+        self._pos = 0
+
+    @staticmethod
+    def from_dataset(ds: DataSet, batch_size: int) -> "ListDataSetIterator":
+        return ListDataSetIterator(ds.batch_by(batch_size))
+
+    def reset(self):
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self._batches)
+
+    def next(self):
+        b = self._batches[self._pos]
+        self._pos += 1
+        return b
+
+    def batch_size(self):
+        return self._batches[0].num_examples() if self._batches else 0
+
+    def total_examples(self):
+        return sum(b.num_examples() for b in self._batches)
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    """Wrap any Python iterable of DataSets
+    (ref: datasets/iterator/ExistingDataSetIterator.java)."""
+
+    def __init__(self, iterable):
+        self._iterable = iterable
+        self._it = None
+        self._peek: Optional[DataSet] = None
+
+    def reset(self):
+        self._it = iter(self._iterable)
+        self._peek = None
+
+    def _ensure(self):
+        if self._it is None:
+            self.reset()
+        if self._peek is None:
+            try:
+                self._peek = next(self._it)
+            except StopIteration:
+                self._peek = None
+
+    def has_next(self):
+        self._ensure()
+        return self._peek is not None
+
+    def next(self):
+        self._ensure()
+        if self._peek is None:
+            raise StopIteration
+        out, self._peek = self._peek, None
+        return out
+
+    def batch_size(self):
+        return 0
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Sample minibatches with replacement from a full DataSet
+    (ref: datasets/iterator/SamplingDataSetIterator.java)."""
+
+    def __init__(self, dataset: DataSet, batch_size: int, total_batches: int,
+                 seed: int = 0):
+        self._ds = dataset
+        self._bs = batch_size
+        self._total = total_batches
+        self._count = 0
+        self._rng = np.random.default_rng(seed)
+
+    def reset(self):
+        self._count = 0
+
+    def has_next(self):
+        return self._count < self._total
+
+    def next(self):
+        idx = self._rng.integers(0, self._ds.num_examples(), size=self._bs)
+        self._count += 1
+        return DataSet(self._ds.features[idx], self._ds.labels[idx])
+
+    def batch_size(self):
+        return self._bs
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Repeat an underlying iterator for N epochs
+    (ref: datasets/iterator/MultipleEpochsIterator.java)."""
+
+    def __init__(self, epochs: int, base: DataSetIterator):
+        self._epochs = epochs
+        self._base = base
+        self._epoch = 0
+
+    def reset(self):
+        self._epoch = 0
+        self._base.reset()
+
+    def has_next(self):
+        if self._base.has_next():
+            return True
+        if self._epoch + 1 < self._epochs:
+            self._epoch += 1
+            self._base.reset()
+            return self._base.has_next()
+        return False
+
+    def next(self):
+        if not self.has_next():
+            raise StopIteration
+        return self._base.next()
+
+    def batch_size(self):
+        return self._base.batch_size()
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background prefetch thread + bounded queue
+    (ref: AsyncDataSetIterator.java:33-75 — same structure: producer thread
+    fills a BlockingQueue of size ``queue_size``; poison pill on exhaustion)."""
+
+    def __init__(self, base: DataSetIterator, queue_size: int = 8):
+        self._base = base
+        self._queue_size = queue_size
+        self._queue: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._thread: Optional[threading.Thread] = None
+        self._peek = None  # ("data", ds) | ("error", exc) | ("end", None)
+        self._done = False
+        self._start()
+
+    def _producer(self, q: "queue.Queue"):
+        # In-order tagged items: already-produced batches are consumed before
+        # an error is raised, and the stream always terminates cleanly.
+        try:
+            while self._base.has_next():
+                q.put(("data", self._base.next()))
+            q.put(("end", None))
+        except BaseException as e:  # surfaced, in order, on the consumer side
+            q.put(("error", e))
+
+    def _start(self):
+        self._done = False
+        self._thread = threading.Thread(target=self._producer,
+                                        args=(self._queue,), daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        if self._thread is not None and self._thread.is_alive():
+            # drain so the producer can exit; terminal item ends the stream
+            while True:
+                tag, _ = self._queue.get()
+                if tag in ("end", "error"):
+                    break
+            self._thread.join()
+        self._queue = queue.Queue(maxsize=self._queue_size)
+        self._peek = None
+        self._base.reset()
+        self._start()
+
+    def _ensure(self):
+        if self._peek is None and not self._done:
+            self._peek = self._queue.get()
+
+    def has_next(self):
+        if self._done:
+            return False
+        self._ensure()
+        tag, payload = self._peek
+        if tag == "error":  # propagate instead of silently ending the epoch
+            self._done = True
+            raise payload
+        return tag == "data"
+
+    def next(self):
+        if self._done:
+            raise StopIteration
+        self._ensure()
+        tag, payload = self._peek
+        if tag == "data":
+            self._peek = None
+            return payload
+        # terminal item: mark exhausted so subsequent calls never block
+        self._done = True
+        if tag == "error":
+            raise payload
+        raise StopIteration
+
+    def batch_size(self):
+        return self._base.batch_size()

@@ -1,0 +1,5 @@
+"""Evaluation suite (ref: deeplearning4j-nn/.../eval/)."""
+
+from deeplearning4j_tpu.eval.evaluation import Evaluation, ConfusionMatrix  # noqa: F401
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation  # noqa: F401
+from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass  # noqa: F401

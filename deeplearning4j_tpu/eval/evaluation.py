@@ -1,0 +1,120 @@
+"""Classification evaluation: accuracy/precision/recall/F1 + confusion matrix.
+
+Ref: eval/Evaluation.java:441-587 (stats(), per-class precision/recall/F1,
+confusion matrix accumulation) and eval/ConfusionMatrix.java. Time-series
+variants respect label masks (ref: EvaluationUtils time-series reshaping).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class ConfusionMatrix:
+    def __init__(self, num_classes: int):
+        self.matrix = np.zeros((num_classes, num_classes), dtype=np.int64)
+
+    def add(self, actual: np.ndarray, predicted: np.ndarray):
+        np.add.at(self.matrix, (actual, predicted), 1)
+
+    def get_count(self, actual: int, predicted: int) -> int:
+        return int(self.matrix[actual, predicted])
+
+
+class Evaluation:
+    """Accumulating classification evaluator (ref: eval/Evaluation.java)."""
+
+    def __init__(self, num_classes: Optional[int] = None,
+                 labels: Optional[List[str]] = None):
+        self.num_classes = num_classes
+        self.label_names = labels
+        self.confusion: Optional[ConfusionMatrix] = None
+        self.examples = 0
+
+    def _ensure(self, n: int):
+        if self.confusion is None:
+            self.num_classes = self.num_classes or n
+            self.confusion = ConfusionMatrix(self.num_classes)
+
+    def eval(self, labels: np.ndarray, predictions: np.ndarray,
+             mask: Optional[np.ndarray] = None):
+        """labels/predictions: [B, C] one-hot/probabilities, or time series
+        [B, T, C] (flattened with mask exclusion, as the reference's
+        evalTimeSeries does)."""
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:
+            B, T, C = labels.shape
+            labels = labels.reshape(B * T, C)
+            predictions = predictions.reshape(B * T, C)
+            if mask is not None:
+                keep = np.asarray(mask).reshape(B * T) > 0
+                labels, predictions = labels[keep], predictions[keep]
+        elif mask is not None:
+            keep = np.asarray(mask).reshape(len(labels)) > 0
+            labels, predictions = labels[keep], predictions[keep]
+        self._ensure(labels.shape[-1])
+        actual = np.argmax(labels, axis=-1)
+        pred = np.argmax(predictions, axis=-1)
+        self.confusion.add(actual, pred)
+        self.examples += len(actual)
+
+    # ------------------------------------------------------------- metrics
+    def _tp(self) -> np.ndarray:
+        return np.diag(self.confusion.matrix)
+
+    def accuracy(self) -> float:
+        m = self.confusion.matrix
+        total = m.sum()
+        return float(np.diag(m).sum() / total) if total else 0.0
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        m = self.confusion.matrix
+        col = m.sum(axis=0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per = np.where(col > 0, np.diag(m) / np.maximum(col, 1), 0.0)
+        if cls is not None:
+            return float(per[cls])
+        present = m.sum(axis=1) > 0
+        return float(per[present].mean()) if present.any() else 0.0
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        m = self.confusion.matrix
+        row = m.sum(axis=1)
+        per = np.where(row > 0, np.diag(m) / np.maximum(row, 1), 0.0)
+        if cls is not None:
+            return float(per[cls])
+        present = row > 0
+        return float(per[present].mean()) if present.any() else 0.0
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p, r = self.precision(cls), self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
+
+    def false_positive_rate(self, cls: int) -> float:
+        m = self.confusion.matrix
+        fp = m[:, cls].sum() - m[cls, cls]
+        tn = m.sum() - m[cls, :].sum() - m[:, cls].sum() + m[cls, cls]
+        return float(fp / (fp + tn)) if (fp + tn) else 0.0
+
+    def stats(self) -> str:
+        """Human-readable report (ref: Evaluation.stats())."""
+        n = self.num_classes or 0
+        names = self.label_names or [str(i) for i in range(n)]
+        lines = ["========================Evaluation Metrics========================",
+                 f" # of classes: {n}",
+                 f" Examples:     {self.examples}",
+                 f" Accuracy:     {self.accuracy():.4f}",
+                 f" Precision:    {self.precision():.4f}",
+                 f" Recall:       {self.recall():.4f}",
+                 f" F1 Score:     {self.f1():.4f}",
+                 "", "Confusion matrix (rows=actual, cols=predicted):"]
+        m = self.confusion.matrix if self.confusion is not None else np.zeros((0, 0))
+        header = "      " + " ".join(f"{nm:>6}" for nm in names)
+        lines.append(header)
+        for i in range(n):
+            lines.append(f"{names[i]:>6}" + " ".join(f"{m[i, j]:>6}" for j in range(n)))
+        lines.append("==================================================================")
+        return "\n".join(lines)

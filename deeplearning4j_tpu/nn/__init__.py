@@ -1,0 +1,1 @@
+"""Neural network runtime: configuration DSL, layers, containers, updaters."""

@@ -1,0 +1,19 @@
+"""Configuration DSL (ref: deeplearning4j-nn/.../nn/conf/)."""
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: F401
+from deeplearning4j_tpu.nn.conf.builder import (  # noqa: F401
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+    TrainingConfig,
+    UpdaterConfig,
+    ListBuilder,
+)
+from deeplearning4j_tpu.nn.conf.preprocessors import (  # noqa: F401
+    InputPreProcessor,
+    CnnToFeedForwardPreProcessor,
+    FeedForwardToCnnPreProcessor,
+    RnnToFeedForwardPreProcessor,
+    FeedForwardToRnnPreProcessor,
+    CnnToRnnPreProcessor,
+    RnnToCnnPreProcessor,
+)

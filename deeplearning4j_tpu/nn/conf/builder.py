@@ -1,0 +1,346 @@
+"""Network configuration DSL.
+
+Mirrors the reference's fluent builder chain
+(ref: nn/conf/NeuralNetConfiguration.java:211-250 `ListBuilder`,
+nn/conf/MultiLayerConfiguration.java:108-124) producing a JSON-serializable
+configuration: global hyperparameters (inherited per layer), the layer list,
+auto-inserted preprocessors, shape inference from an ``InputType``, and
+training settings (updater, schedules, gradient clipping, tBPTT).
+
+Example::
+
+    conf = (NeuralNetConfiguration.builder()
+        .seed(12345)
+        .updater("adam", learning_rate=1e-3)
+        .weight_init("xavier")
+        .l2(1e-4)
+        .list()
+        .layer(DenseLayer(n_out=256, activation="relu"))
+        .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(784))
+        .build())
+
+JSON round-trip: ``conf.to_json()`` / ``MultiLayerConfiguration.from_json``
+(ref: NeuralNetConfiguration.java:283-360 to/fromJson). Polymorphic layer
+subtypes resolve through LAYER_REGISTRY type tags instead of Jackson
+classpath reflection.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    InputPreProcessor, auto_preprocessor,
+)
+from deeplearning4j_tpu.nn.layers.base import BaseLayerConf, GlobalConf, layer_from_dict
+from deeplearning4j_tpu.nn.weights import Distribution
+
+# Layer-family classification for automatic preprocessor insertion
+# (plays the role of InputType.getPreProcessorForInputType overrides).
+_CNN_LAYERS = {"ConvolutionLayer", "SubsamplingLayer", "ZeroPaddingLayer",
+               "LocalResponseNormalization"}
+_RNN_LAYERS = {"LSTM", "GravesLSTM", "GravesBidirectionalLSTM", "SimpleRnn",
+               "RnnOutputLayer", "Convolution1DLayer", "Subsampling1DLayer"}
+_ANY_LAYERS = {"BatchNormalization", "GlobalPoolingLayer", "ActivationLayer",
+               "DropoutLayer", "LossLayer"}
+
+
+def expected_input_kind(layer: BaseLayerConf) -> str:
+    tag = type(layer).__name__
+    if tag in _CNN_LAYERS:
+        return "cnn"
+    if tag in _RNN_LAYERS:
+        return "rnn"
+    if tag in _ANY_LAYERS:
+        return "any"
+    return "ff"
+
+
+@dataclass
+class UpdaterConfig:
+    """Updater + hyperparams (ref: nn/conf/Updater.java enum — SGD, ADAM,
+    ADADELTA, NESTEROVS, ADAGRAD, RMSPROP, NONE — with params held on the
+    layer conf: momentum, rho, epsilon, adamMeanDecay/adamVarDecay)."""
+    name: str = "sgd"
+    learning_rate: float = 0.1
+    momentum: float = 0.9           # nesterovs
+    rho: float = 0.95               # adadelta / rmsprop decay
+    epsilon: float = 1e-8
+    beta1: float = 0.9              # adam
+    beta2: float = 0.999
+    # learning-rate policy (ref: nn/conf/LearningRatePolicy.java)
+    lr_policy: str = "none"         # none|exponential|inverse|poly|sigmoid|step|schedule
+    lr_policy_decay_rate: float = 0.0
+    lr_policy_power: float = 1.0
+    lr_policy_steps: float = 1.0
+    lr_schedule: Optional[Dict[int, float]] = None  # iteration -> lr
+
+    def to_dict(self) -> dict:
+        d = {k: v for k, v in self.__dict__.items() if v is not None}
+        if self.lr_schedule is not None:
+            d["lr_schedule"] = {str(k): v for k, v in self.lr_schedule.items()}
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "UpdaterConfig":
+        d = dict(d)
+        if d.get("lr_schedule"):
+            d["lr_schedule"] = {int(k): v for k, v in d["lr_schedule"].items()}
+        return UpdaterConfig(**d)
+
+
+@dataclass
+class TrainingConfig:
+    """Training-loop settings carried alongside the layer stack
+    (ref: NeuralNetConfiguration fields + MultiLayerConfiguration
+    backprop/pretrain/backpropType/tBPTT*)."""
+    seed: int = 12345
+    optimization_algo: str = "sgd"  # sgd | line_gradient_descent | conjugate_gradient | lbfgs
+    max_num_line_search_iterations: int = 5
+    minimize: bool = True
+    minibatch: bool = True
+    updater: UpdaterConfig = field(default_factory=UpdaterConfig)
+    # gradient normalization (ref: nn/conf/GradientNormalization.java)
+    gradient_normalization: str = "none"
+    gradient_normalization_threshold: float = 1.0
+    # backprop style
+    backprop: bool = True
+    pretrain: bool = False
+    backprop_type: str = "standard"  # standard | truncated_bptt
+    tbptt_fwd_length: int = 20
+    tbptt_bwd_length: int = 20
+    dtype: str = "float32"
+
+    def to_dict(self) -> dict:
+        d = dict(self.__dict__)
+        d["updater"] = self.updater.to_dict()
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "TrainingConfig":
+        d = dict(d)
+        d["updater"] = UpdaterConfig.from_dict(d["updater"])
+        return TrainingConfig(**d)
+
+
+@dataclass
+class MultiLayerConfiguration:
+    """The fully-resolved sequential-network config
+    (ref: nn/conf/MultiLayerConfiguration.java)."""
+    layers: List[BaseLayerConf]
+    preprocessors: Dict[int, InputPreProcessor] = field(default_factory=dict)
+    input_type: Optional[InputType] = None
+    input_types: List[InputType] = field(default_factory=list)  # per-layer, resolved
+    training: TrainingConfig = field(default_factory=TrainingConfig)
+
+    # ------------------------------------------------------------------ serde
+    def to_dict(self) -> dict:
+        return {
+            "format": "deeplearning4j_tpu/MultiLayerConfiguration",
+            "version": 1,
+            "training": self.training.to_dict(),
+            "input_type": self.input_type.to_dict() if self.input_type else None,
+            "input_types": [t.to_dict() for t in self.input_types],
+            "preprocessors": {str(i): p.to_dict() for i, p in self.preprocessors.items()},
+            "layers": [l.to_dict() for l in self.layers],
+        }
+
+    def to_json(self, indent: int = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    @staticmethod
+    def from_dict(d: dict) -> "MultiLayerConfiguration":
+        return MultiLayerConfiguration(
+            layers=[layer_from_dict(ld) for ld in d["layers"]],
+            preprocessors={int(i): InputPreProcessor.from_dict(pd)
+                           for i, pd in d.get("preprocessors", {}).items()},
+            input_type=(InputType.from_dict(d["input_type"])
+                        if d.get("input_type") else None),
+            input_types=[InputType.from_dict(t) for t in d.get("input_types", [])],
+            training=TrainingConfig.from_dict(d["training"]),
+        )
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        return MultiLayerConfiguration.from_dict(json.loads(s))
+
+
+class ListBuilder:
+    """Sequential-stack builder (ref: NeuralNetConfiguration.ListBuilder)."""
+
+    def __init__(self, parent: "NeuralNetConfiguration"):
+        self._parent = parent
+        self._layers: List[BaseLayerConf] = []
+        self._preprocessors: Dict[int, InputPreProcessor] = {}
+        self._input_type: Optional[InputType] = None
+
+    def layer(self, layer: BaseLayerConf, index: Optional[int] = None) -> "ListBuilder":
+        if index is not None and index != len(self._layers):
+            raise ValueError("layers must be added in order")
+        self._layers.append(layer)
+        return self
+
+    def input_pre_processor(self, layer_index: int,
+                            p: InputPreProcessor) -> "ListBuilder":
+        self._preprocessors[layer_index] = p
+        return self
+
+    def set_input_type(self, t: InputType) -> "ListBuilder":
+        self._input_type = t
+        return self
+
+    # alias matching the reference naming
+    setInputType = set_input_type
+
+    def backprop_type(self, t: str, fwd: int = 20, bwd: int = 20) -> "ListBuilder":
+        self._parent._training.backprop_type = t
+        self._parent._training.tbptt_fwd_length = fwd
+        self._parent._training.tbptt_bwd_length = bwd
+        return self
+
+    def pretrain(self, flag: bool) -> "ListBuilder":
+        self._parent._training.pretrain = flag
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        g = self._parent._global
+        training = self._parent._training
+        if not self._layers:
+            raise ValueError("No layers added")
+        # 1. inherit global hyperparams (ref: Builder.layer() semantics)
+        for l in self._layers:
+            l.apply_global_defaults(g)
+        # 2. shape inference + auto preprocessors (ref: setInputType flow)
+        input_types: List[InputType] = []
+        cur = self._input_type
+        if cur is not None:
+            for i, l in enumerate(self._layers):
+                if i not in self._preprocessors:
+                    p = auto_preprocessor(cur, expected_input_kind(l))
+                    if p is not None:
+                        self._preprocessors[i] = p
+                if i in self._preprocessors:
+                    cur = self._preprocessors[i].infer_output_type(cur)
+                l.set_n_in(cur)  # inference overrides any manual n_in
+                input_types.append(cur)
+                cur = l.infer_output_type(cur)
+        else:
+            for l in self._layers:
+                if l.has_params() and l.n_in is None:
+                    raise ValueError(
+                        f"Layer {l}: n_in not set and no input_type given")
+        return MultiLayerConfiguration(
+            layers=self._layers,
+            preprocessors=self._preprocessors,
+            input_type=self._input_type,
+            input_types=input_types,
+            training=training,
+        )
+
+
+class NeuralNetConfiguration:
+    """Global-hyperparameter builder (ref: NeuralNetConfiguration.Builder)."""
+
+    def __init__(self):
+        self._global = GlobalConf()
+        self._training = TrainingConfig()
+
+    @staticmethod
+    def builder() -> "NeuralNetConfiguration":
+        return NeuralNetConfiguration()
+
+    # ---- fluent global hyperparameters ----
+    def seed(self, s: int) -> "NeuralNetConfiguration":
+        self._training.seed = int(s)
+        return self
+
+    def activation(self, a: str) -> "NeuralNetConfiguration":
+        self._global.activation = a
+        return self
+
+    def weight_init(self, w: str) -> "NeuralNetConfiguration":
+        self._global.weight_init = w
+        return self
+
+    def dist(self, d: Distribution) -> "NeuralNetConfiguration":
+        self._global.dist = d
+        return self
+
+    def bias_init(self, b: float) -> "NeuralNetConfiguration":
+        self._global.bias_init = b
+        return self
+
+    def l1(self, v: float) -> "NeuralNetConfiguration":
+        self._global.l1 = v
+        return self
+
+    def l2(self, v: float) -> "NeuralNetConfiguration":
+        self._global.l2 = v
+        return self
+
+    def dropout(self, retain_prob: float) -> "NeuralNetConfiguration":
+        self._global.dropout = retain_prob
+        return self
+
+    # ---- training config ----
+    def updater(self, name: str, **kwargs) -> "NeuralNetConfiguration":
+        # mutate in place so the fluent chain is order-insensitive
+        # (.learning_rate(x).updater('adam') keeps x, like the reference)
+        u = self._training.updater
+        u.name = name.lower()
+        for k, v in kwargs.items():
+            if not hasattr(u, k):
+                raise ValueError(f"Unknown updater option {k!r}")
+            setattr(u, k, v)
+        return self
+
+    def learning_rate(self, lr: float) -> "NeuralNetConfiguration":
+        self._training.updater.learning_rate = lr
+        return self
+
+    def optimization_algo(self, algo: str) -> "NeuralNetConfiguration":
+        self._training.optimization_algo = algo.lower()
+        return self
+
+    def minimize(self, flag: bool = True) -> "NeuralNetConfiguration":
+        self._training.minimize = flag
+        return self
+
+    def gradient_normalization(self, kind: str,
+                               threshold: float = 1.0) -> "NeuralNetConfiguration":
+        self._training.gradient_normalization = kind.lower()
+        self._training.gradient_normalization_threshold = threshold
+        return self
+
+    def lr_policy(self, policy: str, decay_rate: float = 0.0, power: float = 1.0,
+                  steps: float = 1.0,
+                  schedule: Optional[Dict[int, float]] = None) -> "NeuralNetConfiguration":
+        u = self._training.updater
+        u.lr_policy = policy.lower()
+        u.lr_policy_decay_rate = decay_rate
+        u.lr_policy_power = power
+        u.lr_policy_steps = steps
+        u.lr_schedule = schedule
+        return self
+
+    def dtype(self, dt: str) -> "NeuralNetConfiguration":
+        self._training.dtype = dt
+        return self
+
+    # ---- transition to layer stacking ----
+    def list(self) -> ListBuilder:
+        return ListBuilder(self)
+
+    def graph_builder(self):
+        """DAG-network builder (ref: ComputationGraphConfiguration.
+        GraphBuilder)."""
+        try:
+            from deeplearning4j_tpu.nn.conf.graph_builder import GraphBuilder
+        except ImportError as e:
+            raise NotImplementedError(
+                "ComputationGraph builder not available yet") from e
+        return GraphBuilder(self)

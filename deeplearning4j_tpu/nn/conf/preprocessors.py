@@ -1,0 +1,161 @@
+"""Input preprocessors: reshape activations between layer families.
+
+Ref: nn/conf/preprocessor/{CnnToFeedForwardPreProcessor,
+FeedForwardToCnnPreProcessor, RnnToFeedForwardPreProcessor,
+FeedForwardToRnnPreProcessor, CnnToRnnPreProcessor, RnnToCnnPreProcessor}.java
+— the reference reshapes both activations (forward) and epsilons (backward);
+under autodiff only the forward reshape is needed. Auto-insertion between
+mismatched layer families mirrors the legacy ConvolutionLayerSetup wiring
+(ref: nn/conf/layers/setup/ConvolutionLayerSetup.java:40).
+
+Layout note: CNN tensors are NHWC here (TPU-native) vs the reference's NCHW;
+RNN tensors are [B, T, F] vs the reference's [B, F, T].
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Type
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+Array = jax.Array
+
+PREPROCESSOR_REGISTRY: Dict[str, Type["InputPreProcessor"]] = {}
+
+
+def register_preprocessor(cls):
+    PREPROCESSOR_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclass
+class InputPreProcessor:
+    def transform(self, x: Array, in_type: InputType) -> Array:
+        raise NotImplementedError
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    def transform_mask(self, mask: Optional[Array], in_type: InputType):
+        return mask
+
+    def to_dict(self) -> dict:
+        d = {"@type": type(self).__name__}
+        d.update({k: v for k, v in self.__dict__.items() if v is not None})
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "InputPreProcessor":
+        d = dict(d)
+        tag = d.pop("@type")
+        return PREPROCESSOR_REGISTRY[tag](**d)
+
+
+@register_preprocessor
+@dataclass
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    def transform(self, x, in_type):
+        return x.reshape(x.shape[0], -1)
+
+    def infer_output_type(self, in_type):
+        return InputType.feed_forward(in_type.flat_size())
+
+
+@register_preprocessor
+@dataclass
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def transform(self, x, in_type):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def infer_output_type(self, in_type):
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preprocessor
+@dataclass
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[B, T, F] kept as-is; downstream FF layers broadcast over T. The
+    reference flattens to [B*T, F] (RnnToFeedForwardPreProcessor.java) — the
+    broadcast form is numerically identical for dense ops and avoids the
+    reshape round-trip."""
+
+    def transform(self, x, in_type):
+        return x
+
+    def infer_output_type(self, in_type):
+        return InputType.feed_forward(in_type.size)
+
+
+@register_preprocessor
+@dataclass
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    def transform(self, x, in_type):
+        return x  # [B, T, F] already, or [B, F] broadcast handled by layer
+
+    def infer_output_type(self, in_type):
+        return InputType.recurrent(in_type.flat_size())
+
+
+@register_preprocessor
+@dataclass
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """[B, H, W, C] -> [B, T=H*W, F=C]? No — the reference treats each
+    example's whole CNN volume as one timestep-feature vector per time slice
+    is not well defined without a time axis; it flattens HWC to features and
+    yields T=1. We follow: flatten to [B, 1, H*W*C]."""
+
+    def transform(self, x, in_type):
+        return x.reshape(x.shape[0], 1, -1)
+
+    def infer_output_type(self, in_type):
+        return InputType.recurrent(in_type.flat_size(), 1)
+
+
+@register_preprocessor
+@dataclass
+class RnnToCnnPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def transform(self, x, in_type):
+        b, t, f = x.shape
+        return x.reshape(b * t, self.height, self.width, self.channels)
+
+    def infer_output_type(self, in_type):
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+def auto_preprocessor(current: InputType, expected_kind: str) -> Optional[InputPreProcessor]:
+    """Choose the preprocessor bridging ``current`` to a layer expecting
+    ``expected_kind`` ('ff' | 'cnn' | 'rnn' | 'any')."""
+    kind = "ff" if current.kind == "cnnflat" else current.kind
+    if expected_kind in ("any", kind):
+        if current.kind == "cnnflat" and expected_kind == "cnn":
+            return FeedForwardToCnnPreProcessor(current.height, current.width,
+                                                current.channels)
+        return None
+    if kind == "cnn" and expected_kind == "ff":
+        return CnnToFeedForwardPreProcessor()
+    if kind == "ff" and expected_kind == "cnn":
+        if current.kind == "cnnflat":
+            return FeedForwardToCnnPreProcessor(current.height, current.width,
+                                                current.channels)
+        raise ValueError(
+            f"Cannot infer CNN shape from {current}; set an explicit "
+            "FeedForwardToCnnPreProcessor")
+    if kind == "rnn" and expected_kind == "ff":
+        return RnnToFeedForwardPreProcessor()
+    if kind == "ff" and expected_kind == "rnn":
+        return FeedForwardToRnnPreProcessor()
+    if kind == "cnn" and expected_kind == "rnn":
+        return CnnToRnnPreProcessor()
+    raise ValueError(f"No preprocessor from {current.kind} to {expected_kind}")

@@ -1,0 +1,274 @@
+"""Convolution / subsampling / padding layers (NHWC, XLA-native).
+
+The reference implements conv as im2col + gemm in Java with an optional
+cuDNN helper (ref: nn/layers/convolution/ConvolutionLayer.java:55-77 helper
+discovery, deeplearning4j-cuda/.../CudnnConvolutionHelper.java). Here conv
+lowers straight to XLA ``conv_general_dilated`` (the MXU path — the entire
+descriptor/algorithm/workspace machinery of the cuDNN helper collapses into
+XLA's compile-time selection); pooling lowers to ``lax.reduce_window``
+(ref: CudnnSubsamplingHelper.java -> XLA ReduceWindow).
+
+ConvolutionMode semantics follow the reference enum
+(nn/conf/ConvolutionMode.java): Strict (shapes must divide exactly),
+Truncate (floor), Same (pad to ceil(in/stride)).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import BaseLayerConf, Params, register_layer
+from deeplearning4j_tpu.ops.activations import get_activation
+
+DIMS_NHWC = ("NHWC", "HWIO", "NHWC")
+
+
+def _out_size(in_size: int, k: int, s: int, p: int, mode: str) -> int:
+    if mode == "same":
+        return math.ceil(in_size / s)
+    out = (in_size + 2 * p - k) / s + 1
+    if mode == "strict":
+        if out != int(out):
+            raise ValueError(
+                f"ConvolutionMode.Strict: (in={in_size} + 2*{p} - {k}) / {s} + 1 "
+                f"= {out} is not an integer (ref: ConvolutionMode.java)")
+        return int(out)
+    return int(math.floor((in_size + 2 * p - k) / s)) + 1
+
+
+def _padding_config(mode: str, pad: Tuple[int, int]) -> object:
+    return "SAME" if mode == "same" else [(pad[0], pad[0]), (pad[1], pad[1])]
+
+
+@register_layer
+@dataclass
+class ConvolutionLayer(BaseLayerConf):
+    """2D convolution (ref: nn/conf/layers/ConvolutionLayer.java).
+    Kernel stored HWIO; activations NHWC."""
+    n_out: int = 0
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: str = "truncate"   # strict | truncate | same
+    dilation: Tuple[int, int] = (1, 1)
+    has_bias: bool = True
+    # filled by the builder from the incoming InputType:
+    in_channels: Optional[int] = None
+
+    def set_n_in(self, in_type: InputType) -> None:
+        if in_type.kind != "cnn":
+            raise ValueError(f"ConvolutionLayer expects CNN input, got {in_type}")
+        self.in_channels = in_type.channels
+        self.n_in = in_type.flat_size()
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        ph, pw = self.padding
+        h = _out_size(in_type.height, kh, sh, ph, self.convolution_mode)
+        w = _out_size(in_type.width, kw, sw, pw, self.convolution_mode)
+        return InputType.convolutional(h, w, self.n_out)
+
+    def param_order(self) -> List[str]:
+        return ["W", "b"] if self.has_bias else ["W"]
+
+    def init_params(self, rng, dtype=jnp.float32) -> Params:
+        kh, kw = self.kernel_size
+        fan_in = self.in_channels * kh * kw
+        fan_out = self.n_out * kh * kw
+        k_w, _ = jax.random.split(rng)
+        p = {"W": self._init_w(k_w, (kh, kw, self.in_channels, self.n_out),
+                               fan_in, fan_out, dtype)}
+        if self.has_bias:
+            p["b"] = self._init_b((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        x = self._dropout_input(x, train, rng)
+        out = lax.conv_general_dilated(
+            x, params["W"],
+            window_strides=self.stride,
+            padding=_padding_config(self.convolution_mode, self.padding),
+            rhs_dilation=self.dilation,
+            dimension_numbers=DIMS_NHWC,
+        )
+        if self.has_bias:
+            out = out + params["b"]
+        return get_activation(self.activation)(out), state
+
+
+@register_layer
+@dataclass
+class Convolution1DLayer(ConvolutionLayer):
+    """1D conv over the time axis of RNN-format data [B, T, F]
+    (ref: nn/conf/layers/Convolution1DLayer.java — implemented there by
+    reshaping to a width-1 2D conv; here a direct 1D conv)."""
+    kernel_size: Tuple[int, int] = (3, 1)
+
+    def set_n_in(self, in_type: InputType) -> None:
+        if in_type.kind != "rnn":
+            raise ValueError(f"Convolution1D expects RNN input, got {in_type}")
+        self.in_channels = in_type.size
+        self.n_in = in_type.size
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        k, s, p = self.kernel_size[0], self.stride[0], self.padding[0]
+        t = in_type.timesteps
+        t_out = None if t is None else _out_size(t, k, s, p, self.convolution_mode)
+        return InputType.recurrent(self.n_out, t_out)
+
+    def init_params(self, rng, dtype=jnp.float32) -> Params:
+        k = self.kernel_size[0]
+        fan_in = self.in_channels * k
+        fan_out = self.n_out * k
+        k_w, _ = jax.random.split(rng)
+        p = {"W": self._init_w(k_w, (k, self.in_channels, self.n_out),
+                               fan_in, fan_out, dtype)}
+        if self.has_bias:
+            p["b"] = self._init_b((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        x = self._dropout_input(x, train, rng)
+        pad = ("SAME" if self.convolution_mode == "same"
+               else [(self.padding[0], self.padding[0])])
+        out = lax.conv_general_dilated(
+            x, params["W"],
+            window_strides=(self.stride[0],),
+            padding=pad,
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        )
+        if self.has_bias:
+            out = out + params["b"]
+        return get_activation(self.activation)(out), state
+
+
+@register_layer
+@dataclass
+class SubsamplingLayer(BaseLayerConf):
+    """Max/avg/p-norm pooling (ref: nn/conf/layers/SubsamplingLayer.java;
+    impl nn/layers/convolution/subsampling/SubsamplingLayer.java +
+    CudnnSubsamplingHelper → XLA ReduceWindow)."""
+    pooling_type: str = "max"   # max | avg | pnorm | sum
+    kernel_size: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+
+    def set_n_in(self, in_type: InputType) -> None:
+        if in_type.kind != "cnn":
+            raise ValueError(f"SubsamplingLayer expects CNN input, got {in_type}")
+        self.n_in = in_type.flat_size()
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        ph, pw = self.padding
+        h = _out_size(in_type.height, kh, sh, ph, self.convolution_mode)
+        w = _out_size(in_type.width, kw, sw, pw, self.convolution_mode)
+        return InputType.convolutional(h, w, in_type.channels)
+
+    def param_order(self) -> List[str]:
+        return []
+
+    def _window(self):
+        return (1, self.kernel_size[0], self.kernel_size[1], 1)
+
+    def _strides(self):
+        return (1, self.stride[0], self.stride[1], 1)
+
+    def _pad(self):
+        if self.convolution_mode == "same":
+            return "SAME"
+        ph, pw = self.padding
+        return [(0, 0), (ph, ph), (pw, pw), (0, 0)]
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        if self.pooling_type == "max":
+            init = -jnp.inf
+            out = lax.reduce_window(x, init, lax.max, self._window(),
+                                    self._strides(), self._pad())
+        elif self.pooling_type in ("avg", "sum"):
+            out = lax.reduce_window(x, 0.0, lax.add, self._window(),
+                                    self._strides(), self._pad())
+            if self.pooling_type == "avg":
+                kh, kw = self.kernel_size
+                if self.convolution_mode == "same":
+                    ones = jnp.ones_like(x)
+                    counts = lax.reduce_window(ones, 0.0, lax.add, self._window(),
+                                               self._strides(), self._pad())
+                    out = out / counts
+                else:
+                    out = out / (kh * kw)
+        elif self.pooling_type == "pnorm":
+            p = float(self.pnorm)
+            out = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, self._window(),
+                                    self._strides(), self._pad()) ** (1.0 / p)
+        else:
+            raise ValueError(f"Unknown pooling type {self.pooling_type!r}")
+        return out, state
+
+
+@register_layer
+@dataclass
+class Subsampling1DLayer(SubsamplingLayer):
+    """1D pooling over [B, T, F] (ref: Subsampling1DLayer.java)."""
+
+    def set_n_in(self, in_type: InputType) -> None:
+        if in_type.kind != "rnn":
+            raise ValueError(f"Subsampling1D expects RNN input, got {in_type}")
+        self.n_in = in_type.size
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        k, s, p = self.kernel_size[0], self.stride[0], self.padding[0]
+        t = in_type.timesteps
+        t_out = None if t is None else _out_size(t, k, s, p, self.convolution_mode)
+        return InputType.recurrent(in_type.size, t_out)
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        # [B, T, F]: pool over T
+        window = (1, self.kernel_size[0], 1)
+        strides = (1, self.stride[0], 1)
+        if self.convolution_mode == "same":
+            pad = "SAME"
+        else:
+            pad = [(0, 0), (self.padding[0], self.padding[0]), (0, 0)]
+        if self.pooling_type == "max":
+            out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad)
+        else:
+            out = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+            if self.pooling_type == "avg":
+                out = out / self.kernel_size[0]
+        return out, state
+
+
+@register_layer
+@dataclass
+class ZeroPaddingLayer(BaseLayerConf):
+    """Spatial zero padding (ref: nn/conf/layers/ZeroPaddingLayer.java).
+    ``pad`` = (top, bottom, left, right)."""
+    pad: Tuple[int, int, int, int] = (0, 0, 0, 0)
+
+    def set_n_in(self, in_type: InputType) -> None:
+        self.n_in = in_type.flat_size()
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        t, b, l, r = self.pad
+        return InputType.convolutional(in_type.height + t + b,
+                                       in_type.width + l + r,
+                                       in_type.channels)
+
+    def param_order(self) -> List[str]:
+        return []
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        t, b, l, r = self.pad
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state

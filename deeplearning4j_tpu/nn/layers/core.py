@@ -1,0 +1,301 @@
+"""Feed-forward layer zoo: Dense, Output, Loss, Activation, Dropout,
+Embedding, AutoEncoder, RBM, CenterLossOutput.
+
+References:
+- Dense:      nn/layers/feedforward/dense/DenseLayer.java over
+              nn/layers/BaseLayer.java:351-432 (W·x + b via Nd4j.gemm)
+- Output:     nn/layers/BaseOutputLayer.java / OutputLayer.java
+- Embedding:  nn/layers/feedforward/embedding/EmbeddingLayer.java
+              (index lookup == one-hot matmul; here a gather, which XLA
+              lowers to a dynamic-slice — MXU-friendly at scale)
+- AutoEncoder nn/layers/feedforward/autoencoder/AutoEncoder.java
+  (denoising AE: corrupt → encode → decode, pretrain via reconstruction)
+- RBM:        nn/layers/feedforward/rbm/RBM.java (CD-k pretraining; gradients
+  for CD are hand-coded since they are not a plain autodiff loss)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import (
+    Array, BaseLayerConf, Params, State, register_layer,
+)
+from deeplearning4j_tpu.ops.activations import get_activation
+from deeplearning4j_tpu.ops.losses import get_loss
+
+
+@register_layer
+@dataclass
+class DenseLayer(BaseLayerConf):
+    """Fully connected: act(x @ W + b)."""
+    n_out: int = 0
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, rng, dtype=jnp.float32) -> Params:
+        k_w, _ = jax.random.split(rng)
+        return {
+            "W": self._init_w(k_w, (self.n_in, self.n_out), self.n_in, self.n_out, dtype),
+            "b": self._init_b((self.n_out,), dtype),
+        }
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        x = self._dropout_input(x, train, rng)
+        return get_activation(self.activation)(x @ params["W"] + params["b"]), state
+
+
+@register_layer
+@dataclass
+class OutputLayer(DenseLayer):
+    """Dense + loss head (ref: nn/conf/layers/OutputLayer.java;
+    impl nn/layers/BaseOutputLayer.java computeScore/backpropGradient)."""
+    loss: str = "mcxent"
+
+    def compute_loss(self, params, x, labels, *, mask=None, average: bool = True):
+        """Per-example loss from this layer's *input* activations."""
+        preout = x @ params["W"] + params["b"]
+        if preout.shape != labels.shape:
+            raise ValueError(
+                f"OutputLayer: network output shape {preout.shape} != labels "
+                f"shape {labels.shape}. For per-timestep targets use "
+                "RnnOutputLayer; for sequence classification pool time first "
+                "(GlobalPoolingLayer).")
+        per_ex = get_loss(self.loss)(labels, preout, self.activation, mask)
+        return jnp.mean(per_ex) if average else per_ex
+
+
+@register_layer
+@dataclass
+class LossLayer(BaseLayerConf):
+    """Loss-only head, no params (ref: nn/conf/layers/LossLayer.java)."""
+    loss: str = "mcxent"
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        return in_type
+
+    def param_order(self) -> List[str]:
+        return []
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        return get_activation(self.activation)(x), state
+
+    def compute_loss(self, params, x, labels, *, mask=None, average: bool = True):
+        per_ex = get_loss(self.loss)(labels, x, self.activation, mask)
+        return jnp.mean(per_ex) if average else per_ex
+
+
+@register_layer
+@dataclass
+class ActivationLayer(BaseLayerConf):
+    """Parameterless activation (ref: nn/conf/layers/ActivationLayer.java)."""
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        return in_type
+
+    def param_order(self) -> List[str]:
+        return []
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        return get_activation(self.activation)(x), state
+
+
+@register_layer
+@dataclass
+class DropoutLayer(BaseLayerConf):
+    """Standalone dropout (ref: nn/conf/layers/DropoutLayer.java).
+    ``dropout`` holds the retain probability, DL4J-style."""
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        return in_type
+
+    def param_order(self) -> List[str]:
+        return []
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        return self._dropout_input(x, train, rng), state
+
+
+@register_layer
+@dataclass
+class EmbeddingLayer(BaseLayerConf):
+    """Index -> row of W, plus bias (ref: EmbeddingLayer.java — input is a
+    column of indices; equivalent to one-hot × W but done as a gather)."""
+    n_out: int = 0
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, rng, dtype=jnp.float32) -> Params:
+        k_w, _ = jax.random.split(rng)
+        return {
+            "W": self._init_w(k_w, (self.n_in, self.n_out), self.n_in, self.n_out, dtype),
+            "b": self._init_b((self.n_out,), dtype),
+        }
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[:, 0]
+        out = params["W"][idx] + params["b"]
+        return get_activation(self.activation)(out), state
+
+
+@register_layer
+@dataclass
+class AutoEncoder(BaseLayerConf):
+    """Denoising autoencoder (ref: nn/layers/feedforward/autoencoder/
+    AutoEncoder.java). Params: W (tied decode via W^T), b (hidden), vb
+    (visible) — matching PretrainParamInitializer's W/b/vb contract."""
+    n_out: int = 0
+    corruption_level: float = 0.3
+    loss: str = "mse"
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def param_order(self) -> List[str]:
+        return ["W", "b", "vb"]
+
+    def init_params(self, rng, dtype=jnp.float32) -> Params:
+        k_w, _ = jax.random.split(rng)
+        return {
+            "W": self._init_w(k_w, (self.n_in, self.n_out), self.n_in, self.n_out, dtype),
+            "b": self._init_b((self.n_out,), dtype),
+            "vb": self._init_b((self.n_in,), dtype),
+        }
+
+    def encode(self, params, x):
+        return get_activation(self.activation)(x @ params["W"] + params["b"])
+
+    def decode(self, params, h):
+        return get_activation(self.activation)(h @ params["W"].T + params["vb"])
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        x = self._dropout_input(x, train, rng)
+        return self.encode(params, x), state
+
+    def pretrain_loss(self, params, x, *, rng):
+        """Denoising reconstruction loss for layerwise pretraining
+        (ref: AutoEncoder.computeGradientAndScore)."""
+        if self.corruption_level > 0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level, x.shape)
+            corrupted = jnp.where(keep, x, 0.0)
+        else:
+            corrupted = x
+        recon = self.decode(params, self.encode(params, corrupted))
+        return jnp.mean(jnp.sum((recon - x) ** 2, axis=-1))
+
+
+@register_layer
+@dataclass
+class RBM(BaseLayerConf):
+    """Restricted Boltzmann machine with CD-k pretraining
+    (ref: nn/layers/feedforward/rbm/RBM.java, 504 LoC; conf
+    nn/conf/layers/RBM.java — HiddenUnit/VisibleUnit BINARY|GAUSSIAN).
+    Forward pass = propup (sigmoid/identity), used as a feed-forward layer
+    after pretraining, exactly as the reference does."""
+    n_out: int = 0
+    hidden_unit: str = "binary"    # binary | gaussian | relu
+    visible_unit: str = "binary"
+    k: int = 1                      # CD-k steps
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def param_order(self) -> List[str]:
+        return ["W", "b", "vb"]
+
+    def init_params(self, rng, dtype=jnp.float32) -> Params:
+        k_w, _ = jax.random.split(rng)
+        return {
+            "W": self._init_w(k_w, (self.n_in, self.n_out), self.n_in, self.n_out, dtype),
+            "b": self._init_b((self.n_out,), dtype),
+            "vb": self._init_b((self.n_in,), dtype),
+        }
+
+    def _hid_mean(self, params, v):
+        pre = v @ params["W"] + params["b"]
+        return jax.nn.sigmoid(pre) if self.hidden_unit == "binary" else pre
+
+    def _vis_mean(self, params, h):
+        pre = h @ params["W"].T + params["vb"]
+        return jax.nn.sigmoid(pre) if self.visible_unit == "binary" else pre
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        x = self._dropout_input(x, train, rng)
+        return self._hid_mean(params, x), state
+
+    def cd_gradients(self, params, v0, *, rng) -> Tuple[Params, Array]:
+        """One CD-k estimate: returns (gradients, reconstruction_error).
+        Hand-coded because contrastive divergence is not an autodiff loss
+        (ref: RBM.computeGradientAndScore)."""
+        h0 = self._hid_mean(params, v0)
+        hk_mean, vk = h0, v0
+        for i in range(self.k):
+            rng, k_h = jax.random.split(rng)
+            h_sample = (jax.random.uniform(k_h, hk_mean.shape) < hk_mean).astype(v0.dtype) \
+                if self.hidden_unit == "binary" else hk_mean
+            vk = self._vis_mean(params, h_sample)
+            hk_mean = self._hid_mean(params, vk)
+        n = v0.shape[0]
+        grads = {
+            "W": -(v0.T @ h0 - vk.T @ hk_mean) / n,
+            "b": -jnp.mean(h0 - hk_mean, axis=0),
+            "vb": -jnp.mean(v0 - vk, axis=0),
+        }
+        err = jnp.mean(jnp.sum((v0 - vk) ** 2, axis=-1))
+        return grads, err
+
+
+@register_layer
+@dataclass
+class CenterLossOutputLayer(OutputLayer):
+    """Output layer with auxiliary center loss
+    (ref: nn/conf/layers/CenterLossOutputLayer.java + CenterLossParamInitializer:
+    extra non-trained `cL` center matrix updated by exponential moving average;
+    lambda weights the center-distance penalty)."""
+    alpha: float = 0.05
+    lambda_: float = 2e-4
+
+    def param_order(self) -> List[str]:
+        return ["W", "b", "cL"]
+
+    def init_params(self, rng, dtype=jnp.float32) -> Params:
+        p = super().init_params(rng, dtype)
+        p["cL"] = jnp.zeros((self.n_out, self.n_in), dtype)
+        return p
+
+    def regularization(self):
+        reg = super().regularization()
+        reg["cL"] = (0.0, 0.0)
+        return reg
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        x = self._dropout_input(x, train, rng)
+        return get_activation(self.activation)(x @ params["W"] + params["b"]), state
+
+    def compute_loss(self, params, x, labels, *, mask=None, average: bool = True):
+        preout = x @ params["W"] + params["b"]
+        per_ex = get_loss(self.loss)(labels, preout, self.activation, mask)
+        # center loss: squared distance of features to their class center
+        centers = labels @ params["cL"]          # [B, n_in]
+        center_per_ex = jnp.sum((x - jax.lax.stop_gradient(centers)) ** 2, axis=-1)
+        per_ex = per_ex + 0.5 * self.lambda_ * center_per_ex
+        return jnp.mean(per_ex) if average else per_ex
+
+    def updated_centers(self, params, x, labels):
+        """EMA center update (applied outside the gradient step, as the
+        reference's CenterLossOutputLayer does with alpha)."""
+        counts = jnp.maximum(labels.sum(axis=0), 1.0)[:, None]
+        sums = labels.T @ x
+        batch_centers = sums / counts
+        has = (labels.sum(axis=0) > 0)[:, None]
+        cL = params["cL"]
+        return jnp.where(has, (1 - self.alpha) * cL + self.alpha * batch_centers, cL)

@@ -1,0 +1,112 @@
+"""Normalization layers: BatchNormalization, LocalResponseNormalization.
+
+References:
+- nn/layers/normalization/BatchNormalization.java (+ conf
+  nn/conf/layers/BatchNormalization.java): train vs inference stats,
+  running mean/var decay, optional lock of gamma/beta.
+  CudnnBatchNormalizationHelper → here XLA fuses the normalization chain.
+- nn/layers/normalization/LocalResponseNormalization.java (AlexNet LRN).
+
+BN running statistics are layer *state*, threaded functionally through the
+container (the reference mutates globalMean/globalVar params in place).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import BaseLayerConf, Params, State, register_layer
+
+
+@register_layer
+@dataclass
+class BatchNormalization(BaseLayerConf):
+    """Batch norm over the channel/feature axis (last axis in NHWC/FF)."""
+    decay: float = 0.9
+    eps: float = 1e-5
+    is_minibatch: bool = True
+    lock_gamma_beta: bool = False
+    gamma: float = 1.0
+    beta: float = 0.0
+    # filled by builder:
+    n_features: int = 0
+
+    def set_n_in(self, in_type: InputType) -> None:
+        self.n_in = in_type.flat_size()
+        self.n_features = (in_type.channels if in_type.kind == "cnn"
+                           else in_type.flat_size())
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        return in_type
+
+    def param_order(self) -> List[str]:
+        return [] if self.lock_gamma_beta else ["gamma", "beta"]
+
+    def init_params(self, rng, dtype=jnp.float32) -> Params:
+        if self.lock_gamma_beta:
+            return {}
+        return {"gamma": jnp.full((self.n_features,), self.gamma, dtype),
+                "beta": jnp.full((self.n_features,), self.beta, dtype)}
+
+    def init_state(self) -> State:
+        return {"mean": jnp.zeros((self.n_features,)),
+                "var": jnp.ones((self.n_features,))}
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        axes = tuple(range(x.ndim - 1))  # all but channel/feature
+        if train and self.is_minibatch:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = jax.lax.rsqrt(var + self.eps)
+        xhat = (x - mean) * inv
+        if self.lock_gamma_beta:
+            out = self.gamma * xhat + self.beta
+        else:
+            out = params["gamma"] * xhat + params["beta"]
+        return out, new_state
+
+
+@register_layer
+@dataclass
+class LocalResponseNormalization(BaseLayerConf):
+    """Cross-channel LRN: x / (k + alpha*sum_{nearby channels} x^2)^beta
+    (ref: nn/layers/normalization/LocalResponseNormalization.java;
+    CudnnLocalResponseNormalizationHelper). Composed from XLA reduce-window
+    over the channel axis."""
+    k: float = 2.0
+    n: float = 5.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def set_n_in(self, in_type: InputType) -> None:
+        self.n_in = in_type.flat_size()
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        return in_type
+
+    def param_order(self) -> List[str]:
+        return []
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        half = int(self.n // 2)
+        sq = x * x
+        # sum over a window of `n` channels centered at each channel (NHWC)
+        summed = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add,
+            window_dimensions=(1, 1, 1, int(self.n)),
+            window_strides=(1, 1, 1, 1),
+            padding=[(0, 0), (0, 0), (0, 0), (half, half)],
+        )
+        return x / jnp.power(self.k + self.alpha * summed, self.beta), state

@@ -1,0 +1,74 @@
+"""Global pooling (ref: nn/layers/pooling/GlobalPoolingLayer.java +
+util/MaskedReductionUtil.java — mask-aware reductions over time or space).
+
+Pools RNN [B,T,F] over T, or CNN [B,H,W,C] over (H,W); supports
+sum/avg/max/pnorm; respects per-timestep masks exactly as the reference's
+MaskedReductionUtil does (masked elements excluded from the reduction)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import BaseLayerConf, register_layer
+
+
+@register_layer
+@dataclass
+class GlobalPoolingLayer(BaseLayerConf):
+    pooling_type: str = "max"  # max | avg | sum | pnorm
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+
+    def set_n_in(self, in_type: InputType) -> None:
+        self.n_in = in_type.flat_size()
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        if in_type.kind == "rnn":
+            return InputType.feed_forward(in_type.size)
+        if in_type.kind == "cnn":
+            return InputType.feed_forward(in_type.channels)
+        raise ValueError(f"GlobalPooling expects RNN or CNN input, got {in_type}")
+
+    def param_order(self) -> List[str]:
+        return []
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        if x.ndim == 3:      # [B, T, F] -> pool over T
+            axes = (1,)
+        elif x.ndim == 4:    # [B, H, W, C] -> pool over H, W
+            axes = (1, 2)
+        else:
+            raise ValueError(f"GlobalPooling: unsupported rank {x.ndim}")
+
+        if mask is not None and x.ndim == 3:
+            m = mask[..., None]  # [B, T, 1]
+            if self.pooling_type == "max":
+                out = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=axes)
+            elif self.pooling_type == "sum":
+                out = jnp.sum(x * m, axis=axes)
+            elif self.pooling_type == "avg":
+                out = jnp.sum(x * m, axis=axes) / jnp.maximum(
+                    jnp.sum(m, axis=axes), 1e-8)
+            elif self.pooling_type == "pnorm":
+                p = float(self.pnorm)
+                out = jnp.sum(jnp.abs(x * m) ** p, axis=axes) ** (1.0 / p)
+            else:
+                raise ValueError(self.pooling_type)
+            return out, state
+
+        if self.pooling_type == "max":
+            out = jnp.max(x, axis=axes)
+        elif self.pooling_type == "sum":
+            out = jnp.sum(x, axis=axes)
+        elif self.pooling_type == "avg":
+            out = jnp.mean(x, axis=axes)
+        elif self.pooling_type == "pnorm":
+            p = float(self.pnorm)
+            out = jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p)
+        else:
+            raise ValueError(self.pooling_type)
+        return out, state

@@ -1,0 +1,288 @@
+"""Recurrent layers: LSTM / GravesLSTM (peepholes) / bidirectional / RNN out.
+
+The reference implements LSTM with a hand-written per-timestep Java loop and
+cached gate activations (ref: nn/layers/recurrent/LSTMHelpers.java:57-420 —
+forward loop at :161, backward loop at :333, FwdPassReturn caching). Here the
+time loop is ``jax.lax.scan`` — XLA compiles it into a single fused while-op,
+and autodiff through scan replaces the hand-written backward loop; the
+activation caching the reference does by hand is what jax does automatically
+(and can be tuned with ``jax.checkpoint``).
+
+Param layout (our ordering contract, cf. nn/params/GravesLSTMParamInitializer
+W/RW/b): W [n_in, 4H], RW [n_out, 4H], b [4H]; Graves peepholes pW [3H]
+(input/forget/output gates see c). **Gate block order is (i, f, g, o)** —
+documented here because checkpoints and Keras import depend on it.
+
+Masking: per-timestep mask [B, T]; masked steps pass previous state through
+unchanged and output zeros (matches the reference's mask-propagation through
+feedForwardMaskArray + zeroed epsilons).
+
+Stateful streaming inference (``rnnTimeStep``,
+ref: MultiLayerNetwork.java:2234) is supported via ``step()`` — the container
+stores the carried (h, c) per layer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import (
+    Array, BaseLayerConf, Params, register_layer,
+)
+from deeplearning4j_tpu.nn.layers.core import OutputLayer
+from deeplearning4j_tpu.ops.activations import get_activation
+from deeplearning4j_tpu.ops.losses import get_loss
+
+
+def _lstm_cell(params: Params, x_t: Array, h: Array, c: Array,
+               gate_act, out_act, forget_bias: float,
+               peephole: bool) -> Tuple[Array, Array]:
+    """One LSTM step. Gate order (i, f, g, o)."""
+    z = x_t @ params["W"] + h @ params["RW"] + params["b"]
+    H = h.shape[-1]
+    zi, zf, zg, zo = jnp.split(z, 4, axis=-1)
+    if peephole:
+        pi, pf, po = jnp.split(params["pW"], 3, axis=-1)
+        zi = zi + c * pi
+        zf = zf + c * pf
+    i = gate_act(zi)
+    f = gate_act(zf + forget_bias)
+    g = out_act(zg)
+    c_new = f * c + i * g
+    if peephole:
+        zo = zo + c_new * po
+    o = gate_act(zo)
+    h_new = o * out_act(c_new)
+    return h_new, c_new
+
+
+@register_layer
+@dataclass
+class LSTM(BaseLayerConf):
+    """Standard LSTM (no peepholes)."""
+    n_out: int = 0
+    forget_gate_bias_init: float = 1.0
+    gate_activation: str = "sigmoid"
+
+    _peephole = False
+    # Containers thread (h, c) carries through layers with this set — the
+    # tBPTT / rnnTimeStep dispatch flag. Bidirectional layers cannot stream
+    # (the backward pass needs the full sequence) so they leave it False.
+    supports_carry = True
+
+    def set_n_in(self, in_type: InputType) -> None:
+        if in_type.kind != "rnn":
+            raise ValueError(f"{type(self).__name__} expects RNN input, got {in_type}")
+        self.n_in = in_type.size
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, in_type.timesteps)
+
+    def param_order(self) -> List[str]:
+        return ["W", "RW", "b"] + (["pW"] if self._peephole else [])
+
+    def init_params(self, rng, dtype=jnp.float32) -> Params:
+        H = self.n_out
+        k1, k2, _ = jax.random.split(rng, 3)
+        fan_in, fan_out = self.n_in + H, 4 * H
+        p = {
+            "W": self._init_w(k1, (self.n_in, 4 * H), fan_in, fan_out, dtype),
+            "RW": self._init_w(k2, (H, 4 * H), fan_in, fan_out, dtype),
+            "b": jnp.zeros((4 * H,), dtype),
+        }
+        if self._peephole:
+            p["pW"] = jnp.zeros((3 * H,), dtype)
+        return p
+
+    def initial_carry(self, batch: int, dtype=jnp.float32):
+        H = self.n_out
+        return (jnp.zeros((batch, H), dtype), jnp.zeros((batch, H), dtype))
+
+    def step(self, params: Params, x_t: Array, carry):
+        """Single timestep for stateful inference (rnnTimeStep)."""
+        h, c = carry
+        gate_act = get_activation(self.gate_activation)
+        out_act = get_activation(self.activation or "tanh")
+        h2, c2 = _lstm_cell(params, x_t, h, c, gate_act, out_act,
+                            self.forget_gate_bias_init, self._peephole)
+        return h2, (h2, c2)
+
+    def scan(self, params: Params, x: Array, carry, mask: Optional[Array],
+             reverse: bool = False):
+        """Run the full sequence [B, T, F] -> ([B, T, H], final_carry)."""
+        gate_act = get_activation(self.gate_activation)
+        out_act = get_activation(self.activation or "tanh")
+
+        def body(carry, inp):
+            h, c = carry
+            if mask is None:
+                x_t = inp
+                h2, c2 = _lstm_cell(params, x_t, h, c, gate_act, out_act,
+                                    self.forget_gate_bias_init, self._peephole)
+                return (h2, c2), h2
+            x_t, m_t = inp
+            h2, c2 = _lstm_cell(params, x_t, h, c, gate_act, out_act,
+                                self.forget_gate_bias_init, self._peephole)
+            m = m_t[:, None]
+            h2 = m * h2 + (1 - m) * h
+            c2 = m * c2 + (1 - m) * c
+            return (h2, c2), m * h2
+
+        xs = jnp.swapaxes(x, 0, 1)  # [T, B, F] time-major for scan
+        inputs = xs if mask is None else (xs, jnp.swapaxes(mask, 0, 1))
+        final, ys = jax.lax.scan(body, carry, inputs, reverse=reverse)
+        return jnp.swapaxes(ys, 0, 1), final
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        x = self._dropout_input(x, train, rng)
+        carry = self.initial_carry(x.shape[0], x.dtype)
+        ys, _ = self.scan(params, x, carry, mask)
+        return ys, state
+
+
+@register_layer
+@dataclass
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections, as in Graves (2013)
+    (ref: nn/layers/recurrent/GravesLSTM.java + LSTMHelpers.java)."""
+    _peephole = True
+
+
+@register_layer
+@dataclass
+class GravesBidirectionalLSTM(LSTM):
+    """Bidirectional Graves LSTM; forward and backward outputs are **added**
+    (ref: nn/layers/recurrent/GravesBidirectionalLSTM.java:206
+    `fwdOutput.addi(backOutput)`)."""
+    _peephole = True
+    supports_carry = False  # backward direction needs the full sequence
+
+    def param_order(self) -> List[str]:
+        return ["W", "RW", "b", "pW", "W_bwd", "RW_bwd", "b_bwd", "pW_bwd"]
+
+    def init_params(self, rng, dtype=jnp.float32) -> Params:
+        k_f, k_b = jax.random.split(rng)
+        fwd = super().init_params(k_f, dtype)
+        bwd = super().init_params(k_b, dtype)
+        fwd.update({f"{k}_bwd": v for k, v in bwd.items()})
+        return fwd
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        x = self._dropout_input(x, train, rng)
+        carry = self.initial_carry(x.shape[0], x.dtype)
+        fwd_p = {k: params[k] for k in ("W", "RW", "b", "pW")}
+        bwd_p = {k: params[f"{k}_bwd"] for k in ("W", "RW", "b", "pW")}
+        ys_f, _ = self.scan(fwd_p, x, carry, mask)
+        ys_b, _ = self.scan(bwd_p, x, carry, mask, reverse=True)
+        return ys_f + ys_b, state
+
+
+@register_layer
+@dataclass
+class SimpleRnn(BaseLayerConf):
+    """Vanilla RNN: h_t = act(x_t W + h_{t-1} RW + b)."""
+    n_out: int = 0
+
+    supports_carry = True
+
+    def set_n_in(self, in_type: InputType) -> None:
+        if in_type.kind != "rnn":
+            raise ValueError(f"SimpleRnn expects RNN input, got {in_type}")
+        self.n_in = in_type.size
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, in_type.timesteps)
+
+    def param_order(self) -> List[str]:
+        return ["W", "RW", "b"]
+
+    def init_params(self, rng, dtype=jnp.float32) -> Params:
+        H = self.n_out
+        k1, k2 = jax.random.split(rng)
+        return {
+            "W": self._init_w(k1, (self.n_in, H), self.n_in, H, dtype),
+            "RW": self._init_w(k2, (H, H), H, H, dtype),
+            "b": self._init_b((H,), dtype),
+        }
+
+    def initial_carry(self, batch: int, dtype=jnp.float32):
+        return jnp.zeros((batch, self.n_out), dtype)
+
+    def step(self, params, x_t, carry):
+        act = get_activation(self.activation or "tanh")
+        h = act(x_t @ params["W"] + carry @ params["RW"] + params["b"])
+        return h, h
+
+    def scan(self, params, x, carry, mask: Optional[Array] = None,
+             reverse: bool = False):
+        act = get_activation(self.activation or "tanh")
+
+        def body(h, inp):
+            if mask is None:
+                x_t = inp
+                h2 = act(x_t @ params["W"] + h @ params["RW"] + params["b"])
+                return h2, h2
+            x_t, m_t = inp
+            h2 = act(x_t @ params["W"] + h @ params["RW"] + params["b"])
+            m = m_t[:, None]
+            h2 = m * h2 + (1 - m) * h
+            return h2, m * h2
+
+        xs = jnp.swapaxes(x, 0, 1)
+        inputs = xs if mask is None else (xs, jnp.swapaxes(mask, 0, 1))
+        final, ys = jax.lax.scan(body, carry, inputs, reverse=reverse)
+        return jnp.swapaxes(ys, 0, 1), final
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        x = self._dropout_input(x, train, rng)
+        ys, _ = self.scan(params, x, self.initial_carry(x.shape[0], x.dtype), mask)
+        return ys, state
+
+
+@register_layer
+@dataclass
+class RnnOutputLayer(BaseLayerConf):
+    """Per-timestep dense + loss over [B, T, F]
+    (ref: nn/layers/recurrent/RnnOutputLayer.java — 2D reshape + OutputLayer;
+    here just a batched matmul over the time axis)."""
+    n_out: int = 0
+    loss: str = "mcxent"
+
+    def set_n_in(self, in_type: InputType) -> None:
+        if in_type.kind != "rnn":
+            raise ValueError(f"RnnOutputLayer expects RNN input, got {in_type}")
+        self.n_in = in_type.size
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, in_type.timesteps)
+
+    def init_params(self, rng, dtype=jnp.float32) -> Params:
+        k_w, _ = jax.random.split(rng)
+        return {
+            "W": self._init_w(k_w, (self.n_in, self.n_out), self.n_in, self.n_out, dtype),
+            "b": self._init_b((self.n_out,), dtype),
+        }
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        out = get_activation(self.activation)(x @ params["W"] + params["b"])
+        if mask is not None:
+            out = out * mask[..., None]
+        return out, state
+
+    def compute_loss(self, params, x, labels, *, mask=None, average: bool = True):
+        """Loss summed over timesteps; score = total / minibatch size, with
+        masked timesteps excluded from the total (matches the reference's
+        score semantics for time series)."""
+        preout = x @ params["W"] + params["b"]
+        B, T, F = preout.shape
+        flat_pre = preout.reshape(B * T, F)
+        flat_lab = labels.reshape(B * T, F)
+        flat_mask = mask.reshape(B * T) if mask is not None else None
+        per = get_loss(self.loss)(flat_lab, flat_pre, self.activation, flat_mask)
+        per_ex = per.reshape(B, T).sum(axis=1)
+        return jnp.mean(per_ex) if average else per.reshape(B, T)

@@ -1,0 +1,473 @@
+"""MultiLayerNetwork: the sequential model container.
+
+Ref: nn/multilayer/MultiLayerNetwork.java:75 — init (:393-477, flattened
+param buffer + per-layer views), fit(DataSetIterator) (:947-1016),
+backprop (:1019-1116), doTruncatedBPTT (:1119), output (:1512),
+computeGradientAndScore (:1805), rnnTimeStep (:2234).
+
+TPU-native redesign:
+- Parameters are a **pytree** (list of per-layer name->array dicts); the
+  reference's single flattened buffer survives only as a serialization
+  view (``params_flat`` / ``set_params_flat``) so checkpoints keep the
+  coefficients.bin contract.
+- The whole of Solver/BaseOptimizer/backprop collapses into ONE jitted
+  train step: value_and_grad of (loss + L1/L2) → gradient normalization →
+  optax update. XLA sees the entire step as a single program and fuses it.
+- BN running stats etc. are a state pytree threaded through the step
+  (the reference mutates layer fields in place).
+- tBPTT slices the time axis outside jit and carries RNN state pytrees
+  across slices; ``rnn_time_step`` keeps carries on the instance exactly
+  like the reference's stateful rnnTimeStep.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import (
+    AsyncDataSetIterator, DataSetIterator, ListDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers.base import BaseLayerConf
+from deeplearning4j_tpu.nn.updater import (
+    build_optimizer, l1_l2_penalty, normalize_gradients, per_layer_lr_scale,
+)
+from deeplearning4j_tpu.optimize.listeners import IterationListener, TrainingListener
+
+Array = jax.Array
+
+
+def _dtype_of(name: str):
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+            "float16": jnp.float16, "float64": jnp.float64}[name]
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers: List[BaseLayerConf] = conf.layers
+        self.params: Optional[List[Dict[str, Array]]] = None
+        self.states: Optional[List[Dict[str, Array]]] = None
+        self.opt_state = None
+        self.iteration_count = 0
+        self.epoch_count = 0
+        self.score_value: float = float("nan")
+        self.listeners: List[IterationListener] = []
+        self.last_batch_size: int = 0
+        self._tx = build_optimizer(conf.training)
+        self._train_step_fn = None
+        self._rnn_carries: Optional[List[Any]] = None  # rnnTimeStep state
+        self._rng = jax.random.PRNGKey(conf.training.seed)
+
+    # ------------------------------------------------------------------ init
+    def init(self, params: Optional[List[Dict[str, Array]]] = None) -> "MultiLayerNetwork":
+        """Materialize parameters (ref: MultiLayerNetwork.init:393-477)."""
+        dtype = _dtype_of(self.conf.training.dtype)
+        if params is not None:
+            self.params = params
+        else:
+            key = jax.random.PRNGKey(self.conf.training.seed)
+            keys = jax.random.split(key, max(len(self.layers), 1))
+            self.params = [l.init_params(k, dtype) if l.has_params() else {}
+                           for l, k in zip(self.layers, keys)]
+        self.states = [l.init_state() for l in self.layers]
+        self.opt_state = self._tx.init(self.params)
+        return self
+
+    def _check_init(self):
+        if self.params is None:
+            raise RuntimeError("Call init() before using the network")
+
+    # ------------------------------------------------------------- listeners
+    def set_listeners(self, *listeners: IterationListener) -> None:
+        self.listeners = list(listeners)
+
+    def add_listener(self, l: IterationListener) -> None:
+        self.listeners.append(l)
+
+    # ---------------------------------------------------------------- forward
+    def _forward(self, params, states, x, *, train: bool, rng, mask=None,
+                 carries: Optional[list] = None, collect: bool = False):
+        """Pure forward through preprocessors + layers.
+
+        ``carries``: optional per-layer RNN carry list (tBPTT / rnnTimeStep).
+        Returns (final_activation_input_to_loss, per_layer_activations,
+        new_states, new_carries, last_mask).
+        """
+        acts = []
+        new_states: List[Dict[str, Array]] = []
+        new_carries: list = [None] * len(self.layers)
+        cur_mask = mask
+        in_types = self.conf.input_types
+        h = x
+        n = len(self.layers)
+        for i, layer in enumerate(self.layers):
+            if i in self.conf.preprocessors:
+                it = in_types[i] if in_types else None
+                h = self.conf.preprocessors[i].transform(h, it)
+                cur_mask = self.conf.preprocessors[i].transform_mask(cur_mask, it)
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            is_last = i == n - 1
+            if is_last and hasattr(layer, "compute_loss"):
+                # loss head consumes the pre-layer activation
+                acts.append(h)
+                new_states.append(states[i])
+                break
+            if carries is not None and getattr(layer, "supports_carry", False):
+                c_in = carries[i]
+                if c_in is None:
+                    c_in = layer.initial_carry(h.shape[0], h.dtype)
+                h, c_out = layer.scan(params[i], h, c_in, cur_mask)
+                new_carries[i] = c_out
+                s = states[i]
+            else:
+                h, s = layer.apply(params[i], h, state=states[i], train=train,
+                                   rng=sub, mask=cur_mask)
+            new_states.append(s)
+            if collect:
+                acts.append(h)
+        return h, acts, new_states, new_carries, cur_mask
+
+    def feed_forward(self, x, train: bool = False) -> List[Array]:
+        """All layer activations (ref: MultiLayerNetwork.feedForward)."""
+        self._check_init()
+        x = jnp.asarray(x)
+        h, acts, _, _, _ = self._forward(self.params, self.states, x,
+                                         train=train, rng=None, collect=True)
+        out_layer = self.layers[-1]
+        if hasattr(out_layer, "compute_loss"):
+            final, _ = out_layer.apply(self.params[-1], h, state=self.states[-1],
+                                       train=train, rng=None)
+            acts.append(final)
+        return acts
+
+    def output(self, x, train: bool = False) -> Array:
+        """Final network output (ref: MultiLayerNetwork.output:1512-1594)."""
+        return self.feed_forward(x, train=train)[-1]
+
+    def predict(self, x) -> np.ndarray:
+        """Argmax class predictions (ref: MultiLayerNetwork.predict)."""
+        return np.asarray(jnp.argmax(self.output(x), axis=-1))
+
+    # ------------------------------------------------------------------- loss
+    def _loss_fn(self, params, states, features, labels, fmask, lmask, rng,
+                 train: bool = True):
+        h, _, new_states, _, cur_mask = self._forward(
+            params, states, features, train=train, rng=rng, mask=fmask)
+        out_layer = self.layers[-1]
+        if not hasattr(out_layer, "compute_loss"):
+            raise ValueError("Last layer must be an output/loss layer for fit()")
+        mask = lmask if lmask is not None else (
+            cur_mask if labels.ndim > 2 else None)
+        data_loss = out_layer.compute_loss(params[-1], h, labels, mask=mask)
+        reg = l1_l2_penalty(params, self.layers)
+        return data_loss + reg, new_states
+
+    def score(self, dataset: Optional[DataSet] = None, train: bool = False) -> float:
+        """Mean per-example loss + regularization
+        (ref: MultiLayerNetwork.score / computeGradientAndScore:1805-1840)."""
+        self._check_init()
+        if dataset is None:
+            return self.score_value
+        loss, _ = self._loss_fn(
+            self.params, self.states,
+            jnp.asarray(dataset.features), jnp.asarray(dataset.labels),
+            None if dataset.features_mask is None else jnp.asarray(dataset.features_mask),
+            None if dataset.labels_mask is None else jnp.asarray(dataset.labels_mask),
+            rng=None, train=train)
+        return float(loss)
+
+    # ------------------------------------------------------------- train step
+    def _build_train_step(self):
+        tx = self._tx
+        training = self.conf.training
+        from deeplearning4j_tpu.nn.layers.core import CenterLossOutputLayer
+        center_loss_head = isinstance(self.layers[-1], CenterLossOutputLayer)
+
+        def train_step(params, opt_state, states, features, labels, fmask,
+                       lmask, rng):
+            def loss_for_grad(p):
+                h, _, new_states, _, cur_mask = self._forward(
+                    p, states, features, train=True, rng=rng, mask=fmask)
+                out_layer = self.layers[-1]
+                mask = lmask if lmask is not None else (
+                    cur_mask if labels.ndim > 2 else None)
+                data_loss = out_layer.compute_loss(p[-1], h, labels, mask=mask)
+                reg = l1_l2_penalty(p, self.layers)
+                return data_loss + reg, (new_states, h)
+
+            (loss, (new_states, h_last)), grads = jax.value_and_grad(
+                loss_for_grad, has_aux=True)(params)
+            grads = normalize_gradients(grads, training)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            updates = per_layer_lr_scale(updates, self.layers,
+                                         training.updater.learning_rate)
+            new_params = jax.tree.map(
+                lambda p, u: p + u, params, updates)
+            if center_loss_head:
+                # EMA center update outside the gradient step
+                # (ref: CenterLossOutputLayer alpha semantics)
+                new_params[-1]["cL"] = self.layers[-1].updated_centers(
+                    {"cL": params[-1]["cL"]}, h_last, labels)
+            return new_params, new_opt, new_states, loss
+
+        return jax.jit(train_step)
+
+    def fit_batch(self, dataset: DataSet) -> float:
+        """One optimization step on one minibatch (ref: fit(DataSet))."""
+        self._check_init()
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        if (self.conf.training.backprop_type == "truncated_bptt"
+                and dataset.features.ndim == 3):
+            return self._fit_tbptt(dataset)
+        self._rng, step_rng = jax.random.split(self._rng)
+        fmask = None if dataset.features_mask is None else jnp.asarray(dataset.features_mask)
+        lmask = None if dataset.labels_mask is None else jnp.asarray(dataset.labels_mask)
+        self.params, self.opt_state, self.states, loss = self._train_step_fn(
+            self.params, self.opt_state, self.states,
+            jnp.asarray(dataset.features), jnp.asarray(dataset.labels),
+            fmask, lmask, step_rng)
+        self.last_batch_size = dataset.num_examples()
+        self.score_value = float(loss)
+        self.iteration_count += 1
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration_count, self.score_value)
+        return self.score_value
+
+    # ------------------------------------------------------------------ tBPTT
+    def _build_tbptt_step(self):
+        tx = self._tx
+        training = self.conf.training
+
+        def step(params, opt_state, states, features, labels, fmask, lmask,
+                 carries, rng):
+            def loss_for_grad(p):
+                h, _, new_states, new_carries, cur_mask = self._forward(
+                    p, states, features, train=True, rng=rng, mask=fmask,
+                    carries=carries)
+                out_layer = self.layers[-1]
+                mask = lmask if lmask is not None else cur_mask
+                data_loss = out_layer.compute_loss(p[-1], h, labels, mask=mask)
+                reg = l1_l2_penalty(p, self.layers)
+                return data_loss + reg, (new_states, new_carries)
+
+            (loss, (new_states, new_carries)), grads = jax.value_and_grad(
+                loss_for_grad, has_aux=True)(params)
+            grads = normalize_gradients(grads, training)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            updates = per_layer_lr_scale(updates, self.layers,
+                                         training.updater.learning_rate)
+            new_params = jax.tree.map(lambda a, u: a + u, params, updates)
+            # stop gradients across tBPTT boundaries
+            new_carries = jax.tree.map(jax.lax.stop_gradient, new_carries)
+            return new_params, new_opt, new_states, new_carries, loss
+
+        return jax.jit(step)
+
+    def _fit_tbptt(self, dataset: DataSet) -> float:
+        """Truncated BPTT over time slices, carrying RNN state
+        (ref: MultiLayerNetwork.doTruncatedBPTT:1119-1183)."""
+        if not hasattr(self, "_tbptt_step_fn") or self._tbptt_step_fn is None:
+            self._tbptt_step_fn = self._build_tbptt_step()
+        fwd = self.conf.training.tbptt_fwd_length
+        T = dataset.features.shape[1]
+        carries: list = [None] * len(self.layers)
+        # materialize initial carries so the jit signature is stable
+        B = dataset.features.shape[0]
+        for i, l in enumerate(self.layers):
+            if getattr(l, "supports_carry", False):
+                carries[i] = l.initial_carry(B)
+        total, slices = 0.0, 0
+        for start in range(0, T, fwd):
+            end = min(start + fwd, T)
+            feats = jnp.asarray(dataset.features[:, start:end])
+            labs = jnp.asarray(dataset.labels[:, start:end])
+            fm = (None if dataset.features_mask is None
+                  else jnp.asarray(dataset.features_mask[:, start:end]))
+            lm = (None if dataset.labels_mask is None
+                  else jnp.asarray(dataset.labels_mask[:, start:end]))
+            self._rng, step_rng = jax.random.split(self._rng)
+            self.params, self.opt_state, self.states, carries, loss = \
+                self._tbptt_step_fn(self.params, self.opt_state, self.states,
+                                    feats, labs, fm, lm, carries, step_rng)
+            total += float(loss)
+            slices += 1
+            self.iteration_count += 1
+            self.score_value = float(loss)
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration_count, self.score_value)
+        self.last_batch_size = dataset.num_examples()
+        return total / max(slices, 1)
+
+    # -------------------------------------------------------------------- fit
+    def fit(self, data, labels=None, epochs: int = 1, use_async: bool = True) -> "MultiLayerNetwork":
+        """Train (ref: MultiLayerNetwork.fit(DataSetIterator):947-1016).
+        Accepts a DataSetIterator, a DataSet, or (features, labels) arrays."""
+        self._check_init()
+        if labels is not None:
+            data = DataSet(np.asarray(data), np.asarray(labels))
+        if isinstance(data, DataSet):
+            data = ListDataSetIterator([data])
+        assert isinstance(data, DataSetIterator)
+        it = (AsyncDataSetIterator(data)
+              if use_async and data.async_supported() else data)
+        for _ in range(epochs):
+            for listener in self.listeners:
+                if isinstance(listener, TrainingListener):
+                    listener.on_epoch_start(self)
+            for batch in it:  # __iter__ resets the (async) iterator
+                self.fit_batch(batch)
+            self.epoch_count += 1
+            for listener in self.listeners:
+                if isinstance(listener, TrainingListener):
+                    listener.on_epoch_end(self)
+        return self
+
+    # --------------------------------------------------------------- pretrain
+    def pretrain(self, iterator: DataSetIterator, epochs: int = 1) -> None:
+        """Greedy layerwise pretraining for AE/RBM/VAE layers
+        (ref: MultiLayerNetwork.pretrain — walks layers, trains each
+        pretrainable layer on the activations of the stack below it)."""
+        self._check_init()
+        from deeplearning4j_tpu.nn.layers.core import RBM, AutoEncoder
+        from deeplearning4j_tpu.nn.layers.variational import VariationalAutoencoder
+
+        for idx, layer in enumerate(self.layers):
+            is_pretrainable = isinstance(layer, (RBM, AutoEncoder, VariationalAutoencoder))
+            if not is_pretrainable:
+                continue
+            tx = build_optimizer(self.conf.training)
+            layer_opt = tx.init(self.params[idx])
+
+            if isinstance(layer, RBM):
+                def step(p, opt, x, rng, _layer=layer, _tx=tx):
+                    grads, err = _layer.cd_gradients(p, x, rng=rng)
+                    updates, opt = _tx.update(grads, opt, p)
+                    return jax.tree.map(lambda a, u: a + u, p, updates), opt, err
+            else:
+                def step(p, opt, x, rng, _layer=layer, _tx=tx):
+                    loss, grads = jax.value_and_grad(
+                        lambda pp: _layer.pretrain_loss(pp, x, rng=rng))(p)
+                    updates, opt = _tx.update(grads, opt, p)
+                    return jax.tree.map(lambda a, u: a + u, p, updates), opt, loss
+            step = jax.jit(step)
+
+            for _ in range(epochs):
+                iterator.reset()
+                for batch in iterator:
+                    x = jnp.asarray(batch.features)
+                    if idx > 0:
+                        x = self._activate_to(idx, x)
+                    p, layer_opt, loss = step(self.params[idx], layer_opt, x,
+                                              self._next_rng())
+                    self.params[idx] = p
+                    self.score_value = float(loss)
+
+    def _next_rng(self):
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    def _activate_to(self, layer_index: int, x: Array) -> Array:
+        """Activations feeding layer ``layer_index`` (inference mode) —
+        used by layerwise pretraining and TransferLearningHelper featurize
+        (ref: MultiLayerNetwork.feedForwardToLayer)."""
+        h = x
+        in_types = self.conf.input_types
+        for i in range(layer_index):
+            if i in self.conf.preprocessors:
+                it = in_types[i] if in_types else None
+                h = self.conf.preprocessors[i].transform(h, it)
+            h, _ = self.layers[i].apply(self.params[i], h, state=self.states[i],
+                                        train=False, rng=None)
+        if layer_index in self.conf.preprocessors:
+            it = in_types[layer_index] if in_types else None
+            h = self.conf.preprocessors[layer_index].transform(h, it)
+        return h
+
+    # ------------------------------------------------------- rnn statefulness
+    def rnn_clear_previous_state(self):
+        self._rnn_carries = None
+
+    def rnn_time_step(self, x) -> Array:
+        """Stateful streaming inference (ref: MultiLayerNetwork.rnnTimeStep:
+        2234 — keeps stateMap between calls). ``x``: [B, T, F] or [B, F]."""
+        self._check_init()
+        x = jnp.asarray(x)
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[:, None, :]
+        if self._rnn_carries is None:
+            self._rnn_carries = [
+                l.initial_carry(x.shape[0])
+                if getattr(l, "supports_carry", False) else None
+                for l in self.layers]
+        h, _, _, new_carries, _ = self._forward(
+            self.params, self.states, x, train=False, rng=None,
+            carries=self._rnn_carries)
+        # keep existing carries for non-RNN layers
+        self._rnn_carries = [
+            nc if nc is not None else oc
+            for nc, oc in zip(new_carries, self._rnn_carries)]
+        out_layer = self.layers[-1]
+        if hasattr(out_layer, "compute_loss"):
+            h, _ = out_layer.apply(self.params[-1], h, state=self.states[-1],
+                                   train=False, rng=None)
+        return h[:, 0] if squeeze else h
+
+    # ----------------------------------------------------------- param access
+    def num_params(self) -> int:
+        self._check_init()
+        return sum(int(np.prod(a.shape))
+                   for p in self.params for a in p.values())
+
+    def params_flat(self) -> np.ndarray:
+        """Single flat parameter vector in the documented layer/param order —
+        the coefficients.bin view (ref: MultiLayerNetwork.params())."""
+        self._check_init()
+        chunks = []
+        for layer, p in zip(self.layers, self.params):
+            for name in layer.param_order():
+                chunks.append(np.asarray(p[name]).ravel())
+        return np.concatenate(chunks) if chunks else np.zeros(0, np.float32)
+
+    def set_params_flat(self, flat: np.ndarray) -> None:
+        self._check_init()
+        pos = 0
+        new_params = []
+        for layer, p in zip(self.layers, self.params):
+            d = {}
+            for name in layer.param_order():
+                n = int(np.prod(p[name].shape))
+                d[name] = jnp.asarray(
+                    flat[pos:pos + n].reshape(p[name].shape), p[name].dtype)
+                pos += n
+            new_params.append(d)
+        if pos != len(flat):
+            raise ValueError(f"Expected {pos} params, got {len(flat)}")
+        self.params = new_params
+
+    def clone(self) -> "MultiLayerNetwork":
+        net = MultiLayerNetwork(self.conf)
+        net.init(params=jax.tree.map(lambda x: x, self.params))
+        net.states = jax.tree.map(lambda x: x, self.states)
+        return net
+
+    # ------------------------------------------------------------- evaluation
+    def evaluate(self, iterator: DataSetIterator):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        e = Evaluation()
+        iterator.reset()
+        for batch in iterator:
+            out = self.output(batch.features)
+            e.eval(batch.labels, np.asarray(out), mask=batch.labels_mask)
+        return e

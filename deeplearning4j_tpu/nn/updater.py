@@ -1,0 +1,173 @@
+"""Updaters: gradient post-processing + update rules, built on optax.
+
+The reference's updater pipeline (ref: nn/updater/LayerUpdater.java):
+``preApply`` (gradient normalization/clipping, :186-220) → per-param
+``GradientUpdater.getGradient`` (Adam/Nesterov/... math in ND4J's
+org.nd4j.linalg.learning) → ``postApply`` (L1/L2 into gradient, ÷ batch,
+:106-116). Here:
+
+- normalization/clipping = :func:`normalize_gradients` applied to the
+  per-layer gradient pytree inside the jitted train step;
+- the update rule = an optax ``GradientTransformation`` built by
+  :func:`build_optimizer` from the conf's :class:`UpdaterConfig`;
+- L1/L2 is added to the loss (so autodiff produces the regularized
+  gradient), and batch division is implicit in the mean-loss convention;
+- learning-rate policies (ref: nn/conf/LearningRatePolicy.java) become an
+  optax schedule from :func:`make_lr_schedule`.
+
+Optimizer state is a pytree mirroring the param pytree — the flattened
+``updaterState.bin`` view the reference checkpoints
+(nn/updater/MultiLayerUpdater.java) is recovered at the serialization
+boundary by util/serializer.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from deeplearning4j_tpu.nn.conf.builder import TrainingConfig, UpdaterConfig
+
+
+def make_lr_schedule(u: UpdaterConfig) -> Callable:
+    """iteration -> learning rate (ref: LearningRatePolicy.java semantics,
+    applied in BaseOptimizer.applyLearningRateDecayPolicy)."""
+    base = u.learning_rate
+    policy = (u.lr_policy or "none").lower()
+    if policy == "none":
+        return lambda step: base
+    if policy == "exponential":
+        return lambda step: base * jnp.power(u.lr_policy_decay_rate, step)
+    if policy == "inverse":
+        return lambda step: base / jnp.power(
+            1.0 + u.lr_policy_decay_rate * step, u.lr_policy_power)
+    if policy == "poly":
+        return lambda step: base * jnp.power(
+            jnp.maximum(1.0 - step / jnp.maximum(u.lr_policy_steps, 1.0), 0.0),
+            u.lr_policy_power)
+    if policy == "sigmoid":
+        return lambda step: base / (
+            1.0 + jnp.exp(-u.lr_policy_decay_rate * (step - u.lr_policy_steps)))
+    if policy == "step":
+        return lambda step: base * jnp.power(
+            u.lr_policy_decay_rate, jnp.floor(step / u.lr_policy_steps))
+    if policy == "schedule":
+        sched = sorted((u.lr_schedule or {}).items())
+        if not sched:
+            return lambda step: base
+        bounds = jnp.array([k for k, _ in sched])
+        values = jnp.array([base] + [v for _, v in sched])
+        return lambda step: values[jnp.searchsorted(bounds, step, side="right")]
+    raise ValueError(f"Unknown lr policy {policy!r}")
+
+
+def build_optimizer(training: TrainingConfig) -> optax.GradientTransformation:
+    """UpdaterConfig -> optax transform (ref: nn/conf/Updater.java enum +
+    UpdaterCreator)."""
+    u = training.updater
+    lr = make_lr_schedule(u)
+    name = u.name.lower()
+    if name == "sgd":
+        tx = optax.sgd(lr)
+    elif name == "nesterovs":
+        tx = optax.sgd(lr, momentum=u.momentum, nesterov=True)
+    elif name == "adam":
+        tx = optax.adam(lr, b1=u.beta1, b2=u.beta2, eps=u.epsilon)
+    elif name == "adamax":
+        tx = optax.adamax(lr, b1=u.beta1, b2=u.beta2, eps=u.epsilon)
+    elif name == "adagrad":
+        tx = optax.adagrad(lr, eps=u.epsilon)
+    elif name == "adadelta":
+        tx = optax.adadelta(learning_rate=1.0, rho=u.rho, eps=u.epsilon)
+    elif name == "rmsprop":
+        tx = optax.rmsprop(lr, decay=u.rho, eps=u.epsilon)
+    elif name == "none":
+        tx = optax.sgd(lr)
+    else:
+        raise ValueError(f"Unknown updater {u.name!r}")
+    if not training.minimize:
+        # maximize: ascend the objective (ref: conf.minimize flag consumed by
+        # the step function, stepfunctions/NegativeGradientStepFunction)
+        tx = optax.chain(optax.scale(-1.0), tx)
+    return tx
+
+
+def _global_norm(tree) -> jax.Array:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(l * l) for l in leaves) + 1e-12)
+
+
+def normalize_gradients(grads, training: TrainingConfig):
+    """Gradient normalization/clipping applied before the update rule
+    (ref: nn/conf/GradientNormalization.java + LayerUpdater.preApply:186-220).
+
+    ``grads`` is the container gradient pytree: list (per layer) of dicts
+    (param name -> array), or any nested pytree where the first level is the
+    per-layer grouping.
+    """
+    kind = (training.gradient_normalization or "none").lower()
+    t = training.gradient_normalization_threshold
+    if kind in ("none", ""):
+        return grads
+
+    def per_layer(fn):
+        if isinstance(grads, list):
+            return [fn(g) for g in grads]
+        return fn(grads)
+
+    if kind == "renormalizel2perlayer":
+        return per_layer(lambda g: jax.tree.map(lambda x: x / _global_norm(g), g))
+    if kind == "renormalizel2perparamtype":
+        return jax.tree.map(
+            lambda x: x / jnp.sqrt(jnp.sum(x * x) + 1e-12), grads)
+    if kind == "clipelementwiseabsolutevalue":
+        return jax.tree.map(lambda x: jnp.clip(x, -t, t), grads)
+    if kind == "clipl2perlayer":
+        def clip_layer(g):
+            n = _global_norm(g)
+            scale = jnp.where(n > t, t / n, 1.0)
+            return jax.tree.map(lambda x: x * scale, g)
+        return per_layer(clip_layer)
+    if kind == "clipl2perparamtype":
+        def clip_param(x):
+            n = jnp.sqrt(jnp.sum(x * x) + 1e-12)
+            return x * jnp.where(n > t, t / n, 1.0)
+        return jax.tree.map(clip_param, grads)
+    raise ValueError(f"Unknown gradient normalization {kind!r}")
+
+
+def l1_l2_penalty(params, layers) -> jax.Array:
+    """Score regularization term: sum over layers of 0.5*l2*||W||^2 + l1*|W|
+    (ref: BaseLayer.calcL2/calcL1; added to score in computeGradientAndScore).
+    ``params``: list of per-layer param dicts aligned with ``layers``."""
+    total = jnp.zeros(())
+    for layer, p in zip(layers, params):
+        if not p:
+            continue
+        reg = layer.regularization()
+        for name, arr in p.items():
+            l1, l2 = reg.get(name, (0.0, 0.0))
+            if l2:
+                total = total + 0.5 * l2 * jnp.sum(arr * arr)
+            if l1:
+                total = total + l1 * jnp.sum(jnp.abs(arr))
+    return total
+
+
+def per_layer_lr_scale(updates, layers, base_lr: float):
+    """Per-layer learning-rate override: scale each layer's update by
+    layer.learning_rate / base_lr (the reference instead builds a separate
+    GradientUpdater per layer with its own lr — equivalent scaling since
+    update magnitude is linear in lr for every supported rule)."""
+    if not any(l.learning_rate is not None for l in layers):
+        return updates
+    out = []
+    for layer, upd in zip(layers, updates):
+        if layer.learning_rate is not None and base_lr > 0:
+            s = layer.learning_rate / base_lr
+            upd = jax.tree.map(lambda x: x * s, upd)
+        out.append(upd)
+    return out

@@ -1,0 +1,116 @@
+"""Weight initialization.
+
+Mirrors the reference's ``WeightInit`` enum + ``WeightInitUtil``
+(ref: nn/weights/WeightInit.java:47-48 — DISTRIBUTION, ZERO, SIGMOID_UNIFORM,
+UNIFORM, XAVIER, XAVIER_UNIFORM, XAVIER_FAN_IN, XAVIER_LEGACY, RELU,
+RELU_UNIFORM) and the distribution confs under nn/conf/distribution/.
+
+``init_weight(rng, shape, fan_in, fan_out, scheme, distribution)`` returns a
+jnp array. Fan-in/fan-out are passed explicitly because conv kernels compute
+them from receptive-field size, as WeightInitUtil does.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class Distribution:
+    """Serializable distribution spec (ref: nn/conf/distribution/*.java)."""
+    kind: str  # "normal" | "uniform" | "binomial" | "gaussian"
+    mean: float = 0.0
+    std: float = 1.0
+    lower: float = -1.0
+    upper: float = 1.0
+    n_trials: int = 1
+    prob: float = 0.5
+
+    @staticmethod
+    def normal(mean: float = 0.0, std: float = 1.0) -> "Distribution":
+        return Distribution(kind="normal", mean=mean, std=std)
+
+    @staticmethod
+    def uniform(lower: float, upper: float) -> "Distribution":
+        return Distribution(kind="uniform", lower=lower, upper=upper)
+
+    def sample(self, rng: jax.Array, shape) -> jax.Array:
+        if self.kind in ("normal", "gaussian"):
+            return self.mean + self.std * jax.random.normal(rng, shape)
+        if self.kind == "uniform":
+            return jax.random.uniform(rng, shape, minval=self.lower, maxval=self.upper)
+        if self.kind == "binomial":
+            return jax.random.binomial(rng, self.n_trials, self.prob, shape).astype(jnp.float32)
+        raise ValueError(self.kind)
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "mean": self.mean, "std": self.std,
+                "lower": self.lower, "upper": self.upper,
+                "n_trials": self.n_trials, "prob": self.prob}
+
+    @staticmethod
+    def from_dict(d: dict) -> "Distribution":
+        return Distribution(**d)
+
+
+WEIGHT_INITS = (
+    "distribution", "zero", "one", "sigmoid_uniform", "uniform",
+    "xavier", "xavier_uniform", "xavier_fan_in", "xavier_legacy",
+    "relu", "relu_uniform", "lecun_normal", "normal",
+)
+
+
+def init_weight(
+    rng: jax.Array,
+    shape,
+    fan_in: float,
+    fan_out: float,
+    scheme: str = "xavier",
+    distribution: Optional[Distribution] = None,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Sample an initial weight tensor (ref: nn/weights/WeightInitUtil.java)."""
+    scheme = scheme.lower()
+    if scheme == "distribution":
+        if distribution is None:
+            raise ValueError("weight_init='distribution' requires a Distribution")
+        return distribution.sample(rng, shape).astype(dtype)
+    if scheme == "zero":
+        return jnp.zeros(shape, dtype)
+    if scheme == "one":
+        return jnp.ones(shape, dtype)
+    if scheme == "uniform":
+        a = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(rng, shape, minval=-a, maxval=a).astype(dtype)
+    if scheme == "sigmoid_uniform":
+        a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, minval=-a, maxval=a).astype(dtype)
+    if scheme == "xavier":
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return (std * jax.random.normal(rng, shape)).astype(dtype)
+    if scheme == "xavier_uniform":
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, minval=-a, maxval=a).astype(dtype)
+    if scheme == "xavier_fan_in":
+        std = math.sqrt(1.0 / fan_in)
+        return (std * jax.random.normal(rng, shape)).astype(dtype)
+    if scheme == "xavier_legacy":
+        std = math.sqrt(1.0 / (fan_in + fan_out))
+        return (std * jax.random.normal(rng, shape)).astype(dtype)
+    if scheme == "relu":
+        std = math.sqrt(2.0 / fan_in)
+        return (std * jax.random.normal(rng, shape)).astype(dtype)
+    if scheme == "relu_uniform":
+        a = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(rng, shape, minval=-a, maxval=a).astype(dtype)
+    if scheme == "lecun_normal":
+        std = math.sqrt(1.0 / fan_in)
+        return (std * jax.random.normal(rng, shape)).astype(dtype)
+    if scheme == "normal":
+        return (jax.random.normal(rng, shape) / math.sqrt(fan_in)).astype(dtype)
+    raise ValueError(f"Unknown weight init {scheme!r}; available: {WEIGHT_INITS}")

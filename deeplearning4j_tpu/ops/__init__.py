@@ -1,0 +1,9 @@
+"""Core tensor ops: activations, losses, initializer math.
+
+The reference delegates these to ND4J (`org.nd4j.linalg.activations.IActivation`,
+`org.nd4j.linalg.lossfunctions.ILossFunction`); here they are plain JAX
+functions fused by XLA into surrounding matmuls.
+"""
+
+from deeplearning4j_tpu.ops.activations import get_activation, ACTIVATIONS  # noqa: F401
+from deeplearning4j_tpu.ops.losses import get_loss, LOSSES  # noqa: F401

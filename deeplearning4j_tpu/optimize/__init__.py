@@ -1,0 +1,15 @@
+"""Training-loop machinery: listeners, solvers.
+
+Ref: deeplearning4j-nn/.../optimize/ — Solver, BaseOptimizer, listeners.
+Under autodiff+optax the Solver/StepFunction tower collapses into the jitted
+train step owned by the containers; what remains user-visible is the
+listener API and the second-order optimizers (optimize/solvers.py).
+"""
+
+from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
+    IterationListener,
+    TrainingListener,
+    ScoreIterationListener,
+    PerformanceListener,
+    CollectScoresIterationListener,
+)

@@ -1,0 +1,88 @@
+"""Iteration/training listeners.
+
+Ref: optimize/api/{IterationListener,TrainingListener}.java (invoked from
+BaseOptimizer.gradientAndScore, ref: optimize/solvers/BaseOptimizer.java:160)
+and the built-ins in optimize/listeners/ — ScoreIterationListener,
+PerformanceListener (samples/sec, batches/sec — the framework's throughput
+metric feeding BASELINE), CollectScoresIterationListener.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class IterationListener:
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        pass
+
+
+class TrainingListener(IterationListener):
+    def on_epoch_start(self, model) -> None:
+        pass
+
+    def on_epoch_end(self, model) -> None:
+        pass
+
+    def on_forward_pass(self, model, activations) -> None:
+        pass
+
+    def on_gradient_calculation(self, model) -> None:
+        pass
+
+    def on_backward_pass(self, model) -> None:
+        pass
+
+
+class ScoreIterationListener(IterationListener):
+    """Log score every N iterations (ref: ScoreIterationListener.java)."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, print_iterations)
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.print_iterations == 0:
+            logger.info("Score at iteration %d is %s", iteration, score)
+
+
+class PerformanceListener(IterationListener):
+    """Throughput reporting: samples/sec, batches/sec, iteration ms
+    (ref: optimize/listeners/PerformanceListener.java:24-97)."""
+
+    def __init__(self, frequency: int = 1, report_score: bool = False):
+        self.frequency = max(1, frequency)
+        self.report_score = report_score
+        self._last_time: Optional[float] = None
+        self.history: List[Tuple[int, float, float]] = []  # (iter, samples/s, batches/s)
+
+    def iteration_done(self, model, iteration, score):
+        now = time.perf_counter()
+        if self._last_time is not None and iteration % self.frequency == 0:
+            dt = now - self._last_time
+            batch = getattr(model, "last_batch_size", None) or 0
+            sps = batch * self.frequency / dt if dt > 0 else float("inf")
+            bps = self.frequency / dt if dt > 0 else float("inf")
+            self.history.append((iteration, sps, bps))
+            msg = (f"iteration {iteration}: {sps:.1f} samples/sec, "
+                   f"{bps:.2f} batches/sec, {1e3 * dt / self.frequency:.1f} ms/iter")
+            if self.report_score:
+                msg += f", score {score}"
+            logger.info(msg)
+        self._last_time = now
+
+
+class CollectScoresIterationListener(IterationListener):
+    """Record (iteration, score) pairs
+    (ref: CollectScoresIterationListener.java)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[Tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(score)))

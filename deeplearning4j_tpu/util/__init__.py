@@ -1,0 +1,3 @@
+"""Utilities: model serialization, gradient checking support."""
+
+from deeplearning4j_tpu.util.serializer import ModelSerializer  # noqa: F401

@@ -1,0 +1,115 @@
+"""Config DSL + JSON round-trip tests.
+
+Models the reference's conf serialization suite
+(deeplearning4j-core/src/test/.../nn/conf/ — every conf class JSON
+round-trips to an equal object)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    DropoutLayer, GlobalPoolingLayer, GravesLSTM, LSTM, OutputLayer,
+    RnnOutputLayer, SubsamplingLayer, ZeroPaddingLayer,
+)
+
+
+def _mlp_conf():
+    return (NeuralNetConfiguration.builder()
+            .seed(42)
+            .updater("adam", learning_rate=1e-3)
+            .weight_init("xavier")
+            .l2(1e-4)
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+
+
+def test_builder_infers_shapes():
+    conf = _mlp_conf()
+    assert conf.layers[0].n_in == 8
+    assert conf.layers[1].n_in == 16
+    assert conf.layers[0].l2 == 1e-4  # inherited global
+    assert conf.layers[0].activation == "relu"  # per-layer override
+
+
+def test_json_round_trip_mlp():
+    conf = _mlp_conf()
+    j = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(j)
+    assert conf2.to_json() == j
+    assert conf2.layers[0].n_out == 16
+    assert conf2.training.updater.name == "adam"
+    assert conf2.training.updater.learning_rate == 1e-3
+
+
+def test_json_round_trip_cnn():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater("nesterovs", learning_rate=0.01, momentum=0.9)
+            .list()
+            .layer(ConvolutionLayer(n_out=6, kernel_size=(5, 5), stride=(1, 1),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(BatchNormalization())
+            .layer(ZeroPaddingLayer(pad=(1, 1, 1, 1)))
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax"))
+            .set_input_type(InputType.convolutional(28, 28, 1))
+            .build())
+    j = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(j)
+    assert conf2.to_json() == j
+    # conv shape inference: 28 -> 24 -> 12(pool) -> BN -> pad 14
+    assert conf.layers[4].n_in == 14 * 14 * 6
+
+
+def test_json_round_trip_rnn():
+    conf = (NeuralNetConfiguration.builder()
+            .list()
+            .layer(GravesLSTM(n_out=12, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=4, activation="softmax"))
+            .set_input_type(InputType.recurrent(6))
+            .build())
+    j = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(j)
+    assert conf2.to_json() == j
+    assert conf2.layers[0].n_in == 6
+    assert conf2.layers[1].n_in == 12
+
+
+def test_preprocessor_auto_insertion():
+    conf = (NeuralNetConfiguration.builder()
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3)))
+            .layer(DenseLayer(n_out=10))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build())
+    # CNN -> FF boundary at layer 1 needs a preprocessor
+    assert 1 in conf.preprocessors
+    assert type(conf.preprocessors[1]).__name__ == "CnnToFeedForwardPreProcessor"
+
+
+def test_strict_convolution_mode_raises():
+    with pytest.raises(ValueError, match="Strict"):
+        (NeuralNetConfiguration.builder()
+         .list()
+         .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3), stride=(2, 2),
+                                 convolution_mode="strict"))
+         .layer(OutputLayer(n_out=2))
+         .set_input_type(InputType.convolutional(10, 10, 1))
+         .build())
+
+
+def test_restored_conf_builds_working_net():
+    conf = _mlp_conf()
+    conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+    net = MultiLayerNetwork(conf2).init()
+    out = net.output(np.random.default_rng(0).normal(size=(5, 8)).astype(np.float32))
+    assert out.shape == (5, 3)
+    assert np.allclose(np.asarray(out).sum(axis=-1), 1.0, atol=1e-5)

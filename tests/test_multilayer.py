@@ -1,0 +1,139 @@
+"""MultiLayerNetwork end-to-end tests (models the reference's
+MultiLayerTest.java smoke tests: fit on small data, score decreases,
+evaluate accuracy, params round-trip)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets import IrisDataSetIterator, ListDataSetIterator
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize import CollectScoresIterationListener
+
+
+def _iris_net(updater="adam", lr=0.05, **kwargs):
+    b = (NeuralNetConfiguration.builder()
+         .seed(12345)
+         .updater(updater, learning_rate=lr, **kwargs)
+         .weight_init("xavier"))
+    conf = (b.list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_init_param_shapes():
+    net = _iris_net()
+    assert net.params[0]["W"].shape == (4, 16)
+    assert net.params[0]["b"].shape == (16,)
+    assert net.params[1]["W"].shape == (16, 3)
+    assert net.num_params() == 4 * 16 + 16 + 16 * 3 + 3
+
+
+def test_fit_reduces_score_iris():
+    net = _iris_net()
+    it = IrisDataSetIterator(batch_size=50)
+    ds = DataSet.merge(list(it))
+    initial = net.score(ds)
+    net.fit(it, epochs=30, use_async=False)
+    final = net.score(ds)
+    assert final < initial * 0.5, (initial, final)
+
+
+def test_evaluate_accuracy_iris():
+    net = _iris_net()
+    it = IrisDataSetIterator(batch_size=50)
+    net.fit(it, epochs=40, use_async=False)
+    e = net.evaluate(it)
+    assert e.accuracy() > 0.85, e.stats()
+
+
+def test_async_iterator_matches_sync():
+    net1 = _iris_net()
+    net2 = _iris_net()
+    it = IrisDataSetIterator(batch_size=50)
+    net1.fit(it, epochs=3, use_async=False)
+    net2.fit(it, epochs=3, use_async=True)
+    np.testing.assert_allclose(net1.params_flat(), net2.params_flat(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_params_flat_round_trip():
+    net = _iris_net()
+    flat = net.params_flat()
+    net2 = _iris_net()
+    net2.set_params_flat(flat)
+    np.testing.assert_array_equal(flat, net2.params_flat())
+    x = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(net2.output(x)), rtol=1e-6)
+
+
+def test_listeners_collect_scores():
+    net = _iris_net()
+    collector = CollectScoresIterationListener()
+    net.set_listeners(collector)
+    net.fit(IrisDataSetIterator(batch_size=50), epochs=2, use_async=False)
+    assert len(collector.scores) == 6  # 3 batches x 2 epochs
+    assert all(np.isfinite(s) for _, s in collector.scores)
+
+
+@pytest.mark.parametrize("updater", ["sgd", "adam", "nesterovs", "rmsprop",
+                                     "adagrad", "adadelta"])
+def test_all_updaters_learn(updater):
+    lr = {"sgd": 0.5, "adam": 0.05, "nesterovs": 0.1, "rmsprop": 0.01,
+          "adagrad": 0.5, "adadelta": 1.0}[updater]
+    net = _iris_net(updater=updater, lr=lr)
+    it = IrisDataSetIterator(batch_size=150)
+    ds = DataSet.merge(list(it))
+    initial = net.score(ds)
+    net.fit(it, epochs=30, use_async=False)
+    assert net.score(ds) < initial, updater
+
+
+def test_l2_regularization_changes_gradient():
+    net_plain = _iris_net()
+    conf_l2 = (NeuralNetConfiguration.builder()
+               .seed(12345).updater("sgd", learning_rate=0.1)
+               .weight_init("xavier").l2(0.5)
+               .list()
+               .layer(DenseLayer(n_out=16, activation="relu"))
+               .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+               .set_input_type(InputType.feed_forward(4))
+               .build())
+    net_l2 = MultiLayerNetwork(conf_l2).init()
+    ds = DataSet.merge(list(IrisDataSetIterator(batch_size=150)))
+    # same init (same seed) => same starting params
+    np.testing.assert_allclose(net_plain.params_flat(), net_l2.params_flat())
+    net_plain.fit(ds)
+    net_l2.fit(ds)
+    assert not np.allclose(net_plain.params_flat(), net_l2.params_flat())
+    # L2 score includes the penalty term
+    assert net_l2.score(ds) > net_plain.score(ds)
+
+
+def test_gradient_clipping_runs():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater("sgd", learning_rate=0.1)
+            .gradient_normalization("clipl2perlayer", threshold=0.5)
+            .list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ds = DataSet.merge(list(IrisDataSetIterator(batch_size=150)))
+    s0 = net.score(ds)
+    net.fit(ds)
+    assert np.isfinite(net.score(ds))
+
+
+def test_predict_shapes():
+    net = _iris_net()
+    x = np.random.default_rng(0).normal(size=(7, 4)).astype(np.float32)
+    preds = net.predict(x)
+    assert preds.shape == (7,)
+    assert preds.dtype in (np.int32, np.int64)

@@ -1,0 +1,89 @@
+"""Regression tests for code-review findings on the initial implementation."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets import AsyncDataSetIterator, ExistingDataSetIterator
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.nn.layers import CenterLossOutputLayer, DenseLayer, OutputLayer
+
+
+def test_updater_chain_order_insensitive():
+    """.learning_rate() before .updater() must not be discarded."""
+    b = (NeuralNetConfiguration.builder()
+         .learning_rate(0.01)
+         .updater("adam"))
+    assert b._training.updater.learning_rate == 0.01
+    assert b._training.updater.name == "adam"
+    b2 = (NeuralNetConfiguration.builder()
+          .lr_policy("step", decay_rate=0.5, steps=10)
+          .updater("nesterovs", momentum=0.8))
+    assert b2._training.updater.lr_policy == "step"
+    assert b2._training.updater.momentum == 0.8
+
+
+def test_unknown_updater_option_raises():
+    with pytest.raises(ValueError, match="Unknown updater option"):
+        NeuralNetConfiguration.builder().updater("adam", bogus_knob=1.0)
+
+
+def test_center_loss_centers_update_during_fit():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater("adam", learning_rate=0.05)
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(CenterLossOutputLayer(n_out=3, activation="softmax",
+                                         alpha=0.2, lambda_=0.01))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert np.allclose(np.asarray(net.params[-1]["cL"]), 0.0)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(30, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 30)]
+    net.fit(DataSet(x, y))
+    # centers must move off zero via the EMA update
+    assert not np.allclose(np.asarray(net.params[-1]["cL"]), 0.0)
+
+
+def test_output_layer_shape_mismatch_raises():
+    conf = (NeuralNetConfiguration.builder()
+            .list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.zeros((5, 4), np.float32)
+    bad_labels = np.zeros((5, 7), np.float32)
+    with pytest.raises(ValueError, match="labels"):
+        net.fit(DataSet(x, bad_labels), use_async=False)
+
+
+def test_async_iterator_propagates_producer_error():
+    def gen():
+        yield DataSet(np.zeros((2, 3), np.float32), np.zeros((2, 2), np.float32))
+        raise RuntimeError("boom in producer")
+
+    it = AsyncDataSetIterator(ExistingDataSetIterator(gen()))
+    first = it.next()
+    assert first.num_examples() == 2
+    with pytest.raises(RuntimeError, match="boom in producer"):
+        while it.has_next():
+            it.next()
+    # exhausted afterwards, never blocks
+    assert not it.has_next()
+
+
+def test_evaluation_2d_mask_respected():
+    e = Evaluation()
+    labels = np.eye(2, dtype=np.float32)[[0, 1, 1, 0]]
+    # predictions wrong on the rows that are masked out
+    preds = np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]
+    mask = np.array([1.0, 1.0, 0.0, 0.0])
+    e.eval(labels, preds, mask=mask)
+    assert e.examples == 2
+    assert e.accuracy() == 1.0
