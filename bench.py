@@ -1,0 +1,87 @@
+"""Benchmark: ResNet-50 ImageNet training throughput, samples/sec/chip.
+
+The BASELINE north-star metric (BASELINE.json: "samples/sec/chip, ResNet-50
+ImageNet, MultiLayerNetwork.fit equivalent"). The reference publishes no
+numbers (BASELINE.md), so ``vs_baseline`` is the ratio against the first
+recorded value of this benchmark (kept in BENCH_HISTORY below; 1.0 on the
+first run).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+
+Runs on whatever device jax selects (TPU under the driver; CPU fallback for
+local smoke with BENCH_SMALL=1).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+# First recorded full-size value (round 1). Update when a round improves it
+# so vs_baseline tracks cumulative speedup over the first measurement.
+BENCH_HISTORY = {
+    "resnet50_b64_bf16_samples_per_sec_per_chip": None,  # round 1 fills this
+}
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    small = os.environ.get("BENCH_SMALL", "0") == "1"
+    platform = jax.devices()[0].platform
+    if small or platform == "cpu":
+        # smoke configuration for hosts without a TPU
+        height = width = 64
+        batch = 8
+        steps = 3
+        warmup = 1
+    else:
+        height = width = 224
+        batch = int(os.environ.get("BENCH_BATCH", "64"))
+        steps = int(os.environ.get("BENCH_STEPS", "20"))
+        warmup = 3
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.resnet import resnet50
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    conf = resnet50(height=height, width=width, dtype="bfloat16",
+                    updater="nesterovs", learning_rate=0.1)
+    net = ComputationGraph(conf).init()
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, height, width, 3)).astype(np.float32)
+    y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]
+    ds = DataSet(x, y)
+
+    # compile + warmup
+    for _ in range(warmup):
+        net.fit_batch(ds)
+    jax.block_until_ready(net.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        net.fit_batch(ds)
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+
+    sps = batch * steps / dt
+    name = "resnet50_b64_bf16_samples_per_sec_per_chip"
+    base = BENCH_HISTORY.get(name)
+    vs = (sps / base) if base else 1.0
+    print(json.dumps({
+        "metric": name if not (small or platform == "cpu")
+        else name + "_SMOKE",
+        "value": round(sps, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""Early stopping (ref: deeplearning4j-nn/.../earlystopping/)."""
+
+from deeplearning4j_tpu.earlystopping.config import (  # noqa: F401
+    EarlyStoppingConfiguration,
+    EarlyStoppingResult,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+    DataSetLossCalculator,
+    InMemoryModelSaver,
+    LocalFileModelSaver,
+)
+from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingTrainer  # noqa: F401

@@ -1,0 +1,175 @@
+"""Early stopping configuration: termination conditions, score calculators,
+model savers.
+
+Ref: earlystopping/EarlyStoppingConfiguration.java + termination/ (epoch &
+iteration conditions), scorecalc/DataSetLossCalculator.java, saver/
+{InMemoryModelSaver, LocalFileModelSaver}.java.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import List, Optional
+
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+
+
+# ----------------------------------------------------------- epoch conditions
+class EpochTerminationCondition:
+    def initialize(self):
+        pass
+
+    def terminate(self, epoch: int, score: float) -> bool:
+        raise NotImplementedError
+
+
+@dataclass
+class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    max_epochs: int = 30
+
+    def terminate(self, epoch, score):
+        return epoch >= self.max_epochs
+
+
+@dataclass
+class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
+    """Stop after N epochs with no score improvement
+    (ref: termination/ScoreImprovementEpochTerminationCondition.java)."""
+    max_epochs_without_improvement: int = 5
+    min_improvement: float = 0.0
+
+    def initialize(self):
+        self._best: Optional[float] = None
+        self._since = 0
+
+    def terminate(self, epoch, score):
+        if self._best is None or self._best - score > self.min_improvement:
+            self._best = score
+            self._since = 0
+            return False
+        self._since += 1
+        return self._since >= self.max_epochs_without_improvement
+
+
+# -------------------------------------------------------- iteration conditions
+class IterationTerminationCondition:
+    def initialize(self):
+        pass
+
+    def terminate(self, score: float) -> bool:
+        raise NotImplementedError
+
+
+@dataclass
+class MaxScoreIterationTerminationCondition(IterationTerminationCondition):
+    """Abort if the score explodes past a bound
+    (ref: termination/MaxScoreIterationTerminationCondition.java)."""
+    max_score: float = 1e9
+
+    def terminate(self, score):
+        return score > self.max_score or score != score  # NaN guard
+
+
+@dataclass
+class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
+    max_seconds: float = 3600.0
+
+    def initialize(self):
+        self._start = time.monotonic()
+
+    def terminate(self, score):
+        return (time.monotonic() - self._start) > self.max_seconds
+
+
+# ------------------------------------------------------------ score calculator
+@dataclass
+class DataSetLossCalculator:
+    """Model score (loss) over a held-out iterator
+    (ref: scorecalc/DataSetLossCalculator.java)."""
+    iterator: DataSetIterator
+    average: bool = True
+
+    def calculate_score(self, net) -> float:
+        total, n = 0.0, 0
+        self.iterator.reset()
+        for batch in self.iterator:
+            s = net.score(batch)
+            b = batch.num_examples()
+            total += s * b
+            n += b
+        return total / max(n, 1) if self.average else total
+
+
+# --------------------------------------------------------------------- savers
+class InMemoryModelSaver:
+    """(ref: saver/InMemoryModelSaver.java)"""
+
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    def save_best_model(self, net, score: float):
+        self._best = (copy.deepcopy(net.params), copy.deepcopy(net.states), score)
+
+    def save_latest_model(self, net, score: float):
+        self._latest = (copy.deepcopy(net.params), copy.deepcopy(net.states), score)
+
+    def get_best_model(self, net):
+        if self._best is None:
+            return net
+        net.params, net.states = (copy.deepcopy(self._best[0]),
+                                  copy.deepcopy(self._best[1]))
+        return net
+
+
+class LocalFileModelSaver:
+    """Write bestModel.zip / latestModel.zip
+    (ref: saver/LocalFileModelSaver.java)."""
+
+    def __init__(self, directory: str):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+
+    def save_best_model(self, net, score: float):
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+        ModelSerializer.write_model(net, self.dir / "bestModel.zip")
+
+    def save_latest_model(self, net, score: float):
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+        ModelSerializer.write_model(net, self.dir / "latestModel.zip")
+
+    def get_best_model(self, net):
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+        path = self.dir / "bestModel.zip"
+        if path.exists():
+            return ModelSerializer.restore_multi_layer_network(path)
+        return net
+
+
+# ---------------------------------------------------------------- config+result
+@dataclass
+class EarlyStoppingConfiguration:
+    """(ref: earlystopping/EarlyStoppingConfiguration.java Builder)"""
+    epoch_termination_conditions: List[EpochTerminationCondition] = field(
+        default_factory=list)
+    iteration_termination_conditions: List[IterationTerminationCondition] = field(
+        default_factory=list)
+    score_calculator: Optional[DataSetLossCalculator] = None
+    model_saver: object = field(default_factory=InMemoryModelSaver)
+    evaluate_every_n_epochs: int = 1
+    save_last_model: bool = False
+
+
+@dataclass
+class EarlyStoppingResult:
+    """(ref: earlystopping/EarlyStoppingResult.java)"""
+    termination_reason: str
+    termination_details: str
+    total_epochs: int
+    best_model_epoch: int
+    best_model_score: float
+    score_vs_epoch: dict
+    best_model: object

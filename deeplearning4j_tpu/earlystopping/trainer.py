@@ -1,0 +1,85 @@
+"""Early stopping trainer.
+
+Ref: earlystopping/trainer/EarlyStoppingTrainer.java:34 — epoch loop with
+per-iteration abort conditions, periodic held-out scoring, best-model
+checkpointing, and a typed result.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+from deeplearning4j_tpu.earlystopping.config import (
+    EarlyStoppingConfiguration, EarlyStoppingResult,
+)
+
+
+class EarlyStoppingTrainer:
+    def __init__(self, config: EarlyStoppingConfiguration, net,
+                 train_data: DataSetIterator):
+        self.config = config
+        self.net = net
+        self.train_data = train_data
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        net = self.net
+        for c in cfg.epoch_termination_conditions:
+            c.initialize()
+        for c in cfg.iteration_termination_conditions:
+            c.initialize()
+        score_vs_epoch = {}
+        best_score: Optional[float] = None
+        best_epoch = -1
+        epoch = 0
+        reason, details = "MaxEpochs", ""
+        while True:
+            self.train_data.reset()
+            aborted = False
+            for batch in self.train_data:
+                net.fit_batch(batch)
+                for c in cfg.iteration_termination_conditions:
+                    if c.terminate(net.score_value):
+                        reason = "IterationTerminationCondition"
+                        details = f"{type(c).__name__} at score {net.score_value}"
+                        aborted = True
+                        break
+                if aborted:
+                    break
+            if aborted:
+                break
+            epoch += 1
+            net.epoch_count += 1
+            if epoch % cfg.evaluate_every_n_epochs == 0:
+                if cfg.score_calculator is not None:
+                    score = cfg.score_calculator.calculate_score(net)
+                else:
+                    score = net.score_value
+                score_vs_epoch[epoch] = score
+                if best_score is None or score < best_score:
+                    best_score = score
+                    best_epoch = epoch
+                    cfg.model_saver.save_best_model(net, score)
+                if cfg.save_last_model:
+                    cfg.model_saver.save_latest_model(net, score)
+            stop = False
+            for c in cfg.epoch_termination_conditions:
+                if c.terminate(epoch, score_vs_epoch.get(epoch, net.score_value)):
+                    reason = "EpochTerminationCondition"
+                    details = f"{type(c).__name__} at epoch {epoch}"
+                    stop = True
+                    break
+            if stop:
+                break
+        best_model = cfg.model_saver.get_best_model(net)
+        return EarlyStoppingResult(
+            termination_reason=reason,
+            termination_details=details,
+            total_epochs=epoch,
+            best_model_epoch=best_epoch,
+            best_model_score=best_score if best_score is not None else float("nan"),
+            score_vs_epoch=score_vs_epoch,
+            best_model=best_model,
+        )

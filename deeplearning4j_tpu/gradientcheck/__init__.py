@@ -1,0 +1,3 @@
+"""Gradient check harness."""
+
+from deeplearning4j_tpu.gradientcheck.check import GradientCheckUtil  # noqa: F401

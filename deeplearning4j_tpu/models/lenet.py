@@ -1,0 +1,39 @@
+"""LeNet-5 for MNIST (BASELINE config #1).
+
+Mirrors the classic DL4J LeNet example topology (conv5x5x20 - pool2 -
+conv5x5x50 - pool2 - dense500 - softmax10) trained via
+MultiLayerNetwork.fit (ref: the reference's examples repo convention; conv
+machinery per nn/layers/convolution/ConvolutionLayer.java)."""
+
+from deeplearning4j_tpu.nn.conf.builder import (
+    MultiLayerConfiguration, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (
+    ConvolutionLayer, DenseLayer, OutputLayer, SubsamplingLayer,
+)
+
+
+def lenet_mnist(seed: int = 12345, learning_rate: float = 1e-3,
+                updater: str = "adam", dtype: str = "float32",
+                channels: int = 1, height: int = 28, width: int = 28,
+                n_classes: int = 10) -> MultiLayerConfiguration:
+    return (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(updater, learning_rate=learning_rate)
+            .weight_init("xavier")
+            .dtype(dtype)
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5), stride=(1, 1),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5), stride=(1, 1),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=n_classes, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.convolutional(height, width, channels))
+            .build())

@@ -1,0 +1,46 @@
+"""Loader for the native C++ components (built from native/*.cc).
+
+The reference loads its native components (libnd4j, cuDNN helpers, libhdf5)
+through JavaCPP JNI bindings discovered at runtime
+(ref: nn/layers/convolution/ConvolutionLayer.java:69-77 Class.forName
+pattern). Same idea here: ctypes dlopen with on-demand compilation — if a
+lib is missing, native/build.sh is invoked once; if the toolchain or a
+system dependency is absent, the caller gets None and uses its documented
+pure-Python fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+_LIB_DIR = Path(__file__).parent / "native_lib"
+_BUILD = Path(__file__).parent.parent / "native" / "build.sh"
+_cache = {}
+_build_attempted = False
+
+
+def load_native(name: str) -> Optional[ctypes.CDLL]:
+    """Load lib<name>.so, building the native tree once if needed."""
+    global _build_attempted
+    if name in _cache:
+        return _cache[name]
+    path = _LIB_DIR / f"lib{name}.so"
+    if not path.exists() and not _build_attempted:
+        _build_attempted = True
+        if _BUILD.exists():
+            try:
+                subprocess.run(["sh", str(_BUILD)], capture_output=True,
+                               timeout=120, check=False)
+            except Exception:
+                pass
+    lib = None
+    if path.exists():
+        try:
+            lib = ctypes.CDLL(str(path))
+        except OSError:
+            lib = None
+    _cache[name] = lib
+    return lib

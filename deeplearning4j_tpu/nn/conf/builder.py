@@ -44,7 +44,8 @@ from deeplearning4j_tpu.nn.weights import Distribution
 _CNN_LAYERS = {"ConvolutionLayer", "SubsamplingLayer", "ZeroPaddingLayer",
                "LocalResponseNormalization"}
 _RNN_LAYERS = {"LSTM", "GravesLSTM", "GravesBidirectionalLSTM", "SimpleRnn",
-               "RnnOutputLayer", "Convolution1DLayer", "Subsampling1DLayer"}
+               "RnnOutputLayer", "Convolution1DLayer", "Subsampling1DLayer",
+               "SelfAttentionLayer"}
 _ANY_LAYERS = {"BatchNormalization", "GlobalPoolingLayer", "ActivationLayer",
                "DropoutLayer", "LossLayer"}
 

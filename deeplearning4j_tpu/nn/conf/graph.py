@@ -1,0 +1,306 @@
+"""Graph vertices: non-layer DAG ops for ComputationGraph.
+
+Ref: nn/graph/vertex/impl/{MergeVertex, ElementWiseVertex, SubsetVertex,
+StackVertex, UnstackVertex, L2Vertex, L2NormalizeVertex, ScaleVertex,
+PreprocessorVertex, LayerVertex}.java and rnn/{LastTimeStepVertex,
+DuplicateToTimeSeriesVertex}.java. Each vertex here is a dataclass with
+``infer_output_type(list[InputType])`` and a pure
+``apply(params, inputs, ...)``; the reference's hand-written doBackward
+methods disappear under autodiff.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+Array = jax.Array
+
+VERTEX_REGISTRY: Dict[str, Type["GraphVertex"]] = {}
+
+
+def register_vertex(cls):
+    VERTEX_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclass
+class GraphVertex:
+    """Parameterless multi-input op in the DAG."""
+
+    def n_inputs(self) -> Optional[int]:
+        return None  # None = any
+
+    def infer_output_type(self, in_types: List[InputType]) -> InputType:
+        raise NotImplementedError
+
+    def apply(self, inputs: List[Array]) -> Array:
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        d = {"@type": type(self).__name__}
+        d.update({k: v for k, v in self.__dict__.items() if v is not None})
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "GraphVertex":
+        d = dict(d)
+        tag = d.pop("@type")
+        cls = VERTEX_REGISTRY[tag]
+        for k, v in list(d.items()):
+            if isinstance(v, list):
+                d[k] = tuple(v)
+        return cls(**d)
+
+
+@register_vertex
+@dataclass
+class MergeVertex(GraphVertex):
+    """Concatenate along the feature (last) axis
+    (ref: MergeVertex.java — concat along dim 1 in NCHW; here last axis in
+    NHWC/FF, which is the same logical channel/feature axis)."""
+
+    def infer_output_type(self, in_types):
+        t0 = in_types[0]
+        if t0.kind == "cnn":
+            return InputType.convolutional(
+                t0.height, t0.width, sum(t.channels for t in in_types))
+        if t0.kind == "rnn":
+            return InputType.recurrent(sum(t.size for t in in_types), t0.timesteps)
+        return InputType.feed_forward(sum(t.flat_size() for t in in_types))
+
+    def apply(self, inputs):
+        return jnp.concatenate(inputs, axis=-1)
+
+
+@register_vertex
+@dataclass
+class ElementWiseVertex(GraphVertex):
+    """Pointwise add/subtract/product/average/max
+    (ref: ElementWiseVertex.java — Op enum Add, Subtract, Product; later
+    versions add Average/Max; subtract requires exactly 2 inputs)."""
+    op: str = "add"
+
+    def infer_output_type(self, in_types):
+        return in_types[0]
+
+    def apply(self, inputs):
+        op = self.op.lower()
+        if op == "add":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out
+        if op == "subtract":
+            if len(inputs) != 2:
+                raise ValueError("subtract needs exactly 2 inputs")
+            return inputs[0] - inputs[1]
+        if op == "product":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out * x
+            return out
+        if op == "average":
+            return sum(inputs) / len(inputs)
+        if op == "max":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        raise ValueError(f"Unknown elementwise op {self.op!r}")
+
+
+@register_vertex
+@dataclass
+class SubsetVertex(GraphVertex):
+    """Feature-axis slice [from, to] inclusive (ref: SubsetVertex.java)."""
+    from_index: int = 0
+    to_index: int = 0
+
+    def n_inputs(self):
+        return 1
+
+    def infer_output_type(self, in_types):
+        n = self.to_index - self.from_index + 1
+        t = in_types[0]
+        if t.kind == "rnn":
+            return InputType.recurrent(n, t.timesteps)
+        return InputType.feed_forward(n)
+
+    def apply(self, inputs):
+        return inputs[0][..., self.from_index:self.to_index + 1]
+
+
+@register_vertex
+@dataclass
+class StackVertex(GraphVertex):
+    """Stack along the batch axis (ref: StackVertex.java — used for shared
+    weights / triplet nets)."""
+
+    def infer_output_type(self, in_types):
+        return in_types[0]
+
+    def apply(self, inputs):
+        return jnp.concatenate(inputs, axis=0)
+
+
+@register_vertex
+@dataclass
+class UnstackVertex(GraphVertex):
+    """Take slice ``index`` of ``num_stacks`` along batch axis
+    (ref: UnstackVertex.java)."""
+    index: int = 0
+    num_stacks: int = 1
+
+    def n_inputs(self):
+        return 1
+
+    def infer_output_type(self, in_types):
+        return in_types[0]
+
+    def apply(self, inputs):
+        x = inputs[0]
+        step = x.shape[0] // self.num_stacks
+        return x[self.index * step:(self.index + 1) * step]
+
+
+@register_vertex
+@dataclass
+class L2NormalizeVertex(GraphVertex):
+    """x / ||x||_2 over feature axes (ref: L2NormalizeVertex.java)."""
+    eps: float = 1e-8
+
+    def n_inputs(self):
+        return 1
+
+    def infer_output_type(self, in_types):
+        return in_types[0]
+
+    def apply(self, inputs):
+        x = inputs[0]
+        axes = tuple(range(1, x.ndim))
+        n = jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=True) + self.eps)
+        return x / n
+
+
+@register_vertex
+@dataclass
+class L2Vertex(GraphVertex):
+    """Pairwise L2 distance between two inputs -> [batch, 1]
+    (ref: L2Vertex.java — used by triplet/siamese losses)."""
+    eps: float = 1e-8
+
+    def n_inputs(self):
+        return 2
+
+    def infer_output_type(self, in_types):
+        return InputType.feed_forward(1)
+
+    def apply(self, inputs):
+        a, b = inputs
+        axes = tuple(range(1, a.ndim))
+        return jnp.sqrt(jnp.sum((a - b) ** 2, axis=axes, keepdims=True) + self.eps)
+
+
+@register_vertex
+@dataclass
+class ScaleVertex(GraphVertex):
+    """Multiply by a fixed scalar (ref: ScaleVertex.java)."""
+    scale_factor: float = 1.0
+
+    def n_inputs(self):
+        return 1
+
+    def infer_output_type(self, in_types):
+        return in_types[0]
+
+    def apply(self, inputs):
+        return inputs[0] * self.scale_factor
+
+
+@register_vertex
+@dataclass
+class ShiftVertex(GraphVertex):
+    """Add a fixed scalar (ref: ShiftVertex.java)."""
+    shift: float = 0.0
+
+    def n_inputs(self):
+        return 1
+
+    def infer_output_type(self, in_types):
+        return in_types[0]
+
+    def apply(self, inputs):
+        return inputs[0] + self.shift
+
+
+@register_vertex
+@dataclass
+class ReshapeVertex(GraphVertex):
+    """Reshape trailing (non-batch) dims (ref: ReshapeVertex.java)."""
+    shape: Tuple[int, ...] = ()
+
+    def n_inputs(self):
+        return 1
+
+    def infer_output_type(self, in_types):
+        if len(self.shape) == 1:
+            return InputType.feed_forward(self.shape[0])
+        if len(self.shape) == 3:
+            return InputType.convolutional(*self.shape)
+        if len(self.shape) == 2:
+            return InputType.recurrent(self.shape[1], self.shape[0])
+        raise ValueError(self.shape)
+
+    def apply(self, inputs):
+        x = inputs[0]
+        return x.reshape((x.shape[0],) + tuple(self.shape))
+
+
+@register_vertex
+@dataclass
+class LastTimeStepVertex(GraphVertex):
+    """[B, T, F] -> [B, F] at the last unmasked step
+    (ref: rnn/LastTimeStepVertex.java). Mask-aware variant is applied by the
+    container, which passes the current mask via ``apply_masked``."""
+
+    def n_inputs(self):
+        return 1
+
+    def infer_output_type(self, in_types):
+        return InputType.feed_forward(in_types[0].size)
+
+    def apply(self, inputs):
+        return inputs[0][:, -1, :]
+
+    def apply_masked(self, inputs, mask):
+        if mask is None:
+            return self.apply(inputs)
+        x = inputs[0]
+        # index of last step where mask==1, per example
+        idx = jnp.sum(mask > 0, axis=1).astype(jnp.int32) - 1
+        idx = jnp.maximum(idx, 0)
+        return x[jnp.arange(x.shape[0]), idx]
+
+
+@register_vertex
+@dataclass
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """[B, F] -> [B, T, F] by duplication; T taken from a reference input
+    (ref: rnn/DuplicateToTimeSeriesVertex.java). The container substitutes
+    ``timesteps`` at build time from the named reference input."""
+    timesteps: int = 1
+
+    def n_inputs(self):
+        return 1
+
+    def infer_output_type(self, in_types):
+        return InputType.recurrent(in_types[0].flat_size(), self.timesteps)
+
+    def apply(self, inputs):
+        return jnp.repeat(inputs[0][:, None, :], self.timesteps, axis=1)

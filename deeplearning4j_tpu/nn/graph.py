@@ -1,0 +1,321 @@
+"""ComputationGraph: the DAG model container.
+
+Ref: nn/graph/ComputationGraph.java:79 — init (:273-483), fit (:701-771),
+topologicalSortOrder (:888), computeGradientAndScore (:995-1036),
+calcBackpropGradients (:1224). As with MultiLayerNetwork, the reference's
+hand-written reverse-topological epsilon propagation collapses into
+``jax.grad`` over one pure forward walk; the whole train step is a single
+jitted XLA program.
+
+Params are a dict keyed by node name -> {param name -> array}. Multi-input /
+multi-output training uses MultiDataSet; plain DataSet maps to the first
+input/output (ref: ComputationGraph.fit(DataSet) does the same).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.iterator import (
+    AsyncDataSetIterator, DataSetIterator, ListDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf.graph import LastTimeStepVertex
+from deeplearning4j_tpu.nn.conf.graph_builder import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.updater import build_optimizer, compute_updates
+from deeplearning4j_tpu.optimize.listeners import IterationListener, TrainingListener
+
+Array = jax.Array
+
+
+def _dtype_of(name: str):
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+            "float16": jnp.float16, "float64": jnp.float64}[name]
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.params: Optional[Dict[str, Dict[str, Array]]] = None
+        self.states: Optional[Dict[str, Dict[str, Array]]] = None
+        self.opt_state = None
+        self.iteration_count = 0
+        self.epoch_count = 0
+        self.score_value = float("nan")
+        self.listeners: List[IterationListener] = []
+        self.last_batch_size = 0
+        self._tx = build_optimizer(conf.training)
+        self._train_step_fn = None
+        self._rng = jax.random.PRNGKey(conf.training.seed)
+        # layer nodes in topological order (the trainable walk)
+        self._layer_nodes = [n for n in conf.topological_order
+                             if conf.nodes[n].kind == "layer"]
+        self._output_layers = [conf.nodes[o] for o in conf.network_outputs]
+
+    # ------------------------------------------------------------------ init
+    def init(self, params=None) -> "ComputationGraph":
+        dtype = _dtype_of(self.conf.training.dtype)
+        if params is not None:
+            self.params = params
+        else:
+            key = jax.random.PRNGKey(self.conf.training.seed)
+            keys = jax.random.split(key, max(len(self._layer_nodes), 1))
+            self.params = {}
+            for name, k in zip(self._layer_nodes, keys):
+                layer = self.conf.nodes[name].layer
+                self.params[name] = (layer.init_params(k, dtype)
+                                     if layer.has_params() else {})
+        self.states = {name: self.conf.nodes[name].layer.init_state()
+                       for name in self._layer_nodes}
+        self.opt_state = self._tx.init(self.params)
+        return self
+
+    def _check_init(self):
+        if self.params is None:
+            raise RuntimeError("Call init() before using the network")
+
+    def set_listeners(self, *listeners: IterationListener):
+        self.listeners = list(listeners)
+
+    # ---------------------------------------------------------------- forward
+    def _forward(self, params, states, inputs: Dict[str, Array], *,
+                 train: bool, rng, masks: Optional[Dict[str, Array]] = None,
+                 stop_before_loss: bool = True):
+        """Walk the DAG in topological order.
+
+        Returns (activations dict, masks dict, new_states). For output-layer
+        nodes with a loss head, the stored activation is the node's INPUT
+        (pre-head) when stop_before_loss — compute_loss consumes it —
+        mirroring feedForward(excludeOutput=true) (ref: CG.java:1006).
+        """
+        acts: Dict[str, Array] = {}
+        out_masks: Dict[str, Optional[Array]] = {}
+        new_states: Dict[str, Dict[str, Array]] = {}
+        output_set = set(self.conf.network_outputs)
+        for name in self.conf.topological_order:
+            node = self.conf.nodes[name]
+            if node.kind == "input":
+                acts[name] = inputs[name]
+                out_masks[name] = (masks or {}).get(name)
+                continue
+            in_acts = [acts[i] for i in node.inputs]
+            in_mask = out_masks.get(node.inputs[0]) if node.inputs else None
+            if node.kind == "vertex":
+                if isinstance(node.vertex, LastTimeStepVertex):
+                    acts[name] = node.vertex.apply_masked(in_acts, in_mask)
+                    out_masks[name] = None
+                else:
+                    acts[name] = node.vertex.apply(in_acts)
+                    out_masks[name] = in_mask
+                continue
+            # layer node
+            h = in_acts[0]
+            cur_mask = in_mask
+            if node.preprocessor is not None:
+                h = node.preprocessor.transform(h, None)
+                cur_mask = node.preprocessor.transform_mask(cur_mask, None)
+            layer = node.layer
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            if (stop_before_loss and name in output_set
+                    and hasattr(layer, "compute_loss")):
+                acts[name] = h          # input to the loss head
+                out_masks[name] = cur_mask
+                new_states[name] = states[name]
+                continue
+            layer_train = train and not layer.frozen
+            h, s = layer.apply(params[name], h, state=states[name],
+                               train=layer_train, rng=sub, mask=cur_mask)
+            if layer.frozen:
+                s = states[name]
+            acts[name] = h
+            # layers that reduce away the time axis consume the mask
+            from deeplearning4j_tpu.nn.layers.pooling import GlobalPoolingLayer
+            out_masks[name] = None if isinstance(layer, GlobalPoolingLayer) else cur_mask
+            new_states[name] = s
+        return acts, out_masks, new_states
+
+    def outputs(self, inputs: Union[Array, Sequence[Array], Dict[str, Array]],
+                train: bool = False) -> List[Array]:
+        """Final activations of all output nodes
+        (ref: ComputationGraph.output(...))."""
+        self._check_init()
+        in_map = self._to_input_map(inputs)
+        acts, _, _ = self._forward(self.params, self.states, in_map,
+                                   train=train, rng=None, stop_before_loss=False)
+        return [acts[o] for o in self.conf.network_outputs]
+
+    def output(self, inputs, train: bool = False) -> Array:
+        return self.outputs(inputs, train=train)[0]
+
+    def _to_input_map(self, inputs) -> Dict[str, Array]:
+        names = self.conf.network_inputs
+        if isinstance(inputs, dict):
+            return {k: jnp.asarray(v) for k, v in inputs.items()}
+        if isinstance(inputs, (list, tuple)):
+            return {n: jnp.asarray(x) for n, x in zip(names, inputs)}
+        return {names[0]: jnp.asarray(inputs)}
+
+    # ------------------------------------------------------------------- loss
+    def _loss_fn(self, params, states, inputs, labels: Dict[str, Array],
+                 masks, label_masks, rng, train=True):
+        acts, out_masks, new_states = self._forward(
+            params, states, inputs, train=train, rng=rng, masks=masks)
+        total = jnp.zeros(())
+        for out_name in self.conf.network_outputs:
+            layer = self.conf.nodes[out_name].layer
+            if not hasattr(layer, "compute_loss"):
+                raise ValueError(f"Output node {out_name!r} has no loss head")
+            lm = (label_masks or {}).get(out_name)
+            if lm is None:
+                lbl = labels[out_name]
+                lm = out_masks.get(out_name) if lbl.ndim > 2 else None
+            total = total + layer.compute_loss(params[out_name], acts[out_name],
+                                               labels[out_name], mask=lm)
+        # L1/L2 over all layer params (score = Σ output losses + reg;
+        # ref: CG.computeGradientAndScore:1016-1028)
+        from deeplearning4j_tpu.nn.updater import l1_l2_penalty
+        layer_list = [self.conf.nodes[n].layer for n in self._layer_nodes]
+        param_list = [params[n] for n in self._layer_nodes]
+        total = total + l1_l2_penalty(param_list, layer_list)
+        return total, new_states
+
+    def score(self, data: Union[DataSet, MultiDataSet], train: bool = False) -> float:
+        self._check_init()
+        inputs, labels, masks, lmasks = self._split(data)
+        loss, _ = self._loss_fn(self.params, self.states, inputs, labels,
+                                masks, lmasks, rng=None, train=train)
+        return float(loss)
+
+    def _split(self, data: Union[DataSet, MultiDataSet]):
+        names_in = self.conf.network_inputs
+        names_out = self.conf.network_outputs
+        if isinstance(data, DataSet):
+            inputs = {names_in[0]: jnp.asarray(data.features)}
+            labels = {names_out[0]: jnp.asarray(data.labels)}
+            masks = ({names_in[0]: jnp.asarray(data.features_mask)}
+                     if data.features_mask is not None else None)
+            lmasks = ({names_out[0]: jnp.asarray(data.labels_mask)}
+                      if data.labels_mask is not None else None)
+            return inputs, labels, masks, lmasks
+        inputs = {n: jnp.asarray(x) for n, x in zip(names_in, data.features)}
+        labels = {n: jnp.asarray(x) for n, x in zip(names_out, data.labels)}
+        masks = None
+        if data.features_masks is not None:
+            masks = {n: (None if m is None else jnp.asarray(m))
+                     for n, m in zip(names_in, data.features_masks)}
+        lmasks = None
+        if data.labels_masks is not None:
+            lmasks = {n: (None if m is None else jnp.asarray(m))
+                      for n, m in zip(names_out, data.labels_masks)}
+        return inputs, labels, masks, lmasks
+
+    # ------------------------------------------------------------- train step
+    def _build_train_step(self):
+        tx = self._tx
+        training = self.conf.training
+
+        def train_step(params, opt_state, states, inputs, labels, masks,
+                       lmasks, rng):
+            def loss_for_grad(p):
+                return self._loss_fn(p, states, inputs, labels, masks,
+                                     lmasks, rng)
+
+            (loss, new_states), grads = jax.value_and_grad(
+                loss_for_grad, has_aux=True)(params)
+            layer_list = [self.conf.nodes[n].layer for n in self._layer_nodes]
+            new_params, new_opt = compute_updates(
+                tx, grads, opt_state, params, layer_list, training)
+            return new_params, new_opt, new_states, loss
+
+        return jax.jit(train_step)
+
+    def fit_batch(self, data: Union[DataSet, MultiDataSet]) -> float:
+        self._check_init()
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        inputs, labels, masks, lmasks = self._split(data)
+        self._rng, step_rng = jax.random.split(self._rng)
+        self.params, self.opt_state, self.states, loss = self._train_step_fn(
+            self.params, self.opt_state, self.states, inputs, labels, masks,
+            lmasks, step_rng)
+        self.last_batch_size = data.num_examples()
+        self.score_value = float(loss)
+        self.iteration_count += 1
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration_count, self.score_value)
+        return self.score_value
+
+    def fit(self, data, epochs: int = 1, use_async: bool = True) -> "ComputationGraph":
+        """(ref: ComputationGraph.fit(DataSetIterator):701-771)"""
+        self._check_init()
+        if isinstance(data, (DataSet, MultiDataSet)):
+            batches = [data]
+            data = ListDataSetIterator(batches) if isinstance(data, DataSet) else None
+            if data is None:
+                for _ in range(epochs):
+                    self.fit_batch(batches[0])
+                return self
+        assert isinstance(data, DataSetIterator)
+        it = (AsyncDataSetIterator(data)
+              if use_async and data.async_supported() else data)
+        for _ in range(epochs):
+            for listener in self.listeners:
+                if isinstance(listener, TrainingListener):
+                    listener.on_epoch_start(self)
+            for batch in it:
+                self.fit_batch(batch)
+            self.epoch_count += 1
+            for listener in self.listeners:
+                if isinstance(listener, TrainingListener):
+                    listener.on_epoch_end(self)
+        return self
+
+    # ----------------------------------------------------------- param access
+    def num_params(self) -> int:
+        self._check_init()
+        return sum(int(np.prod(a.shape))
+                   for p in self.params.values() for a in p.values())
+
+    def params_flat(self) -> np.ndarray:
+        """Flat param vector in topological-order/param-order
+        (coefficients.bin contract for graphs)."""
+        self._check_init()
+        chunks = []
+        for name in self._layer_nodes:
+            layer = self.conf.nodes[name].layer
+            for pname in layer.param_order():
+                chunks.append(np.asarray(self.params[name][pname]).ravel())
+        return np.concatenate(chunks) if chunks else np.zeros(0, np.float32)
+
+    def set_params_flat(self, flat: np.ndarray) -> None:
+        self._check_init()
+        pos = 0
+        for name in self._layer_nodes:
+            layer = self.conf.nodes[name].layer
+            for pname in layer.param_order():
+                ref = self.params[name][pname]
+                n = int(np.prod(ref.shape))
+                self.params[name][pname] = jnp.asarray(
+                    flat[pos:pos + n].reshape(ref.shape), ref.dtype)
+                pos += n
+        if pos != len(flat):
+            raise ValueError(f"Expected {pos} params, got {len(flat)}")
+
+    def predict(self, inputs) -> np.ndarray:
+        return np.asarray(jnp.argmax(self.output(inputs), axis=-1))
+
+    def evaluate(self, iterator: DataSetIterator):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        e = Evaluation()
+        iterator.reset()
+        for batch in iterator:
+            out = self.output(batch.features)
+            e.eval(batch.labels, np.asarray(out), mask=batch.labels_mask)
+        return e

@@ -46,3 +46,4 @@ from deeplearning4j_tpu.nn.layers.recurrent import (  # noqa: F401
     SimpleRnn,
 )
 from deeplearning4j_tpu.nn.layers.variational import VariationalAutoencoder  # noqa: F401
+from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer  # noqa: F401

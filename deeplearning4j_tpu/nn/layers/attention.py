@@ -1,0 +1,159 @@
+"""Attention layers.
+
+The reference snapshot predates attention entirely (SURVEY §5.7: "there is
+no attention at all in this snapshot; the RNN era") — long sequences are
+handled by truncated BPTT. This module is the modern long-context path the
+TPU build treats as first-class: standard multi-head attention for
+single-device use, and a blockwise (flash-style) kernel that
+parallel/sequence.py distributes as ring attention over a mesh axis.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import (
+    Array, BaseLayerConf, Params, register_layer,
+)
+
+NEG_INF = -1e30
+
+
+def attention_reference(q: Array, k: Array, v: Array,
+                        causal: bool = False,
+                        mask: Optional[Array] = None) -> Array:
+    """Plain softmax(QK^T/sqrt(d))V. q,k,v: [B, H, T, D]."""
+    d = q.shape[-1]
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        logits = jnp.where(cm, logits, NEG_INF)
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :] > 0, logits, NEG_INF)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(logits, axis=-1), v)
+
+
+def blockwise_attention(q: Array, k: Array, v: Array, *,
+                        block_size: int = 512, causal: bool = False,
+                        q_offset: int = 0) -> Tuple[Array, Array, Array]:
+    """Flash-style blockwise attention over the KV axis with running
+    log-sum-exp, returning (unnormalized_out, running_max, running_lse) so
+    partial results compose across ring steps.
+
+    q,k,v: [B, H, T, D]. ``q_offset``: global position of q block 0 —
+    needed for causal masking when q is a sequence shard (ring attention).
+    Scanning KV blocks keeps the T x T score matrix out of HBM, which is
+    what lets sequence length scale past VMEM on TPU.
+    """
+    B, H, TQ, D = q.shape
+    TK = k.shape[2]
+    bs = min(block_size, TK)
+    n_blocks = (TK + bs - 1) // bs
+    pad = n_blocks * bs - TK
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = k.reshape(B, H, n_blocks, bs, D).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(B, H, n_blocks, bs, D).transpose(2, 0, 1, 3, 4)
+    scale = 1.0 / math.sqrt(D)
+    q_pos = q_offset + jnp.arange(TQ)
+
+    def body(carry, blk):
+        out, m, lse = carry
+        kblk, vblk, bidx = blk
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, kblk) * scale
+        k_pos = bidx * bs + jnp.arange(bs)
+        valid = k_pos < TK
+        logits = jnp.where(valid[None, None, None, :], logits, NEG_INF)
+        if causal:
+            cm = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(cm[None, None], logits, NEG_INF)
+        m_blk = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        # rescale previous accumulators
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new[..., None])
+        out = out * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vblk)
+        lse = lse * corr + jnp.sum(p, axis=-1)
+        return (out, m_new, lse), None
+
+    # derive initial carries from q so their varying-manual-axes match the
+    # body outputs under shard_map (constants are unvarying; q is varying)
+    out0 = q * 0.0
+    m0 = q[..., 0] * 0.0 + NEG_INF
+    lse0 = q[..., 0] * 0.0
+    (out, m, lse), _ = jax.lax.scan(
+        body, (out0, m0, lse0),
+        (kb, vb, jnp.arange(n_blocks)))
+    return out, m, lse
+
+
+def finalize_attention(out: Array, lse: Array) -> Array:
+    return out / jnp.maximum(lse[..., None], 1e-30)
+
+
+@register_layer
+@dataclass
+class SelfAttentionLayer(BaseLayerConf):
+    """Multi-head self attention over [B, T, F] with optional causal mask
+    and the blockwise kernel. Params: Wq/Wk/Wv [F, H*D], Wo [H*D, F]."""
+    n_heads: int = 8
+    head_dim: int = 0          # default F // n_heads
+    causal: bool = False
+    block_size: int = 512
+    use_blockwise: bool = True
+
+    supports_carry = False
+
+    def set_n_in(self, in_type: InputType) -> None:
+        if in_type.kind != "rnn":
+            raise ValueError(f"SelfAttentionLayer expects RNN input, got {in_type}")
+        self.n_in = in_type.size
+        if not self.head_dim:
+            self.head_dim = max(1, self.n_in // self.n_heads)
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_in, in_type.timesteps)
+
+    def param_order(self) -> List[str]:
+        return ["Wq", "Wk", "Wv", "Wo"]
+
+    def init_params(self, rng, dtype=jnp.float32) -> Params:
+        F = self.n_in
+        HD = self.n_heads * self.head_dim
+        ks = jax.random.split(rng, 4)
+        return {
+            "Wq": self._init_w(ks[0], (F, HD), F, HD, dtype),
+            "Wk": self._init_w(ks[1], (F, HD), F, HD, dtype),
+            "Wv": self._init_w(ks[2], (F, HD), F, HD, dtype),
+            "Wo": self._init_w(ks[3], (HD, F), HD, F, dtype),
+        }
+
+    def _split_heads(self, x):
+        B, T, _ = x.shape
+        return x.reshape(B, T, self.n_heads, self.head_dim).transpose(0, 2, 1, 3)
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        x = self._dropout_input(x, train, rng)
+        q = self._split_heads(x @ params["Wq"])
+        k = self._split_heads(x @ params["Wk"])
+        v = self._split_heads(x @ params["Wv"])
+        if self.use_blockwise:
+            out, _, lse = blockwise_attention(q, k, v, block_size=self.block_size,
+                                              causal=self.causal)
+            out = finalize_attention(out, lse)
+        else:
+            out = attention_reference(q, k, v, causal=self.causal, mask=mask)
+        B, H, T, D = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(B, T, H * D)
+        out = out @ params["Wo"]
+        if mask is not None:
+            out = out * mask[..., None]
+        return out, state

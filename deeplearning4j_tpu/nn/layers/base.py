@@ -66,6 +66,9 @@ class BaseLayerConf:
     dropout: Optional[float] = None           # DL4J semantics: *retain* prob
     learning_rate: Optional[float] = None     # per-layer LR multiplier source
     updater: Optional[str] = None             # per-layer updater override
+    # frozen layers take no updates (ref: nn/layers/FrozenLayer.java wrapper;
+    # here a flag consumed by the train step's update mask)
+    frozen: bool = False
     # filled by the builder:
     n_in: Optional[int] = None
 

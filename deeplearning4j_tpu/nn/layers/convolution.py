@@ -92,6 +92,8 @@ class ConvolutionLayer(BaseLayerConf):
 
     def apply(self, params, x, *, state, train, rng, mask=None):
         x = self._dropout_input(x, train, rng)
+        # mixed precision: compute in the kernel's dtype (bf16 on the MXU)
+        x = x.astype(params["W"].dtype)
         out = lax.conv_general_dilated(
             x, params["W"],
             window_strides=self.stride,

@@ -27,7 +27,7 @@ from deeplearning4j_tpu.nn.layers.base import (
     Array, BaseLayerConf, Params, State, register_layer,
 )
 from deeplearning4j_tpu.ops.activations import get_activation
-from deeplearning4j_tpu.ops.losses import get_loss
+from deeplearning4j_tpu.ops.losses import get_loss, promote_loss_dtype
 
 
 @register_layer
@@ -61,6 +61,7 @@ class OutputLayer(DenseLayer):
     def compute_loss(self, params, x, labels, *, mask=None, average: bool = True):
         """Per-example loss from this layer's *input* activations."""
         preout = x @ params["W"] + params["b"]
+        preout, labels = promote_loss_dtype(preout, labels)
         if preout.shape != labels.shape:
             raise ValueError(
                 f"OutputLayer: network output shape {preout.shape} != labels "
@@ -87,6 +88,7 @@ class LossLayer(BaseLayerConf):
         return get_activation(self.activation)(x), state
 
     def compute_loss(self, params, x, labels, *, mask=None, average: bool = True):
+        x, labels = promote_loss_dtype(x, labels)
         per_ex = get_loss(self.loss)(labels, x, self.activation, mask)
         return jnp.mean(per_ex) if average else per_ex
 

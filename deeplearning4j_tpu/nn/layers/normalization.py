@@ -59,9 +59,13 @@ class BatchNormalization(BaseLayerConf):
 
     def apply(self, params, x, *, state, train, rng, mask=None):
         axes = tuple(range(x.ndim - 1))  # all but channel/feature
+        in_dtype = x.dtype
+        # statistics in >= f32 for stability (standard mixed-precision BN);
+        # promote (not hard-cast) so f64 gradient checks stay f64
+        xs = x.astype(jnp.promote_types(in_dtype, jnp.float32))
         if train and self.is_minibatch:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            mean = jnp.mean(xs, axis=axes)
+            var = jnp.var(xs, axis=axes)
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
                 "var": self.decay * state["var"] + (1 - self.decay) * var,
@@ -70,12 +74,12 @@ class BatchNormalization(BaseLayerConf):
             mean, var = state["mean"], state["var"]
             new_state = state
         inv = jax.lax.rsqrt(var + self.eps)
-        xhat = (x - mean) * inv
+        xhat = (xs - mean) * inv
         if self.lock_gamma_beta:
             out = self.gamma * xhat + self.beta
         else:
             out = params["gamma"] * xhat + params["beta"]
-        return out, new_state
+        return out.astype(in_dtype), new_state
 
 
 @register_layer

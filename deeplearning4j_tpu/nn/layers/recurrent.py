@@ -36,7 +36,7 @@ from deeplearning4j_tpu.nn.layers.base import (
 )
 from deeplearning4j_tpu.nn.layers.core import OutputLayer
 from deeplearning4j_tpu.ops.activations import get_activation
-from deeplearning4j_tpu.ops.losses import get_loss
+from deeplearning4j_tpu.ops.losses import get_loss, promote_loss_dtype
 
 
 def _lstm_cell(params: Params, x_t: Array, h: Array, c: Array,
@@ -279,6 +279,7 @@ class RnnOutputLayer(BaseLayerConf):
         masked timesteps excluded from the total (matches the reference's
         score semantics for time series)."""
         preout = x @ params["W"] + params["b"]
+        preout, labels = promote_loss_dtype(preout, labels)
         B, T, F = preout.shape
         flat_pre = preout.reshape(B * T, F)
         flat_lab = labels.reshape(B * T, F)

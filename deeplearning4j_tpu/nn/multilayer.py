@@ -36,7 +36,7 @@ from deeplearning4j_tpu.datasets.iterator import (
 from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers.base import BaseLayerConf
 from deeplearning4j_tpu.nn.updater import (
-    build_optimizer, l1_l2_penalty, normalize_gradients, per_layer_lr_scale,
+    build_optimizer, compute_updates, l1_l2_penalty,
 )
 from deeplearning4j_tpu.optimize.listeners import IterationListener, TrainingListener
 
@@ -130,8 +130,11 @@ class MultiLayerNetwork:
                 new_carries[i] = c_out
                 s = states[i]
             else:
-                h, s = layer.apply(params[i], h, state=states[i], train=train,
-                                   rng=sub, mask=cur_mask)
+                layer_train = train and not layer.frozen
+                h, s = layer.apply(params[i], h, state=states[i],
+                                   train=layer_train, rng=sub, mask=cur_mask)
+                if layer.frozen:
+                    s = states[i]  # frozen: BN running stats don't move
             new_states.append(s)
             if collect:
                 acts.append(h)
@@ -207,12 +210,8 @@ class MultiLayerNetwork:
 
             (loss, (new_states, h_last)), grads = jax.value_and_grad(
                 loss_for_grad, has_aux=True)(params)
-            grads = normalize_gradients(grads, training)
-            updates, new_opt = tx.update(grads, opt_state, params)
-            updates = per_layer_lr_scale(updates, self.layers,
-                                         training.updater.learning_rate)
-            new_params = jax.tree.map(
-                lambda p, u: p + u, params, updates)
+            new_params, new_opt = compute_updates(
+                tx, grads, opt_state, params, self.layers, training)
             if center_loss_head:
                 # EMA center update outside the gradient step
                 # (ref: CenterLossOutputLayer alpha semantics)
@@ -263,11 +262,8 @@ class MultiLayerNetwork:
 
             (loss, (new_states, new_carries)), grads = jax.value_and_grad(
                 loss_for_grad, has_aux=True)(params)
-            grads = normalize_gradients(grads, training)
-            updates, new_opt = tx.update(grads, opt_state, params)
-            updates = per_layer_lr_scale(updates, self.layers,
-                                         training.updater.learning_rate)
-            new_params = jax.tree.map(lambda a, u: a + u, params, updates)
+            new_params, new_opt = compute_updates(
+                tx, grads, opt_state, params, self.layers, training)
             # stop gradients across tBPTT boundaries
             new_carries = jax.tree.map(jax.lax.stop_gradient, new_carries)
             return new_params, new_opt, new_states, new_carries, loss

@@ -1,0 +1,194 @@
+"""Transfer learning: rebuild networks from pretrained ones.
+
+Ref: nn/transferlearning/TransferLearning.java:34-129 (Builder),
+FineTuneConfiguration.java (global hyperparameter overrides),
+TransferLearningHelper.java (freeze + featurize-and-cache).
+
+Capabilities matching the reference Builder:
+- ``set_feature_extractor(n)``  — freeze layers [0..n] (FrozenLayer wrapper
+  in the reference; the ``frozen`` flag + update mask here)
+- ``n_out_replace(i, n_out, weight_init)`` — swap a layer's output width,
+  re-initializing it and the following layer's inputs
+- ``remove_output_layer`` / ``remove_layers_from_output(k)``
+- ``add_layer(layer)``
+- ``fine_tune_configuration(...)`` — override updater/lr/etc.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.builder import (
+    ListBuilder, MultiLayerConfiguration, NeuralNetConfiguration,
+    TrainingConfig, UpdaterConfig,
+)
+from deeplearning4j_tpu.nn.layers.base import BaseLayerConf
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+@dataclass
+class FineTuneConfiguration:
+    """Hyperparameter overrides applied to the copied conf
+    (ref: transferlearning/FineTuneConfiguration.java)."""
+    updater: Optional[str] = None
+    learning_rate: Optional[float] = None
+    seed: Optional[int] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None
+
+    def apply(self, training: TrainingConfig, layers: List[BaseLayerConf]):
+        if self.updater is not None:
+            training.updater.name = self.updater.lower()
+        if self.learning_rate is not None:
+            training.updater.learning_rate = self.learning_rate
+        if self.seed is not None:
+            training.seed = self.seed
+        for l in layers:
+            if self.l1 is not None:
+                l.l1 = self.l1
+            if self.l2 is not None:
+                l.l2 = self.l2
+            if self.dropout is not None:
+                l.dropout = self.dropout
+
+
+class TransferLearning:
+    """``TransferLearning.builder(net)`` (ref: TransferLearning.Builder)."""
+
+    class Builder:
+        def __init__(self, net: MultiLayerNetwork):
+            net._check_init()
+            self._src = net
+            self._conf = copy.deepcopy(net.conf)
+            self._params = [dict(p) for p in net.params]
+            self._states = [dict(s) for s in net.states]
+            self._freeze_until: Optional[int] = None
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._reinit: List[int] = []
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, layer_index: int):
+            """Freeze layers [0..layer_index] inclusive
+            (ref: Builder.setFeatureExtractor)."""
+            self._freeze_until = layer_index
+            return self
+
+        def n_out_replace(self, layer_index: int, n_out: int,
+                          weight_init: Optional[str] = None):
+            """Change layer_index's n_out, re-initializing it and the next
+            parameterized layer's inputs (ref: Builder.nOutReplace)."""
+            layers = self._conf.layers
+            layer = layers[layer_index]
+            layer.n_out = n_out
+            if weight_init is not None:
+                layer.weight_init = weight_init
+            self._reinit.append(layer_index)
+            # next layer's n_in changes => re-init it too
+            for j in range(layer_index + 1, len(layers)):
+                nxt = layers[j]
+                if nxt.has_params():
+                    nxt.n_in = n_out
+                    self._reinit.append(j)
+                    break
+            return self
+
+        def remove_output_layer(self):
+            return self.remove_layers_from_output(1)
+
+        def remove_layers_from_output(self, k: int):
+            for _ in range(k):
+                self._conf.layers.pop()
+                self._params.pop()
+                self._states.pop()
+                if self._conf.input_types:
+                    self._conf.input_types.pop()
+            return self
+
+        def add_layer(self, layer: BaseLayerConf):
+            layers = self._conf.layers
+            # infer n_in from the previous layer's output type
+            prev_out = None
+            for prev in reversed(layers):
+                t = getattr(prev, "n_out", None)
+                if t:
+                    prev_out = t
+                    break
+            from deeplearning4j_tpu.nn.conf.inputs import InputType
+            if prev_out is not None:
+                in_t = InputType.feed_forward(prev_out)
+                layer.set_n_in(in_t)
+                if self._conf.input_types:
+                    self._conf.input_types.append(in_t)
+            from deeplearning4j_tpu.nn.layers.base import GlobalConf
+            layer.apply_global_defaults(GlobalConf())
+            layers.append(layer)
+            self._params.append({})
+            self._states.append({})
+            self._reinit.append(len(layers) - 1)
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            if self._fine_tune is not None:
+                self._fine_tune.apply(self._conf.training, self._conf.layers)
+            if self._freeze_until is not None:
+                for i in range(self._freeze_until + 1):
+                    self._conf.layers[i].frozen = True
+            net = MultiLayerNetwork(self._conf)
+            # re-init changed layers, keep the rest of the pretrained params
+            key = jax.random.PRNGKey(self._conf.training.seed)
+            keys = jax.random.split(key, max(len(self._conf.layers), 1))
+            params = []
+            for i, layer in enumerate(self._conf.layers):
+                if i in self._reinit or not self._params[i]:
+                    params.append(layer.init_params(keys[i])
+                                  if layer.has_params() else {})
+                else:
+                    params.append(self._params[i])
+            net.init(params=params)
+            for i, s in enumerate(self._states):
+                if i not in self._reinit and s:
+                    net.states[i] = s
+            return net
+
+    @staticmethod
+    def builder(net: MultiLayerNetwork) -> "TransferLearning.Builder":
+        return TransferLearning.Builder(net)
+
+
+class TransferLearningHelper:
+    """Featurize-and-cache training for frozen-bottom networks
+    (ref: transferlearning/TransferLearningHelper.java): run inputs through
+    the frozen stack once, then train only the unfrozen top on the cached
+    features."""
+
+    def __init__(self, net: MultiLayerNetwork):
+        net._check_init()
+        self.net = net
+        frozen = [i for i, l in enumerate(net.layers) if l.frozen]
+        self._split = (max(frozen) + 1) if frozen else 0
+
+    def featurize(self, features) -> jnp.ndarray:
+        """Activations at the frozen/unfrozen boundary."""
+        return self.net._activate_to(self._split, jnp.asarray(features))
+
+    def unfrozen_net(self) -> MultiLayerNetwork:
+        """A standalone net of the unfrozen top layers sharing params."""
+        conf = copy.deepcopy(self.net.conf)
+        conf.layers = conf.layers[self._split:]
+        conf.preprocessors = {i - self._split: p
+                              for i, p in conf.preprocessors.items()
+                              if i >= self._split}
+        conf.input_types = conf.input_types[self._split:]
+        top = MultiLayerNetwork(conf)
+        top.init(params=self.net.params[self._split:])
+        top.states = self.net.states[self._split:]
+        return top

@@ -157,6 +157,46 @@ def l1_l2_penalty(params, layers) -> jax.Array:
     return total
 
 
+def _zip_layers(tree, layers):
+    """Pair each layer with its per-layer subtree. ``tree`` is a list
+    aligned with ``layers`` (MultiLayerNetwork) or a dict keyed by the
+    layer's node name (ComputationGraph)."""
+    if isinstance(tree, dict):
+        by_name = {l.name: l for l in layers}
+        return [(by_name[k], k, v) for k, v in tree.items()]
+    return [(l, i, v) for i, (l, v) in enumerate(zip(layers, tree))]
+
+
+def mask_frozen(grads, layers):
+    """Zero frozen layers' gradients BEFORE clipping/updating, matching the
+    reference's FrozenLayer.backpropGradient returning a zero gradient
+    (so frozen params neither skew global-norm clipping nor accumulate
+    optimizer moments)."""
+    if not any(l.frozen for l in layers):
+        return grads
+    if isinstance(grads, dict):
+        by_name = {l.name: l for l in layers}
+        return {k: (jax.tree.map(jnp.zeros_like, v)
+                    if by_name[k].frozen else v)
+                for k, v in grads.items()}
+    return [jax.tree.map(jnp.zeros_like, g) if l.frozen else g
+            for l, g in zip(layers, grads)]
+
+
+def compute_updates(tx, grads, opt_state, params, layers,
+                    training: TrainingConfig):
+    """The shared post-gradient pipeline every training path uses:
+    freeze-mask -> gradient normalization/clipping -> update rule ->
+    per-layer LR scaling. Returns (new_params, new_opt_state)."""
+    grads = mask_frozen(grads, layers)
+    grads = normalize_gradients(grads, training)
+    updates, new_opt = tx.update(grads, opt_state, params)
+    updates = per_layer_lr_scale(updates, layers,
+                                 training.updater.learning_rate)
+    new_params = jax.tree.map(lambda p, u: p + u, params, updates)
+    return new_params, new_opt
+
+
 def per_layer_lr_scale(updates, layers, base_lr: float):
     """Per-layer learning-rate override: scale each layer's update by
     layer.learning_rate / base_lr (the reference instead builds a separate
@@ -164,10 +204,10 @@ def per_layer_lr_scale(updates, layers, base_lr: float):
     update magnitude is linear in lr for every supported rule)."""
     if not any(l.learning_rate is not None for l in layers):
         return updates
-    out = []
-    for layer, upd in zip(layers, updates):
+    scaled = {} if isinstance(updates, dict) else [None] * len(layers)
+    for layer, key, upd in _zip_layers(updates, layers):
         if layer.learning_rate is not None and base_lr > 0:
             s = layer.learning_rate / base_lr
             upd = jax.tree.map(lambda x: x * s, upd)
-        out.append(upd)
-    return out
+        scaled[key] = upd
+    return scaled

@@ -31,6 +31,13 @@ def _apply_act(preout: Array, activation: str) -> Array:
     return get_activation(activation)(preout)
 
 
+def promote_loss_dtype(preout: Array, labels: Array):
+    """Mixed precision: losses compute in >= f32 (promote, don't hard-cast,
+    so f64 gradient checks stay f64)."""
+    dt = jnp.promote_types(preout.dtype, jnp.float32)
+    return preout.astype(dt), labels.astype(dt)
+
+
 def _reduce(per_elem: Array, mask: Optional[Array]) -> Array:
     """Sum per-element losses over feature axes -> [batch]; apply mask."""
     if mask is not None:

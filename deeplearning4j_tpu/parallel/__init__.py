@@ -1,0 +1,21 @@
+"""Distributed training over a jax.sharding.Mesh.
+
+Replaces the reference's three data-parallel strategies (SURVEY §2.3):
+
+- ParallelWrapper (threads + param averaging,
+  ref: deeplearning4j-scaleout-parallelwrapper/.../ParallelWrapper.java)
+- ParameterServerParallelWrapper (Aeron UDP push/pull,
+  ref: ...-parameter-server/.../ParameterServerParallelWrapper.java)
+- Spark ParameterAveragingTrainingMaster
+  (ref: spark/dl4j-spark/.../paramavg/ParameterAveragingTrainingMaster.java)
+
+with ONE SPMD trainer: shardings over a device mesh, XLA-inserted
+collectives riding ICI (all three reference tiers collapse into mesh-axis
+choices; multi-host/multi-slice = the same program over DCN-connected
+meshes). A parameter-averaging compatibility mode reproduces the
+reference's average-every-k semantics for parity testing.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import MeshContext  # noqa: F401
+from deeplearning4j_tpu.parallel.trainer import ParallelTrainer  # noqa: F401
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: F401

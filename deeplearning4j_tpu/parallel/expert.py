@@ -1,0 +1,114 @@
+"""Expert parallelism: mixture-of-experts FFN with sharded experts.
+
+No counterpart in the reference (SURVEY §2.3); part of the TPU build's
+first-class scale-out. Mesh-TensorFlow-style dense dispatch: top-1 gating
+produces a dispatch tensor, token->expert routing is an einsum, and with
+the expert axis of the stacked expert weights sharded over mesh axis
+``ep``, XLA lowers the dispatch/combine einsums to all-to-all over ICI —
+no hand-written collectives.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import (
+    Array, BaseLayerConf, Params, register_layer,
+)
+from deeplearning4j_tpu.ops.activations import get_activation
+
+
+def moe_dispatch(gates: Array, capacity: int):
+    """Top-1 dispatch/combine tensors (Switch-style).
+
+    gates: [N, E] softmax scores. Returns (dispatch [N, E, C] one-hot,
+    combine [N, E, C] gate-weighted, aux_loss scalar).
+    """
+    N, E = gates.shape
+    expert_idx = jnp.argmax(gates, axis=-1)                       # [N]
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=gates.dtype)     # [N, E]
+    # position of each token within its expert's buffer
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot             # [N, E]
+    keep = (pos < capacity).astype(gates.dtype) * onehot
+    pos_clipped = jnp.minimum(pos, capacity - 1).astype(jnp.int32)
+    pos_onehot = jax.nn.one_hot(pos_clipped, capacity, dtype=gates.dtype)
+    dispatch = keep[..., None] * pos_onehot                       # [N, E, C]
+    gate_val = jnp.sum(gates * onehot, axis=-1, keepdims=True)    # [N, 1]
+    combine = dispatch * gate_val[..., None]
+    # Switch load-balancing loss: E * sum_e (fraction_tokens_e * mean_gate_e)
+    frac = jnp.mean(onehot, axis=0)
+    mean_gate = jnp.mean(gates, axis=0)
+    aux = E * jnp.sum(frac * mean_gate)
+    return dispatch, combine, aux
+
+
+def moe_ffn(params: Params, x: Array, activation: str = "relu",
+            capacity_factor: float = 1.25):
+    """x: [N, F] tokens. params: Wg [F, E]; W1 [E, F, H]; b1 [E, H];
+    W2 [E, H, F]; b2 [E, F]. Returns ([N, F], aux_loss)."""
+    N, F = x.shape
+    E = params["Wg"].shape[-1]
+    capacity = max(1, int(capacity_factor * N / E))
+    gates = jax.nn.softmax(x @ params["Wg"], axis=-1)
+    dispatch, combine, aux = moe_dispatch(gates, capacity)
+    # token -> expert buffers (XLA: all_to_all when E is sharded over 'ep')
+    expert_in = jnp.einsum("nec,nf->ecf", dispatch, x)            # [E, C, F]
+    act = get_activation(activation)
+    h = act(jnp.einsum("ecf,efh->ech", expert_in, params["W1"])
+            + params["b1"][:, None, :])
+    expert_out = (jnp.einsum("ech,ehf->ecf", h, params["W2"])
+                  + params["b2"][:, None, :])                     # [E, C, F]
+    out = jnp.einsum("nec,ecf->nf", combine, expert_out)          # [N, F]
+    return out, aux
+
+
+@register_layer
+@dataclass
+class MoELayer(BaseLayerConf):
+    """Mixture-of-experts FFN layer over [B, F] (or [B, T, F] flattened to
+    tokens). Stacked expert weights carry a leading expert axis — shard it
+    over an 'ep' mesh axis for expert parallelism."""
+    n_experts: int = 8
+    hidden: int = 0           # expert FFN hidden width; default 4*F
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 1e-2
+
+    def set_n_in(self, in_type: InputType) -> None:
+        self.n_in = in_type.size if in_type.kind == "rnn" else in_type.flat_size()
+        if not self.hidden:
+            self.hidden = 4 * self.n_in
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        return in_type
+
+    def param_order(self) -> List[str]:
+        return ["Wg", "W1", "b1", "W2", "b2"]
+
+    def init_params(self, rng, dtype=jnp.float32) -> Params:
+        F, E, H = self.n_in, self.n_experts, self.hidden
+        ks = jax.random.split(rng, 3)
+        return {
+            "Wg": self._init_w(ks[0], (F, E), F, E, dtype),
+            "W1": self._init_w(ks[1], (E, F, H), F, H, dtype),
+            "b1": jnp.zeros((E, H), dtype),
+            "W2": self._init_w(ks[2], (E, H, F), H, F, dtype),
+            "b2": jnp.zeros((E, F), dtype),
+        }
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        shape = x.shape
+        tokens = x.reshape(-1, shape[-1])
+        out, aux = moe_ffn(params, tokens, self.activation or "relu",
+                           self.capacity_factor)
+        # aux loss surfaces through state so the container can add it
+        new_state = dict(state)
+        new_state["aux_loss"] = aux * self.aux_loss_weight
+        return out.reshape(shape), new_state
+
+    def init_state(self):
+        return {"aux_loss": jnp.zeros(())}

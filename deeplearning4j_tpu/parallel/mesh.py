@@ -1,0 +1,87 @@
+"""Device-mesh management + sharding rules.
+
+The scaling-model design: pick a Mesh with named axes
+('data', 'model'), annotate params/batches with NamedShardings, let XLA
+insert the collectives (psum for gradients over 'data', all-gather /
+reduce-scatter for 'model'-sharded matmuls), profile, iterate. Replaces the
+reference's AffinityManager device pinning (ParallelWrapper.java:348) and
+every explicit parameter-blob exchange.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass
+class MeshContext:
+    """A named mesh plus the policy mapping framework state onto it."""
+    mesh: Mesh
+    data_axis: str = "data"
+    model_axis: Optional[str] = "model"
+    # shard a param's last axis over `model` only when it's at least this big
+    min_shard_size: int = 1024
+
+    @staticmethod
+    def create(n_data: Optional[int] = None, n_model: int = 1,
+               devices: Optional[Sequence] = None) -> "MeshContext":
+        devices = list(devices if devices is not None else jax.devices())
+        if n_data is None:
+            n_data = len(devices) // n_model
+        if n_data * n_model != len(devices):
+            devices = devices[:n_data * n_model]
+        arr = np.array(devices).reshape(n_data, n_model)
+        mesh = Mesh(arr, axis_names=("data", "model"))
+        return MeshContext(mesh=mesh,
+                           model_axis=None if n_model == 1 else "model")
+
+    @property
+    def n_data(self) -> int:
+        return self.mesh.shape[self.data_axis]
+
+    @property
+    def n_model(self) -> int:
+        return self.mesh.shape.get("model", 1) if self.model_axis else 1
+
+    # ------------------------------------------------------------- shardings
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharding(self, ndim: int) -> NamedSharding:
+        """Shard the leading (batch) axis over 'data'."""
+        return NamedSharding(self.mesh, P(self.data_axis,
+                                          *([None] * (ndim - 1))))
+
+    def param_spec(self, name: str, shape: Tuple[int, ...]) -> P:
+        """Tensor-parallel policy: shard the output/feature (last) axis of
+        large kernels over 'model'; replicate everything else. Matches the
+        megatron-style column-parallel layout for dense/conv kernels."""
+        if (self.model_axis is not None and len(shape) >= 2
+                and shape[-1] % self.n_model == 0
+                and int(np.prod(shape)) >= self.min_shard_size):
+            return P(*([None] * (len(shape) - 1)), self.model_axis)
+        return P()
+
+    def param_sharding(self, name: str, shape) -> NamedSharding:
+        return NamedSharding(self.mesh, self.param_spec(name, tuple(shape)))
+
+    def shard_params(self, params):
+        """device_put a param pytree according to the policy."""
+        def put(path, x):
+            name = "/".join(str(p) for p in path)
+            return jax.device_put(x, self.param_sharding(name, x.shape))
+        return jax.tree_util.tree_map_with_path(put, params)
+
+    def shard_batch(self, *arrays):
+        out = []
+        for a in arrays:
+            if a is None:
+                out.append(None)
+            else:
+                out.append(jax.device_put(a, self.batch_sharding(np.ndim(a))))
+        return tuple(out) if len(out) > 1 else out[0]

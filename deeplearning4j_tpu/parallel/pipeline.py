@@ -1,0 +1,78 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline over a mesh axis.
+
+No counterpart exists in the reference (data parallelism only — SURVEY
+§2.3); this is part of the TPU build's first-class scale-out. Design: the
+S pipeline stages are homogeneous (same activation shapes), their params
+stacked on a leading stage axis sharded over mesh axis ``pp``. Inside
+``shard_map`` every device runs the same program: at tick t it applies its
+stage to the activation it holds, then passes the result to its ring
+neighbor with ``ppermute`` (ICI neighbor hop). Stage 0 injects microbatch
+t; stage S-1 collects finished microbatches. M microbatches drain the
+bubble in S-1 ticks — utilization M/(M+S-1), the GPipe schedule.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
+                   mesh: Mesh, axis: str = "pp"):
+    """Run the pipeline.
+
+    stage_fn(params_slice, x) -> y with y.shape == x.shape (homogeneous
+    stages). ``stacked_params``: pytree with leading stage axis S == mesh
+    size over ``axis``. ``x_microbatches``: [M, B_mb, ...] (replicated).
+    Returns [M, B_mb, ...] outputs of the final stage.
+    """
+    S = mesh.shape[axis]
+    M = x_microbatches.shape[0]
+    T = M + S - 1  # total ticks incl. pipeline fill
+
+    def device_fn(params, xs):
+        # params: this stage's slice, leading axis 1; xs: all microbatches
+        params = jax.tree.map(lambda a: a[0], params)
+        sid = jax.lax.axis_index(axis)
+        perm = [(j, (j + 1) % S) for j in range(S)]
+
+        def tick(carry, t):
+            held, outbuf = carry
+            # stage 0 injects microbatch t (zeros once drained)
+            inject = jnp.where(t < M, t, 0)
+            x_in = jnp.where(sid == 0, xs[inject], held)
+            y = stage_fn(params, x_in)
+            # last stage stores finished microbatch t-(S-1)
+            done_idx = t - (S - 1)
+            store = jnp.logical_and(sid == S - 1, done_idx >= 0)
+            idx = jnp.maximum(done_idx, 0)
+            cur = jax.lax.dynamic_index_in_dim(outbuf, idx, 0, keepdims=False)
+            val = jnp.where(store, y, cur)
+            outbuf = jax.lax.dynamic_update_index_in_dim(outbuf, val, idx, 0)
+            # hand activation to the next stage
+            held_next = jax.lax.ppermute(y, axis, perm)
+            return (held_next, outbuf), None
+
+        # pvary: carries must be device-varying to match the scan body
+        held0 = jax.lax.pvary(xs[0] * 0.0, (axis,))
+        outbuf0 = jax.lax.pvary(xs * 0.0, (axis,))
+        (_, outbuf), _ = jax.lax.scan(tick, (held0, outbuf0), jnp.arange(T))
+        # every device returns its buffer; only the last stage's is real.
+        # psum gathers it to all (cheap: zeros elsewhere).
+        return jax.lax.psum(outbuf, axis)
+
+    fn = shard_map(device_fn, mesh=mesh,
+                   in_specs=(P(axis), P()),
+                   out_specs=P())
+    return fn(stacked_params, x_microbatches)
+
+
+def stack_stage_params(param_list):
+    """Stack per-stage param pytrees along a new leading axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *param_list)

@@ -1,0 +1,22 @@
+#!/bin/sh
+# Build the native components into deeplearning4j_tpu/native_lib/.
+# Works without HDF5 dev headers: prototypes are self-declared and the
+# link goes straight against the runtime .so the image ships.
+set -e
+cd "$(dirname "$0")"
+OUT=../deeplearning4j_tpu/native_lib
+mkdir -p "$OUT"
+
+HDF5_SO=$(ls /lib/x86_64-linux-gnu/libhdf5_serial.so.* 2>/dev/null | head -1)
+if [ -n "$HDF5_SO" ]; then
+  g++ -O2 -shared -fPIC hdf5_reader.cc "$HDF5_SO" -o "$OUT/libh5reader.so"
+  echo "built $OUT/libh5reader.so (against $HDF5_SO)"
+else
+  echo "libhdf5 not found; skipping h5 reader" >&2
+fi
+
+g++ -O2 -shared -fPIC stats_codec.cc -o "$OUT/libstatscodec.so"
+echo "built $OUT/libstatscodec.so"
+
+g++ -O2 -shared -fPIC dataloader.cc -o "$OUT/libdataloader.so"
+echo "built $OUT/libdataloader.so"

@@ -1,0 +1,118 @@
+// Native data loaders: IDX (MNIST-format) and numeric CSV.
+//
+// Role parity: the reference's data ingestion rides DataVec record readers
+// with the hot parsing in native-backed ND4J buffers (ref:
+// deeplearning4j-core/.../datasets/fetchers/MnistDataFetcher.java:65-83 IDX
+// parsing; RecordReaderDataSetIterator bridging CSV records). This is the
+// TPU build's native IO path, exposed via C ABI to
+// deeplearning4j_tpu/datasets/native_io.py, keeping the host CPU ahead of
+// the device feed.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+static uint32_t be32(const uint8_t *p) {
+  return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+         ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+}
+
+extern "C" {
+
+// Parses an IDX file. Returns ndims (>0) and fills dims_out; data written
+// as float32 normalized by `scale` (pass 1/255 for images, 1 for labels).
+// Returns negative on error: -1 open, -2 magic, -3 capacity.
+int idx_read(const char *path, double scale, int64_t *dims_out, int max_dims,
+             float *out, int64_t capacity) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return -1;
+  uint8_t header[4];
+  if (fread(header, 1, 4, f) != 4 || header[0] != 0 || header[1] != 0) {
+    fclose(f);
+    return -2;
+  }
+  int dtype = header[2];  // 0x08 = u8 (the only type MNIST uses)
+  int nd = header[3];
+  if (nd > max_dims) {
+    fclose(f);
+    return -2;
+  }
+  int64_t total = 1;
+  for (int i = 0; i < nd; ++i) {
+    uint8_t d[4];
+    if (fread(d, 1, 4, f) != 4) {
+      fclose(f);
+      return -2;
+    }
+    dims_out[i] = (int64_t)be32(d);
+    total *= dims_out[i];
+  }
+  if (total > capacity) {
+    fclose(f);
+    return -3;
+  }
+  if (dtype == 0x08) {
+    const int64_t CHUNK = 1 << 20;
+    uint8_t *buf = (uint8_t *)malloc(CHUNK);
+    int64_t done = 0;
+    while (done < total) {
+      int64_t want = total - done < CHUNK ? total - done : CHUNK;
+      size_t got = fread(buf, 1, (size_t)want, f);
+      if (got == 0) break;
+      for (size_t i = 0; i < got; ++i)
+        out[done + (int64_t)i] = (float)(buf[i] * scale);
+      done += (int64_t)got;
+    }
+    free(buf);
+    fclose(f);
+    return done == total ? nd : -2;
+  }
+  fclose(f);
+  return -2;
+}
+
+// Parses a numeric CSV (no quoting) into a row-major float32 matrix.
+// Returns number of rows, fills *n_cols; negative on error.
+int64_t csv_read(const char *path, char delimiter, int skip_rows,
+                 float *out, int64_t capacity, int32_t *n_cols) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return -1;
+  char line[65536];
+  int64_t rows = 0, written = 0;
+  int32_t cols = -1;
+  int skipped = 0;
+  while (fgets(line, sizeof(line), f)) {
+    if (skipped < skip_rows) {
+      ++skipped;
+      continue;
+    }
+    int32_t c = 0;
+    char *p = line;
+    while (*p && *p != '\n' && *p != '\r') {
+      char *endp = nullptr;
+      float v = strtof(p, &endp);
+      if (endp == p) break;
+      if (written >= capacity) {
+        fclose(f);
+        return -3;
+      }
+      out[written++] = v;
+      ++c;
+      p = endp;
+      while (*p == delimiter || *p == ' ') ++p;
+    }
+    if (c == 0) continue;
+    if (cols < 0) cols = c;
+    if (c != cols) {
+      fclose(f);
+      return -2;  // ragged rows
+    }
+    ++rows;
+  }
+  fclose(f);
+  *n_cols = cols < 0 ? 0 : cols;
+  return rows;
+}
+
+}  // extern "C"

@@ -1,0 +1,399 @@
+// Native HDF5 reader for Keras model import.
+//
+// Role parity: the reference reads Keras .h5 files through a JavaCPP
+// binding of the HDF5 C library (ref: deeplearning4j-modelimport/.../keras/
+// Hdf5Archive.java:22-51 over org.bytedeco.javacpp.hdf5). This is the
+// TPU build's equivalent native component: a thin C++ shim over
+// libhdf5(_serial) exposing a flat C ABI consumed from Python via ctypes
+// (deeplearning4j_tpu/keras/hdf5.py).
+//
+// Built without HDF5 dev headers (the runtime .so ships in the image, the
+// headers don't), so the needed C API surface is declared here. hid_t is
+// int64_t as of HDF5 1.10 (the image ships libhdf5_serial.so.103 = 1.10.x).
+//
+// Build: see native/build.sh (g++ -shared -fPIC, linked directly against
+// /lib/x86_64-linux-gnu/libhdf5_serial.so.103).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+typedef int64_t hid_t;
+typedef uint64_t hsize_t;
+typedef int herr_t;
+typedef int htri_t;
+
+// library + file
+herr_t H5open(void);
+herr_t H5Eset_auto2(hid_t estack, void *func, void *client_data);
+hid_t H5Fopen(const char *name, unsigned flags, hid_t fapl_id);
+herr_t H5Fclose(hid_t);
+
+// objects / links (H5G old-style iteration API is the simplest
+// header-free option and is stable across 1.8/1.10)
+hid_t H5Gopen2(hid_t loc, const char *name, hid_t gapl);
+herr_t H5Gclose(hid_t);
+herr_t H5Gget_num_objs(hid_t loc, hsize_t *num);
+ssize_t H5Gget_objname_by_idx(hid_t loc, hsize_t idx, char *name, size_t size);
+int H5Gget_objtype_by_idx(hid_t loc, hsize_t idx);
+
+// attributes
+htri_t H5Aexists_by_name(hid_t loc, const char *obj, const char *attr,
+                         hid_t lapl);
+hid_t H5Aopen_by_name(hid_t loc, const char *obj, const char *attr,
+                      hid_t aapl, hid_t lapl);
+hid_t H5Aget_type(hid_t attr);
+hid_t H5Aget_space(hid_t attr);
+herr_t H5Aread(hid_t attr, hid_t type, void *buf);
+herr_t H5Aclose(hid_t);
+
+// datasets / dataspaces / types
+hid_t H5Dopen2(hid_t loc, const char *name, hid_t dapl);
+hid_t H5Dget_space(hid_t ds);
+hid_t H5Dget_type(hid_t ds);
+herr_t H5Dread(hid_t ds, hid_t mem_type, hid_t mem_space, hid_t file_space,
+               hid_t xfer, void *buf);
+herr_t H5Dclose(hid_t);
+int H5Sget_simple_extent_ndims(hid_t space);
+int H5Sget_simple_extent_dims(hid_t space, hsize_t *dims, hsize_t *maxdims);
+herr_t H5Sclose(hid_t);
+size_t H5Tget_size(hid_t type);
+htri_t H5Tis_variable_str(hid_t type);
+hid_t H5Tget_native_type(hid_t type, int direction);
+herr_t H5Tclose(hid_t);
+hid_t H5Tcopy(hid_t type);
+herr_t H5Tset_size(hid_t type, size_t size);
+
+// write-side API
+hid_t H5Fcreate(const char *name, unsigned flags, hid_t fcpl, hid_t fapl);
+hid_t H5Gcreate2(hid_t loc, const char *name, hid_t lcpl, hid_t gcpl,
+                 hid_t gapl);
+hid_t H5Screate_simple(int rank, const hsize_t *dims, const hsize_t *maxdims);
+hid_t H5Screate(int type);  // H5S_SCALAR = 0
+hid_t H5Dcreate2(hid_t loc, const char *name, hid_t type, hid_t space,
+                 hid_t lcpl, hid_t dcpl, hid_t dapl);
+herr_t H5Dwrite(hid_t ds, hid_t mem_type, hid_t mem_space, hid_t file_space,
+                hid_t xfer, const void *buf);
+hid_t H5Acreate_by_name(hid_t loc, const char *obj, const char *attr,
+                        hid_t type, hid_t space, hid_t acpl, hid_t aapl,
+                        hid_t lapl);
+herr_t H5Awrite(hid_t attr, hid_t type, const void *buf);
+
+// global type ids (resolved after H5open(); names stable across versions)
+extern hid_t H5T_NATIVE_FLOAT_g;
+extern hid_t H5T_NATIVE_DOUBLE_g;
+extern hid_t H5T_C_S1_g;
+}
+
+static const unsigned H5F_ACC_RDONLY = 0u;
+static const hid_t H5P_DEFAULT = 0;
+static const size_t H5T_VARIABLE = (size_t)-1;
+
+extern "C" {
+
+// ---- lifecycle ----
+int64_t h5r_open(const char *path) {
+  H5open();
+  H5Eset_auto2(0, nullptr, nullptr);  // errors surface as return codes, not stderr spew
+  hid_t f = H5Fopen(path, H5F_ACC_RDONLY, H5P_DEFAULT);
+  return (int64_t)f;  // < 0 on failure
+}
+
+int h5r_close(int64_t file) { return (int)H5Fclose((hid_t)file); }
+
+// ---- attributes ----
+// Reads a string attribute on `obj_path` into buf (NUL-terminated).
+// Returns the string length, -1 if missing, -2 on read error,
+// or required capacity (>buflen) if the buffer is too small.
+int64_t h5r_read_attr_str(int64_t file, const char *obj_path,
+                          const char *attr_name, char *buf, int64_t buflen) {
+  htri_t ex = H5Aexists_by_name((hid_t)file, obj_path, attr_name, H5P_DEFAULT);
+  if (ex <= 0) return -1;
+  hid_t attr = H5Aopen_by_name((hid_t)file, obj_path, attr_name, H5P_DEFAULT,
+                               H5P_DEFAULT);
+  if (attr < 0) return -2;
+  hid_t ftype = H5Aget_type(attr);
+  int64_t out = -2;
+  if (H5Tis_variable_str(ftype) > 0) {
+    char *p = nullptr;
+    hid_t mtype = H5Tcopy(H5T_C_S1_g);
+    H5Tset_size(mtype, H5T_VARIABLE);
+    if (H5Aread(attr, mtype, &p) >= 0 && p != nullptr) {
+      int64_t n = (int64_t)strlen(p);
+      if (n + 1 <= buflen) {
+        memcpy(buf, p, n + 1);
+        out = n;
+      } else {
+        out = n + 1;
+      }
+      free(p);
+    }
+    H5Tclose(mtype);
+  } else {
+    size_t n = H5Tget_size(ftype);
+    if ((int64_t)n + 1 <= buflen) {
+      memset(buf, 0, n + 1);
+      hid_t mtype = H5Tcopy(H5T_C_S1_g);
+      H5Tset_size(mtype, n);
+      if (H5Aread(attr, mtype, buf) >= 0) out = (int64_t)strlen(buf);
+      H5Tclose(mtype);
+    } else {
+      out = (int64_t)n + 1;
+    }
+  }
+  H5Tclose(ftype);
+  H5Aclose(attr);
+  return out;
+}
+
+// Reads a 1-D array-of-strings attribute (e.g. Keras "layer_names",
+// "weight_names") as newline-joined text. Return semantics as above.
+int64_t h5r_read_attr_strlist(int64_t file, const char *obj_path,
+                              const char *attr_name, char *buf,
+                              int64_t buflen) {
+  htri_t ex = H5Aexists_by_name((hid_t)file, obj_path, attr_name, H5P_DEFAULT);
+  if (ex <= 0) return -1;
+  hid_t attr = H5Aopen_by_name((hid_t)file, obj_path, attr_name, H5P_DEFAULT,
+                               H5P_DEFAULT);
+  if (attr < 0) return -2;
+  hid_t ftype = H5Aget_type(attr);
+  hid_t space = H5Aget_space(attr);
+  hsize_t dims[8] = {0};
+  int nd = H5Sget_simple_extent_ndims(space);
+  if (nd > 0) H5Sget_simple_extent_dims(space, dims, nullptr);
+  hsize_t count = nd > 0 ? dims[0] : 1;
+  std::string joined;
+  int64_t out = -2;
+  if (H5Tis_variable_str(ftype) > 0) {
+    std::vector<char *> ptrs(count, nullptr);
+    hid_t mtype = H5Tcopy(H5T_C_S1_g);
+    H5Tset_size(mtype, H5T_VARIABLE);
+    if (H5Aread(attr, mtype, ptrs.data()) >= 0) {
+      for (hsize_t i = 0; i < count; ++i) {
+        if (ptrs[i]) {
+          if (!joined.empty()) joined += '\n';
+          joined += ptrs[i];
+          free(ptrs[i]);
+        }
+      }
+      out = 0;
+    }
+    H5Tclose(mtype);
+  } else {
+    size_t sz = H5Tget_size(ftype);
+    std::vector<char> raw(count * sz + 1, 0);
+    hid_t mtype = H5Tcopy(H5T_C_S1_g);
+    H5Tset_size(mtype, sz);
+    if (H5Aread(attr, mtype, raw.data()) >= 0) {
+      for (hsize_t i = 0; i < count; ++i) {
+        std::string s(raw.data() + i * sz, strnlen(raw.data() + i * sz, sz));
+        if (!joined.empty()) joined += '\n';
+        joined += s;
+      }
+      out = 0;
+    }
+    H5Tclose(mtype);
+  }
+  if (out == 0) {
+    int64_t n = (int64_t)joined.size();
+    if (n + 1 <= buflen) {
+      memcpy(buf, joined.c_str(), n + 1);
+      out = n;
+    } else {
+      out = n + 1;
+    }
+  }
+  H5Sclose(space);
+  H5Tclose(ftype);
+  H5Aclose(attr);
+  return out;
+}
+
+// ---- group listing ----
+// Child names of a group, newline-joined; type char prefix 'g'/'d'/'?'.
+int64_t h5r_list_children(int64_t file, const char *path, char *buf,
+                          int64_t buflen) {
+  hid_t g = H5Gopen2((hid_t)file, path, H5P_DEFAULT);
+  if (g < 0) return -1;
+  hsize_t n = 0;
+  if (H5Gget_num_objs(g, &n) < 0) {
+    H5Gclose(g);
+    return -2;
+  }
+  std::string joined;
+  char name[1024];
+  for (hsize_t i = 0; i < n; ++i) {
+    ssize_t len = H5Gget_objname_by_idx(g, i, name, sizeof(name));
+    if (len <= 0) continue;
+    int t = H5Gget_objtype_by_idx(g, i);
+    char tc = t == 0 ? 'g' : (t == 1 ? 'd' : '?');  // H5G_GROUP=0, H5G_DATASET=1
+    if (!joined.empty()) joined += '\n';
+    joined += tc;
+    joined += name;
+  }
+  H5Gclose(g);
+  int64_t len = (int64_t)joined.size();
+  if (len + 1 <= buflen) {
+    memcpy(buf, joined.c_str(), len + 1);
+    return len;
+  }
+  return len + 1;
+}
+
+// ---- datasets ----
+// ndims, or <0 on error
+int h5r_dataset_ndims(int64_t file, const char *path) {
+  hid_t d = H5Dopen2((hid_t)file, path, H5P_DEFAULT);
+  if (d < 0) return -1;
+  hid_t s = H5Dget_space(d);
+  int nd = H5Sget_simple_extent_ndims(s);
+  H5Sclose(s);
+  H5Dclose(d);
+  return nd;
+}
+
+int h5r_dataset_shape(int64_t file, const char *path, int64_t *dims_out,
+                      int max_dims) {
+  hid_t d = H5Dopen2((hid_t)file, path, H5P_DEFAULT);
+  if (d < 0) return -1;
+  hid_t s = H5Dget_space(d);
+  hsize_t dims[32];
+  int nd = H5Sget_simple_extent_ndims(s);
+  if (nd > max_dims || nd > 32) {
+    H5Sclose(s);
+    H5Dclose(d);
+    return -2;
+  }
+  H5Sget_simple_extent_dims(s, dims, nullptr);
+  for (int i = 0; i < nd; ++i) dims_out[i] = (int64_t)dims[i];
+  H5Sclose(s);
+  H5Dclose(d);
+  return nd;
+}
+
+// Reads the full dataset as float32 (HDF5 converts from f64/int as needed).
+int h5r_read_dataset_float(int64_t file, const char *path, float *out,
+                           int64_t capacity) {
+  hid_t d = H5Dopen2((hid_t)file, path, H5P_DEFAULT);
+  if (d < 0) return -1;
+  hid_t s = H5Dget_space(d);
+  hsize_t dims[32];
+  int nd = H5Sget_simple_extent_ndims(s);
+  H5Sget_simple_extent_dims(s, dims, nullptr);
+  int64_t n = 1;
+  for (int i = 0; i < nd; ++i) n *= (int64_t)dims[i];
+  int rc = -2;
+  if (n <= capacity) {
+    if (H5Dread(d, H5T_NATIVE_FLOAT_g, 0, 0, H5P_DEFAULT, out) >= 0) rc = 0;
+  } else {
+    rc = -3;  // capacity too small
+  }
+  H5Sclose(s);
+  H5Dclose(d);
+  return rc;
+}
+
+// ---- write side (fixture creation + Keras-compatible export) ----
+
+int64_t h5w_create(const char *path) {
+  H5open();
+  // H5F_ACC_TRUNC == 2
+  return (int64_t)H5Fcreate(path, 2u, H5P_DEFAULT, H5P_DEFAULT);
+}
+
+int h5w_create_group(int64_t file, const char *path) {
+  hid_t g = H5Gcreate2((hid_t)file, path, H5P_DEFAULT, H5P_DEFAULT,
+                       H5P_DEFAULT);
+  if (g < 0) return -1;
+  H5Gclose(g);
+  return 0;
+}
+
+// Fixed-length string scalar attribute on obj_path.
+int h5w_write_attr_str(int64_t file, const char *obj_path, const char *attr,
+                       const char *value) {
+  hid_t type = H5Tcopy(H5T_C_S1_g);
+  size_t n = strlen(value);
+  H5Tset_size(type, n ? n : 1);
+  hid_t space = H5Screate(0 /*H5S_SCALAR*/);
+  hid_t a = H5Acreate_by_name((hid_t)file, obj_path, attr, type, space,
+                              H5P_DEFAULT, H5P_DEFAULT, H5P_DEFAULT);
+  int rc = -1;
+  if (a >= 0) {
+    rc = H5Awrite(a, type, value) >= 0 ? 0 : -2;
+    H5Aclose(a);
+  }
+  H5Sclose(space);
+  H5Tclose(type);
+  return rc;
+}
+
+// 1-D fixed-length string-array attribute (newline-separated input).
+int h5w_write_attr_strlist(int64_t file, const char *obj_path,
+                           const char *attr, const char *joined) {
+  // split on '\n'; element size = longest string
+  size_t maxlen = 1, count = 1;
+  for (const char *p = joined; *p; ++p)
+    if (*p == '\n') ++count;
+  {
+    size_t cur = 0;
+    for (const char *p = joined;; ++p) {
+      if (*p == '\n' || *p == 0) {
+        if (cur > maxlen) maxlen = cur;
+        cur = 0;
+        if (*p == 0) break;
+      } else {
+        ++cur;
+      }
+    }
+  }
+  std::vector<char> packed(count * maxlen, 0);
+  {
+    size_t idx = 0, cur = 0;
+    for (const char *p = joined;; ++p) {
+      if (*p == '\n' || *p == 0) {
+        ++idx;
+        cur = 0;
+        if (*p == 0) break;
+      } else {
+        packed[idx * maxlen + cur++] = *p;
+      }
+    }
+  }
+  hid_t type = H5Tcopy(H5T_C_S1_g);
+  H5Tset_size(type, maxlen);
+  hsize_t dims[1] = {(hsize_t)count};
+  hid_t space = H5Screate_simple(1, dims, nullptr);
+  hid_t a = H5Acreate_by_name((hid_t)file, obj_path, attr, type, space,
+                              H5P_DEFAULT, H5P_DEFAULT, H5P_DEFAULT);
+  int rc = -1;
+  if (a >= 0) {
+    rc = H5Awrite(a, type, packed.data()) >= 0 ? 0 : -2;
+    H5Aclose(a);
+  }
+  H5Sclose(space);
+  H5Tclose(type);
+  return rc;
+}
+
+int h5w_write_dataset_float(int64_t file, const char *path,
+                            const int64_t *dims, int nd, const float *data) {
+  hsize_t hdims[32];
+  for (int i = 0; i < nd; ++i) hdims[i] = (hsize_t)dims[i];
+  hid_t space = H5Screate_simple(nd, hdims, nullptr);
+  hid_t d = H5Dcreate2((hid_t)file, path, H5T_NATIVE_FLOAT_g, space,
+                       H5P_DEFAULT, H5P_DEFAULT, H5P_DEFAULT);
+  int rc = -1;
+  if (d >= 0) {
+    rc = H5Dwrite(d, H5T_NATIVE_FLOAT_g, 0, 0, H5P_DEFAULT, data) >= 0 ? 0 : -2;
+    H5Dclose(d);
+  }
+  H5Sclose(space);
+  return rc;
+}
+
+}  // extern "C"

@@ -1,0 +1,123 @@
+// Compact binary stats codec.
+//
+// Role parity: the reference serializes training stats with generated
+// Simple Binary Encoding codecs (ref: deeplearning4j-ui-parent/
+// deeplearning4j-ui-model/.../stats/sbe/{UpdateEncoder,UpdateDecoder}.java,
+// ~8.2k generated LoC). This is the TPU build's equivalent: a fixed-layout
+// little-endian record + length-prefixed series, exposed via C ABI to
+// Python (deeplearning4j_tpu/ui/codec.py). One hand-written file instead
+// of a code generator — same wire-compactness goal.
+//
+// Record layout (version 1):
+//   u32 magic 0x53544154 ("STAT")  u16 version  u16 flags
+//   i64 iteration   i64 timestamp_ms   f64 score
+//   f64 samples_per_sec   f64 batches_per_sec
+//   u32 n_series; then per series:
+//     u16 name_len, name bytes, u32 value_count, f32 values[count]
+
+#include <cstdint>
+#include <cstring>
+
+static const uint32_t MAGIC = 0x53544154u;
+static const uint16_t VERSION = 1;
+
+extern "C" {
+
+// Returns encoded size, or -1 if capacity insufficient.
+int64_t stats_encode(int64_t iteration, int64_t timestamp_ms, double score,
+                     double samples_per_sec, double batches_per_sec,
+                     const char **series_names, const float **series_values,
+                     const int32_t *series_lengths, int32_t n_series,
+                     uint8_t *out, int64_t capacity) {
+  int64_t need = 4 + 2 + 2 + 8 + 8 + 8 + 8 + 8 + 4;
+  for (int32_t i = 0; i < n_series; ++i) {
+    need += 2 + (int64_t)strlen(series_names[i]) + 4 +
+            4 * (int64_t)series_lengths[i];
+  }
+  if (need > capacity) return -1;
+  uint8_t *p = out;
+  auto w32 = [&p](uint32_t v) { memcpy(p, &v, 4); p += 4; };
+  auto w16 = [&p](uint16_t v) { memcpy(p, &v, 2); p += 2; };
+  auto w64 = [&p](int64_t v) { memcpy(p, &v, 8); p += 8; };
+  auto wf64 = [&p](double v) { memcpy(p, &v, 8); p += 8; };
+  w32(MAGIC);
+  w16(VERSION);
+  w16(0);
+  w64(iteration);
+  w64(timestamp_ms);
+  wf64(score);
+  wf64(samples_per_sec);
+  wf64(batches_per_sec);
+  w32((uint32_t)n_series);
+  for (int32_t i = 0; i < n_series; ++i) {
+    uint16_t nl = (uint16_t)strlen(series_names[i]);
+    w16(nl);
+    memcpy(p, series_names[i], nl);
+    p += nl;
+    w32((uint32_t)series_lengths[i]);
+    memcpy(p, series_values[i], 4 * (size_t)series_lengths[i]);
+    p += 4 * (size_t)series_lengths[i];
+  }
+  return (int64_t)(p - out);
+}
+
+// Decodes the fixed header. Returns 0 on success, negative on error.
+int stats_decode_header(const uint8_t *buf, int64_t len, int64_t *iteration,
+                        int64_t *timestamp_ms, double *score,
+                        double *samples_per_sec, double *batches_per_sec,
+                        int32_t *n_series) {
+  if (len < 48) return -1;
+  uint32_t magic;
+  memcpy(&magic, buf, 4);
+  if (magic != MAGIC) return -2;
+  uint16_t version;
+  memcpy(&version, buf + 4, 2);
+  if (version != VERSION) return -3;
+  memcpy(iteration, buf + 8, 8);
+  memcpy(timestamp_ms, buf + 16, 8);
+  memcpy(score, buf + 24, 8);
+  memcpy(samples_per_sec, buf + 32, 8);
+  memcpy(batches_per_sec, buf + 40, 8);
+  uint32_t ns;
+  memcpy(&ns, buf + 48, 4);
+  *n_series = (int32_t)ns;
+  return 0;
+}
+
+// Walks to series `index`; copies its name (NUL-terminated) and values.
+// Returns the value count, or negative on error / insufficient capacity.
+int32_t stats_decode_series(const uint8_t *buf, int64_t len, int32_t index,
+                            char *name_out, int32_t name_capacity,
+                            float *values_out, int32_t value_capacity) {
+  if (len < 52) return -1;
+  const uint8_t *p = buf + 52;
+  const uint8_t *end = buf + len;
+  uint32_t ns;
+  memcpy(&ns, buf + 48, 4);
+  if ((uint32_t)index >= ns) return -2;
+  for (int32_t i = 0; i <= index; ++i) {
+    if (p + 2 > end) return -3;
+    uint16_t nl;
+    memcpy(&nl, p, 2);
+    p += 2;
+    const uint8_t *name_p = p;
+    p += nl;
+    if (p + 4 > end) return -3;
+    uint32_t count;
+    memcpy(&count, p, 4);
+    p += 4;
+    const uint8_t *vals_p = p;
+    p += 4 * (size_t)count;
+    if (p > end) return -3;
+    if (i == index) {
+      if (nl + 1 > name_capacity || (int32_t)count > value_capacity) return -4;
+      memcpy(name_out, name_p, nl);
+      name_out[nl] = 0;
+      memcpy(values_out, vals_p, 4 * (size_t)count);
+      return (int32_t)count;
+    }
+  }
+  return -5;
+}
+
+}  // extern "C"

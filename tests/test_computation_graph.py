@@ -1,0 +1,217 @@
+"""ComputationGraph tests — models the reference's
+TestComputationGraphNetwork.java / GradientCheckTestsComputationGraph.java:
+DAG building, topological sort, vertex ops, multi-input/multi-output
+training, JSON round-trip, gradient checks through merge/elementwise."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets import IrisDataSetIterator
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.gradientcheck import GradientCheckUtil
+from deeplearning4j_tpu.nn.conf.graph import (
+    ElementWiseVertex, L2NormalizeVertex, MergeVertex, ScaleVertex,
+    StackVertex, SubsetVertex, UnstackVertex,
+)
+from deeplearning4j_tpu.nn.conf.graph_builder import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+RNG = np.random.default_rng(0)
+
+
+def _simple_graph():
+    return (NeuralNetConfiguration.builder()
+            .seed(12345).updater("adam", learning_rate=0.05)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=16, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax"), "d1")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+
+
+def test_topological_order():
+    conf = _simple_graph()
+    order = conf.topological_order
+    assert order.index("in") < order.index("d1") < order.index("out")
+
+
+def test_graph_fit_iris():
+    net = ComputationGraph(_simple_graph()).init()
+    it = IrisDataSetIterator(batch_size=50)
+    ds = DataSet.merge(list(it))
+    s0 = net.score(ds)
+    net.fit(it, epochs=30, use_async=False)
+    assert net.score(ds) < s0 * 0.5
+    assert net.evaluate(it).accuracy() > 0.85
+
+
+def test_graph_json_round_trip():
+    conf = _simple_graph()
+    j = conf.to_json()
+    conf2 = ComputationGraphConfiguration.from_json(j)
+    assert conf2.to_json() == j
+    net = ComputationGraph(conf2).init()
+    assert net.output(np.zeros((2, 4), np.float32)).shape == (2, 3)
+
+
+def test_skip_connection_elementwise():
+    """Residual-style: d1 + d2(d1) -> out."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater("sgd", learning_rate=0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("d2", DenseLayer(n_out=8, activation="tanh"), "d1")
+            .add_vertex("add", ElementWiseVertex(op="add"), "d1", "d2")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax"), "add")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    net = ComputationGraph(conf).init()
+    ds = DataSet.merge(list(IrisDataSetIterator(batch_size=150)))
+    s0 = net.score(ds)
+    net.fit(ds, epochs=20)
+    assert net.score(ds) < s0
+
+
+def test_merge_vertex_multi_input():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater("adam", learning_rate=0.05)
+            .graph_builder()
+            .add_inputs("inA", "inB")
+            .add_layer("dA", DenseLayer(n_out=6, activation="tanh"), "inA")
+            .add_layer("dB", DenseLayer(n_out=6, activation="tanh"), "inB")
+            .add_vertex("m", MergeVertex(), "dA", "dB")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "m")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(3), InputType.feed_forward(5))
+            .build())
+    net = ComputationGraph(conf).init()
+    # merged width must be 12
+    assert conf.nodes["out"].layer.n_in == 12
+    xa = RNG.normal(size=(10, 3)).astype(np.float32)
+    xb = RNG.normal(size=(10, 5)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[RNG.integers(0, 2, 10)]
+    mds = MultiDataSet(features=[xa, xb], labels=[y])
+    s0 = net.score(mds)
+    for _ in range(30):
+        net.fit_batch(mds)
+    assert net.score(mds) < s0
+
+
+def test_multi_output_training():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater("adam", learning_rate=0.05)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("trunk", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("out1", OutputLayer(n_out=2, activation="softmax"), "trunk")
+            .add_layer("out2", OutputLayer(n_out=4, activation="identity",
+                                           loss="mse"), "trunk")
+            .set_outputs("out1", "out2")
+            .set_input_types(InputType.feed_forward(3))
+            .build())
+    net = ComputationGraph(conf).init()
+    x = RNG.normal(size=(12, 3)).astype(np.float32)
+    y1 = np.eye(2, dtype=np.float32)[RNG.integers(0, 2, 12)]
+    y2 = RNG.normal(size=(12, 4)).astype(np.float32)
+    mds = MultiDataSet(features=[x], labels=[y1, y2])
+    s0 = net.score(mds)
+    for _ in range(40):
+        net.fit_batch(mds)
+    assert net.score(mds) < s0
+    outs = net.outputs([x])
+    assert outs[0].shape == (12, 2) and outs[1].shape == (12, 4)
+
+
+def test_subset_scale_stack_unstack_vertices():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_vertex("sub", SubsetVertex(from_index=0, to_index=1), "in")
+            .add_vertex("sc", ScaleVertex(scale_factor=2.0), "sub")
+            .add_vertex("n", L2NormalizeVertex(), "sc")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "n")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    net = ComputationGraph(conf).init()
+    assert conf.nodes["out"].layer.n_in == 2
+    out = net.output(np.ones((3, 4), np.float32))
+    assert out.shape == (3, 2)
+
+
+def test_cycle_detection():
+    with pytest.raises(ValueError, match="cycle"):
+        (NeuralNetConfiguration.builder()
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("a", DenseLayer(n_out=4), "b")
+         .add_layer("b", DenseLayer(n_out=4), "a")
+         .add_layer("out", OutputLayer(n_out=2), "b")
+         .set_outputs("out")
+         .set_input_types(InputType.feed_forward(4))
+         .build())
+
+
+def test_graph_gradient_check():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=5, activation="tanh"), "in")
+            .add_layer("d2", DenseLayer(n_out=5, activation="sigmoid"), "d1")
+            .add_vertex("add", ElementWiseVertex(op="add"), "d1", "d2")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax"), "add")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    net = ComputationGraph(conf).init()
+    x = RNG.normal(size=(5, 4))
+    y = np.eye(3)[RNG.integers(0, 3, 5)]
+
+    import jax
+    import jax.numpy as jnp
+
+    with jax.enable_x64(True):
+        params64 = {n: {k: jnp.asarray(np.asarray(v), jnp.float64)
+                        for k, v in p.items()} for n, p in net.params.items()}
+        states64 = {n: {k: jnp.asarray(np.asarray(v), jnp.float64)
+                        for k, v in s.items()} for n, s in net.states.items()}
+        xin = {"in": jnp.asarray(x)}
+        lab = {"out": jnp.asarray(y)}
+
+        def loss(p):
+            val, _ = net._loss_fn(p, states64, xin, lab, None, None, rng=None)
+            return val
+
+        analytic = jax.grad(loss)(params64)
+        rng = np.random.default_rng(3)
+        eps = 1e-6
+        for node, pdict in params64.items():
+            for pname, arr in pdict.items():
+                flat = np.array(arr).ravel()
+                a_flat = np.asarray(analytic[node][pname]).ravel()
+                idxs = rng.choice(flat.size, size=min(10, flat.size), replace=False)
+                for i in idxs:
+                    orig = flat[i]
+                    for sign, store in ((1, "p"), (-1, "m")):
+                        flat[i] = orig + sign * eps
+                        p2 = {n: dict(d) for n, d in params64.items()}
+                        p2[node][pname] = jnp.asarray(flat.reshape(arr.shape))
+                        if sign == 1:
+                            sp = float(loss(p2))
+                        else:
+                            sm = float(loss(p2))
+                    flat[i] = orig
+                    numeric = (sp - sm) / (2 * eps)
+                    a = float(a_flat[i])
+                    denom = max(abs(a), abs(numeric))
+                    rel = abs(a - numeric) / denom if denom > 0 else 0.0
+                    assert rel < 1e-3 or abs(a - numeric) < 1e-8, \
+                        (node, pname, i, a, numeric)

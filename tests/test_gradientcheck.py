@@ -1,0 +1,166 @@
+"""Gradient checks per layer type — models the reference's
+gradientcheck suite (GradientCheckTests.java, CNNGradientCheckTest.java,
+LSTMGradientCheckTests.java): every layer family x loss x smooth activation
+validated against centered finite differences in f64."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.gradientcheck import GradientCheckUtil
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalization, ConvolutionLayer, DenseLayer, EmbeddingLayer,
+    GlobalPoolingLayer, GravesBidirectionalLSTM, GravesLSTM, LSTM,
+    LocalResponseNormalization, OutputLayer, RnnOutputLayer, SimpleRnn,
+    SubsamplingLayer,
+)
+
+RNG = np.random.default_rng(42)
+
+
+def _check(conf, features, labels, **kw):
+    net = MultiLayerNetwork(conf).init()
+    # subset=24: every param tensor is covered, 24 random entries each —
+    # keeps the eager-f64 harness fast on one CPU core (the reference checks
+    # all entries but runs on multi-core native BLAS)
+    kw.setdefault("subset", 24)
+    ok = GradientCheckUtil.check_gradients(net, features, labels,
+                                           print_results=True, **kw)
+    assert ok, "gradient check failed"
+
+
+@pytest.mark.parametrize("loss,out_act", [
+    ("mcxent", "softmax"),
+    ("mse", "identity"),
+    ("mse", "tanh"),
+    ("xent", "sigmoid"),
+])
+def test_dense_gradients(loss, out_act):
+    n_labels = 3
+    labels = np.eye(n_labels, dtype=np.float64)[RNG.integers(0, n_labels, 6)]
+    if loss == "xent":
+        labels = (labels > 0).astype(np.float64)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).l2(0.01).l1(0.005)
+            .list()
+            .layer(DenseLayer(n_out=5, activation="tanh"))
+            .layer(OutputLayer(n_out=n_labels, activation=out_act, loss=loss))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    _check(conf, RNG.normal(size=(6, 4)), labels)
+
+
+def test_cnn_gradients():
+    labels = np.eye(2, dtype=np.float64)[RNG.integers(0, 2, 4)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .list()
+            .layer(ConvolutionLayer(n_out=3, kernel_size=(2, 2), stride=(1, 1),
+                                    activation="tanh"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(1, 1)))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(5, 5, 2))
+            .build())
+    _check(conf, RNG.normal(size=(4, 5, 5, 2)), labels)
+
+
+def test_cnn_avg_pool_same_mode_gradients():
+    labels = np.eye(2, dtype=np.float64)[RNG.integers(0, 2, 3)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .list()
+            .layer(ConvolutionLayer(n_out=2, kernel_size=(3, 3),
+                                    convolution_mode="same", activation="sigmoid"))
+            .layer(SubsamplingLayer(pooling_type="avg", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(4, 4, 1))
+            .build())
+    _check(conf, RNG.normal(size=(3, 4, 4, 1)), labels)
+
+
+def test_batchnorm_gradients():
+    labels = np.eye(3, dtype=np.float64)[RNG.integers(0, 3, 5)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .list()
+            .layer(DenseLayer(n_out=6, activation="tanh"))
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    _check(conf, RNG.normal(size=(5, 4)), labels)
+
+
+def test_lrn_gradients():
+    labels = np.eye(2, dtype=np.float64)[RNG.integers(0, 2, 3)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(2, 2), activation="tanh"))
+            .layer(LocalResponseNormalization())
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(4, 4, 1))
+            .build())
+    _check(conf, RNG.normal(size=(3, 4, 4, 1)), labels)
+
+
+@pytest.mark.parametrize("layer_cls", [LSTM, GravesLSTM, GravesBidirectionalLSTM,
+                                       SimpleRnn])
+def test_rnn_gradients(layer_cls):
+    B, T, F, C = 3, 4, 3, 2
+    labels = np.eye(C, dtype=np.float64)[RNG.integers(0, C, (B, T))]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .list()
+            .layer(layer_cls(n_out=4, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=C, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(F))
+            .build())
+    _check(conf, RNG.normal(size=(B, T, F)), labels)
+
+
+def test_lstm_masked_gradients():
+    B, T, F, C = 3, 5, 3, 2
+    labels = np.eye(C, dtype=np.float64)[RNG.integers(0, C, (B, T))]
+    mask = np.ones((B, T))
+    mask[0, 3:] = 0.0
+    mask[2, 1:] = 0.0
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .list()
+            .layer(GravesLSTM(n_out=4, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=C, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(F))
+            .build())
+    _check(conf, RNG.normal(size=(B, T, F)), labels,
+           features_mask=mask, labels_mask=mask)
+
+
+def test_global_pooling_rnn_gradients():
+    B, T, F, C = 3, 4, 3, 2
+    labels = np.eye(C, dtype=np.float64)[RNG.integers(0, C, B)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .list()
+            .layer(LSTM(n_out=4, activation="tanh"))
+            .layer(GlobalPoolingLayer(pooling_type="avg"))
+            .layer(OutputLayer(n_out=C, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(F))
+            .build())
+    _check(conf, RNG.normal(size=(B, T, F)), labels)
+
+
+def test_embedding_gradients():
+    B, V, C = 5, 7, 3
+    labels = np.eye(C, dtype=np.float64)[RNG.integers(0, C, B)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .list()
+            .layer(EmbeddingLayer(n_out=4, activation="identity"))
+            .layer(OutputLayer(n_out=C, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(V))
+            .build())
+    feats = RNG.integers(0, V, (B, 1)).astype(np.float64)
+    _check(conf, feats, labels)

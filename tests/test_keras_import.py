@@ -1,0 +1,171 @@
+"""Keras import tests — models the reference's KerasModelEndToEndTest
+golden-file pattern: build a Keras-format .h5 (via the native writer, since
+this is a zero-egress image without h5py), import it, and assert configs,
+weights, and end-to-end predictions match hand-computed values."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.keras.hdf5 import Hdf5Archive, Hdf5Writer
+from deeplearning4j_tpu.keras.keras_import import KerasModelImport
+
+RNG = np.random.default_rng(42)
+
+
+def _write_sequential_mlp(path: str, W1, b1, W2, b2):
+    """Keras-2-style Sequential MLP: Dense(relu) -> Dense(softmax)."""
+    model_config = {
+        "class_name": "Sequential",
+        "config": {"layers": [
+            {"class_name": "Dense",
+             "config": {"name": "dense_1", "units": int(W1.shape[1]),
+                        "activation": "relu",
+                        "batch_input_shape": [None, int(W1.shape[0])]}},
+            {"class_name": "Dense",
+             "config": {"name": "dense_2", "units": int(W2.shape[1]),
+                        "activation": "softmax"}},
+        ]},
+    }
+    with Hdf5Writer(path) as w:
+        w.write_attr_str("/", "model_config", json.dumps(model_config))
+        w.create_group("/model_weights")
+        for name, kernel, bias in (("dense_1", W1, b1), ("dense_2", W2, b2)):
+            g = f"/model_weights/{name}"
+            w.create_group(g)
+            w.create_group(f"{g}/{name}")
+            w.write_dataset(f"{g}/{name}/kernel:0", kernel)
+            w.write_dataset(f"{g}/{name}/bias:0", bias)
+            w.write_attr_strlist(g, "weight_names",
+                                 [f"{name}/kernel:0", f"{name}/bias:0"])
+        w.write_attr_strlist("/model_weights", "layer_names",
+                             ["dense_1", "dense_2"])
+
+
+def test_hdf5_write_read_round_trip(tmp_path):
+    path = str(tmp_path / "t.h5")
+    data = RNG.normal(size=(3, 4)).astype(np.float32)
+    with Hdf5Writer(path) as w:
+        w.write_attr_str("/", "greeting", "hello hdf5")
+        w.create_group("/grp")
+        w.write_dataset("/grp/data", data)
+        w.write_attr_strlist("/grp", "names", ["alpha", "beta"])
+    with Hdf5Archive(path) as h5:
+        assert h5.read_attribute_as_string("greeting") == "hello hdf5"
+        assert h5.read_attribute_as_string("missing") is None
+        np.testing.assert_allclose(h5.read_dataset("/grp/data"), data)
+        assert h5.read_attribute_as_string_list("names", "/grp") == ["alpha", "beta"]
+        kinds = dict((n, k) for k, n in h5.list_children("/"))
+        assert kinds.get("grp") == "g"
+
+
+def test_import_sequential_mlp_end_to_end(tmp_path):
+    path = str(tmp_path / "mlp.h5")
+    W1 = RNG.normal(size=(4, 8)).astype(np.float32)
+    b1 = RNG.normal(size=(8,)).astype(np.float32)
+    W2 = RNG.normal(size=(8, 3)).astype(np.float32)
+    b2 = RNG.normal(size=(3,)).astype(np.float32)
+    _write_sequential_mlp(path, W1, b1, W2, b2)
+
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    np.testing.assert_allclose(np.asarray(net.params[0]["W"]), W1, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(net.params[1]["b"]), b2, rtol=1e-6)
+
+    x = RNG.normal(size=(5, 4)).astype(np.float32)
+    out = np.asarray(net.output(x))
+    # golden: hand-computed forward pass
+    h = np.maximum(x @ W1 + b1, 0.0)
+    logits = h @ W2 + b2
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    ref = e / e.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_import_cnn_with_flatten(tmp_path):
+    """Conv2D -> MaxPool -> Flatten -> Dense; flatten maps to the auto
+    CnnToFeedForward preprocessor."""
+    path = str(tmp_path / "cnn.h5")
+    kernel = RNG.normal(size=(3, 3, 1, 4)).astype(np.float32)  # HWIO
+    kbias = np.zeros(4, np.float32)
+    W = RNG.normal(size=(4 * 3 * 3, 2)).astype(np.float32)
+    b = np.zeros(2, np.float32)
+    model_config = {
+        "class_name": "Sequential",
+        "config": {"layers": [
+            {"class_name": "Conv2D",
+             "config": {"name": "conv", "filters": 4, "kernel_size": [3, 3],
+                        "strides": [1, 1], "padding": "valid",
+                        "activation": "relu",
+                        "batch_input_shape": [None, 8, 8, 1]}},
+            {"class_name": "MaxPooling2D",
+             "config": {"name": "pool", "pool_size": [2, 2],
+                        "strides": [2, 2], "padding": "valid"}},
+            {"class_name": "Flatten", "config": {"name": "flatten"}},
+            {"class_name": "Dense",
+             "config": {"name": "fc", "units": 2, "activation": "softmax"}},
+        ]},
+    }
+    with Hdf5Writer(path) as w:
+        w.write_attr_str("/", "model_config", json.dumps(model_config))
+        w.create_group("/model_weights")
+        for name, arrays in (("conv", {"kernel:0": kernel, "bias:0": kbias}),
+                             ("fc", {"kernel:0": W, "bias:0": b})):
+            g = f"/model_weights/{name}"
+            w.create_group(g)
+            w.create_group(f"{g}/{name}")
+            for an, av in arrays.items():
+                w.write_dataset(f"{g}/{name}/{an}", av)
+            w.write_attr_strlist(g, "weight_names",
+                                 [f"{name}/{k}" for k in arrays])
+
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = RNG.normal(size=(2, 8, 8, 1)).astype(np.float32)
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 2)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(net.params[0]["W"]), kernel, rtol=1e-6)
+
+
+def test_import_lstm_keras2(tmp_path):
+    path = str(tmp_path / "lstm.h5")
+    F, H, C = 3, 5, 2
+    kernel = RNG.normal(size=(F, 4 * H)).astype(np.float32)
+    rkernel = RNG.normal(size=(H, 4 * H)).astype(np.float32)
+    bias = RNG.normal(size=(4 * H,)).astype(np.float32)
+    W2 = RNG.normal(size=(H, C)).astype(np.float32)
+    b2 = np.zeros(C, np.float32)
+    model_config = {
+        "class_name": "Sequential",
+        "config": {"layers": [
+            {"class_name": "LSTM",
+             "config": {"name": "lstm", "units": H, "activation": "tanh",
+                        "recurrent_activation": "sigmoid",
+                        "batch_input_shape": [None, 7, F]}},
+            {"class_name": "GlobalAveragePooling1D", "config": {"name": "gap"}},
+            {"class_name": "Dense",
+             "config": {"name": "out", "units": C, "activation": "softmax"}},
+        ]},
+    }
+    with Hdf5Writer(path) as w:
+        w.write_attr_str("/", "model_config", json.dumps(model_config))
+        w.create_group("/model_weights")
+        for name, arrays in (
+                ("lstm", {"kernel:0": kernel, "recurrent_kernel:0": rkernel,
+                          "bias:0": bias}),
+                ("out", {"kernel:0": W2, "bias:0": b2})):
+            g = f"/model_weights/{name}"
+            w.create_group(g)
+            w.create_group(f"{g}/{name}")
+            for an, av in arrays.items():
+                w.write_dataset(f"{g}/{name}/{an}", av)
+            w.write_attr_strlist(g, "weight_names",
+                                 [f"{name}/{k}" for k in arrays])
+
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    np.testing.assert_allclose(np.asarray(net.params[0]["W"]), kernel, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(net.params[0]["RW"]), rkernel, rtol=1e-6)
+    x = RNG.normal(size=(2, 7, F)).astype(np.float32)
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, C)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)

@@ -1,0 +1,87 @@
+"""Model zoo smoke tests: each BASELINE config builds, runs a forward pass,
+and takes a training step on tiny shapes."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.models import char_rnn_lstm, lenet_mnist, resnet50
+from deeplearning4j_tpu.models.resnet import resnet_tiny
+from deeplearning4j_tpu.models.vgg import vgg16_cifar10
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+RNG = np.random.default_rng(1)
+
+
+def test_lenet_builds_and_steps():
+    net = MultiLayerNetwork(lenet_mnist()).init()
+    x = RNG.normal(size=(4, 28, 28, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[RNG.integers(0, 10, 4)]
+    out = net.output(x)
+    assert out.shape == (4, 10)
+    s0 = net.score(DataSet(x, y))
+    net.fit(DataSet(x, y), use_async=False)
+    assert np.isfinite(net.score(DataSet(x, y)))
+    # overfit a tiny batch: a few steps must reduce loss
+    for _ in range(10):
+        net.fit(DataSet(x, y), use_async=False)
+    assert net.score(DataSet(x, y)) < s0
+
+
+def test_vgg16_cifar_builds():
+    net = MultiLayerNetwork(vgg16_cifar10()).init()
+    x = RNG.normal(size=(2, 32, 32, 3)).astype(np.float32)
+    out = net.output(x)
+    assert out.shape == (2, 10)
+    assert net.num_params() > 10_000_000  # VGG16-CIFAR ~15M params
+
+
+def test_resnet_tiny_builds_and_steps():
+    conf = resnet_tiny()
+    net = ComputationGraph(conf).init()
+    x = RNG.normal(size=(2, 32, 32, 3)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[RNG.integers(0, 10, 2)]
+    out = net.output(x)
+    assert out.shape == (2, 10)
+    net.fit_batch(DataSet(x, y))
+    assert np.isfinite(net.score_value)
+
+
+def test_resnet50_param_count():
+    # full-size ResNet-50 must build (no forward — just shape inference)
+    conf = resnet50()
+    net = ComputationGraph(conf).init()
+    n = net.num_params()
+    # reference ResNet-50 ~25.6M params
+    assert 24_000_000 < n < 27_000_000, n
+
+
+def test_char_rnn_tbptt_trains():
+    V = 12
+    conf = char_rnn_lstm(vocab_size=V, hidden=16, layers=2, tbptt_length=5)
+    net = MultiLayerNetwork(conf).init()
+    B, T = 3, 12
+    idx = RNG.integers(0, V, (B, T + 1))
+    x = np.eye(V, dtype=np.float32)[idx[:, :-1]]
+    y = np.eye(V, dtype=np.float32)[idx[:, 1:]]
+    net.fit(DataSet(x, y), use_async=False)
+    # tBPTT: 12 steps / fwd 5 -> 3 slices
+    assert net.iteration_count == 3
+    assert np.isfinite(net.score_value)
+
+
+def test_char_rnn_stateful_sampling():
+    V = 8
+    conf = char_rnn_lstm(vocab_size=V, hidden=12, layers=1, tbptt_length=4)
+    net = MultiLayerNetwork(conf).init()
+    net.rnn_clear_previous_state()
+    x0 = np.eye(V, dtype=np.float32)[[2]]  # [1, V] single step
+    out1 = net.rnn_time_step(x0)
+    out2 = net.rnn_time_step(x0)
+    assert out1.shape == (1, V)
+    # state carried: same input gives different output on second step
+    assert not np.allclose(np.asarray(out1), np.asarray(out2))
+    net.rnn_clear_previous_state()
+    out3 = net.rnn_time_step(x0)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out3), rtol=1e-5)

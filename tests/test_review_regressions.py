@@ -87,3 +87,79 @@ def test_evaluation_2d_mask_respected():
     e.eval(labels, preds, mask=mask)
     assert e.examples == 2
     assert e.accuracy() == 1.0
+
+
+def test_frozen_layers_respected_in_computation_graph():
+    """Frozen layers must not update through ComputationGraph either."""
+    from deeplearning4j_tpu.nn.conf.inputs import InputType as IT
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater("sgd", learning_rate=0.5)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=8, activation="tanh", frozen=True), "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax"), "d1")
+            .set_outputs("out")
+            .set_input_types(IT.feed_forward(4))
+            .build())
+    net = ComputationGraph(conf).init()
+    before = np.asarray(net.params["d1"]["W"]).copy()
+    x = np.random.default_rng(0).normal(size=(10, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.arange(10) % 3]
+    for _ in range(3):
+        net.fit_batch(DataSet(x, y))
+    np.testing.assert_array_equal(np.asarray(net.params["d1"]["W"]), before)
+    assert not np.allclose(np.asarray(net.params["out"]["W"]),
+                           np.asarray(ComputationGraph(conf).init().params["out"]["W"]))
+
+
+def test_frozen_grads_excluded_from_clipping():
+    """Frozen gradients are zeroed BEFORE global-norm clipping, so the clip
+    scale is computed over unfrozen layers only."""
+    from deeplearning4j_tpu.nn.updater import compute_updates, build_optimizer
+    import jax
+    import jax.numpy as jnp
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater("sgd", learning_rate=1.0)
+            .gradient_normalization("clipl2perparamtype", threshold=1.0)
+            .list()
+            .layer(DenseLayer(n_out=4, activation="tanh", frozen=True))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.feed_forward(3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    # huge fake gradient on frozen layer, small on output
+    grads = [jax.tree.map(lambda x: jnp.ones_like(x) * 1e6, net.params[0]),
+             jax.tree.map(lambda x: jnp.ones_like(x) * 0.1, net.params[1])]
+    new_params, _ = compute_updates(net._tx, grads, net.opt_state, net.params,
+                                    net.layers, net.conf.training)
+    # frozen layer unchanged
+    np.testing.assert_array_equal(np.asarray(new_params[0]["W"]),
+                                  np.asarray(net.params[0]["W"]))
+    # output layer update reflects its own small gradient (norm < threshold
+    # => unclipped 0.1 step), not a scale polluted by the frozen 1e6 grads
+    delta = np.asarray(net.params[1]["b"]) - np.asarray(new_params[1]["b"])
+    np.testing.assert_allclose(delta, 0.1, rtol=1e-5)
+
+
+def test_frozen_layer_runs_in_inference_mode():
+    """Frozen BN must not update running stats during fit (ref: FrozenLayer
+    forces test-mode activation)."""
+    from deeplearning4j_tpu.nn.layers import BatchNormalization
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater("sgd", learning_rate=0.1)
+            .list()
+            .layer(DenseLayer(n_out=6, activation="tanh", frozen=True))
+            .layer(BatchNormalization(frozen=True))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    mean_before = np.asarray(net.states[1]["mean"]).copy()
+    x = np.random.default_rng(1).normal(5.0, 2.0, size=(20, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.arange(20) % 2]
+    net.fit(DataSet(x, y), use_async=False)
+    np.testing.assert_array_equal(np.asarray(net.states[1]["mean"]), mean_before)

@@ -1,0 +1,142 @@
+"""Transfer learning + early stopping tests — models the reference's
+TransferLearningMLNTest.java and early stopping test suite."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets import IrisDataSetIterator
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    InMemoryModelSaver, MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration, TransferLearning, TransferLearningHelper,
+)
+
+
+def _pretrained(seed=12345, lr=0.05):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater("adam", learning_rate=lr).weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(IrisDataSetIterator(batch_size=50), epochs=10, use_async=False)
+    return net
+
+
+def test_frozen_layers_do_not_update():
+    net = _pretrained()
+    tl = (TransferLearning.builder(net)
+          .set_feature_extractor(1)  # freeze layers 0 and 1
+          .build())
+    frozen_before = [np.asarray(tl.params[0]["W"]).copy(),
+                     np.asarray(tl.params[1]["W"]).copy()]
+    out_before = np.asarray(tl.params[2]["W"]).copy()
+    tl.fit(IrisDataSetIterator(batch_size=50), epochs=3, use_async=False)
+    np.testing.assert_array_equal(np.asarray(tl.params[0]["W"]), frozen_before[0])
+    np.testing.assert_array_equal(np.asarray(tl.params[1]["W"]), frozen_before[1])
+    assert not np.allclose(np.asarray(tl.params[2]["W"]), out_before)
+
+
+def test_n_out_replace_reinitializes():
+    net = _pretrained()
+    tl = (TransferLearning.builder(net)
+          .n_out_replace(1, 12)  # widen hidden layer 1: 8 -> 12
+          .build())
+    assert tl.params[1]["W"].shape == (16, 12)
+    assert tl.params[2]["W"].shape == (12, 3)
+    # layer 0 retains pretrained weights
+    np.testing.assert_array_equal(np.asarray(tl.params[0]["W"]),
+                                  np.asarray(net.params[0]["W"]))
+    out = tl.output(np.zeros((2, 4), np.float32))
+    assert out.shape == (2, 3)
+
+
+def test_remove_and_add_output_layer():
+    net = _pretrained()
+    tl = (TransferLearning.builder(net)
+          .remove_output_layer()
+          .add_layer(OutputLayer(n_out=5, activation="softmax", loss="mcxent"))
+          .build())
+    assert tl.output(np.zeros((2, 4), np.float32)).shape == (2, 5)
+    tl.fit(DataSet(np.random.default_rng(0).normal(size=(10, 4)).astype(np.float32),
+                   np.eye(5, dtype=np.float32)[np.arange(10) % 5]),
+           use_async=False)
+
+
+def test_fine_tune_configuration_overrides():
+    net = _pretrained()
+    tl = (TransferLearning.builder(net)
+          .fine_tune_configuration(FineTuneConfiguration(
+              updater="sgd", learning_rate=0.5, l2=0.01))
+          .build())
+    assert tl.conf.training.updater.name == "sgd"
+    assert tl.conf.training.updater.learning_rate == 0.5
+    assert tl.conf.layers[0].l2 == 0.01
+
+
+def test_transfer_helper_featurize():
+    net = _pretrained()
+    tl = (TransferLearning.builder(net).set_feature_extractor(0).build())
+    helper = TransferLearningHelper(tl)
+    x = np.random.default_rng(0).normal(size=(6, 4)).astype(np.float32)
+    feats = helper.featurize(x)
+    assert feats.shape == (6, 16)
+    top = helper.unfrozen_net()
+    out = top.output(feats)
+    assert out.shape == (6, 3)
+
+
+def test_early_stopping_max_epochs():
+    net = _pretrained()
+    it = IrisDataSetIterator(batch_size=50)
+    es = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(4)],
+        score_calculator=DataSetLossCalculator(IrisDataSetIterator(batch_size=150)),
+        model_saver=InMemoryModelSaver())
+    result = EarlyStoppingTrainer(es, net, it).fit()
+    assert result.termination_reason == "EpochTerminationCondition"
+    assert result.total_epochs == 4
+    assert result.best_model_epoch >= 1
+    assert np.isfinite(result.best_model_score)
+
+
+def test_early_stopping_score_improvement():
+    # tiny lr: no measurable improvement per epoch -> stops early
+    net = _pretrained(lr=1e-8)
+    it = IrisDataSetIterator(batch_size=50)
+    es = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[
+            MaxEpochsTerminationCondition(50),
+            ScoreImprovementEpochTerminationCondition(
+                max_epochs_without_improvement=2, min_improvement=1e-3)],
+        score_calculator=DataSetLossCalculator(IrisDataSetIterator(batch_size=150)))
+    result = EarlyStoppingTrainer(es, net, it).fit()
+    assert result.total_epochs < 50
+
+
+def test_early_stopping_nan_abort():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater("sgd", learning_rate=1e6)  # diverges
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    es = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(20)],
+        iteration_termination_conditions=[
+            MaxScoreIterationTerminationCondition(max_score=1e4)])
+    result = EarlyStoppingTrainer(es, net,
+                                  IrisDataSetIterator(batch_size=50)).fit()
+    assert result.termination_reason == "IterationTerminationCondition"
