@@ -220,8 +220,10 @@ class KerasModelImport:
             if wnames is None:
                 children = h5.list_children(group)
                 wnames = [n for k, n in children if k == "d"]
-                datasets = {n.split("/")[-1]: h5.read_dataset(f"{group}/{n}")
-                            for n in wnames}
+                datasets = {
+                    n.split("/")[-1].split(":")[0]:
+                        h5.read_dataset(f"{group}/{n}")
+                    for n in wnames}
             else:
                 datasets = {}
                 for wn in wnames:

@@ -1,0 +1,39 @@
+"""NLP / embedding-model stack.
+
+TPU-native re-design of ``deeplearning4j-nlp-parent/deeplearning4j-nlp``
+(ref: models/sequencevectors/SequenceVectors.java:187, models/word2vec/,
+models/paragraphvectors/, models/glove/, text/).
+
+The reference trains embeddings with `workers` hogwild threads doing
+racy per-pair updates on a shared lookup table
+(SequenceVectors.java:276-305). Here training is a single jitted JAX
+step over a *batch* of (center, context, negatives) index arrays with
+scatter-add updates — the TPU-idiomatic equivalent: no races by
+construction, and the batched gather/scatter + matmuls run on the MXU.
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import (  # noqa: F401
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+    CommonPreprocessor,
+    BasicLineIterator,
+    CollectionSentenceIterator,
+    LabelsSource,
+    STOP_WORDS,
+)
+from deeplearning4j_tpu.nlp.vocab import (  # noqa: F401
+    VocabWord,
+    VocabCache,
+    VocabConstructor,
+    build_huffman,
+)
+from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable  # noqa: F401
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors  # noqa: F401
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: F401
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors  # noqa: F401
+from deeplearning4j_tpu.nlp.glove import Glove  # noqa: F401
+from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer  # noqa: F401
+from deeplearning4j_tpu.nlp.vectorizers import (  # noqa: F401
+    BagOfWordsVectorizer,
+    TfidfVectorizer,
+)

@@ -1,0 +1,142 @@
+"""ParagraphVectors (doc2vec): PV-DBOW and PV-DM.
+
+Ref: deeplearning4j-nlp models/paragraphvectors/ParagraphVectors.java and
+the sequence learning algorithms models/embeddings/learning/impl/sequence/
+{DBOW,DM}.java. inferVector follows the reference's approach: freeze word
+weights, gradient-descend a fresh doc vector.
+
+TPU-native: doc vectors live in their own [num_docs, D] matrix trained by
+the same jitted batched steps as words (DBOW = skip-gram with the doc id
+as the "center"; DM = CBOW with the doc vector added to the context mean).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sequencevectors import (
+    SequenceVectors, _cbow_ns_step, _sgns_step, _cbow_windows)
+from deeplearning4j_tpu.nlp.tokenization import (DefaultTokenizerFactory,
+                                                 LabelsSource)
+
+
+class ParagraphVectors(SequenceVectors):
+    def __init__(self, sequence_algo: str = "dbow",
+                 tokenizer_factory: Optional[DefaultTokenizerFactory] = None,
+                 train_words: bool = True, **kwargs):
+        kwargs.setdefault("negative", 5)
+        super().__init__(**kwargs)
+        self.sequence_algo = sequence_algo.lower()
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.train_words = train_words
+        self.labels_source = LabelsSource()
+        self._label_index: Dict[str, int] = {}
+        self.doc_vectors: Optional[np.ndarray] = None
+
+    # -- fitting ------------------------------------------------------
+    def fit_documents(self, documents: Sequence[str],
+                      labels: Optional[Sequence[str]] = None) -> None:
+        """documents: raw strings; labels default to DOC_i."""
+        token_docs = [self.tokenizer_factory.create(d).get_tokens()
+                      for d in documents]
+        if labels is None:
+            labels = [self.labels_source.next_label() for _ in documents]
+        else:
+            for l in labels:
+                self.labels_source.store_label(l)
+        self._label_index = {l: i for i, l in enumerate(labels)}
+
+        if self.train_words or self.vocab is None:
+            self.build_vocab(token_docs)
+            super().fit(token_docs)  # word vectors first (as reference does)
+
+        lt = self.lookup_table
+        rng = np.random.default_rng(self.seed + 7)
+        D = self.layer_size
+        docs_idx = self._index_sequences(token_docs)
+        dv = ((rng.random((len(labels), D)) - 0.5) / D).astype(np.float32)
+        dvj = jnp.asarray(dv)
+        syn1neg = jnp.asarray(lt.syn1neg)
+
+        for epoch in range(max(1, self.epochs)):
+            lr = max(self.min_learning_rate,
+                     self.learning_rate * (1 - epoch / max(1, self.epochs)))
+            if self.sequence_algo == "dm":
+                # PV-DM: context words + doc vector -> center. Implemented
+                # as CBOW over an augmented "vocab" where row d of dvj acts
+                # as one extra context slot handled separately.
+                for d, seq in enumerate(docs_idx):
+                    if len(seq) < 2:
+                        continue
+                    ctx, mask, cents = _cbow_windows([seq], self.window)
+                    negs = lt.sample_negatives(
+                        rng, (len(cents), max(1, self.negative)))
+                    # Treat the doc vector as a one-row syn0 with all-ones
+                    # context of width 1 concatenated to the word context.
+                    doc_ids = np.zeros(len(cents), np.int32)
+                    one = np.ones((len(cents), 1), np.float32)
+                    aug_syn0 = jnp.concatenate(
+                        [dvj[d:d + 1], jnp.asarray(lt.syn0)], axis=0)
+                    aug_ctx = np.concatenate(
+                        [doc_ids[:, None], ctx + 1], axis=1)
+                    aug_mask = np.concatenate([one, mask], axis=1)
+                    aug_syn0, syn1neg = _cbow_ns_step(
+                        aug_syn0, syn1neg, jnp.asarray(aug_ctx),
+                        jnp.asarray(aug_mask), jnp.asarray(cents),
+                        jnp.asarray(negs), lr)
+                    dvj = dvj.at[d].set(aug_syn0[0])
+            else:
+                # PV-DBOW: doc id predicts each word in the doc (skip-gram
+                # with center = doc vector row).
+                cs, os_ = [], []
+                for d, seq in enumerate(docs_idx):
+                    cs.append(np.full(len(seq), d, np.int32))
+                    os_.append(seq)
+                cs = np.concatenate(cs) if cs else np.zeros(0, np.int32)
+                os_ = np.concatenate(os_) if os_ else np.zeros(0, np.int32)
+                order = rng.permutation(len(cs))
+                for s in range(0, len(order), self.batch_size):
+                    sel = order[s:s + self.batch_size]
+                    negs = lt.sample_negatives(
+                        rng, (len(sel), max(1, self.negative)))
+                    dvj, syn1neg = _sgns_step(
+                        dvj, syn1neg, jnp.asarray(cs[sel]),
+                        jnp.asarray(os_[sel]), jnp.asarray(negs), lr)
+        self.doc_vectors = np.asarray(dvj)
+        lt.syn1neg = np.asarray(syn1neg)
+
+    # -- queries ------------------------------------------------------
+    def get_doc_vector(self, label: str) -> Optional[np.ndarray]:
+        i = self._label_index.get(label)
+        return None if i is None else self.doc_vectors[i]
+
+    def infer_vector(self, text: str, steps: int = 50,
+                     lr: float = 0.05) -> np.ndarray:
+        """Gradient-descend a fresh doc vector against frozen word weights
+        (ref: ParagraphVectors.inferVector)."""
+        lt = self.lookup_table
+        toks = self.tokenizer_factory.create(text).get_tokens()
+        seq = np.array([i for i in (self.vocab.index_of(t) for t in toks)
+                        if i >= 0], dtype=np.int32)
+        rng = np.random.default_rng(self.seed + 99)
+        v = jnp.asarray(((rng.random(self.layer_size) - 0.5)
+                         / self.layer_size).astype(np.float32))[None, :]
+        syn1neg = jnp.asarray(lt.syn1neg)
+        if len(seq) == 0:
+            return np.asarray(v[0])
+        for _ in range(steps):
+            negs = lt.sample_negatives(rng, (len(seq), max(1, self.negative)))
+            centers = np.zeros(len(seq), np.int32)
+            v, _ = _sgns_step(v, syn1neg, jnp.asarray(centers),
+                              jnp.asarray(seq), jnp.asarray(negs), lr)
+            syn1neg = jnp.asarray(lt.syn1neg)  # keep outputs frozen
+        return np.asarray(v[0])
+
+    def similarity_to_label(self, text: str, label: str) -> float:
+        iv = self.infer_vector(text)
+        dv = self.get_doc_vector(label)
+        denom = (np.linalg.norm(iv) * np.linalg.norm(dv)) or 1e-12
+        return float(np.dot(iv, dv) / denom)

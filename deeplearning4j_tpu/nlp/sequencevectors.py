@@ -1,0 +1,298 @@
+"""SequenceVectors: the generic embedding trainer.
+
+Ref: deeplearning4j-nlp models/sequencevectors/SequenceVectors.java
+(:103-110 buildVocab, :187-330 fit loop) and the element learning
+algorithms models/embeddings/learning/impl/elements/{SkipGram,CBOW}.java.
+
+Reference design: `workers` threads pull sequences from an AsyncSequencer
+and do per-pair hogwild updates on the shared table
+(SequenceVectors.java:276-305). TPU-native design: the host vectorizes
+each epoch's training pairs into integer arrays (centers, contexts,
+negatives | huffman codes/points), and ONE jitted function applies a
+whole batch of SGNS/CBOW/HS updates via gather + matmul + scatter-add.
+Word2vec's lock-free races become deterministic batched accumulation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+from deeplearning4j_tpu.nlp.vocab import (VocabCache, VocabConstructor,
+                                          huffman_arrays)
+
+
+def _scatter_mean_add(mat, idx, upd):
+    """mat[idx] += sum of upd rows, scaled 1/sqrt(count) per index.
+
+    The reference's hogwild threads apply each pair's update sequentially
+    at the then-current weights, which self-limits as sigmoids saturate.
+    A batched scatter-SUM computes every duplicate-index update at the
+    same stale point, multiplying the effective LR by the duplicate count
+    (divergence for small vocabs); a scatter-MEAN starves progress to one
+    effective update per batch. 1/sqrt(count) is the stable compromise —
+    validated to converge where sum diverges and mean stalls — and equals
+    the plain sum when indices are unique (large vocabs)."""
+    cnt = jnp.zeros(mat.shape[0], mat.dtype).at[idx].add(1.0)
+    tot = jnp.zeros_like(mat).at[idx].add(upd)
+    return mat + tot / jnp.sqrt(jnp.maximum(cnt, 1.0))[:, None]
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _sgns_step(syn0, syn1neg, centers, contexts, negs, lr):
+    """One batched skip-gram negative-sampling update.
+
+    For each pair (c, o) with K negatives n_k: standard SGNS gradients
+    (ref: SkipGram.java iterateSample — per-pair scalar loop there).
+    """
+    v = syn0[centers]                                   # [B, D]
+    targets = jnp.concatenate([contexts[:, None], negs], axis=1)  # [B,1+K]
+    labels = jnp.concatenate(
+        [jnp.ones_like(contexts[:, None], dtype=syn0.dtype),
+         jnp.zeros(negs.shape, dtype=syn0.dtype)], axis=1)        # [B,1+K]
+    u = syn1neg[targets]                                # [B, 1+K, D]
+    score = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", v, u))
+    g = (labels - score) * lr                           # [B, 1+K]
+    dv = jnp.einsum("bk,bkd->bd", g, u)
+    du = g[..., None] * v[:, None, :]                   # [B, 1+K, D]
+    syn0 = _scatter_mean_add(syn0, centers, dv)
+    syn1neg = _scatter_mean_add(syn1neg, targets.reshape(-1),
+                                du.reshape(-1, du.shape[-1]))
+    return syn0, syn1neg
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _hs_step(syn0, syn1, centers, points, codes, mask, lr):
+    """One batched hierarchical-softmax update. points/codes/mask are the
+    context word's padded Huffman path ([B, L]); label = 1 - code
+    (word2vec convention, ref: SkipGram.java / Huffman path usage)."""
+    v = syn0[centers]                                   # [B, D]
+    u = syn1[points]                                    # [B, L, D]
+    score = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", v, u))
+    g = ((1.0 - codes) - score) * lr * mask             # [B, L]
+    dv = jnp.einsum("bl,bld->bd", g, u)
+    du = g[..., None] * v[:, None, :]
+    syn0 = _scatter_mean_add(syn0, centers, dv)
+    # Padded path slots (index 0, mask 0) must not inflate the count
+    # normalizer for syn1 row 0 — weight counts by the mask.
+    flat_pts = points.reshape(-1)
+    cnt = jnp.zeros(syn1.shape[0], syn1.dtype).at[flat_pts].add(
+        mask.reshape(-1))
+    tot = jnp.zeros_like(syn1).at[flat_pts].add(
+        du.reshape(-1, du.shape[-1]))
+    syn1 = syn1 + tot / jnp.sqrt(jnp.maximum(cnt, 1.0))[:, None]
+    return syn0, syn1
+
+
+@partial(jax.jit, donate_argnums=(0, 1), static_argnames=("update_inputs",))
+def _cbow_ns_step(syn0, syn1neg, ctx, ctx_mask, centers, negs, lr,
+                  update_inputs=True):
+    """Batched CBOW with negative sampling: h = mean of context vectors
+    predicts the center word (ref: CBOW.java). The input-side gradient is
+    applied to every real context word (word2vec cbow_mean semantics)."""
+    cvecs = syn0[ctx]                                   # [B, W, D]
+    cnt = jnp.maximum(ctx_mask.sum(axis=1, keepdims=True), 1.0)
+    h = (cvecs * ctx_mask[..., None]).sum(axis=1) / cnt  # [B, D]
+    targets = jnp.concatenate([centers[:, None], negs], axis=1)
+    labels = jnp.concatenate(
+        [jnp.ones_like(centers[:, None], dtype=syn0.dtype),
+         jnp.zeros(negs.shape, dtype=syn0.dtype)], axis=1)
+    u = syn1neg[targets]
+    score = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, u))
+    g = (labels - score) * lr
+    dh = jnp.einsum("bk,bkd->bd", g, u)                 # [B, D]
+    du = g[..., None] * h[:, None, :]
+    syn1neg = _scatter_mean_add(syn1neg, targets.reshape(-1),
+                                du.reshape(-1, du.shape[-1]))
+    if update_inputs:
+        dctx = dh[:, None, :] * ctx_mask[..., None]     # [B, W, D]
+        # Padded ctx slots point at word 0 but carry zero updates; the
+        # count-normalizer must not count them, so fold the mask into a
+        # sentinel by scattering only masked rows' weight.
+        flat_idx = ctx.reshape(-1)
+        flat_upd = dctx.reshape(-1, dctx.shape[-1])
+        cnt = jnp.zeros(syn0.shape[0], syn0.dtype).at[flat_idx].add(
+            ctx_mask.reshape(-1))
+        tot = jnp.zeros_like(syn0).at[flat_idx].add(flat_upd)
+        syn0 = syn0 + tot / jnp.sqrt(jnp.maximum(cnt, 1.0))[:, None]
+    return syn0, syn1neg
+
+
+def _skipgram_pairs(seqs: List[np.ndarray], window: int,
+                    rng: np.random.Generator) -> Tuple[np.ndarray, np.ndarray]:
+    """All (center, context) pairs with per-center random reduced window
+    (word2vec's `b = random % window`), built vectorized on the host."""
+    cs, os_ = [], []
+    for s in seqs:
+        n = len(s)
+        if n < 2:
+            continue
+        b = rng.integers(1, window + 1, size=n)  # actual half-window per pos
+        for off in range(1, window + 1):
+            sel = b >= off
+            idx = np.arange(n)
+            left = idx - off
+            ok = sel & (left >= 0)
+            cs.append(s[idx[ok]]); os_.append(s[left[ok]])
+            right = idx + off
+            ok = sel & (right < n)
+            cs.append(s[idx[ok]]); os_.append(s[right[ok]])
+    if not cs:
+        return (np.zeros(0, np.int32),) * 2
+    return (np.concatenate(cs).astype(np.int32),
+            np.concatenate(os_).astype(np.int32))
+
+
+def _cbow_windows(seqs: List[np.ndarray], window: int
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(context [N, 2*window], mask, center [N]) arrays for CBOW."""
+    ctxs, masks, cents = [], [], []
+    W = 2 * window
+    for s in seqs:
+        n = len(s)
+        if n < 2:
+            continue
+        for i in range(n):
+            lo, hi = max(0, i - window), min(n, i + window + 1)
+            c = [s[j] for j in range(lo, hi) if j != i]
+            row = np.zeros(W, np.int32)
+            m = np.zeros(W, np.float32)
+            row[:len(c)] = c
+            m[:len(c)] = 1.0
+            ctxs.append(row); masks.append(m); cents.append(s[i])
+    if not cents:
+        return np.zeros((0, W), np.int32), np.zeros((0, W), np.float32), \
+            np.zeros(0, np.int32)
+    return np.stack(ctxs), np.stack(masks), np.asarray(cents, np.int32)
+
+
+class SequenceVectors:
+    """Generic embedding trainer over element sequences.
+
+    elements_algo: 'skipgram' | 'cbow' (ref: learning/impl/elements/).
+    use_hierarchic_softmax / negative mirror the reference's knobs.
+    """
+
+    def __init__(self, layer_size: int = 100, window: int = 5,
+                 min_word_frequency: int = 1, epochs: int = 1,
+                 learning_rate: float = 0.025, min_learning_rate: float = 1e-4,
+                 negative: int = 5, use_hierarchic_softmax: bool = False,
+                 sampling: float = 0.0, elements_algo: str = "skipgram",
+                 batch_size: int = 512, seed: int = 123,
+                 stop_words: Sequence[str] = ()):
+        self.layer_size = layer_size
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.negative = negative
+        self.use_hs = use_hierarchic_softmax or negative <= 0
+        self.sampling = sampling
+        self.elements_algo = elements_algo.lower()
+        self.batch_size = batch_size
+        self.seed = seed
+        self.stop_words = stop_words
+        self.vocab: Optional[VocabCache] = None
+        self.lookup_table: Optional[InMemoryLookupTable] = None
+
+    # -- vocab --------------------------------------------------------
+    def build_vocab(self, token_sequences: Iterable[Sequence[str]]) -> None:
+        self.vocab = VocabConstructor(
+            self.min_word_frequency, self.stop_words).build_vocab(
+                token_sequences)
+        self.lookup_table = InMemoryLookupTable(
+            self.vocab, self.layer_size, self.seed,
+            use_hs=self.use_hs, negative=self.negative)
+
+    def _index_sequences(self, token_sequences: Iterable[Sequence[str]]
+                         ) -> List[np.ndarray]:
+        assert self.vocab is not None
+        out = []
+        for seq in token_sequences:
+            idx = [self.vocab.index_of(t) for t in seq]
+            out.append(np.array([i for i in idx if i >= 0], dtype=np.int32))
+        return out
+
+    def _subsample(self, seqs: List[np.ndarray],
+                   rng: np.random.Generator) -> List[np.ndarray]:
+        """Frequent-word subsampling (word2vec `sample` knob; ref
+        SkipGram.java pre-filtering)."""
+        if self.sampling <= 0 or self.vocab is None:
+            return seqs
+        counts = np.array([w.count for w in self.vocab.vocab_words()])
+        freq = counts / max(self.vocab.total_word_count, 1.0)
+        keep = np.minimum(
+            1.0, (np.sqrt(freq / self.sampling) + 1) * self.sampling / np.maximum(freq, 1e-12))
+        return [s[rng.random(len(s)) < keep[s]] for s in seqs]
+
+    # -- training -----------------------------------------------------
+    def fit(self, token_sequences: Sequence[Sequence[str]]) -> None:
+        if self.vocab is None:
+            self.build_vocab(token_sequences)
+        lt = self.lookup_table
+        assert lt is not None
+        rng = np.random.default_rng(self.seed)
+        seqs0 = self._index_sequences(token_sequences)
+        syn0 = jnp.asarray(lt.syn0)
+        syn1 = jnp.asarray(lt.syn1)
+        syn1neg = jnp.asarray(lt.syn1neg)
+        if self.use_hs:
+            w_codes, w_points, w_mask = huffman_arrays(self.vocab)
+
+        total_steps = max(1, self.epochs)
+        for epoch in range(self.epochs):
+            # Linear LR decay across epochs (SequenceVectors decays per
+            # processed word; per-epoch is the batched equivalent).
+            frac = epoch / total_steps
+            lr = max(self.min_learning_rate,
+                     self.learning_rate * (1.0 - frac))
+            seqs = self._subsample(seqs0, rng)
+            if self.elements_algo == "cbow":
+                ctx, mask, cents = _cbow_windows(seqs, self.window)
+                order = rng.permutation(len(cents))
+                for s in range(0, len(order), self.batch_size):
+                    sel = order[s:s + self.batch_size]
+                    negs = lt.sample_negatives(
+                        rng, (len(sel), max(1, self.negative)))
+                    syn0, syn1neg = _cbow_ns_step(
+                        syn0, syn1neg, jnp.asarray(ctx[sel]),
+                        jnp.asarray(mask[sel]), jnp.asarray(cents[sel]),
+                        jnp.asarray(negs), lr)
+            else:
+                cs, os_ = _skipgram_pairs(seqs, self.window, rng)
+                order = rng.permutation(len(cs))
+                for s in range(0, len(order), self.batch_size):
+                    sel = order[s:s + self.batch_size]
+                    if self.use_hs:
+                        pts = w_points[os_[sel]]
+                        cds = w_codes[os_[sel]]
+                        msk = w_mask[os_[sel]]
+                        syn0, syn1 = _hs_step(
+                            syn0, syn1, jnp.asarray(cs[sel]),
+                            jnp.asarray(pts), jnp.asarray(cds),
+                            jnp.asarray(msk), lr)
+                    else:
+                        negs = lt.sample_negatives(
+                            rng, (len(sel), max(1, self.negative)))
+                        syn0, syn1neg = _sgns_step(
+                            syn0, syn1neg, jnp.asarray(cs[sel]),
+                            jnp.asarray(os_[sel]), jnp.asarray(negs), lr)
+        lt.syn0 = np.asarray(syn0)
+        lt.syn1 = np.asarray(syn1)
+        lt.syn1neg = np.asarray(syn1neg)
+
+    # -- queries delegate to the lookup table -------------------------
+    def similarity(self, a: str, b: str) -> float:
+        return self.lookup_table.similarity(a, b)
+
+    def words_nearest(self, word, top_n: int = 10) -> List[str]:
+        return self.lookup_table.words_nearest(word, top_n)
+
+    def get_word_vector(self, word: str):
+        return self.lookup_table.get_word_vector(word)

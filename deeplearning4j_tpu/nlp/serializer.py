@@ -1,0 +1,85 @@
+"""WordVectorSerializer: persist/load word vectors.
+
+Ref: deeplearning4j-nlp models/embeddings/loader/WordVectorSerializer.java
+(2824 LoC: word2vec C text/binary formats + full-model zip). Provided
+here: the word2vec C *text* format (interoperable with the reference's
+writeWordVectors/loadTxtVectors) and a full-model npz+json bundle.
+"""
+
+from __future__ import annotations
+
+import json
+import zipfile
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord, build_huffman
+
+
+class WordVectorSerializer:
+    @staticmethod
+    def write_word2vec_format(table: InMemoryLookupTable, path) -> None:
+        """word2vec C text format: header "V D", then "word f f f ..."."""
+        lines = [f"{len(table.vocab)} {table.vector_length}"]
+        for vw in table.vocab.vocab_words():
+            vec = " ".join(f"{v:.6f}" for v in table.syn0[vw.index])
+            lines.append(f"{vw.word} {vec}")
+        Path(path).write_text("\n".join(lines) + "\n", encoding="utf-8")
+
+    @staticmethod
+    def read_word2vec_format(path) -> InMemoryLookupTable:
+        text = Path(path).read_text(encoding="utf-8").splitlines()
+        header = text[0].split()
+        v, d = int(header[0]), int(header[1])
+        cache = VocabCache()
+        vecs = np.zeros((v, d), dtype=np.float32)
+        for i, line in enumerate(text[1:1 + v]):
+            parts = line.rstrip().split(" ")
+            word, vals = parts[0], parts[1:]
+            cache.add(VocabWord(word, 1.0))
+            vecs[i] = np.array([float(x) for x in vals], dtype=np.float32)
+        cache.total_word_count = float(v)
+        build_huffman(cache)
+        table = InMemoryLookupTable(cache, d)
+        table.syn0 = vecs
+        return table
+
+    @staticmethod
+    def write_full_model(table: InMemoryLookupTable, path) -> None:
+        """Zip bundle: vocab.json + weights.npz (syn0/syn1/syn1neg) —
+        the analog of the reference's full-model format that preserves
+        HS/NS output weights for continued training."""
+        path = Path(path)
+        vocab_meta = [{"word": w.word, "count": w.count,
+                       "codes": w.codes, "points": w.points}
+                      for w in table.vocab.vocab_words()]
+        with zipfile.ZipFile(path, "w") as zf:
+            zf.writestr("vocab.json", json.dumps(
+                {"vector_length": table.vector_length, "words": vocab_meta}))
+            import io
+            buf = io.BytesIO()
+            np.savez(buf, syn0=table.syn0, syn1=table.syn1,
+                     syn1neg=table.syn1neg)
+            zf.writestr("weights.npz", buf.getvalue())
+
+    @staticmethod
+    def read_full_model(path) -> InMemoryLookupTable:
+        import io
+        with zipfile.ZipFile(Path(path), "r") as zf:
+            meta = json.loads(zf.read("vocab.json"))
+            npz = np.load(io.BytesIO(zf.read("weights.npz")))
+        cache = VocabCache()
+        for m in meta["words"]:
+            vw = VocabWord(m["word"], m["count"])
+            vw.codes, vw.points = m["codes"], m["points"]
+            cache.add(vw)
+        cache.total_word_count = float(
+            sum(w.count for w in cache.vocab_words()))
+        table = InMemoryLookupTable(cache, meta["vector_length"])
+        table.syn0 = npz["syn0"]
+        table.syn1 = npz["syn1"]
+        table.syn1neg = npz["syn1neg"]
+        return table

@@ -288,9 +288,18 @@ class NeuralNetConfiguration:
         return self
 
     # ---- training config ----
+    #: the Updater enum (ref: nn/conf/Updater.java:9-11 — SGD, ADAM,
+    #: ADADELTA, NESTEROVS, ADAGRAD, RMSPROP, NONE + ADAMAX)
+    KNOWN_UPDATERS = ("sgd", "adam", "adamax", "adadelta", "nesterovs",
+                      "adagrad", "rmsprop", "none")
+
     def updater(self, name: str, **kwargs) -> "NeuralNetConfiguration":
         # mutate in place so the fluent chain is order-insensitive
         # (.learning_rate(x).updater('adam') keeps x, like the reference)
+        if name.lower() not in self.KNOWN_UPDATERS:
+            raise ValueError(
+                f"Unknown updater {name!r}; expected one of "
+                f"{self.KNOWN_UPDATERS}")
         u = self._training.updater
         u.name = name.lower()
         for k, v in kwargs.items():

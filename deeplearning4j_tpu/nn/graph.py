@@ -184,6 +184,8 @@ class ComputationGraph:
         layer_list = [self.conf.nodes[n].layer for n in self._layer_nodes]
         param_list = [params[n] for n in self._layer_nodes]
         total = total + l1_l2_penalty(param_list, layer_list)
+        from deeplearning4j_tpu.nn.multilayer import _sum_aux_losses
+        total = total + _sum_aux_losses(new_states)
         return total, new_states
 
     def score(self, data: Union[DataSet, MultiDataSet], train: bool = False) -> float:

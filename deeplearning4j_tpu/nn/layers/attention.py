@@ -42,13 +42,16 @@ def attention_reference(q: Array, k: Array, v: Array,
 
 def blockwise_attention(q: Array, k: Array, v: Array, *,
                         block_size: int = 512, causal: bool = False,
-                        q_offset: int = 0) -> Tuple[Array, Array, Array]:
+                        q_offset: int = 0,
+                        kv_mask: Optional[Array] = None
+                        ) -> Tuple[Array, Array, Array]:
     """Flash-style blockwise attention over the KV axis with running
     log-sum-exp, returning (unnormalized_out, running_max, running_lse) so
     partial results compose across ring steps.
 
     q,k,v: [B, H, T, D]. ``q_offset``: global position of q block 0 —
     needed for causal masking when q is a sequence shard (ring attention).
+    ``kv_mask``: [B, TK] validity of key positions (sequence padding).
     Scanning KV blocks keeps the T x T score matrix out of HBM, which is
     what lets sequence length scale past VMEM on TPU.
     """
@@ -62,16 +65,21 @@ def blockwise_attention(q: Array, k: Array, v: Array, *,
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
     kb = k.reshape(B, H, n_blocks, bs, D).transpose(2, 0, 1, 3, 4)
     vb = v.reshape(B, H, n_blocks, bs, D).transpose(2, 0, 1, 3, 4)
+    if kv_mask is not None:
+        mb = jnp.pad(kv_mask.astype(bool), ((0, 0), (0, pad)))
+        mb = mb.reshape(B, n_blocks, bs).transpose(1, 0, 2)  # [n, B, bs]
+    else:
+        mb = jnp.ones((n_blocks, B, bs), bool)
     scale = 1.0 / math.sqrt(D)
     q_pos = q_offset + jnp.arange(TQ)
 
     def body(carry, blk):
         out, m, lse = carry
-        kblk, vblk, bidx = blk
+        kblk, vblk, mblk, bidx = blk
         logits = jnp.einsum("bhqd,bhkd->bhqk", q, kblk) * scale
         k_pos = bidx * bs + jnp.arange(bs)
-        valid = k_pos < TK
-        logits = jnp.where(valid[None, None, None, :], logits, NEG_INF)
+        valid = (k_pos < TK)[None, :] & mblk          # [B, bs]
+        logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
         if causal:
             cm = q_pos[:, None] >= k_pos[None, :]
             logits = jnp.where(cm[None, None], logits, NEG_INF)
@@ -91,7 +99,7 @@ def blockwise_attention(q: Array, k: Array, v: Array, *,
     lse0 = q[..., 0] * 0.0
     (out, m, lse), _ = jax.lax.scan(
         body, (out0, m0, lse0),
-        (kb, vb, jnp.arange(n_blocks)))
+        (kb, vb, mb, jnp.arange(n_blocks)))
     return out, m, lse
 
 
@@ -147,7 +155,7 @@ class SelfAttentionLayer(BaseLayerConf):
         v = self._split_heads(x @ params["Wv"])
         if self.use_blockwise:
             out, _, lse = blockwise_attention(q, k, v, block_size=self.block_size,
-                                              causal=self.causal)
+                                              causal=self.causal, kv_mask=mask)
             out = finalize_attention(out, lse)
         else:
             out = attention_reference(q, k, v, causal=self.causal, mask=mask)

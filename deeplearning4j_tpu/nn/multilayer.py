@@ -48,6 +48,19 @@ def _dtype_of(name: str):
             "float16": jnp.float16, "float64": jnp.float64}[name]
 
 
+def _sum_aux_losses(states) -> Array:
+    """Sum differentiable auxiliary losses layers surface via their state
+    (e.g. MoE load-balancing loss, parallel/expert.py). Must be added to
+    the objective INSIDE the grad closure — the states pytree itself is
+    returned through has_aux and carries no gradient."""
+    total = jnp.zeros(())
+    leaves = states.values() if isinstance(states, dict) else states
+    for st in leaves:
+        if isinstance(st, dict) and "aux_loss" in st:
+            total = total + st["aux_loss"]
+    return total
+
+
 class MultiLayerNetwork:
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
@@ -162,6 +175,10 @@ class MultiLayerNetwork:
         return np.asarray(jnp.argmax(self.output(x), axis=-1))
 
     # ------------------------------------------------------------------- loss
+    @staticmethod
+    def _aux_losses(states) -> "jnp.ndarray":
+        return _sum_aux_losses(states)
+
     def _loss_fn(self, params, states, features, labels, fmask, lmask, rng,
                  train: bool = True):
         h, _, new_states, _, cur_mask = self._forward(
@@ -173,7 +190,7 @@ class MultiLayerNetwork:
             cur_mask if labels.ndim > 2 else None)
         data_loss = out_layer.compute_loss(params[-1], h, labels, mask=mask)
         reg = l1_l2_penalty(params, self.layers)
-        return data_loss + reg, new_states
+        return data_loss + reg + _sum_aux_losses(new_states), new_states
 
     def score(self, dataset: Optional[DataSet] = None, train: bool = False) -> float:
         """Mean per-example loss + regularization
@@ -206,7 +223,8 @@ class MultiLayerNetwork:
                     cur_mask if labels.ndim > 2 else None)
                 data_loss = out_layer.compute_loss(p[-1], h, labels, mask=mask)
                 reg = l1_l2_penalty(p, self.layers)
-                return data_loss + reg, (new_states, h)
+                return (data_loss + reg + _sum_aux_losses(new_states),
+                        (new_states, h))
 
             (loss, (new_states, h_last)), grads = jax.value_and_grad(
                 loss_for_grad, has_aux=True)(params)

@@ -75,20 +75,29 @@ class ParallelTrainer:
                 # per-worker model copies
                 def micro(carry, mb):
                     g_acc, l_acc, st = carry
-                    f, l, r = mb
+                    f, l, fm, lm, r = mb
                     (loss, st2), g = jax.value_and_grad(
-                        loss_fn, has_aux=True)(params, st, f, l, None, None, r)
+                        loss_fn, has_aux=True)(params, st, f, l, fm, lm, r)
                     g_acc = jax.tree.map(lambda a, b: a + b, g_acc, g)
                     return (g_acc, l_acc + loss, st2), None
 
                 B = feats.shape[0]
+                if B % accum != 0:
+                    raise ValueError(
+                        f"batch size {B} not divisible by "
+                        f"gradient_accumulation={accum}")
                 mb_size = B // accum
-                f_mb = feats.reshape((accum, mb_size) + feats.shape[1:])
-                l_mb = labels.reshape((accum, mb_size) + labels.shape[1:])
+
+                def split(x):
+                    return (None if x is None else
+                            x.reshape((accum, mb_size) + x.shape[1:]))
+
                 rngs = jax.random.split(rng, accum)
                 zero_g = jax.tree.map(jnp.zeros_like, params)
                 (grads, loss, new_states), _ = jax.lax.scan(
-                    micro, (zero_g, jnp.zeros(()), states), (f_mb, l_mb, rngs))
+                    micro, (zero_g, jnp.zeros(()), states),
+                    (split(feats), split(labels), split(fmask),
+                     split(lmask), rngs))
                 grads = jax.tree.map(lambda g: g / accum, grads)
                 loss = loss / accum
             new_params, new_opt = compute_updates(

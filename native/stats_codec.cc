@@ -66,7 +66,7 @@ int stats_decode_header(const uint8_t *buf, int64_t len, int64_t *iteration,
                         int64_t *timestamp_ms, double *score,
                         double *samples_per_sec, double *batches_per_sec,
                         int32_t *n_series) {
-  if (len < 48) return -1;
+  if (len < 52) return -1;  // header fields end at 48; n_series at 48-51
   uint32_t magic;
   memcpy(&magic, buf, 4);
   if (magic != MAGIC) return -2;

@@ -1,0 +1,198 @@
+"""NLP stack tests — Word2Vec/CBOW/HS/GloVe/ParagraphVectors sanity on a
+tiny synthetic corpus with two clearly-separated topic clusters, mirroring
+the reference's nearest-neighbor-style asserts
+(deeplearning4j-nlp word2vec tests: wordsNearest("day") contains "night").
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BagOfWordsVectorizer, BasicLineIterator, CollectionSentenceIterator,
+    CommonPreprocessor, DefaultTokenizerFactory, Glove, LabelsSource,
+    NGramTokenizerFactory, ParagraphVectors, TfidfVectorizer, VocabCache,
+    VocabConstructor, Word2Vec, WordVectorSerializer, build_huffman,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabWord, huffman_arrays
+
+
+def _corpus(n=300, seed=0):
+    """Two topics; words within a topic co-occur, across topics never."""
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "mouse", "horse", "cow"]
+    foods = ["apple", "bread", "cheese", "rice", "soup"]
+    sents = []
+    for _ in range(n):
+        pool = animals if rng.random() < 0.5 else foods
+        sents.append(" ".join(rng.choice(pool, size=6)))
+    return sents, animals, foods
+
+
+# ---------- tokenization ----------
+
+def test_default_tokenizer_and_preprocessor():
+    tf = DefaultTokenizerFactory(CommonPreprocessor())
+    toks = tf.create("Hello, World! 123 foo.bar").get_tokens()
+    assert toks == ["hello", "world", "foobar"]
+
+
+def test_ngram_tokenizer():
+    tf = NGramTokenizerFactory(min_n=1, max_n=2)
+    toks = tf.create("a b c").get_tokens()
+    assert "a b" in toks and "b c" in toks and "a" in toks
+
+
+def test_sentence_iterators(tmp_path):
+    it = CollectionSentenceIterator(["s one", "s two"])
+    assert list(it) == ["s one", "s two"]
+    assert list(it) == ["s one", "s two"]  # reset works
+    p = tmp_path / "corpus.txt"
+    p.write_text("line1\n\nline2\n")
+    assert list(BasicLineIterator(p)) == ["line1", "line2"]
+
+
+def test_labels_source():
+    ls = LabelsSource()
+    assert ls.next_label() == "DOC_0"
+    assert ls.next_label() == "DOC_1"
+    assert ls.get_labels() == ["DOC_0", "DOC_1"]
+
+
+# ---------- vocab + huffman ----------
+
+def test_vocab_construction_orders_by_frequency():
+    seqs = [["a", "a", "a", "b", "b", "c"]]
+    cache = VocabConstructor(min_word_frequency=2).build_vocab(seqs)
+    assert len(cache) == 2  # c filtered
+    assert cache.word_at(0) == "a" and cache.word_at(1) == "b"
+    assert cache.word_frequency("a") == 3
+
+
+def test_huffman_codes_are_prefix_free():
+    cache = VocabCache()
+    for w, c in [("a", 40), ("b", 30), ("c", 20), ("d", 10)]:
+        cache.add(VocabWord(w, c))
+    build_huffman(cache)
+    codes = ["".join(map(str, w.codes)) for w in cache.vocab_words()]
+    assert len(set(codes)) == 4
+    for i, a in enumerate(codes):
+        for j, b in enumerate(codes):
+            if i != j:
+                assert not a.startswith(b)
+    # more frequent -> shorter-or-equal code
+    assert len(codes[0]) <= len(codes[-1])
+    cds, pts, msk = huffman_arrays(cache)
+    assert cds.shape == pts.shape == msk.shape
+    assert pts.max() < len(cache) - 1  # inner node ids < V-1 roots
+
+
+# ---------- word2vec ----------
+
+@pytest.mark.parametrize("kwargs", [
+    dict(negative=5),                                 # skip-gram + NS
+    dict(negative=0, use_hierarchic_softmax=True),    # skip-gram + HS
+    dict(elements_algo="cbow", negative=5),           # CBOW + NS
+])
+def test_word2vec_separates_topics(kwargs):
+    sents, animals, foods = _corpus()
+    w2v = Word2Vec(layer_size=32, window=3, epochs=8, seed=1,
+                   learning_rate=0.05, **kwargs)
+    w2v.fit(sents)
+    within = w2v.similarity("cat", "dog")
+    across = w2v.similarity("cat", "bread")
+    assert within > across, (within, across)
+    nearest = w2v.words_nearest("cat", top_n=4)
+    assert sum(w in animals for w in nearest) >= 3, nearest
+
+
+def test_word2vec_vector_shape_and_unknown():
+    sents, _, _ = _corpus(50)
+    w2v = Word2Vec(layer_size=16, epochs=1)
+    w2v.fit(sents)
+    assert w2v.get_word_vector("cat").shape == (16,)
+    assert w2v.get_word_vector("zzz") is None
+    assert np.isnan(w2v.similarity("cat", "zzz"))
+
+
+def test_subsampling_runs():
+    sents, _, _ = _corpus(50)
+    w2v = Word2Vec(layer_size=8, epochs=2, sampling=1e-3)
+    w2v.fit(sents)
+    assert w2v.get_word_vector("cat") is not None
+
+
+# ---------- serializer ----------
+
+def test_word2vec_text_roundtrip(tmp_path):
+    sents, _, _ = _corpus(50)
+    w2v = Word2Vec(layer_size=8, epochs=1)
+    w2v.fit(sents)
+    p = tmp_path / "vecs.txt"
+    WordVectorSerializer.write_word2vec_format(w2v.lookup_table, p)
+    table = WordVectorSerializer.read_word2vec_format(p)
+    np.testing.assert_allclose(
+        table.get_word_vector("cat"), w2v.get_word_vector("cat"), atol=1e-5)
+    assert len(table.vocab) == len(w2v.vocab)
+
+
+def test_full_model_roundtrip(tmp_path):
+    sents, _, _ = _corpus(50)
+    w2v = Word2Vec(layer_size=8, epochs=1)
+    w2v.fit(sents)
+    p = tmp_path / "model.zip"
+    WordVectorSerializer.write_full_model(w2v.lookup_table, p)
+    table = WordVectorSerializer.read_full_model(p)
+    np.testing.assert_allclose(table.syn0, w2v.lookup_table.syn0)
+    np.testing.assert_allclose(table.syn1neg, w2v.lookup_table.syn1neg)
+    vw = table.vocab.word_for("cat")
+    assert vw.codes == w2v.vocab.word_for("cat").codes
+
+
+# ---------- glove ----------
+
+def test_glove_separates_topics():
+    sents, animals, _ = _corpus(200, seed=3)
+    glove = Glove(layer_size=16, window=3, epochs=30, seed=2)
+    glove.fit(sents)
+    assert glove.similarity("cat", "dog") > glove.similarity("cat", "bread")
+
+
+# ---------- paragraph vectors ----------
+
+def test_paragraph_vectors_dbow_groups_docs():
+    sents, _, _ = _corpus(60, seed=5)
+    pv = ParagraphVectors(layer_size=16, epochs=6, seed=4,
+                          sequence_algo="dbow")
+    labels = [f"DOC_{i}" for i in range(len(sents))]
+    pv.fit_documents(sents, labels)
+    assert pv.get_doc_vector("DOC_0").shape == (16,)
+    iv = pv.infer_vector(sents[0])
+    assert iv.shape == (16,) and np.isfinite(iv).all()
+
+
+def test_paragraph_vectors_dm_runs():
+    sents, _, _ = _corpus(20, seed=6)
+    pv = ParagraphVectors(layer_size=8, epochs=2, seed=4, sequence_algo="dm")
+    pv.fit_documents(sents[:10])
+    assert pv.doc_vectors.shape == (10, 8)
+    assert np.isfinite(pv.doc_vectors).all()
+
+
+# ---------- vectorizers ----------
+
+def test_bag_of_words():
+    docs = ["cat dog cat", "dog bird"]
+    v = BagOfWordsVectorizer()
+    m = v.fit_transform(docs)
+    assert m.shape == (2, 3)
+    i_cat = v.vocab.index_of("cat")
+    assert m[0, i_cat] == 2.0 and m[1, i_cat] == 0.0
+
+
+def test_tfidf_downweights_common_terms():
+    docs = ["cat dog", "cat bird", "cat fish"]
+    v = TfidfVectorizer()
+    m = v.fit_transform(docs)
+    i_cat, i_dog = v.vocab.index_of("cat"), v.vocab.index_of("dog")
+    assert m[0, i_cat] == pytest.approx(0.0)  # idf(log 3/3)=0
+    assert m[0, i_dog] > 0.0
