@@ -1,0 +1,15 @@
+"""Clustering + nearest-neighbor + t-SNE.
+
+TPU-native re-design of deeplearning4j-core/.../clustering (K-means,
+KD-tree, VP-tree) and plot/BarnesHutTsne.java. The reference's spatial
+trees exist to make neighbor queries sub-quadratic on CPU; on TPU the
+idiomatic replacement is brute-force batched distance matmuls on the MXU,
+which beat tree traversal for the sizes the UI/t-SNE paths use. The tree
+class names are kept as API-compatible facades over that kernel.
+"""
+
+from deeplearning4j_tpu.clustering.kmeans import (  # noqa: F401
+    KMeansClustering, Cluster, ClusterSet, Point,
+)
+from deeplearning4j_tpu.clustering.knn import VPTree, KDTree  # noqa: F401
+from deeplearning4j_tpu.clustering.tsne import Tsne  # noqa: F401

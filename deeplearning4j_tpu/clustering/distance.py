@@ -1,0 +1,24 @@
+"""Shared pairwise-distance kernels for the clustering package.
+
+One MXU-friendly implementation (||a||^2 - 2 a·b + ||b||^2, clamped at 0
+against fp cancellation) serving kmeans, knn, and tsne.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def pairwise_sq_dist(a, b):
+    """[N, D] x [M, D] -> [N, M] squared Euclidean distances."""
+    d = (jnp.sum(a * a, axis=1, keepdims=True)
+         - 2.0 * a @ b.T
+         + jnp.sum(b * b, axis=1)[None, :])
+    return jnp.maximum(d, 0.0)
+
+
+def cosine_dist(a, b):
+    """[N, D] x [M, D] -> [N, M] cosine distances (1 - cos sim)."""
+    an = a / jnp.maximum(jnp.linalg.norm(a, axis=1, keepdims=True), 1e-12)
+    bn = b / jnp.maximum(jnp.linalg.norm(b, axis=1, keepdims=True), 1e-12)
+    return 1.0 - an @ bn.T

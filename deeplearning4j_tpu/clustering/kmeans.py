@@ -1,0 +1,141 @@
+"""K-means clustering.
+
+Ref: deeplearning4j-core/.../clustering/kmeans/KMeansClustering.java and
+cluster/{Cluster,ClusterSet,Point,ClusterUtils}.java. The reference loops
+points/clusters in Java threads; here each Lloyd iteration is one jitted
+step: a [N, K] squared-distance matrix from matmuls (MXU work), argmin,
+and segment-sum centroid update.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.clustering.distance import (cosine_dist,
+                                                    pairwise_sq_dist)
+
+
+@dataclass
+class Point:
+    idx: int
+    array: np.ndarray
+    label: Optional[str] = None
+
+
+@dataclass
+class Cluster:
+    idx: int
+    center: np.ndarray
+    points: List[Point] = field(default_factory=list)
+
+
+@dataclass
+class ClusterSet:
+    clusters: List[Cluster]
+
+    def get_clusters(self) -> List[Cluster]:
+        return self.clusters
+
+    def get_cluster_count(self) -> int:
+        return len(self.clusters)
+
+    def centers(self) -> np.ndarray:
+        return np.stack([c.center for c in self.clusters])
+
+
+@partial(jax.jit, static_argnames=("k", "cosine"))
+def _lloyd_step(x, centers, k, cosine=False):
+    dist = (cosine_dist(x, centers) if cosine
+            else pairwise_sq_dist(x, centers))
+    assign = jnp.argmin(dist, axis=1)
+    one_hot = jax.nn.one_hot(assign, k, dtype=x.dtype)       # [N, K]
+    counts = one_hot.sum(axis=0)                             # [K]
+    sums = one_hot.T @ x                                     # [K, D]
+    new_centers = jnp.where(counts[:, None] > 0,
+                            sums / jnp.maximum(counts[:, None], 1.0),
+                            centers)
+    inertia = jnp.sum(jnp.min(dist, axis=1))
+    return new_centers, assign, inertia
+
+
+class KMeansClustering:
+    """setup(k, maxIterations, distanceFunction) then apply_to(points)
+    (ref: KMeansClustering.setup / applyTo)."""
+
+    def __init__(self, k: int, max_iterations: int = 100,
+                 distance: str = "euclidean", tol: float = 1e-6,
+                 seed: int = 123):
+        self.k = k
+        self.max_iterations = max_iterations
+        self.distance = distance.lower()
+        if self.distance not in ("euclidean", "cosine"):
+            raise ValueError(f"Unknown distance {distance!r}")
+        self.tol = tol
+        self.seed = seed
+        self.inertia_: Optional[float] = None
+        self.n_iter_: int = 0
+
+    @staticmethod
+    def setup(k: int, max_iterations: int = 100,
+              distance: str = "euclidean", **kw) -> "KMeansClustering":
+        return KMeansClustering(k, max_iterations, distance, **kw)
+
+    def _init_centers(self, x: np.ndarray,
+                      rng: np.random.Generator) -> np.ndarray:
+        """k-means++ seeding (better than the reference's random pick)."""
+        n = len(x)
+        centers = [x[rng.integers(n)]]
+        for _ in range(1, self.k):
+            d2 = np.min(
+                ((x[:, None, :] - np.stack(centers)[None]) ** 2).sum(-1),
+                axis=1)
+            p = d2 / max(d2.sum(), 1e-12)
+            centers.append(x[rng.choice(n, p=p)])
+        return np.stack(centers)
+
+    def fit(self, x: np.ndarray) -> "KMeansClustering":
+        x = np.asarray(x, dtype=np.float32)
+        rng = np.random.default_rng(self.seed)
+        centers = jnp.asarray(self._init_centers(x, rng))
+        xj = jnp.asarray(x)
+        prev_inertia = np.inf
+        for i in range(max(1, self.max_iterations)):
+            centers, _, inertia = _lloyd_step(
+                xj, centers, self.k, self.distance == "cosine")
+            self.n_iter_ = i + 1
+            if abs(prev_inertia - float(inertia)) < self.tol:
+                break
+            prev_inertia = float(inertia)
+        self.cluster_centers_ = np.asarray(centers)
+        # assignments/inertia must reflect the FINAL centers (the step
+        # returns pre-update assignments, which would disagree with
+        # predict() whenever the loop exits on max_iterations)
+        _, assign, inertia = _lloyd_step(
+            xj, jnp.asarray(self.cluster_centers_), self.k,
+            self.distance == "cosine")
+        self.labels_ = np.asarray(assign)
+        self.inertia_ = float(inertia)
+        return self
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        _, assign, _ = _lloyd_step(
+            jnp.asarray(np.asarray(x, np.float32)),
+            jnp.asarray(self.cluster_centers_), self.k,
+            self.distance == "cosine")
+        return np.asarray(assign)
+
+    def apply_to(self, points: Sequence[Point]) -> ClusterSet:
+        x = np.stack([np.asarray(p.array, np.float32).ravel()
+                      for p in points])
+        self.fit(x)
+        clusters = [Cluster(i, self.cluster_centers_[i])
+                    for i in range(self.k)]
+        for p, a in zip(points, self.labels_):
+            clusters[int(a)].points.append(p)
+        return ClusterSet(clusters)

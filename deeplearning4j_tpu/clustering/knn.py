@@ -1,0 +1,57 @@
+"""Nearest-neighbor search: VPTree / KDTree facades.
+
+Ref: deeplearning4j-core/.../clustering/vptree/VPTree.java and
+kdtree/KDTree.java. Those trees exist to prune CPU distance evaluations;
+on TPU the idiomatic kernel is a single [Q, N] distance matrix from
+batched matmuls (MXU), then top-k. Both classes share that kernel — the
+names/API are kept for reference parity.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.clustering.distance import (cosine_dist,
+                                                    pairwise_sq_dist)
+
+
+@partial(jax.jit, static_argnames=("k", "cosine"))
+def _topk_neighbors(q, pts, k, cosine=False):
+    dist = cosine_dist(q, pts) if cosine else pairwise_sq_dist(q, pts)
+    neg, idx = jax.lax.top_k(-dist, k)
+    d = -neg
+    return (jnp.sqrt(d) if not cosine else d), idx
+
+
+class VPTree:
+    """search(target, k) -> (indices, distances), Euclidean or cosine."""
+
+    def __init__(self, items: np.ndarray, distance: str = "euclidean"):
+        self.items = np.asarray(items, dtype=np.float32)
+        self.distance = distance.lower()
+        if self.distance not in ("euclidean", "cosine"):
+            raise ValueError(f"Unknown distance {distance!r}")
+
+    def search(self, target: np.ndarray, k: int
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        q = np.atleast_2d(np.asarray(target, dtype=np.float32))
+        d, idx = _topk_neighbors(jnp.asarray(q), jnp.asarray(self.items),
+                                 min(k, len(self.items)),
+                                 self.distance == "cosine")
+        d, idx = np.asarray(d), np.asarray(idx)
+        if np.asarray(target).ndim == 1:
+            return idx[0], d[0]
+        return idx, d
+
+
+class KDTree(VPTree):
+    """Same brute-force kernel; kept for API parity with kdtree/KDTree.java."""
+
+    def nn(self, target: np.ndarray) -> Tuple[int, float]:
+        idx, d = self.search(target, 1)
+        return int(idx[0]), float(d[0])

@@ -1,0 +1,12 @@
+"""Graph embeddings: in-memory graphs, random walks, DeepWalk.
+
+TPU-native re-design of ``deeplearning4j-graph`` (ref:
+deeplearning4j-graph/.../graph/Graph.java, iterator/RandomWalkIterator.java,
+models/deepwalk/DeepWalk.java:95).
+"""
+
+from deeplearning4j_tpu.graph.graph import Graph, Vertex, Edge  # noqa: F401
+from deeplearning4j_tpu.graph.walks import (  # noqa: F401
+    RandomWalkIterator, WeightedRandomWalkIterator,
+)
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphHuffman  # noqa: F401

@@ -1,0 +1,107 @@
+"""Random walk generation.
+
+Ref: deeplearning4j-graph/.../iterator/RandomWalkIterator.java (uniform
+next-hop, NoEdgeHandling SELF_LOOP_ON_DISCONNECTED / EXCEPTION_ON_DISCONNECTED)
+and WeightedRandomWalkIterator.java (weight-proportional next-hop).
+
+TPU-native twist: walks are generated *batched* on the host with numpy
+(all walkers advance one step per vectorized draw) instead of one
+walk-at-a-time; the output feeds the batched skip-gram trainer.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.graph.graph import Graph
+
+
+class NoEdges(Exception):
+    """Raised for a disconnected vertex under 'exception' handling (ref:
+    NoEdgeHandling.EXCEPTION_ON_DISCONNECTED)."""
+
+
+def _build_csr(graph: Graph, weighted: bool):
+    offsets, neigh, wgt = graph.adjacency_arrays()
+    cumw = None
+    if weighted:
+        # Per-vertex cumulative weights for weighted sampling.
+        cumw = wgt.copy()
+        for v in range(graph.num_vertices()):
+            lo, hi = offsets[v], offsets[v + 1]
+            if hi > lo:
+                c = np.cumsum(wgt[lo:hi])
+                cumw[lo:hi] = c / c[-1]
+    return offsets, neigh, wgt, cumw
+
+
+def _batched_walks(csr, walk_length: int, starts: np.ndarray,
+                   rng: np.random.Generator, weighted: bool,
+                   no_edge_handling: str) -> np.ndarray:
+    offsets, neigh, wgt, cumw = csr
+    degrees = (offsets[1:] - offsets[:-1])
+    walks = np.zeros((len(starts), walk_length), dtype=np.int64)
+    walks[:, 0] = starts
+    cur = starts.copy()
+    for step in range(1, walk_length):
+        deg = degrees[cur]
+        connected = deg > 0
+        if no_edge_handling == "exception" and not connected.all():
+            # ref: NoEdgeHandling.EXCEPTION_ON_DISCONNECTED throws for any
+            # visited disconnected vertex, not just the start
+            raise NoEdges("walk reached a vertex with no outgoing edges")
+        nxt = cur.copy()  # self-loop for disconnected vertices
+        if connected.any():
+            c = cur[connected]
+            if weighted:
+                u = rng.random(len(c))
+                pick = np.zeros(len(c), dtype=np.int64)
+                for i, v in enumerate(c):  # searchsorted per vertex slice
+                    lo, hi = offsets[v], offsets[v + 1]
+                    pick[i] = lo + np.searchsorted(cumw[lo:hi], u[i])
+                nxt[connected] = neigh[np.minimum(pick, offsets[c + 1] - 1)]
+            else:
+                off = rng.integers(0, deg[connected])
+                nxt[connected] = neigh[offsets[c] + off]
+        walks[:, step] = nxt
+        cur = nxt
+    return walks
+
+
+class RandomWalkIterator:
+    """Yields one uniform random walk (list of vertex ids) per start
+    vertex, all vertices once per epoch in shuffled order."""
+
+    weighted = False
+
+    def __init__(self, graph: Graph, walk_length: int, seed: int = 123,
+                 no_edge_handling: str = "self_loop"):
+        self.graph = graph
+        self.walk_length = walk_length
+        self.seed = seed
+        self.no_edge_handling = no_edge_handling
+        self._epoch = 0
+        # the graph is fixed for this iterator's lifetime: build the CSR
+        # adjacency (and weighted cumsums) once, not per walks() call
+        self._csr = _build_csr(graph, self.weighted)
+
+    def walks(self, batch: Optional[np.ndarray] = None) -> np.ndarray:
+        rng = np.random.default_rng(self.seed + self._epoch)
+        self._epoch += 1
+        starts = (rng.permutation(self.graph.num_vertices())
+                  if batch is None else batch)
+        return _batched_walks(self._csr, self.walk_length, starts, rng,
+                              self.weighted, self.no_edge_handling)
+
+    def __iter__(self) -> Iterator[List[int]]:
+        for row in self.walks():
+            yield list(row)
+
+
+class WeightedRandomWalkIterator(RandomWalkIterator):
+    """Next hop chosen proportionally to edge weight (ref:
+    WeightedRandomWalkIterator.java)."""
+
+    weighted = True

@@ -101,6 +101,9 @@ class TrainingConfig:
     backprop/pretrain/backpropType/tBPTT*)."""
     seed: int = 12345
     optimization_algo: str = "sgd"  # sgd | line_gradient_descent | conjugate_gradient | lbfgs
+    # outer optimizer iterations per fit() call (ref: conf.iterations)
+    iterations: int = 1
+    # per-iteration Armijo backtracking cap (ref: maxNumLineSearchIterations)
     max_num_line_search_iterations: int = 5
     minimize: bool = True
     minibatch: bool = True
@@ -314,6 +317,14 @@ class NeuralNetConfiguration:
 
     def optimization_algo(self, algo: str) -> "NeuralNetConfiguration":
         self._training.optimization_algo = algo.lower()
+        return self
+
+    def iterations(self, n: int) -> "NeuralNetConfiguration":
+        self._training.iterations = n
+        return self
+
+    def max_num_line_search_iterations(self, n: int) -> "NeuralNetConfiguration":
+        self._training.max_num_line_search_iterations = n
         return self
 
     def minimize(self, flag: bool = True) -> "NeuralNetConfiguration":

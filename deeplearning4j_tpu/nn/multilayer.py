@@ -242,6 +242,20 @@ class MultiLayerNetwork:
     def fit_batch(self, dataset: DataSet) -> float:
         """One optimization step on one minibatch (ref: fit(DataSet))."""
         self._check_init()
+        algo = self.conf.training.optimization_algo
+        if algo not in ("sgd", "stochastic_gradient_descent"):
+            # line-search family: run the batch objective through the
+            # Solver (ref: Solver.java dispatch on OptimizationAlgorithm)
+            from deeplearning4j_tpu.optimize.solvers import Solver
+            score = Solver(
+                self,
+                max_iterations=max(1, self.conf.training.iterations),
+            ).optimize(dataset)
+            self.last_batch_size = dataset.num_examples()
+            self.iteration_count += 1
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration_count, score)
+            return score
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
         if (self.conf.training.backprop_type == "truncated_bptt"

@@ -1,0 +1,189 @@
+"""Full-batch convex optimizers + line search.
+
+Ref: deeplearning4j-nn optimize/Solver.java:41-70 (dispatch on
+OptimizationAlgorithm), optimize/solvers/{StochasticGradientDescent,
+LineGradientDescent,ConjugateGradient,LBFGS,BackTrackLineSearch}.java.
+
+The reference runs these against `model.computeGradientAndScore()` on the
+current minibatch; here they run against any jitted value-and-grad
+objective over a *flat* parameter vector (ravel_pytree), so the same code
+optimizes toy convex problems (TestOptimizers parity) and whole networks.
+SGD itself lives in the jitted train step (multilayer.py) — these are the
+line-search family.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+ValueGrad = Callable[[np.ndarray], Tuple[float, np.ndarray]]
+
+
+def backtrack_line_search(f: Callable[[np.ndarray], float], x: np.ndarray,
+                          fx: float, g: np.ndarray, direction: np.ndarray,
+                          step0: float = 1.0, c1: float = 1e-4,
+                          rho: float = 0.5, max_steps: int = 30,
+                          ) -> float:
+    """Armijo backtracking (ref: BackTrackLineSearch.java — same
+    sufficient-decrease test, geometric step shrink)."""
+    m = float(g @ direction)
+    if m >= 0:  # not a descent direction; signal caller to reset
+        return 0.0
+    step = step0
+    for _ in range(max_steps):
+        if f(x + step * direction) <= fx + c1 * step * m:
+            return step
+        step *= rho
+    return 0.0
+
+
+def minimize(value_grad: ValueGrad, x0: np.ndarray, method: str = "lbfgs",
+             max_iters: int = 100, tol: float = 1e-8, history: int = 10,
+             value_only: Optional[Callable[[np.ndarray], float]] = None,
+             line_search_steps: int = 30
+             ) -> Tuple[np.ndarray, float, int]:
+    """Returns (x, f(x), iterations). method: 'line_gradient_descent' |
+    'conjugate_gradient' | 'lbfgs'. ``value_only``: cheaper loss-only
+    evaluator for line-search probes (skips the backward pass)."""
+    method = method.lower()
+    x = np.asarray(x0, dtype=np.float64).copy()
+    f_only = value_only if value_only is not None else (
+        lambda xx: value_grad(xx)[0])
+
+    fx, g = value_grad(x)
+    it = 0
+    prev_g = None
+    d_prev = None
+    s_hist: List[np.ndarray] = []
+    y_hist: List[np.ndarray] = []
+    for it in range(1, max_iters + 1):
+        gnorm = float(np.linalg.norm(g))
+        if gnorm < tol:
+            break
+        if method == "line_gradient_descent":
+            d = -g
+        elif method == "conjugate_gradient":
+            # Polak-Ribiere+ with automatic restart
+            # (ref: ConjugateGradient.java)
+            if prev_g is None:
+                d = -g
+            else:
+                beta = max(0.0, float(g @ (g - prev_g))
+                           / max(float(prev_g @ prev_g), 1e-300))
+                d = -g + beta * d_prev
+        elif method == "lbfgs":
+            # two-loop recursion (ref: LBFGS.java, memory default 10)
+            q = g.copy()
+            alphas = []
+            for s, y in zip(reversed(s_hist), reversed(y_hist)):
+                rho_i = 1.0 / max(float(y @ s), 1e-300)
+                a = rho_i * float(s @ q)
+                alphas.append((a, rho_i, s, y))
+                q -= a * y
+            if y_hist:
+                y_last, s_last = y_hist[-1], s_hist[-1]
+                q *= float(s_last @ y_last) / max(float(y_last @ y_last),
+                                                  1e-300)
+            for a, rho_i, s, y in reversed(alphas):
+                b = rho_i * float(y @ q)
+                q += (a - b) * s
+            d = -q
+        else:
+            raise ValueError(f"Unknown optimization algorithm {method!r}")
+
+        step = backtrack_line_search(f_only, x, fx, g, d,
+                                     max_steps=line_search_steps)
+        if step == 0.0:
+            if method == "line_gradient_descent":
+                break  # converged (or stuck): steepest descent failed
+            # reset curvature info and retry with steepest descent
+            s_hist.clear(); y_hist.clear()
+            prev_g = None
+            d = -g
+            step = backtrack_line_search(f_only, x, fx, g, d,
+                                         max_steps=line_search_steps)
+            if step == 0.0:
+                break
+        x_new = x + step * d
+        fx_new, g_new = value_grad(x_new)
+        if method == "lbfgs":
+            s = x_new - x
+            y = g_new - g
+            if float(s @ y) > 1e-12:
+                s_hist.append(s); y_hist.append(y)
+                if len(s_hist) > history:
+                    s_hist.pop(0); y_hist.pop(0)
+        prev_g, d_prev = g, d
+        if abs(fx - fx_new) < tol * (1.0 + abs(fx)):
+            x, fx, g = x_new, fx_new, g_new
+            break
+        x, fx, g = x_new, fx_new, g_new
+    return x, fx, it
+
+
+class Solver:
+    """Optimize a network's parameters on one dataset with the configured
+    algorithm (ref: Solver.java + BaseOptimizer: each ``optimize()`` call
+    runs the algorithm against the current batch objective).
+
+    max_iterations: outer algorithm iterations (ref: conf.iterations);
+    the per-iteration Armijo backtracking is capped by the conf's
+    maxNumLineSearchIterations."""
+
+    def __init__(self, net, max_iterations: int = 100):
+        self.net = net
+        self.max_iterations = max_iterations
+
+    def optimize(self, dataset) -> float:
+        net = self.net
+        net._check_init()
+        training = net.conf.training
+        algo = training.optimization_algo
+        feats = jnp.asarray(dataset.features)
+        labels = jnp.asarray(dataset.labels)
+        fmask = (None if dataset.features_mask is None
+                 else jnp.asarray(dataset.features_mask))
+        lmask = (None if dataset.labels_mask is None
+                 else jnp.asarray(dataset.labels_mask))
+        flat0, unravel = ravel_pytree(net.params)
+        net._rng, step_rng = jax.random.split(net._rng)
+
+        def objective(p, rng):
+            return net._loss_fn(p, net.states, feats, labels,
+                                fmask, lmask, rng=rng, train=True)
+
+        @jax.jit
+        def vg(flat, rng):
+            (loss, _), grad = jax.value_and_grad(
+                lambda pp: objective(pp, rng), has_aux=True)(unravel(flat))
+            return loss, ravel_pytree(grad)[0]
+
+        @jax.jit
+        def v_only(flat, rng):
+            return objective(unravel(flat), rng)[0]
+
+        def vg_np(x):
+            l, g = vg(jnp.asarray(x, dtype=flat0.dtype), step_rng)
+            return float(l), np.asarray(g, dtype=np.float64)
+
+        def f_np(x):
+            # loss-only probe for line search: forward pass, no backward
+            return float(v_only(jnp.asarray(x, dtype=flat0.dtype), step_rng))
+
+        x, fx, _ = minimize(
+            vg_np, np.asarray(flat0, np.float64), method=algo,
+            max_iters=self.max_iterations, value_only=f_np,
+            line_search_steps=max(
+                5, training.max_num_line_search_iterations))
+        net.params = unravel(jnp.asarray(x, dtype=flat0.dtype))
+        # refresh layer states (batchnorm running stats etc.) at the final
+        # parameters — the line-search objective doesn't carry them out
+        _, new_states = objective(net.params, step_rng)
+        net.states = new_states
+        net.score_value = fx
+        return fx

@@ -1,0 +1,103 @@
+"""Clustering/t-SNE/kNN tests — analogs of the reference's
+clustering/kmeans and plot (BarnesHutTsne) test coverage."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    Cluster, ClusterSet, KDTree, KMeansClustering, Point, Tsne, VPTree,
+)
+
+
+def _blobs(n_per=50, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[5.0] * d, [-5.0] * d, [5.0] * (d // 2) + [-5.0] * (d - d // 2)])
+    pts = np.concatenate([c + rng.normal(size=(n_per, d)) for c in centers])
+    labels = np.repeat(np.arange(3), n_per)
+    return pts.astype(np.float32), labels
+
+
+def test_kmeans_recovers_blobs():
+    x, labels = _blobs()
+    km = KMeansClustering.setup(3, max_iterations=50).fit(x)
+    # each true cluster should map to exactly one k-means label
+    mapped = set()
+    for c in range(3):
+        vals, counts = np.unique(km.labels_[labels == c], return_counts=True)
+        dominant = vals[np.argmax(counts)]
+        assert counts.max() >= 45  # >=90% pure
+        mapped.add(int(dominant))
+    assert len(mapped) == 3
+    assert km.inertia_ < 2500
+
+
+def test_kmeans_predict_matches_fit_assignments():
+    x, _ = _blobs()
+    km = KMeansClustering(3, seed=1).fit(x)
+    np.testing.assert_array_equal(km.predict(x), km.labels_)
+
+
+def test_kmeans_cosine_distance():
+    rng = np.random.default_rng(2)
+    a = rng.normal(size=(40, 6)) + np.array([10, 0, 0, 0, 0, 0])
+    b = rng.normal(size=(40, 6)) + np.array([0, 10, 0, 0, 0, 0])
+    x = np.concatenate([a, b]).astype(np.float32)
+    km = KMeansClustering(2, distance="cosine", seed=3).fit(x)
+    assert len(np.unique(km.labels_[:40])) == 1
+    assert km.labels_[0] != km.labels_[40]
+
+
+def test_kmeans_apply_to_cluster_set():
+    x, _ = _blobs(10)
+    points = [Point(i, row) for i, row in enumerate(x)]
+    cs = KMeansClustering(3, seed=4).apply_to(points)
+    assert isinstance(cs, ClusterSet)
+    assert cs.get_cluster_count() == 3
+    assert sum(len(c.points) for c in cs.get_clusters()) == len(points)
+    assert cs.centers().shape == (3, 4)
+
+
+def test_kmeans_unknown_distance_raises():
+    with pytest.raises(ValueError, match="distance"):
+        KMeansClustering(2, distance="manhattan")
+
+
+def test_vptree_search_matches_numpy():
+    rng = np.random.default_rng(5)
+    pts = rng.normal(size=(100, 8)).astype(np.float32)
+    tree = VPTree(pts)
+    q = pts[7] + 0.01
+    idx, dist = tree.search(q, 5)
+    ref = np.argsort(np.linalg.norm(pts - q, axis=1))[:5]
+    np.testing.assert_array_equal(np.sort(idx), np.sort(ref))
+    assert idx[0] == 7
+    assert dist[0] == pytest.approx(np.linalg.norm(pts[7] - q), abs=1e-4)
+
+
+def test_kdtree_nn():
+    pts = np.eye(4, dtype=np.float32) * 3
+    t = KDTree(pts)
+    i, d = t.nn(np.array([2.9, 0, 0, 0], np.float32))
+    assert i == 0 and d == pytest.approx(0.1, abs=1e-5)
+
+
+def test_vptree_cosine():
+    pts = np.array([[1, 0], [0, 1], [-1, 0]], np.float32)
+    idx, dist = VPTree(pts, distance="cosine").search(
+        np.array([0.9, 0.1], np.float32), 2)
+    assert idx[0] == 0
+
+
+def test_tsne_separates_blobs():
+    x, labels = _blobs(n_per=30, d=10, seed=6)
+    ts = Tsne(perplexity=10, max_iter=300, seed=7)
+    y = ts.fit(x)
+    assert y.shape == (90, 2)
+    assert np.isfinite(y).all()
+    # cluster means in embedding space should be well separated vs spread
+    means = np.stack([y[labels == c].mean(axis=0) for c in range(3)])
+    spread = np.mean([y[labels == c].std() for c in range(3)])
+    min_gap = min(np.linalg.norm(means[i] - means[j])
+                  for i in range(3) for j in range(i + 1, 3))
+    assert min_gap > 2 * spread, (min_gap, spread)
+    assert ts.kl_divergence_ is not None and ts.kl_divergence_ < 1.5

@@ -1,0 +1,97 @@
+"""Graph module tests — analogs of the reference's TestGraph, TestRandomWalk,
+TestGraphHuffman, TestDeepWalk (deeplearning4j-graph/src/test)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (
+    DeepWalk, Graph, GraphHuffman, RandomWalkIterator,
+    WeightedRandomWalkIterator,
+)
+from deeplearning4j_tpu.graph.walks import NoEdges
+
+
+def _barbell(n=6):
+    """Two cliques of n joined by one edge — clear community structure."""
+    g = Graph(2 * n)
+    for side in (0, n):
+        for i in range(n):
+            for j in range(i + 1, n):
+                g.add_edge(side + i, side + j)
+    g.add_edge(n - 1, n)  # bridge
+    return g
+
+
+def test_graph_basics():
+    g = Graph(4, values=["a", "b", "c", "d"])
+    g.add_edge(0, 1)
+    g.add_edge(1, 2, directed=True)
+    assert g.num_vertices() == 4
+    assert g.get_vertex(0).value == "a"
+    assert sorted(g.get_connected_vertices(1)) == [0, 2]
+    assert g.get_connected_vertices(2) == []  # directed: no back edge
+    assert g.get_vertex_degree(3) == 0
+
+
+def test_random_walks_follow_edges():
+    g = _barbell(4)
+    walks = RandomWalkIterator(g, walk_length=10, seed=0).walks()
+    assert walks.shape == (8, 10)
+    edges = {(u, w) for u in range(8) for w in g.get_connected_vertices(u)}
+    for row in walks:
+        for a, b in zip(row[:-1], row[1:]):
+            assert (int(a), int(b)) in edges
+
+
+def test_disconnected_vertex_self_loops_or_raises():
+    g = Graph(3)
+    g.add_edge(0, 1)
+    walks = RandomWalkIterator(g, walk_length=5, seed=0).walks()
+    row = walks[list(walks[:, 0]).index(2)]
+    assert (row == 2).all()  # self-loop handling
+    with pytest.raises(NoEdges):
+        RandomWalkIterator(g, 5, no_edge_handling="exception").walks()
+
+
+def test_weighted_walks_prefer_heavy_edges():
+    g = Graph(3)
+    g.add_edge(0, 1, weight=100.0)
+    g.add_edge(0, 2, weight=0.01)
+    starts = np.zeros(400, dtype=np.int64)
+    walks = WeightedRandomWalkIterator(g, 2, seed=1).walks(starts)
+    frac_to_1 = (walks[:, 1] == 1).mean()
+    assert frac_to_1 > 0.95
+
+
+def test_graph_huffman_codes():
+    g = _barbell(4)
+    gh = GraphHuffman(g)
+    codes = ["".join(map(str, gh.get_code(v))) for v in range(8)]
+    assert len(set(codes)) == 8  # unique
+    for i, a in enumerate(codes):
+        for j, b in enumerate(codes):
+            if i != j:
+                assert not a.startswith(b)  # prefix-free
+    assert gh.get_code_length(0) == len(gh.get_code(0))
+    assert len(gh.get_path_inner_nodes(0)) == gh.get_code_length(0)
+
+
+def test_deepwalk_embeds_communities():
+    g = _barbell(6)
+    dw = DeepWalk(vector_size=16, window_size=4, learning_rate=0.05,
+                  epochs=5, walks_per_vertex=5, seed=2)
+    dw.fit(g, walk_length=20)
+    # same-clique similarity should beat cross-clique
+    within = np.mean([dw.similarity(0, j) for j in range(1, 5)])
+    across = np.mean([dw.similarity(0, j) for j in range(7, 11)])
+    assert within > across, (within, across)
+    nearest = dw.verticesNearest(1, top_n=4)
+    assert sum(v < 6 for v in nearest) >= 3, nearest
+
+
+def test_deepwalk_weighted_walks_run():
+    g = _barbell(4)
+    dw = DeepWalk(vector_size=8, epochs=1, weighted_walks=True, seed=3)
+    dw.fit(g, walk_length=8)
+    assert dw.get_vertex_vector(0).shape == (8,)
+    assert np.isfinite(dw.vertex_vectors).all()

@@ -1,0 +1,109 @@
+"""Solver/line-search optimizer tests — analog of the reference's
+TestOptimizers.java (convex toy problems per OptimizationAlgorithm) plus
+network integration."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.solvers import (
+    Solver, backtrack_line_search, minimize,
+)
+
+ALGOS = ["line_gradient_descent", "conjugate_gradient", "lbfgs"]
+
+
+def sphere(x):
+    return float(x @ x), 2.0 * x
+
+
+def rosenbrock(x):
+    a, b = 1.0, 100.0
+    f = float((a - x[0]) ** 2 + b * (x[1] - x[0] ** 2) ** 2)
+    g = np.array([
+        -2 * (a - x[0]) - 4 * b * x[0] * (x[1] - x[0] ** 2),
+        2 * b * (x[1] - x[0] ** 2),
+    ])
+    return f, g
+
+
+@pytest.mark.parametrize("algo", ALGOS)
+def test_sphere_minimized(algo):
+    x0 = np.array([3.0, -4.0, 5.0])
+    x, fx, _ = minimize(sphere, x0, method=algo, max_iters=200)
+    assert fx < 1e-6, (algo, fx)
+    np.testing.assert_allclose(x, 0.0, atol=1e-3)
+
+
+@pytest.mark.parametrize("algo,tol_f,tol_x", [
+    ("lbfgs", 1e-5, 1e-2),
+    # CG with Armijo-only backtracking stalls near the optimum on the
+    # Rosenbrock valley (needs Wolfe curvature to keep conjugacy useful)
+    ("conjugate_gradient", 1e-3, 5e-2),
+])
+def test_rosenbrock_minimized(algo, tol_f, tol_x):
+    x, fx, it = minimize(rosenbrock, np.array([-1.2, 1.0]),
+                         method=algo, max_iters=2000)
+    assert fx < tol_f, (algo, fx, it)
+    np.testing.assert_allclose(x, [1.0, 1.0], atol=tol_x)
+
+
+def test_line_search_respects_armijo():
+    f = lambda x: float(x @ x)
+    x = np.array([2.0])
+    g = np.array([4.0])
+    step = backtrack_line_search(f, x, f(x), g, -g)
+    assert step > 0
+    assert f(x - step * g) < f(x)
+
+
+def test_line_search_rejects_ascent_direction():
+    f = lambda x: float(x @ x)
+    x = np.array([2.0])
+    g = np.array([4.0])
+    assert backtrack_line_search(f, x, f(x), g, +g) == 0.0
+
+
+def test_unknown_algo_raises():
+    with pytest.raises(ValueError, match="optimization algorithm"):
+        minimize(sphere, np.ones(2), method="newton")
+
+
+@pytest.mark.parametrize("algo", ["lbfgs", "conjugate_gradient"])
+def test_network_trains_with_solver(algo):
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .optimization_algo(algo)
+            .list()
+            .layer(DenseLayer(n_out=12, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    it = IrisDataSetIterator(150)
+    ds = next(iter(it))
+    s0 = net.score(ds)
+    solver = Solver(net, max_iterations=60)
+    s1 = solver.optimize(ds)
+    assert s1 < s0 * 0.5, (s0, s1)
+    acc = net.evaluate(IrisDataSetIterator(150)).accuracy()
+    assert acc > 0.9, acc
+
+
+def test_fit_batch_routes_through_solver():
+    conf = (NeuralNetConfiguration.builder().seed(2)
+            .optimization_algo("lbfgs")
+            .list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ds = next(iter(IrisDataSetIterator(150)))
+    before = net.score(ds)
+    for _ in range(3):
+        after = net.fit_batch(ds)
+    assert after < before
+    assert net.iteration_count == 3
