@@ -91,12 +91,18 @@ class KMeansClustering:
         """k-means++ seeding (better than the reference's random pick)."""
         n = len(x)
         centers = [x[rng.integers(n)]]
+        # running min squared distance to the nearest chosen center — O(NKD)
+        d2 = ((x - centers[0]) ** 2).sum(-1)
         for _ in range(1, self.k):
-            d2 = np.min(
-                ((x[:, None, :] - np.stack(centers)[None]) ** 2).sum(-1),
-                axis=1)
-            p = d2 / max(d2.sum(), 1e-12)
-            centers.append(x[rng.choice(n, p=p)])
+            total = d2.sum()
+            if total <= 1e-12:
+                # all remaining points coincide with chosen centers;
+                # degenerate but valid — pick uniformly
+                idx = rng.integers(n)
+            else:
+                idx = rng.choice(n, p=d2 / total)
+            centers.append(x[idx])
+            d2 = np.minimum(d2, ((x - centers[-1]) ** 2).sum(-1))
         return np.stack(centers)
 
     def fit(self, x: np.ndarray) -> "KMeansClustering":

@@ -77,7 +77,7 @@ class Tsne:
                  early_exaggeration: float = 12.0, exaggeration_iters: int = 100,
                  momentum: float = 0.5, final_momentum: float = 0.8,
                  momentum_switch: int = 250, seed: int = 123,
-                 use_pca_init: bool = True):
+                 use_pca_init: bool = True, theta: float = 0.0):
         self.n_components = n_components
         self.perplexity = perplexity
         self.max_iter = max_iter

@@ -34,8 +34,10 @@ class Graph:
 
     def __init__(self, num_vertices: int,
                  values: Optional[Sequence[Any]] = None):
-        self._vertices = [Vertex(i, values[i] if values else None)
-                          for i in range(num_vertices)]
+        self._vertices = [
+            Vertex(i, values[i] if values is not None and len(values) > i
+                   else None)
+            for i in range(num_vertices)]
         self._adj: List[List[Tuple[int, float]]] = [
             [] for _ in range(num_vertices)]
 

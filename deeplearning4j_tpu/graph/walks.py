@@ -27,13 +27,23 @@ def _build_csr(graph: Graph, weighted: bool):
     offsets, neigh, wgt = graph.adjacency_arrays()
     cumw = None
     if weighted:
-        # Per-vertex cumulative weights for weighted sampling.
-        cumw = wgt.copy()
+        # Globally increasing cumulative weights: segment v's cumsum is
+        # normalized to (0, 1] then shifted by +v, so one vectorized
+        # searchsorted(cumw, u + v) inverts every vertex's CDF at once.
+        cumw = wgt.astype(np.float64)
         for v in range(graph.num_vertices()):
             lo, hi = offsets[v], offsets[v + 1]
             if hi > lo:
                 c = np.cumsum(wgt[lo:hi])
-                cumw[lo:hi] = c / c[-1]
+                if c[-1] <= 0:
+                    # all-zero weights: uniform CDF, never NaN (a NaN
+                    # segment would corrupt the global searchsorted for
+                    # every later vertex)
+                    c = np.arange(1, hi - lo + 1, dtype=np.float64)
+                    c /= c[-1]
+                else:
+                    c = c / c[-1]
+                cumw[lo:hi] = c + v
     return offsets, neigh, wgt, cumw
 
 
@@ -57,11 +67,11 @@ def _batched_walks(csr, walk_length: int, starts: np.ndarray,
             c = cur[connected]
             if weighted:
                 u = rng.random(len(c))
-                pick = np.zeros(len(c), dtype=np.int64)
-                for i, v in enumerate(c):  # searchsorted per vertex slice
-                    lo, hi = offsets[v], offsets[v + 1]
-                    pick[i] = lo + np.searchsorted(cumw[lo:hi], u[i])
-                nxt[connected] = neigh[np.minimum(pick, offsets[c + 1] - 1)]
+                # side='right' so u=0 lands past segment c-1's terminal
+                # value (exactly c); clamp into [offsets[c], offsets[c+1])
+                pick = np.searchsorted(cumw, u + c, side="right")
+                pick = np.clip(pick, offsets[c], offsets[c + 1] - 1)
+                nxt[connected] = neigh[pick]
             else:
                 off = rng.integers(0, deg[connected])
                 nxt[connected] = neigh[offsets[c] + off]

@@ -48,6 +48,7 @@ class ComputationGraph:
         self.score_value = float("nan")
         self.listeners: List[IterationListener] = []
         self.last_batch_size = 0
+        self.last_grads = None  # most recent gradient pytree (for listeners)
         self._tx = build_optimizer(conf.training)
         self._train_step_fn = None
         self._rng = jax.random.PRNGKey(conf.training.seed)
@@ -80,6 +81,12 @@ class ComputationGraph:
 
     def set_listeners(self, *listeners: IterationListener):
         self.listeners = list(listeners)
+        # see MultiLayerNetwork._on_listeners_changed
+        want = any(getattr(l, "collects_gradients", False)
+                   for l in self.listeners)
+        if want != getattr(self, "_collect_grads", False):
+            self._collect_grads = want
+            self._train_step_fn = None
 
     # ---------------------------------------------------------------- forward
     def _forward(self, params, states, inputs: Dict[str, Array], *,
@@ -222,6 +229,7 @@ class ComputationGraph:
     def _build_train_step(self):
         tx = self._tx
         training = self.conf.training
+        collect_grads = getattr(self, "_collect_grads", False)
 
         def train_step(params, opt_state, states, inputs, labels, masks,
                        lmasks, rng):
@@ -234,19 +242,27 @@ class ComputationGraph:
             layer_list = [self.conf.nodes[n].layer for n in self._layer_nodes]
             new_params, new_opt = compute_updates(
                 tx, grads, opt_state, params, layer_list, training)
-            return new_params, new_opt, new_states, loss
+            return (new_params, new_opt, new_states, loss,
+                    grads if collect_grads else None)
 
         return jax.jit(train_step)
 
     def fit_batch(self, data: Union[DataSet, MultiDataSet]) -> float:
         self._check_init()
+        algo = self.conf.training.optimization_algo
+        if algo not in ("sgd", "stochastic_gradient_descent"):
+            # line-search family (ref: BaseOptimizer.java:295-300 — the
+            # same Solver serves ComputationGraph)
+            from deeplearning4j_tpu.optimize.solvers import solver_fit_batch
+            return solver_fit_batch(self, data)
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
         inputs, labels, masks, lmasks = self._split(data)
         self._rng, step_rng = jax.random.split(self._rng)
-        self.params, self.opt_state, self.states, loss = self._train_step_fn(
-            self.params, self.opt_state, self.states, inputs, labels, masks,
-            lmasks, step_rng)
+        self.params, self.opt_state, self.states, loss, self.last_grads = \
+            self._train_step_fn(
+                self.params, self.opt_state, self.states, inputs, labels,
+                masks, lmasks, step_rng)
         self.last_batch_size = data.num_examples()
         self.score_value = float(loss)
         self.iteration_count += 1

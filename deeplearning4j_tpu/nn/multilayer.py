@@ -73,6 +73,7 @@ class MultiLayerNetwork:
         self.score_value: float = float("nan")
         self.listeners: List[IterationListener] = []
         self.last_batch_size: int = 0
+        self.last_grads = None  # most recent gradient pytree (for listeners)
         self._tx = build_optimizer(conf.training)
         self._train_step_fn = None
         self._rnn_carries: Optional[List[Any]] = None  # rnnTimeStep state
@@ -100,9 +101,21 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------- listeners
     def set_listeners(self, *listeners: IterationListener) -> None:
         self.listeners = list(listeners)
+        self._on_listeners_changed()
 
     def add_listener(self, l: IterationListener) -> None:
         self.listeners.append(l)
+        self._on_listeners_changed()
+
+    def _on_listeners_changed(self) -> None:
+        # gradient-collecting listeners (StatsListener) need the train step
+        # to output grads; everyone else shouldn't pay the extra
+        # param-sized device buffer pinned between steps
+        want = any(getattr(l, "collects_gradients", False)
+                   for l in self.listeners)
+        if want != getattr(self, "_collect_grads", False):
+            self._collect_grads = want
+            self._train_step_fn = None  # rebuild with/without grads output
 
     # ---------------------------------------------------------------- forward
     def _forward(self, params, states, x, *, train: bool, rng, mask=None,
@@ -210,6 +223,7 @@ class MultiLayerNetwork:
     def _build_train_step(self):
         tx = self._tx
         training = self.conf.training
+        collect_grads = getattr(self, "_collect_grads", False)
         from deeplearning4j_tpu.nn.layers.core import CenterLossOutputLayer
         center_loss_head = isinstance(self.layers[-1], CenterLossOutputLayer)
 
@@ -235,7 +249,8 @@ class MultiLayerNetwork:
                 # (ref: CenterLossOutputLayer alpha semantics)
                 new_params[-1]["cL"] = self.layers[-1].updated_centers(
                     {"cL": params[-1]["cL"]}, h_last, labels)
-            return new_params, new_opt, new_states, loss
+            return (new_params, new_opt, new_states, loss,
+                    grads if collect_grads else None)
 
         return jax.jit(train_step)
 
@@ -246,16 +261,8 @@ class MultiLayerNetwork:
         if algo not in ("sgd", "stochastic_gradient_descent"):
             # line-search family: run the batch objective through the
             # Solver (ref: Solver.java dispatch on OptimizationAlgorithm)
-            from deeplearning4j_tpu.optimize.solvers import Solver
-            score = Solver(
-                self,
-                max_iterations=max(1, self.conf.training.iterations),
-            ).optimize(dataset)
-            self.last_batch_size = dataset.num_examples()
-            self.iteration_count += 1
-            for listener in self.listeners:
-                listener.iteration_done(self, self.iteration_count, score)
-            return score
+            from deeplearning4j_tpu.optimize.solvers import solver_fit_batch
+            return solver_fit_batch(self, dataset)
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
         if (self.conf.training.backprop_type == "truncated_bptt"
@@ -264,10 +271,11 @@ class MultiLayerNetwork:
         self._rng, step_rng = jax.random.split(self._rng)
         fmask = None if dataset.features_mask is None else jnp.asarray(dataset.features_mask)
         lmask = None if dataset.labels_mask is None else jnp.asarray(dataset.labels_mask)
-        self.params, self.opt_state, self.states, loss = self._train_step_fn(
-            self.params, self.opt_state, self.states,
-            jnp.asarray(dataset.features), jnp.asarray(dataset.labels),
-            fmask, lmask, step_rng)
+        self.params, self.opt_state, self.states, loss, self.last_grads = \
+            self._train_step_fn(
+                self.params, self.opt_state, self.states,
+                jnp.asarray(dataset.features), jnp.asarray(dataset.labels),
+                fmask, lmask, step_rng)
         self.last_batch_size = dataset.num_examples()
         self.score_value = float(loss)
         self.iteration_count += 1

@@ -119,10 +119,10 @@ def minimize(value_grad: ValueGrad, x0: np.ndarray, method: str = "lbfgs",
                 if len(s_hist) > history:
                     s_hist.pop(0); y_hist.pop(0)
         prev_g, d_prev = g, d
-        if abs(fx - fx_new) < tol * (1.0 + abs(fx)):
-            x, fx, g = x_new, fx_new, g_new
-            break
+        converged = abs(fx - fx_new) < tol * (1.0 + abs(fx))
         x, fx, g = x_new, fx_new, g_new
+        if converged:
+            break
     return x, fx, it
 
 
@@ -139,41 +139,82 @@ class Solver:
         self.net = net
         self.max_iterations = max_iterations
 
+    def _get_jitted(self, unravel):
+        """Jitted value/value-and-grad closures, cached on the net so a
+        fit loop of many solver_fit_batch calls compiles once (same role
+        as the cached _train_step_fn on the SGD path). States and the
+        batch travel as arguments, not closure constants, so the cache
+        stays valid across batches."""
+        net = self.net
+        treedef = jax.tree.structure(net.params)
+        cached = getattr(net, "_solver_fns", None)
+        if cached is not None and cached[0] == treedef:
+            return cached[1], cached[2]
+        from deeplearning4j_tpu.nn.updater import mask_frozen
+        if hasattr(net, "_layer_nodes"):
+            layer_list = [net.conf.nodes[n].layer for n in net._layer_nodes]
+        else:
+            layer_list = net.layers
+        is_graph = hasattr(net, "_split")
+
+        def objective(p, states, batch, rng):
+            feats, labels, fmask, lmask = batch
+            if is_graph:
+                return net._loss_fn(p, states, feats, labels, fmask,
+                                    lmask, rng)
+            return net._loss_fn(p, states, feats, labels, fmask, lmask,
+                                rng=rng, train=True)
+
+        @jax.jit
+        def vg(flat, states, batch, rng):
+            (loss, new_states), grad = jax.value_and_grad(
+                lambda pp: objective(pp, states, batch, rng),
+                has_aux=True)(unravel(flat))
+            grad = mask_frozen(grad, layer_list)
+            return loss, ravel_pytree(grad)[0], new_states
+
+        @jax.jit
+        def v_only(flat, states, batch, rng):
+            # forward only: (loss, new_states) — line-search probes use
+            # the loss, the final state refresh uses new_states
+            return objective(unravel(flat), states, batch, rng)
+
+        net._solver_fns = (treedef, vg, v_only)
+        return vg, v_only
+
     def optimize(self, dataset) -> float:
         net = self.net
         net._check_init()
         training = net.conf.training
         algo = training.optimization_algo
-        feats = jnp.asarray(dataset.features)
-        labels = jnp.asarray(dataset.labels)
-        fmask = (None if dataset.features_mask is None
-                 else jnp.asarray(dataset.features_mask))
-        lmask = (None if dataset.labels_mask is None
-                 else jnp.asarray(dataset.labels_mask))
         flat0, unravel = ravel_pytree(net.params)
         net._rng, step_rng = jax.random.split(net._rng)
 
-        def objective(p, rng):
-            return net._loss_fn(p, net.states, feats, labels,
-                                fmask, lmask, rng=rng, train=True)
+        if hasattr(net, "_split"):
+            # ComputationGraph: per-input/per-output dicts
+            # (ref: BaseOptimizer.java:295-300 — same solver machinery
+            # serves MLN and CG, only the model adapter differs)
+            batch = net._split(dataset)
+        else:
+            batch = (
+                jnp.asarray(dataset.features), jnp.asarray(dataset.labels),
+                (None if dataset.features_mask is None
+                 else jnp.asarray(dataset.features_mask)),
+                (None if dataset.labels_mask is None
+                 else jnp.asarray(dataset.labels_mask)))
 
-        @jax.jit
-        def vg(flat, rng):
-            (loss, _), grad = jax.value_and_grad(
-                lambda pp: objective(pp, rng), has_aux=True)(unravel(flat))
-            return loss, ravel_pytree(grad)[0]
-
-        @jax.jit
-        def v_only(flat, rng):
-            return objective(unravel(flat), rng)[0]
+        vg, v_only = self._get_jitted(unravel)
+        states = net.states
 
         def vg_np(x):
-            l, g = vg(jnp.asarray(x, dtype=flat0.dtype), step_rng)
+            l, g, _ = vg(jnp.asarray(x, dtype=flat0.dtype), states, batch,
+                         step_rng)
             return float(l), np.asarray(g, dtype=np.float64)
 
         def f_np(x):
             # loss-only probe for line search: forward pass, no backward
-            return float(v_only(jnp.asarray(x, dtype=flat0.dtype), step_rng))
+            return float(v_only(jnp.asarray(x, dtype=flat0.dtype), states,
+                                batch, step_rng)[0])
 
         x, fx, _ = minimize(
             vg_np, np.asarray(flat0, np.float64), method=algo,
@@ -182,8 +223,27 @@ class Solver:
                 5, training.max_num_line_search_iterations))
         net.params = unravel(jnp.asarray(x, dtype=flat0.dtype))
         # refresh layer states (batchnorm running stats etc.) at the final
-        # parameters — the line-search objective doesn't carry them out
-        _, new_states = objective(net.params, step_rng)
+        # parameters — the line-search objective doesn't carry them out —
+        # and clear last_grads so listeners don't re-report stale SGD-path
+        # gradients
+        _, new_states = v_only(jnp.asarray(x, dtype=flat0.dtype),
+                               states, batch, step_rng)
         net.states = new_states
+        net.last_grads = None
         net.score_value = fx
         return fx
+
+
+def solver_fit_batch(net, data) -> float:
+    """One fit_batch iteration through the Solver, with the container's
+    bookkeeping (iteration count, listeners) — shared by MultiLayerNetwork
+    and ComputationGraph (ref: BaseOptimizer.java:295-300, the same solver
+    machinery serves both)."""
+    score = Solver(
+        net, max_iterations=max(1, net.conf.training.iterations),
+    ).optimize(data)
+    net.last_batch_size = data.num_examples()
+    net.iteration_count += 1
+    for listener in net.listeners:
+        listener.iteration_done(net, net.iteration_count, score)
+    return score

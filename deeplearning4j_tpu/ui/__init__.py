@@ -1,0 +1,25 @@
+"""Observability stack: stats collection, storage, and dashboard UI.
+
+Role parity with the reference's deeplearning4j-ui-parent (SURVEY.md §2.5):
+listener → compact binary StatsReport (native codec, stats_codec.cc) →
+StatsStorage (in-memory / file) → dashboard HTTP server. Ref:
+deeplearning4j-ui-model/.../stats/BaseStatsListener.java:43,
+deeplearning4j-core/.../api/storage/StatsStorage.java,
+deeplearning4j-play/.../play/PlayUIServer.java.
+"""
+
+from deeplearning4j_tpu.ui.codec import decode_report, encode_report
+from deeplearning4j_tpu.ui.stats import (StatsInitializationReport,
+                                         StatsListener, StatsReport)
+from deeplearning4j_tpu.ui.storage import (FileStatsStorage,
+                                           InMemoryStatsStorage,
+                                           RemoteStatsStorageRouter,
+                                           StatsStorage)
+from deeplearning4j_tpu.ui.server import UIServer
+
+__all__ = [
+    "StatsReport", "StatsInitializationReport", "StatsListener",
+    "StatsStorage", "InMemoryStatsStorage", "FileStatsStorage",
+    "RemoteStatsStorageRouter", "UIServer",
+    "encode_report", "decode_report",
+]

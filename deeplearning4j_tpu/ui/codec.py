@@ -1,0 +1,141 @@
+"""Binary codec for StatsReport records.
+
+The reference serializes stats with generated Simple Binary Encoding codecs
+(ref: deeplearning4j-ui-model/.../stats/sbe/{UpdateEncoder,UpdateDecoder}.java,
+~8.2k generated LoC). Here the wire format is implemented once in C++
+(native/stats_codec.cc) and loaded via ctypes; a bit-identical pure-Python
+encoder/decoder (struct module) is the fallback when the native lib is
+unavailable, mirroring the reference's helper-discovery pattern
+(ref: nn/layers/convolution/ConvolutionLayer.java:69-77).
+
+Wire layout (little-endian, version 1):
+  u32 magic "STAT"  u16 version  u16 flags
+  i64 iteration  i64 timestamp_ms  f64 score
+  f64 samples_per_sec  f64 batches_per_sec
+  u32 n_series; per series: u16 name_len, name, u32 count, f32 values[count]
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+from typing import Dict, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.native_loader import load_native
+
+_MAGIC = 0x53544154
+_VERSION = 1
+_HEADER = struct.Struct("<IHHqqddd")  # magic, ver, flags, iter, ts, score, sps, bps
+
+
+def _native():
+    lib = load_native("statscodec")
+    if lib is None:
+        return None
+    try:
+        lib.stats_encode.restype = ctypes.c_int64
+        lib.stats_decode_header.restype = ctypes.c_int
+        lib.stats_decode_series.restype = ctypes.c_int32
+    except AttributeError:
+        return None
+    return lib
+
+
+def encode_report(iteration: int, timestamp_ms: int, score: float,
+                  samples_per_sec: float, batches_per_sec: float,
+                  series: Dict[str, np.ndarray]) -> bytes:
+    """Encode one stats record. `series` maps name → float32 vector
+    (1-element vectors carry scalars like norms; longer ones carry
+    histogram counts/edges)."""
+    names = list(series.keys())
+    arrays = [np.ascontiguousarray(np.asarray(series[n], np.float32).ravel())
+              for n in names]
+    lib = _native()
+    if lib is not None:
+        cap = 52 + sum(2 + len(n.encode()) + 4 + 4 * a.size
+                       for n, a in zip(names, arrays)) + 64
+        out = (ctypes.c_uint8 * cap)()
+        name_bufs = [n.encode() for n in names]
+        c_names = (ctypes.c_char_p * max(len(names), 1))(*name_bufs)
+        c_vals = (ctypes.POINTER(ctypes.c_float) * max(len(names), 1))(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)) for a in arrays])
+        c_lens = (ctypes.c_int32 * max(len(names), 1))(
+            *[a.size for a in arrays])
+        n = lib.stats_encode(
+            ctypes.c_int64(iteration), ctypes.c_int64(timestamp_ms),
+            ctypes.c_double(score), ctypes.c_double(samples_per_sec),
+            ctypes.c_double(batches_per_sec), c_names, c_vals, c_lens,
+            ctypes.c_int32(len(names)), out, ctypes.c_int64(cap))
+        if n > 0:
+            return bytes(out[:n])
+    # pure-Python fallback, bit-identical layout
+    parts = [_HEADER.pack(_MAGIC, _VERSION, 0, iteration, timestamp_ms,
+                          score, samples_per_sec, batches_per_sec),
+             struct.pack("<I", len(names))]
+    for n, a in zip(names, arrays):
+        nb = n.encode()
+        parts.append(struct.pack("<H", len(nb)))
+        parts.append(nb)
+        parts.append(struct.pack("<I", a.size))
+        parts.append(a.tobytes())
+    return b"".join(parts)
+
+
+def decode_report(buf: bytes) -> Tuple[dict, Dict[str, np.ndarray]]:
+    """Decode one record → (header dict, series dict)."""
+    lib = _native()
+    if lib is not None:
+        it = ctypes.c_int64()
+        ts = ctypes.c_int64()
+        sc = ctypes.c_double()
+        sps = ctypes.c_double()
+        bps = ctypes.c_double()
+        ns = ctypes.c_int32()
+        raw = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
+        rc = lib.stats_decode_header(
+            raw, ctypes.c_int64(len(buf)), ctypes.byref(it), ctypes.byref(ts),
+            ctypes.byref(sc), ctypes.byref(sps), ctypes.byref(bps),
+            ctypes.byref(ns))
+        if rc == 0:
+            series: Dict[str, np.ndarray] = {}
+            name_buf = ctypes.create_string_buffer(4096)
+            val_cap = max(1, (len(buf) // 4) + 1)
+            val_buf = (ctypes.c_float * val_cap)()
+            ok = True
+            for i in range(ns.value):
+                cnt = lib.stats_decode_series(
+                    raw, ctypes.c_int64(len(buf)), ctypes.c_int32(i),
+                    name_buf, ctypes.c_int32(4096), val_buf,
+                    ctypes.c_int32(val_cap))
+                if cnt < 0:
+                    ok = False
+                    break
+                series[name_buf.value.decode()] = np.array(
+                    val_buf[:cnt], np.float32)
+            if ok:
+                header = {"iteration": it.value, "timestamp_ms": ts.value,
+                          "score": sc.value, "samples_per_sec": sps.value,
+                          "batches_per_sec": bps.value}
+                return header, series
+    # fallback decoder
+    magic, ver, _flags, iteration, ts_ms, score, sps_v, bps_v = \
+        _HEADER.unpack_from(buf, 0)
+    if magic != _MAGIC or ver != _VERSION:
+        raise ValueError("bad stats record")
+    (n_series,) = struct.unpack_from("<I", buf, _HEADER.size)
+    off = _HEADER.size + 4
+    series = {}
+    for _ in range(n_series):
+        (nl,) = struct.unpack_from("<H", buf, off)
+        off += 2
+        name = buf[off:off + nl].decode()
+        off += nl
+        (cnt,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        series[name] = np.frombuffer(buf, np.float32, cnt, off).copy()
+        off += 4 * cnt
+    header = {"iteration": iteration, "timestamp_ms": ts_ms, "score": score,
+              "samples_per_sec": sps_v, "batches_per_sec": bps_v}
+    return header, series

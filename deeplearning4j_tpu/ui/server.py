@@ -1,0 +1,226 @@
+"""Dashboard UI server.
+
+Role parity with the reference's Play-framework training dashboard
+(ref: deeplearning4j-play/.../play/PlayUIServer.java:374 and
+module/train/TrainModule.java — score chart, update:parameter ratios,
+throughput, system tab). Implemented on the stdlib http.server with one
+self-contained HTML page (inline JS drawing SVG charts; zero external
+assets, zero egress) polling JSON endpoints.
+
+Endpoints:
+  GET  /                      dashboard page
+  GET  /api/sessions          list of session ids
+  GET  /api/session?id=S      {init: {...}, reports: [...]} (scalars only)
+  POST /api/init              register session (JSON init report)
+  POST /api/post?session=S    ingest one binary StatsReport record
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from deeplearning4j_tpu.ui.stats import StatsInitializationReport, StatsReport
+from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage, StatsStorage
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>tpu-dl4j training UI</title>
+<style>
+ body{font-family:sans-serif;margin:20px;background:#fafafa}
+ h1{font-size:18px} h2{font-size:14px;margin:18px 0 4px}
+ .chart{background:#fff;border:1px solid #ddd;border-radius:4px}
+ #meta{font-size:12px;color:#555;white-space:pre}
+ select{margin-bottom:10px}
+</style></head><body>
+<h1>tpu-dl4j training dashboard</h1>
+<select id="sess"></select>
+<div id="meta"></div>
+<h2>Score vs iteration</h2><svg id="score" class="chart" width="860" height="220"></svg>
+<h2>log10 update:parameter ratio</h2><svg id="ratio" class="chart" width="860" height="220"></svg>
+<h2>Throughput (samples/sec)</h2><svg id="sps" class="chart" width="860" height="220"></svg>
+<script>
+const COLORS=['#1f77b4','#ff7f0e','#2ca02c','#d62728','#9467bd','#8c564b',
+              '#e377c2','#7f7f7f','#bcbd22','#17becf'];
+function line(svg, seriesMap){
+  svg.innerHTML='';
+  const W=svg.width.baseVal.value,H=svg.height.baseVal.value,P=34;
+  let xs=[],ys=[];
+  for(const pts of Object.values(seriesMap)){
+    for(const [x,y] of pts){ if(isFinite(y)){xs.push(x);ys.push(y);} }
+  }
+  if(!xs.length) return;
+  const x0=Math.min(...xs),x1=Math.max(...xs),y0=Math.min(...ys),y1=Math.max(...ys);
+  const sx=x=>P+(W-2*P)*(x1>x0?(x-x0)/(x1-x0):0.5);
+  const sy=y=>H-P-(H-2*P)*(y1>y0?(y-y0)/(y1-y0):0.5);
+  const ns='http://www.w3.org/2000/svg';
+  [[y0,H-P],[y1,P]].forEach(([v,py])=>{
+    const t=document.createElementNS(ns,'text');
+    t.setAttribute('x',2);t.setAttribute('y',py);t.setAttribute('font-size',10);
+    t.textContent=v.toPrecision(3);svg.appendChild(t);});
+  let i=0;
+  for(const [name,pts] of Object.entries(seriesMap)){
+    const p=document.createElementNS(ns,'path');
+    p.setAttribute('d',pts.filter(q=>isFinite(q[1]))
+      .map((q,j)=>(j?'L':'M')+sx(q[0])+','+sy(q[1])).join(' '));
+    p.setAttribute('fill','none');
+    p.setAttribute('stroke',COLORS[i%COLORS.length]);
+    svg.appendChild(p);
+    const t=document.createElementNS(ns,'text');
+    t.setAttribute('x',W-P-150);t.setAttribute('y',14+12*i);
+    t.setAttribute('font-size',10);t.setAttribute('fill',COLORS[i%COLORS.length]);
+    t.textContent=name;svg.appendChild(t);
+    i++;
+  }
+}
+async function refresh(){
+  const sel=document.getElementById('sess');
+  const sessions=await (await fetch('api/sessions')).json();
+  const cur=[...sel.options].map(o=>o.value);
+  if(JSON.stringify(cur)!==JSON.stringify(sessions)){
+    const keep=sel.value;
+    sel.innerHTML='';
+    for(const s of sessions){            // textContent: no HTML injection
+      const o=document.createElement('option');
+      o.textContent=s; o.value=s; sel.appendChild(o);
+    }
+    if(sessions.includes(keep)) sel.value=keep;
+  }
+  if(!sel.value) return;
+  const d=await (await fetch('api/session?id='+encodeURIComponent(sel.value))).json();
+  document.getElementById('meta').textContent=JSON.stringify(d.init||{},null,1);
+  const score=[],sps=[],ratios={};
+  for(const r of d.reports){
+    score.push([r.iteration,r.score]);
+    if(r.samples_per_sec>0) sps.push([r.iteration,r.samples_per_sec]);
+    for(const [k,v] of Object.entries(r.scalars||{})){
+      if(k.startsWith('ratio:')){
+        (ratios[k.slice(6)]=ratios[k.slice(6)]||[]).push(
+          [r.iteration,Math.log10(Math.max(v,1e-12))]);
+      }
+    }
+  }
+  line(document.getElementById('score'),{score});
+  line(document.getElementById('ratio'),ratios);
+  line(document.getElementById('sps'),{'samples/sec':sps});
+}
+setInterval(refresh,2000); refresh();
+</script></body></html>
+"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    storage: StatsStorage = None  # set by UIServer
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str = "application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        try:
+            self._do_get()
+        except Exception as e:  # report instead of dropping the connection
+            self._send(500, json.dumps({"error": str(e)}).encode())
+
+    def do_POST(self):
+        try:
+            self._do_post()
+        except Exception as e:
+            self._send(500, json.dumps({"error": str(e)}).encode())
+
+    def _do_get(self):
+        url = urllib.parse.urlparse(self.path)
+        if url.path in ("/", "/train"):
+            self._send(200, _PAGE.encode(), "text/html; charset=utf-8")
+        elif url.path == "/api/sessions":
+            self._send(200, json.dumps(self.storage.list_sessions()).encode())
+        elif url.path == "/api/session":
+            q = urllib.parse.parse_qs(url.query)
+            sid = q.get("id", [""])[0]
+            init = self.storage.get_init_report(sid)
+            reports = []
+            for r in self.storage.get_reports(sid):
+                reports.append({
+                    "iteration": r.iteration, "timestamp_ms": r.timestamp_ms,
+                    "score": r.score, "samples_per_sec": r.samples_per_sec,
+                    "batches_per_sec": r.batches_per_sec,
+                    "scalars": {k: float(v[0]) for k, v in r.series.items()
+                                if v.size == 1}})
+            body = {"init": None if init is None else {
+                        "software": init.software, "hardware": init.hardware,
+                        "model": init.model},
+                    "reports": reports}
+            self._send(200, json.dumps(body).encode())
+        else:
+            self._send(404, b"{}")
+
+    def _do_post(self):
+        url = urllib.parse.urlparse(self.path)
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        if url.path == "/api/init":
+            d = json.loads(body.decode())
+            rep = StatsInitializationReport(
+                session_id=d["session_id"],
+                timestamp_ms=d.get("timestamp_ms", 0),
+                software=d.get("software", {}), hardware=d.get("hardware", {}),
+                model=d.get("model", {}))
+            self.storage.put_init_report(rep)
+            self._send(200, b"{}")
+        elif url.path == "/api/post":
+            q = urllib.parse.parse_qs(url.query)
+            sid = q.get("session", ["default"])[0]
+            self.storage.put_report(sid, StatsReport.decode(body))
+            self._send(200, b"{}")
+        else:
+            self._send(404, b"{}")
+
+
+class UIServer:
+    """Singleton-style dashboard server (ref: PlayUIServer.getInstance()
+    pattern, deeplearning4j-ui/.../api/UIServer.java)."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000,
+                 storage: Optional[StatsStorage] = None):
+        self.storage = storage or InMemoryStatsStorage()
+        handler = type("BoundHandler", (_Handler,), {"storage": self.storage})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = cls(port=port)
+            cls._instance.start()
+        return cls._instance
+
+    def attach(self, storage: StatsStorage) -> None:
+        """Serve an existing storage (ref: UIServer.attach(StatsStorage))."""
+        self.storage = storage
+        self._httpd.RequestHandlerClass.storage = storage
+
+    def start(self) -> "UIServer":
+        self._thread.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if UIServer._instance is self:
+            UIServer._instance = None

@@ -1,0 +1,204 @@
+"""Stats collection listener and report types.
+
+Role parity: BaseStatsListener gathers per-iteration score, parameter /
+gradient / update histograms & norms, memory and hardware info, and routes
+serialized reports to a StatsStorageRouter
+(ref: deeplearning4j-ui-model/.../stats/BaseStatsListener.java:43,287-537;
+init report: .../stats/impl/SbeStatsInitializationReport.java).
+"""
+
+from __future__ import annotations
+
+import platform
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.ui.codec import decode_report, encode_report
+
+
+@dataclass
+class StatsReport:
+    """One per-iteration record (ref: SbeStatsReport.java)."""
+    iteration: int
+    timestamp_ms: int
+    score: float
+    samples_per_sec: float = 0.0
+    batches_per_sec: float = 0.0
+    # name → float32 vector; scalar stats are 1-element vectors, histograms
+    # are "<name>#counts" / "<name>#edges" pairs.
+    series: Dict[str, np.ndarray] = field(default_factory=dict)
+
+    def encode(self) -> bytes:
+        return encode_report(self.iteration, self.timestamp_ms, self.score,
+                             self.samples_per_sec, self.batches_per_sec,
+                             self.series)
+
+    @staticmethod
+    def decode(buf: bytes) -> "StatsReport":
+        header, series = decode_report(buf)
+        return StatsReport(iteration=header["iteration"],
+                           timestamp_ms=header["timestamp_ms"],
+                           score=header["score"],
+                           samples_per_sec=header["samples_per_sec"],
+                           batches_per_sec=header["batches_per_sec"],
+                           series=series)
+
+    def scalars(self, prefix: str) -> Dict[str, float]:
+        return {k: float(v[0]) for k, v in self.series.items()
+                if k.startswith(prefix) and v.size == 1}
+
+
+@dataclass
+class StatsInitializationReport:
+    """Static session info sent once (ref: SbeStatsInitializationReport.java:
+    hardware, software, model info)."""
+    session_id: str
+    timestamp_ms: int
+    software: Dict[str, str] = field(default_factory=dict)
+    hardware: Dict[str, str] = field(default_factory=dict)
+    model: Dict[str, str] = field(default_factory=dict)
+
+    @staticmethod
+    def create(session_id: str, net=None) -> "StatsInitializationReport":
+        sw = {"python": platform.python_version(),
+              "os": platform.system()}
+        hw = {}
+        try:
+            import jax
+            sw["jax"] = jax.__version__
+            devs = jax.devices()
+            hw = {"backend": devs[0].platform, "device_count": str(len(devs)),
+                  "device_kind": getattr(devs[0], "device_kind", "unknown")}
+        except Exception:
+            pass
+        model = {}
+        if net is not None:
+            try:
+                model = {"class": type(net).__name__,
+                         "n_layers": str(len(getattr(net, "layers", []))),
+                         "n_params": str(net.num_params())}
+            except Exception:
+                model = {"class": type(net).__name__}
+        return StatsInitializationReport(
+            session_id=session_id, timestamp_ms=int(time.time() * 1000),
+            software=sw, hardware=hw, model=model)
+
+
+def _flat_params(params) -> Dict[str, np.ndarray]:
+    """Flatten the per-layer param dicts into 'layerIdx.name' host arrays."""
+    out: Dict[str, np.ndarray] = {}
+    if params is None:
+        return out
+    if isinstance(params, dict):
+        items = params.items()
+    else:
+        items = ((str(i), d) for i, d in enumerate(params))
+    for key, d in items:
+        if not isinstance(d, dict):
+            continue
+        for name, arr in d.items():
+            out[f"{key}.{name}"] = np.asarray(arr, np.float32)
+    return out
+
+
+class StatsListener(TrainingListener):
+    """Collects per-iteration stats into a StatsStorage router.
+
+    What it gathers (ref: BaseStatsListener.java:287-537): score, wall time,
+    throughput, parameter norms, update norms (delta of params between
+    iterations — the applied update, same quantity the reference charts as
+    "Update:Parameter Ratio"), gradient norms when the model exposes its
+    last gradients, and (every `histogram_frequency` iterations) parameter
+    histograms.
+    """
+
+    # tells the network's train step to also output the gradient pytree
+    # (networks check this via getattr; keeps nn/ free of ui imports)
+    collects_gradients = True
+
+    def __init__(self, storage, session_id: Optional[str] = None,
+                 frequency: int = 1, histogram_frequency: int = 0,
+                 n_bins: int = 20):
+        self.storage = storage
+        self.session_id = session_id or f"session-{int(time.time()*1000)}"
+        self.frequency = max(1, frequency)
+        self.histogram_frequency = histogram_frequency  # 0 = never
+        self.n_bins = n_bins
+        self._prev_params: Optional[Dict[str, np.ndarray]] = None
+        self._last_time: Optional[float] = None
+        self._init_sent = False
+        self._skipped = 0  # iterations since last report
+
+    # ------------------------------------------------------------ collection
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        if not self._init_sent:
+            self.storage.put_init_report(
+                StatsInitializationReport.create(self.session_id, model))
+            self._init_sent = True
+        now = time.perf_counter()
+        if iteration % self.frequency != 0:
+            # no device→host transfer on skipped iterations; update norms
+            # are computed over the whole reporting interval
+            self._skipped += 1
+            return
+        flat = _flat_params(getattr(model, "params", None))
+        series: Dict[str, np.ndarray] = {}
+        sps = bps = 0.0
+        interval = self._skipped + 1
+        if self._last_time is not None:
+            dt = now - self._last_time
+            if dt > 0:
+                batch = getattr(model, "last_batch_size", 0) or 0
+                sps = batch * interval / dt
+                bps = interval / dt
+        for name, arr in flat.items():
+            series[f"param_norm:{name}"] = np.array(
+                [np.linalg.norm(arr)], np.float32)
+            if self._prev_params is not None and name in self._prev_params \
+                    and self._prev_params[name].shape == arr.shape:
+                upd = arr - self._prev_params[name]
+                un = float(np.linalg.norm(upd))
+                series[f"update_norm:{name}"] = np.array([un], np.float32)
+                pn = float(np.linalg.norm(arr))
+                if pn > 0:
+                    series[f"ratio:{name}"] = np.array([un / pn], np.float32)
+        grads = getattr(model, "last_grads", None)
+        for name, arr in _flat_params(grads).items():
+            series[f"grad_norm:{name}"] = np.array(
+                [np.linalg.norm(arr)], np.float32)
+        if self.histogram_frequency and \
+                iteration % self.histogram_frequency == 0:
+            for name, arr in flat.items():
+                counts, edges = np.histogram(arr, bins=self.n_bins)
+                series[f"hist_param:{name}#counts"] = counts.astype(np.float32)
+                series[f"hist_param:{name}#edges"] = edges.astype(np.float32)
+        self._mem_stats(series)
+        report = StatsReport(iteration=iteration,
+                             timestamp_ms=int(time.time() * 1000),
+                             score=float(score), samples_per_sec=sps,
+                             batches_per_sec=bps, series=series)
+        self.storage.put_report(self.session_id, report)
+        self._prev_params = flat
+        self._last_time = now
+        self._skipped = 0
+
+    @staticmethod
+    def _mem_stats(series: Dict[str, np.ndarray]) -> None:
+        try:
+            import resource
+            rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            series["mem:host_rss_mb"] = np.array([rss_kb / 1024.0], np.float32)
+        except Exception:
+            pass
+        try:
+            import jax
+            ms = jax.devices()[0].memory_stats()
+            if ms and "bytes_in_use" in ms:
+                series["mem:device_mb"] = np.array(
+                    [ms["bytes_in_use"] / 2**20], np.float32)
+        except Exception:
+            pass

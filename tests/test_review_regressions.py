@@ -281,3 +281,115 @@ def test_moe_aux_loss_reaches_gradients():
 
     # loss must move when only the aux weight changes -> aux term is in it
     assert loss_with_weight(1.0) != pytest.approx(loss_with_weight(0.0))
+
+
+def test_computation_graph_lbfgs_dispatches_to_solver():
+    """Round-1 review: CG.fit_batch silently ran the SGD path for
+    line-search algorithms; it must route through the Solver like MLN
+    (ref: BaseOptimizer.java:295-300)."""
+    from deeplearning4j_tpu.nn.conf.inputs import InputType as IT
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5).updater("sgd", learning_rate=0.1)
+            .optimization_algo("lbfgs")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("h", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "h")
+            .set_outputs("out")
+            .set_input_types(IT.feed_forward(4))
+            .build())
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    ds = DataSet(x, y)
+    s0 = net.score(ds)
+    for _ in range(3):
+        last = net.fit_batch(ds)
+    assert last < s0  # L-BFGS actually optimized the batch objective
+
+
+def test_kmeans_degenerate_duplicate_points():
+    """Round-1 review: k-means++ seeding crashed when fewer than k
+    distinct points exist (all-zero probability vector)."""
+    from deeplearning4j_tpu.clustering import KMeansClustering
+
+    x = np.tile(np.array([[1.0, 2.0, 3.0]], np.float32), (20, 1))
+    km = KMeansClustering(3, max_iterations=5).fit(x)
+    assert km.cluster_centers_.shape == (3, 3)
+    assert (km.predict(x) >= 0).all()
+
+
+def test_graph_values_accepts_numpy_array():
+    """Round-1 review: Graph(n, values=np.array([...])) raised on the
+    ambiguous ndarray truth value."""
+    from deeplearning4j_tpu.graph import Graph
+
+    g = Graph(4, values=np.array([10, 20, 30, 40]))
+    assert g.get_vertex(2).value == 30
+
+
+def test_weighted_walk_distribution():
+    """Vectorized weighted sampling must still follow edge weights."""
+    from deeplearning4j_tpu.graph import Graph
+    from deeplearning4j_tpu.graph.walks import WeightedRandomWalkIterator
+
+    g = Graph(3)
+    g.add_edge(0, 1, weight=9.0, directed=True)
+    g.add_edge(0, 2, weight=1.0, directed=True)
+    g.add_edge(1, 0, weight=1.0, directed=True)
+    g.add_edge(2, 0, weight=1.0, directed=True)
+    counts = {1: 0, 2: 0}
+    it = WeightedRandomWalkIterator(g, walk_length=2, seed=7)
+    for _ in range(30):
+        for walk in it:  # each __iter__ draws a fresh epoch of walks
+            if walk[0] == 0:
+                counts[walk[1]] += 1
+    frac = counts[1] / max(counts[1] + counts[2], 1)
+    assert 0.8 < frac < 1.0, counts
+
+
+def test_lbfgs_respects_frozen_layers():
+    """Round-1 review: the line-search Solver path moved frozen params."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater("sgd", learning_rate=0.1)
+            .optimization_algo("lbfgs")
+            .list()
+            .layer(DenseLayer(n_out=8, activation="tanh", frozen=True))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    w0 = np.asarray(net.params[0]["W"]).copy()
+    out0 = np.asarray(net.params[1]["W"]).copy()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    net.fit_batch(DataSet(x, y))
+    np.testing.assert_array_equal(np.asarray(net.params[0]["W"]), w0)
+    assert not np.allclose(np.asarray(net.params[1]["W"]), out0)
+
+
+def test_weighted_walk_zero_weight_vertex_isolated():
+    """Round-1 review: a vertex whose out-edges all have weight 0 must not
+    corrupt sampling for other vertices (NaN in the global CDF)."""
+    from deeplearning4j_tpu.graph import Graph
+    from deeplearning4j_tpu.graph.walks import WeightedRandomWalkIterator
+
+    g = Graph(6)
+    g.add_edge(2, 3, weight=0.0, directed=True)   # degenerate vertex 2
+    g.add_edge(4, 0, weight=1.0, directed=True)
+    g.add_edge(4, 5, weight=3.0, directed=True)   # vertex AFTER the zero seg
+    for v in (0, 1, 3, 5):
+        g.add_edge(v, 2, weight=1.0, directed=True)
+    counts = {0: 0, 5: 0}
+    it = WeightedRandomWalkIterator(g, walk_length=2, seed=3)
+    for _ in range(60):
+        for walk in it:
+            if walk[0] == 4:
+                counts[walk[1]] += 1
+    frac5 = counts[5] / max(sum(counts.values()), 1)
+    assert 0.6 < frac5 < 0.9, counts  # 3:1 weights => ~0.75

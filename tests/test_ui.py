@@ -1,0 +1,188 @@
+"""Observability stack tests (models the reference's ui-model tests:
+TestStatsListener, TestStatsClasses SBE encode/decode round-trips,
+TestStatsStorage — SURVEY.md §4 'UI tests')."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
+                                   RemoteStatsStorageRouter, StatsListener,
+                                   StatsReport, UIServer)
+from deeplearning4j_tpu.ui import codec as codec_mod
+
+
+def _tiny_net():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater("adam", learning_rate=0.05)
+            .list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _tiny_data(n=32):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 5).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+    return DataSet(x, y)
+
+
+def _sample_report():
+    return StatsReport(
+        iteration=42, timestamp_ms=1234567, score=0.5,
+        samples_per_sec=100.0, batches_per_sec=3.125,
+        series={"param_norm:0.W": np.array([1.5], np.float32),
+                "hist_param:0.W#counts": np.arange(10, dtype=np.float32)})
+
+
+def test_codec_roundtrip():
+    rep = _sample_report()
+    out = StatsReport.decode(rep.encode())
+    assert out.iteration == 42 and out.timestamp_ms == 1234567
+    assert out.score == pytest.approx(0.5)
+    assert out.samples_per_sec == pytest.approx(100.0)
+    np.testing.assert_allclose(out.series["param_norm:0.W"], [1.5])
+    np.testing.assert_allclose(out.series["hist_param:0.W#counts"],
+                               np.arange(10))
+
+
+def test_codec_python_fallback_matches_native(monkeypatch):
+    rep = _sample_report()
+    native_bytes = rep.encode()
+    monkeypatch.setattr(codec_mod, "_native", lambda: None)
+    py_bytes = rep.encode()
+    # bit-identical wire format regardless of implementation
+    assert native_bytes == py_bytes
+    out = StatsReport.decode(py_bytes)
+    assert out.iteration == 42
+    np.testing.assert_allclose(out.series["param_norm:0.W"], [1.5])
+
+
+def test_stats_listener_collects():
+    storage = InMemoryStatsStorage()
+    net = _tiny_net()
+    listener = StatsListener(storage, session_id="s1",
+                             histogram_frequency=2)
+    net.set_listeners(listener)
+    ds = _tiny_data()
+    for _ in range(4):
+        net.fit_batch(ds)
+    assert storage.list_sessions() == ["s1"]
+    reports = storage.get_reports("s1")
+    assert len(reports) == 4
+    last = reports[-1]
+    keys = set(last.series.keys())
+    assert "param_norm:0.W" in keys
+    assert "update_norm:0.W" in keys
+    assert "ratio:0.W" in keys
+    assert "grad_norm:0.W" in keys
+    assert any(k.startswith("hist_param:") for k in keys)
+    init = storage.get_init_report("s1")
+    assert init is not None and init.model["n_layers"] == "2"
+    # round-trip every collected report through the wire format
+    for r in reports:
+        back = StatsReport.decode(r.encode())
+        assert back.iteration == r.iteration
+
+
+def test_file_storage_replay(tmp_path):
+    path = str(tmp_path / "stats.bin")
+    storage = FileStatsStorage(path)
+    net = _tiny_net()
+    net.set_listeners(StatsListener(storage, session_id="file-sess"))
+    ds = _tiny_data()
+    for _ in range(3):
+        net.fit_batch(ds)
+    storage.close()
+    # replay from disk into a fresh index
+    reopened = FileStatsStorage(path)
+    assert reopened.list_sessions() == ["file-sess"]
+    reports = reopened.get_reports("file-sess")
+    assert len(reports) == 3
+    assert reports[0].iteration == 1
+    assert reopened.get_init_report("file-sess") is not None
+    reopened.close()
+
+
+def test_ui_server_and_remote_router():
+    server = UIServer(port=0).start()
+    try:
+        router = RemoteStatsStorageRouter(server.url)
+        net = _tiny_net()
+        net.set_listeners(StatsListener(router, session_id="remote-sess"))
+        ds = _tiny_data()
+        for _ in range(2):
+            net.fit_batch(ds)
+        router.flush()
+        sessions = json.loads(urllib.request.urlopen(
+            server.url + "/api/sessions", timeout=5).read())
+        assert "remote-sess" in sessions
+        data = json.loads(urllib.request.urlopen(
+            server.url + "/api/session?id=remote-sess", timeout=5).read())
+        assert len(data["reports"]) == 2
+        assert data["reports"][-1]["score"] > 0
+        assert any(k.startswith("param_norm:")
+                   for k in data["reports"][-1]["scalars"])
+        assert data["init"]["model"]["n_layers"] == "2"
+        page = urllib.request.urlopen(server.url + "/", timeout=5).read()
+        assert b"training dashboard" in page
+    finally:
+        server.stop()
+
+
+def test_file_storage_truncated_tail(tmp_path):
+    """A torn trailing record (kill mid-append) must not lose the log."""
+    path = str(tmp_path / "stats.bin")
+    storage = FileStatsStorage(path)
+    storage.put_report("s", _sample_report())
+    storage.put_report("s", _sample_report())
+    storage.close()
+    size = __import__("os").path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - 7)  # tear the second record
+    re = FileStatsStorage(path)
+    assert len(re.get_reports("s")) == 1
+    # appending after reopen lands on a clean record boundary
+    re.put_report("s", _sample_report())
+    re.close()
+    re2 = FileStatsStorage(path)
+    assert len(re2.get_reports("s")) == 2
+    re2.close()
+
+
+def test_remote_router_survives_dead_server():
+    """A dashboard outage must not abort training (circuit breaker,
+    async delivery off the training thread)."""
+    import time
+    router = RemoteStatsStorageRouter("http://127.0.0.1:1", max_failures=2,
+                                      timeout=0.5)
+    net = _tiny_net()
+    net.set_listeners(StatsListener(router, session_id="dead"))
+    ds = _tiny_data()
+    for _ in range(4):  # would raise URLError without the guard
+        net.fit_batch(ds)
+    deadline = time.monotonic() + 10
+    while router._consecutive_failures < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert router._consecutive_failures >= 2
+
+
+def test_stats_listener_frequency_interval_norms():
+    storage = InMemoryStatsStorage()
+    net = _tiny_net()
+    net.set_listeners(StatsListener(storage, session_id="f3", frequency=3))
+    ds = _tiny_data()
+    for _ in range(9):
+        net.fit_batch(ds)
+    reports = storage.get_reports("f3")
+    assert [r.iteration for r in reports] == [3, 6, 9]
+    # update norm over the 3-step interval is present from the 2nd report
+    assert "update_norm:0.W" in reports[1].series
