@@ -173,6 +173,23 @@ class MultiLayerConfiguration:
         return MultiLayerConfiguration.from_dict(json.loads(s))
 
 
+def validate_layer_options(layers) -> None:
+    """Fail at config-build time (not first forward) on unknown
+    activation/loss names — misconfiguration should not wait for tracing."""
+    from deeplearning4j_tpu.ops.activations import get_activation
+    from deeplearning4j_tpu.ops.losses import get_loss
+    for l in layers:
+        act = getattr(l, "activation", None)
+        if act:
+            get_activation(act)
+        gate = getattr(l, "gate_activation", None)
+        if gate:
+            get_activation(gate)
+        loss = getattr(l, "loss", None)
+        if loss:
+            get_loss(loss)
+
+
 class ListBuilder:
     """Sequential-stack builder (ref: NeuralNetConfiguration.ListBuilder)."""
 
@@ -218,6 +235,7 @@ class ListBuilder:
         # 1. inherit global hyperparams (ref: Builder.layer() semantics)
         for l in self._layers:
             l.apply_global_defaults(g)
+        validate_layer_options(self._layers)
         # 2. shape inference + auto preprocessors (ref: setInputType flow)
         input_types: List[InputType] = []
         cur = self._input_type

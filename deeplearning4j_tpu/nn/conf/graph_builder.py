@@ -215,6 +215,9 @@ class GraphBuilder:
         for node in self._nodes.values():
             if node.layer is not None:
                 node.layer.apply_global_defaults(g)
+        from deeplearning4j_tpu.nn.conf.builder import validate_layer_options
+        validate_layer_options([n.layer for n in self._nodes.values()
+                                if n.layer is not None])
         conf = ComputationGraphConfiguration(
             nodes=self._nodes,
             network_inputs=self._inputs,

@@ -112,9 +112,32 @@ class LSTM(BaseLayerConf):
                             self.forget_gate_bias_init, self._peephole)
         return h2, (h2, c2)
 
+    def _fused_kernel_ok(self, mask) -> bool:
+        """Helper-discovery decision (the reference's cuDNN-helper seam,
+        ref: ConvolutionLayer.java:55-77): use the Pallas fused kernel when
+        the configuration matches what the kernel hardcodes."""
+        from deeplearning4j_tpu.ops import pallas_kernels
+        return (pallas_kernels.lstm_mode() != "off"
+                and mask is None
+                and self.gate_activation == "sigmoid"
+                and (self.activation or "tanh") == "tanh")
+
     def scan(self, params: Params, x: Array, carry, mask: Optional[Array],
              reverse: bool = False):
         """Run the full sequence [B, T, F] -> ([B, T, H], final_carry)."""
+        if self._fused_kernel_ok(mask):
+            from deeplearning4j_tpu.ops.pallas_kernels import (
+                fused_lstm, lstm_mode)
+            h0, c0 = carry
+            xin = jnp.flip(x, axis=1) if reverse else x
+            ys, hT, cT = fused_lstm(
+                xin, params["W"], params["RW"], params["b"],
+                params.get("pW") if self._peephole else None, h0, c0,
+                forget_bias=self.forget_gate_bias_init,
+                interpret=lstm_mode() == "interpret")
+            if reverse:
+                ys = jnp.flip(ys, axis=1)
+            return ys, (hT, cT)
         gate_act = get_activation(self.gate_activation)
         out_act = get_activation(self.activation or "tanh")
 

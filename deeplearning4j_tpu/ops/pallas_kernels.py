@@ -1,0 +1,330 @@
+"""Pallas TPU kernels for the hot sequential ops.
+
+The reference's accelerated-layer seam is the cuDNN helper pattern: layer
+impls probe for a platform kernel and fall back to the built-in path
+(ref: nn/layers/convolution/ConvolutionLayer.java:55-77 Class.forName
+discovery; the LSTM there is pure Java over gemm,
+ref: nn/layers/recurrent/LSTMHelpers.java:57-420). SURVEY §2.2 maps that
+obligation to "a lax.scan-style fused LSTM (or Pallas kernel)". This module
+is that kernel: the recurrence runs entirely in VMEM — weights ``RW`` and
+the (h, c) carry stay on-chip across all T grid steps — so the only HBM
+traffic per step is one [B, 4H] slice of the precomputed input projection
+and the written outputs. The input projection ``x @ W + b`` is deliberately
+NOT in the kernel: it has no sequential dependency, so it runs as one big
+[B*T, in] x [in, 4H] matmul on the MXU before the kernel launches.
+
+Backward is a custom VJP whose sequential part is a second Pallas kernel
+(reverse grid) producing per-step pre-activation gradients ``dz``; all
+weight gradients are then single large matmuls outside the kernel
+(dW = x^T dz, dRW = h_{t-1}^T dz, ...), again MXU-shaped.
+
+Dispatch seam (mirrors the reference's helper discovery): ``lstm_mode()``
+reads ``DL4J_TPU_PALLAS`` — "auto" (default: compiled kernel on TPU, scan
+elsewhere), "interpret" (kernel in interpreter mode — how CPU CI exercises
+the kernel path), "0" (always scan). Gradient-check parity between the two
+paths is enforced by tests/test_pallas_kernels.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas ships with jax, but keep the probe-and-fallback seam anyway
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+
+def lstm_mode() -> str:
+    """'compiled' | 'interpret' | 'off' — the helper-discovery decision."""
+    env = os.environ.get("DL4J_TPU_PALLAS", "auto")
+    if not _HAVE_PALLAS or env in ("0", "off", "false"):
+        return "off"
+    if env == "interpret":
+        return "interpret"
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        return "off"
+    return "compiled" if platform == "tpu" else "off"
+
+
+# ---------------------------------------------------------------------------
+# fused LSTM: forward kernel
+# ---------------------------------------------------------------------------
+
+def _lstm_fwd_kernel(xz_ref, rw_ref, pw_ref, h0_ref, c0_ref, fb_ref,
+                     hs_ref, gates_ref, cs_ref, h_scr, c_scr):
+    """One grid step = one timestep. Carry (h, c) lives in VMEM scratch,
+    persisting across the sequentially-executed grid."""
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:]
+        c_scr[:] = c0_ref[:]
+
+    h = h_scr[:]
+    c = c_scr[:]
+    H = h.shape[-1]
+    z = xz_ref[0] + jnp.dot(h, rw_ref[:], preferred_element_type=h.dtype)
+    zi, zf, zg, zo = z[:, :H], z[:, H:2 * H], z[:, 2 * H:3 * H], z[:, 3 * H:]
+    pw = pw_ref[:]  # [3H]; zeros when the cell has no peepholes
+    zi = zi + c * pw[None, :H]
+    zf = zf + c * pw[None, H:2 * H]
+    i = jax.nn.sigmoid(zi)
+    f = jax.nn.sigmoid(zf + fb_ref[0])
+    g = jnp.tanh(zg)
+    c_new = f * c + i * g
+    zo = zo + c_new * pw[None, 2 * H:]
+    o = jax.nn.sigmoid(zo)
+    h_new = o * jnp.tanh(c_new)
+
+    h_scr[:] = h_new
+    c_scr[:] = c_new
+    hs_ref[0] = h_new
+    cs_ref[0] = c_new
+    gates_ref[0] = jnp.concatenate([i, f, g, o], axis=-1)
+
+
+def _lstm_fwd_infer_kernel(xz_ref, rw_ref, pw_ref, h0_ref, c0_ref, fb_ref,
+                           hs_ref, cT_ref, h_scr, c_scr):
+    """Forward-only variant: no gate/cell caches — per-step HBM writes are
+    just the hidden slice (plus the final cell block, whose index never
+    changes so only the last write lands)."""
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:]
+        c_scr[:] = c0_ref[:]
+
+    h = h_scr[:]
+    c = c_scr[:]
+    H = h.shape[-1]
+    z = xz_ref[0] + jnp.dot(h, rw_ref[:], preferred_element_type=h.dtype)
+    zi, zf, zg, zo = z[:, :H], z[:, H:2 * H], z[:, 2 * H:3 * H], z[:, 3 * H:]
+    pw = pw_ref[:]
+    i = jax.nn.sigmoid(zi + c * pw[None, :H])
+    f = jax.nn.sigmoid(zf + c * pw[None, H:2 * H] + fb_ref[0])
+    g = jnp.tanh(zg)
+    c_new = f * c + i * g
+    o = jax.nn.sigmoid(zo + c_new * pw[None, 2 * H:])
+    h_new = o * jnp.tanh(c_new)
+
+    h_scr[:] = h_new
+    c_scr[:] = c_new
+    hs_ref[0] = h_new
+    cT_ref[:] = c_new
+
+
+def _run_lstm_fwd_infer(xz, rw, pw, h0, c0, forget_bias, interpret):
+    T, B, H4 = xz.shape
+    H = H4 // 4
+    dt = xz.dtype
+    fb = jnp.full((1,), forget_bias, dt)
+    step = lambda t: (t, 0, 0)
+    fixed = lambda t: (0, 0)
+    return pl.pallas_call(
+        _lstm_fwd_infer_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, 4 * H), step),
+            pl.BlockSpec((H, 4 * H), fixed),
+            pl.BlockSpec((3 * H,), lambda t: (0,)),
+            pl.BlockSpec((B, H), fixed),
+            pl.BlockSpec((B, H), fixed),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H), step),
+            pl.BlockSpec((B, H), fixed),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H), dt),
+            jax.ShapeDtypeStruct((B, H), dt),
+        ],
+        scratch_shapes=[pltpu.VMEM((B, H), dt), pltpu.VMEM((B, H), dt)],
+        interpret=interpret,
+    )(xz, rw, pw, h0, c0, fb)
+
+
+def _lstm_bwd_kernel(eps_ref, gates_ref, cs_ref, cprev_ref, rwT_ref, pw_ref,
+                     dhT_ref, dcT_ref, dz_ref, dh0_ref, dc0_ref,
+                     dh_scr, dc_scr):
+    """Reverse-time grid. Emits dz_t (pre-activation grads, gate order
+    i,f,g,o); carries (dh, dc) in VMEM scratch, seeded with the cotangents
+    of the final (h_T, c_T) outputs. The final carries (= dL/dh0, dL/dc0)
+    are written to dedicated outputs whose block index never changes, so
+    the last grid step's value is what lands in HBM."""
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        dh_scr[:] = dhT_ref[:]
+        dc_scr[:] = dcT_ref[:]
+
+    H = dh_scr.shape[-1]
+    gates = gates_ref[0]
+    i = gates[:, :H]
+    f = gates[:, H:2 * H]
+    g = gates[:, 2 * H:3 * H]
+    o = gates[:, 3 * H:]
+    c_t = cs_ref[0]
+    c_prev = cprev_ref[0]
+    pw = pw_ref[:]
+    pi, pf, po = pw[None, :H], pw[None, H:2 * H], pw[None, 2 * H:]
+
+    dh = dh_scr[:] + eps_ref[0]
+    tc = jnp.tanh(c_t)
+    do = dh * tc
+    dzo = do * o * (1.0 - o)
+    dc = dc_scr[:] + dh * o * (1.0 - tc * tc) + dzo * po
+    di = dc * g
+    dzi = di * i * (1.0 - i)
+    df = dc * c_prev
+    dzf = df * f * (1.0 - f)
+    dg = dc * i
+    dzg = dg * (1.0 - g * g)
+    dz = jnp.concatenate([dzi, dzf, dzg, dzo], axis=-1)
+
+    dc_prev = dc * f + dzi * pi + dzf * pf
+    dh_prev = jnp.dot(dz, rwT_ref[:], preferred_element_type=dz.dtype)
+    dc_scr[:] = dc_prev
+    dh_scr[:] = dh_prev
+    dz_ref[0] = dz
+    dh0_ref[:] = dh_prev
+    dc0_ref[:] = dc_prev
+
+
+def _run_lstm_fwd(xz, rw, pw, h0, c0, forget_bias, interpret):
+    T, B, H4 = xz.shape
+    H = H4 // 4
+    dt = xz.dtype
+    fb = jnp.full((1,), forget_bias, dt)
+    step = lambda t: (t, 0, 0)
+    return pl.pallas_call(
+        _lstm_fwd_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, 4 * H), step),
+            pl.BlockSpec((H, 4 * H), lambda t: (0, 0)),
+            pl.BlockSpec((3 * H,), lambda t: (0,)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H), step),
+            pl.BlockSpec((1, B, 4 * H), step),
+            pl.BlockSpec((1, B, H), step),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H), dt),      # hs
+            jax.ShapeDtypeStruct((T, B, 4 * H), dt),  # gate cache
+            jax.ShapeDtypeStruct((T, B, H), dt),      # cell cache
+        ],
+        scratch_shapes=[pltpu.VMEM((B, H), dt), pltpu.VMEM((B, H), dt)],
+        interpret=interpret,
+    )(xz, rw, pw, h0, c0, fb)
+
+
+def _run_lstm_bwd(eps, gates, cs, c_prev, rw, pw, dhT, dcT, interpret):
+    T, B, H4 = gates.shape
+    H = H4 // 4
+    dt = eps.dtype
+    rev = lambda t: (T - 1 - t, 0, 0)
+    fixed = lambda t: (0, 0)
+    return pl.pallas_call(
+        _lstm_bwd_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H), rev),
+            pl.BlockSpec((1, B, 4 * H), rev),
+            pl.BlockSpec((1, B, H), rev),
+            pl.BlockSpec((1, B, H), rev),
+            pl.BlockSpec((4 * H, H), fixed),
+            pl.BlockSpec((3 * H,), lambda t: (0,)),
+            pl.BlockSpec((B, H), fixed),
+            pl.BlockSpec((B, H), fixed),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, 4 * H), rev),
+            pl.BlockSpec((B, H), fixed),
+            pl.BlockSpec((B, H), fixed),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, 4 * H), dt),  # dz
+            jax.ShapeDtypeStruct((B, H), dt),          # dh0
+            jax.ShapeDtypeStruct((B, H), dt),          # dc0
+        ],
+        scratch_shapes=[pltpu.VMEM((B, H), dt), pltpu.VMEM((B, H), dt)],
+        interpret=interpret,
+    )(eps, gates, cs, c_prev, rw.T, pw, dhT, dcT)
+
+
+# ---------------------------------------------------------------------------
+# custom-VJP wrapper (time-major core; the layer wraps batch-major around it)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _fused_lstm_core(xz, rw, pw, h0, c0, forget_bias, interpret):
+    """xz: [T,B,4H] (= x@W+b), rw: [H,4H], pw: [3H] (zeros = no peephole).
+    Returns (hs [T,B,H], h_T, c_T). The primal (inference) path uses the
+    cache-free kernel; only the VJP forward pays for residual writes."""
+    hs, cT = _run_lstm_fwd_infer(xz, rw, pw, h0, c0, forget_bias, interpret)
+    return hs, hs[-1], cT
+
+
+def _fused_lstm_fwd(xz, rw, pw, h0, c0, forget_bias, interpret):
+    hs, gates, cs = _run_lstm_fwd(xz, rw, pw, h0, c0, forget_bias, interpret)
+    return (hs, hs[-1], cs[-1]), (rw, pw, h0, c0, hs, gates, cs)
+
+
+def _fused_lstm_bwd(forget_bias, interpret, res, grads):
+    rw, pw, h0, c0, hs, gates, cs = res
+    g_hs, g_hT, g_cT = grads
+    h_prev = jnp.concatenate([h0[None], hs[:-1]], axis=0)
+    c_prev = jnp.concatenate([c0[None], cs[:-1]], axis=0)
+    dz, dh0, dc0 = _run_lstm_bwd(g_hs, gates, cs, c_prev, rw, pw,
+                                 g_hT, g_cT, interpret)
+    dxz = dz
+    drw = jnp.einsum("tbh,tbk->hk", h_prev, dz)
+    H = hs.shape[-1]
+    dpw = jnp.concatenate([
+        jnp.einsum("tbh,tbh->h", c_prev, dz[..., :H]),
+        jnp.einsum("tbh,tbh->h", c_prev, dz[..., H:2 * H]),
+        jnp.einsum("tbh,tbh->h", cs, dz[..., 3 * H:]),
+    ])
+    return dxz, drw, dpw, dh0, dc0
+
+
+_fused_lstm_core.defvjp(_fused_lstm_fwd, _fused_lstm_bwd)
+
+
+def fused_lstm(x, w, rw, b, pw, h0, c0, *, forget_bias: float = 0.0,
+               interpret: bool = False
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fused LSTM over a [B, T, F] sequence.
+
+    The input projection is one large MXU matmul; the recurrence is the
+    Pallas kernel. Returns (ys [B,T,H], h_T [B,H], c_T [B,H]).
+    ``pw=None`` → no peepholes. Gate order (i, f, g, o) — the framework's
+    documented param contract (see layers/recurrent.py docstring).
+    """
+    B, T, F = x.shape
+    H = rw.shape[0]
+    xz = (x.reshape(B * T, F) @ w + b).reshape(B, T, 4 * H)
+    xz = jnp.swapaxes(xz, 0, 1)  # time-major
+    if pw is None:
+        pw = jnp.zeros((3 * H,), x.dtype)
+    hs, hT, cT = _fused_lstm_core(xz, rw, pw, h0, c0, float(forget_bias),
+                                  interpret)
+    return jnp.swapaxes(hs, 0, 1), hT, cT
