@@ -12,3 +12,20 @@ from deeplearning4j_tpu.datasets.iterator import (  # noqa: F401
 )
 from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator  # noqa: F401
 from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator  # noqa: F401
+from deeplearning4j_tpu.datasets.fetchers import (  # noqa: F401
+    CifarDataSetIterator,
+    CurvesDataSetIterator,
+    LFWDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.records import (  # noqa: F401
+    CollectionRecordReader,
+    CollectionSequenceRecordReader,
+    CSVRecordReader,
+    CSVSequenceRecordReader,
+    ImageRecordReader,
+    LineRecordReader,
+    RecordReader,
+    RecordReaderDataSetIterator,
+    RecordReaderMultiDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)

@@ -10,3 +10,4 @@ from deeplearning4j_tpu.graph.walks import (  # noqa: F401
     RandomWalkIterator, WeightedRandomWalkIterator,
 )
 from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphHuffman  # noqa: F401
+from deeplearning4j_tpu.graph.node2vec import Node2Vec, node2vec_walks  # noqa: F401

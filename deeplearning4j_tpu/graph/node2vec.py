@@ -1,0 +1,117 @@
+"""node2vec: biased second-order random walks + skip-gram negative sampling.
+
+Ref: the reference ships node2vec as part of its NLP/graph lineage
+(deeplearning4j-nlp `models/node2vec` appears in later snapshots; this
+snapshot's DeepWalk — deeplearning4j-graph/.../models/deepwalk/DeepWalk.java
+— is the 1st-order special case). Grover & Leskovec (2016) semantics:
+return parameter ``p`` and in-out parameter ``q`` bias each hop by
+1/p (back to previous), 1 (neighbor of previous), 1/q (outward).
+
+Walk generation is host-side numpy, batched one step across all walkers
+(same design as graph/walks.py); training is the batched SGNS step from
+nlp/sequencevectors.py on the TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.graph.graph import Graph
+from deeplearning4j_tpu.graph.walks import _build_csr
+from deeplearning4j_tpu.nlp.sequencevectors import _sgns_step, _skipgram_pairs
+
+
+def node2vec_walks(graph: Graph, walk_length: int, p: float = 1.0,
+                   q: float = 1.0, starts: Optional[np.ndarray] = None,
+                   seed: int = 123) -> np.ndarray:
+    """Generate biased walks [n_starts, walk_length]. All walkers advance
+    together; per-step the transition weights are reweighted by the
+    previous vertex (2nd-order Markov)."""
+    offsets, neigh, _, _ = _build_csr(graph, weighted=False)
+    rng = np.random.default_rng(seed)
+    V = graph.num_vertices()
+    if starts is None:
+        starts = np.arange(V)
+    n = len(starts)
+    walks = np.zeros((n, walk_length), np.int64)
+    walks[:, 0] = starts
+    cur = starts.copy()
+    prev = np.full(n, -1)
+    for t in range(1, walk_length):
+        nxt = cur.copy()
+        for i in range(n):  # ragged neighborhoods: per-walker CDF draw
+            v = cur[i]
+            lo, hi = offsets[v], offsets[v + 1]
+            if hi == lo:
+                continue  # self-loop on disconnected (walks.py policy)
+            nbrs = neigh[lo:hi]
+            if prev[i] < 0:
+                nxt[i] = nbrs[rng.integers(len(nbrs))]
+                continue
+            plo, phi = offsets[prev[i]], offsets[prev[i] + 1]
+            dist1 = np.isin(nbrs, neigh[plo:phi])  # vectorized membership
+            w = np.where(nbrs == prev[i], 1.0 / p,
+                         np.where(dist1, 1.0, 1.0 / q))
+            cdf = np.cumsum(w)
+            nxt[i] = nbrs[np.searchsorted(cdf, rng.random() * cdf[-1],
+                                          side="right")]
+        prev, cur = cur, nxt
+        walks[:, t] = cur
+    return walks
+
+
+class Node2Vec:
+    """node2vec embedding trainer (SGNS over biased walks)."""
+
+    def __init__(self, vector_size: int = 64, window_size: int = 5,
+                 p: float = 1.0, q: float = 1.0, walk_length: int = 40,
+                 walks_per_vertex: int = 2, epochs: int = 1,
+                 learning_rate: float = 0.025, negative: int = 5,
+                 batch_size: int = 1024, seed: int = 123):
+        self.vector_size = vector_size
+        self.window_size = window_size
+        self.p, self.q = p, q
+        self.walk_length = walk_length
+        self.walks_per_vertex = walks_per_vertex
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.negative = negative
+        self.batch_size = batch_size
+        self.seed = seed
+        self.vertex_vectors: Optional[np.ndarray] = None
+
+    def fit(self, graph: Graph) -> "Node2Vec":
+        V, D = graph.num_vertices(), self.vector_size
+        rng = np.random.default_rng(self.seed)
+        syn0 = jnp.asarray(((rng.random((V, D)) - 0.5) / D).astype(np.float32))
+        syn1neg = jnp.zeros((V, D), jnp.float32)
+        for epoch in range(self.epochs):
+            lr = max(self.learning_rate * (1 - epoch / max(1, self.epochs)),
+                     1e-4)
+            for w in range(self.walks_per_vertex):
+                walks = node2vec_walks(
+                    graph, self.walk_length, self.p, self.q,
+                    seed=self.seed + epoch * 1000 + w)
+                cs, os_ = _skipgram_pairs(list(walks), self.window_size, rng)
+                order = rng.permutation(len(cs))
+                for s in range(0, len(order), self.batch_size):
+                    sel = order[s:s + self.batch_size]
+                    negs = rng.integers(0, V, size=(len(sel),
+                                                    max(1, self.negative)))
+                    syn0, syn1neg = _sgns_step(
+                        syn0, syn1neg, jnp.asarray(cs[sel]),
+                        jnp.asarray(os_[sel]), jnp.asarray(negs), lr)
+        self.vertex_vectors = np.asarray(syn0)
+        return self
+
+    def get_vertex_vector(self, vertex: int) -> np.ndarray:
+        assert self.vertex_vectors is not None, "fit first"
+        return self.vertex_vectors[vertex]
+
+    def similarity(self, a: int, b: int) -> float:
+        va, vb = self.get_vertex_vector(a), self.get_vertex_vector(b)
+        denom = np.linalg.norm(va) * np.linalg.norm(vb)
+        return float(va @ vb / denom) if denom else 0.0

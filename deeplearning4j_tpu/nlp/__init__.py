@@ -33,6 +33,10 @@ from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: F401
 from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors  # noqa: F401
 from deeplearning4j_tpu.nlp.glove import Glove  # noqa: F401
 from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer  # noqa: F401
+from deeplearning4j_tpu.nlp.distributed import (  # noqa: F401
+    SparkSequenceVectors,
+    SparkWord2Vec,
+)
 from deeplearning4j_tpu.nlp.vectorizers import (  # noqa: F401
     BagOfWordsVectorizer,
     TfidfVectorizer,

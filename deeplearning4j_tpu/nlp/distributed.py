@@ -1,0 +1,57 @@
+"""Distributed embedding training over a device mesh.
+
+Ref: the reference scales Word2Vec two ways — Spark-side per-partition
+training with accumulator-merged vectors (dl4j-spark-nlp/.../word2vec/
+Word2Vec.java + Word2VecPerformer.java) and the java8 SparkSequenceVectors
+that shards sequences across executors (dl4j-spark-nlp-java8/.../
+SparkSequenceVectors.java). TPU-native design: no parameter shuttling —
+the embedding tables are replicated over a ``data`` mesh axis, each device
+computes SGNS/CBOW/HS updates for its shard of the batch, and XLA (GSPMD)
+inserts the ICI all-reduce when the scattered updates combine back into
+the replicated tables. Same jitted step functions as the single-device
+trainer; distribution is purely data placement (the Spark accumulator
+merge becomes a collective).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+class SparkSequenceVectors(SequenceVectors):
+    """SequenceVectors sharded across a mesh. The name mirrors the
+    reference class it replaces (SparkSequenceVectors.java); "Spark" here
+    means the scale-out tier — the executor fleet is a jax device mesh."""
+
+    def __init__(self, *args, devices: Optional[Sequence] = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        devices = list(devices) if devices is not None else jax.devices()
+        self._mesh = Mesh(np.array(devices), ("data",))
+        self._batch_sharding = NamedSharding(self._mesh, P("data"))
+        self._table_sharding = NamedSharding(self._mesh, P())
+        self._n_dev = len(devices)
+
+    def _put_table(self, arr):
+        return jax.device_put(np.asarray(arr), self._table_sharding)
+
+    def _put_batch(self, arr):
+        return jax.device_put(np.asarray(arr), self._batch_sharding)
+
+    def _adjust_selection(self, sel: np.ndarray) -> np.ndarray:
+        """Trim to a multiple of the device count (SGD over a pair stream
+        loses nothing by dropping < n_dev trailing pairs; the reference's
+        Spark split sizing rounds the same way)."""
+        keep = (len(sel) // self._n_dev) * self._n_dev
+        return sel[:keep]
+
+
+class SparkWord2Vec(Word2Vec, SparkSequenceVectors):
+    """Word2Vec trained data-parallel over the mesh (ref: dl4j-spark-nlp
+    Word2Vec.java entry point)."""

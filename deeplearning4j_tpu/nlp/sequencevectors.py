@@ -231,6 +231,21 @@ class SequenceVectors:
             1.0, (np.sqrt(freq / self.sampling) + 1) * self.sampling / np.maximum(freq, 1e-12))
         return [s[rng.random(len(s)) < keep[s]] for s in seqs]
 
+    # -- device placement hooks (overridden by the sharded trainer) ----
+    def _put_table(self, arr):
+        """Embedding-table placement; replicated-over-mesh in the
+        distributed subclass (nlp/distributed.py)."""
+        return jnp.asarray(arr)
+
+    def _put_batch(self, arr):
+        """Training-batch placement; sharded over the data axis in the
+        distributed subclass."""
+        return jnp.asarray(arr)
+
+    def _adjust_selection(self, sel: np.ndarray) -> np.ndarray:
+        """Hook to align batch size with the device count."""
+        return sel
+
     # -- training -----------------------------------------------------
     def fit(self, token_sequences: Sequence[Sequence[str]]) -> None:
         if self.vocab is None:
@@ -239,9 +254,9 @@ class SequenceVectors:
         assert lt is not None
         rng = np.random.default_rng(self.seed)
         seqs0 = self._index_sequences(token_sequences)
-        syn0 = jnp.asarray(lt.syn0)
-        syn1 = jnp.asarray(lt.syn1)
-        syn1neg = jnp.asarray(lt.syn1neg)
+        syn0 = self._put_table(lt.syn0)
+        syn1 = self._put_table(lt.syn1)
+        syn1neg = self._put_table(lt.syn1neg)
         if self.use_hs:
             w_codes, w_points, w_mask = huffman_arrays(self.vocab)
 
@@ -257,32 +272,36 @@ class SequenceVectors:
                 ctx, mask, cents = _cbow_windows(seqs, self.window)
                 order = rng.permutation(len(cents))
                 for s in range(0, len(order), self.batch_size):
-                    sel = order[s:s + self.batch_size]
+                    sel = self._adjust_selection(order[s:s + self.batch_size])
+                    if not len(sel):
+                        continue
                     negs = lt.sample_negatives(
                         rng, (len(sel), max(1, self.negative)))
                     syn0, syn1neg = _cbow_ns_step(
-                        syn0, syn1neg, jnp.asarray(ctx[sel]),
-                        jnp.asarray(mask[sel]), jnp.asarray(cents[sel]),
-                        jnp.asarray(negs), lr)
+                        syn0, syn1neg, self._put_batch(ctx[sel]),
+                        self._put_batch(mask[sel]), self._put_batch(cents[sel]),
+                        self._put_batch(negs), lr)
             else:
                 cs, os_ = _skipgram_pairs(seqs, self.window, rng)
                 order = rng.permutation(len(cs))
                 for s in range(0, len(order), self.batch_size):
-                    sel = order[s:s + self.batch_size]
+                    sel = self._adjust_selection(order[s:s + self.batch_size])
+                    if not len(sel):
+                        continue
                     if self.use_hs:
                         pts = w_points[os_[sel]]
                         cds = w_codes[os_[sel]]
                         msk = w_mask[os_[sel]]
                         syn0, syn1 = _hs_step(
-                            syn0, syn1, jnp.asarray(cs[sel]),
-                            jnp.asarray(pts), jnp.asarray(cds),
-                            jnp.asarray(msk), lr)
+                            syn0, syn1, self._put_batch(cs[sel]),
+                            self._put_batch(pts), self._put_batch(cds),
+                            self._put_batch(msk), lr)
                     else:
                         negs = lt.sample_negatives(
                             rng, (len(sel), max(1, self.negative)))
                         syn0, syn1neg = _sgns_step(
-                            syn0, syn1neg, jnp.asarray(cs[sel]),
-                            jnp.asarray(os_[sel]), jnp.asarray(negs), lr)
+                            syn0, syn1neg, self._put_batch(cs[sel]),
+                            self._put_batch(os_[sel]), self._put_batch(negs), lr)
         lt.syn0 = np.asarray(syn0)
         lt.syn1 = np.asarray(syn1)
         lt.syn1neg = np.asarray(syn1neg)

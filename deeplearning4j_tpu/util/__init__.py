@@ -1,3 +1,6 @@
-"""Utilities: model serialization, gradient checking support."""
+"""Utilities: model serialization, math/time-series helpers, Viterbi
+(ref: deeplearning4j-nn/.../util/)."""
 
 from deeplearning4j_tpu.util.serializer import ModelSerializer  # noqa: F401
+from deeplearning4j_tpu.util.viterbi import Viterbi, viterbi_decode  # noqa: F401
+from deeplearning4j_tpu.util import math_utils, time_series  # noqa: F401

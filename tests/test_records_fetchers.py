@@ -1,0 +1,209 @@
+"""Tests for the DataVec-equivalent record pipeline and dataset fetchers
+(mirrors the reference's RecordReaderDataSetiteratorTest patterns,
+ref: deeplearning4j-core/src/test/.../datasets/datavec/)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.fetchers import (
+    CifarDataSetIterator, CurvesDataSetIterator, LFWDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.records import (
+    CollectionRecordReader, CollectionSequenceRecordReader, CSVRecordReader,
+    CSVSequenceRecordReader, ImageRecordReader, LineRecordReader,
+    RecordReaderDataSetIterator, RecordReaderMultiDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)
+
+IRIS_LINES = [
+    "5.1,3.5,1.4,0.2,0",
+    "4.9,3.0,1.4,0.2,0",
+    "6.2,2.9,4.3,1.3,1",
+    "5.9,3.0,5.1,1.8,2",
+    "6.3,2.8,5.1,1.5,2",
+]
+
+
+def test_csv_reader_classification():
+    rr = CSVRecordReader(IRIS_LINES)
+    it = RecordReaderDataSetIterator(rr, batch_size=3, label_index=4,
+                                     num_possible_labels=3)
+    b1 = it.next()
+    assert b1.features.shape == (3, 4)
+    assert b1.labels.shape == (3, 3)
+    np.testing.assert_array_equal(b1.labels[0], [1, 0, 0])
+    b2 = it.next()
+    assert b2.features.shape == (2, 4)
+    assert not it.has_next()
+    it.reset()
+    assert it.has_next()
+    np.testing.assert_allclose(it.next().features[0],
+                               [5.1, 3.5, 1.4, 0.2])
+
+
+def test_csv_reader_regression_range():
+    rr = CSVRecordReader(IRIS_LINES)
+    it = RecordReaderDataSetIterator(rr, batch_size=5, label_index=2,
+                                     regression=True, label_index_to=3)
+    b = it.next()
+    assert b.features.shape == (5, 3)   # cols 0,1,4
+    assert b.labels.shape == (5, 2)     # cols 2,3
+    np.testing.assert_allclose(b.labels[0], [1.4, 0.2])
+    np.testing.assert_allclose(b.features[0], [5.1, 3.5, 0.0])
+
+
+def test_default_last_column_label():
+    rr = CollectionRecordReader([[0.0, 1.0, 2.0, 1], [3.0, 4.0, 5.0, 0]])
+    it = RecordReaderDataSetIterator(rr, 2, num_possible_labels=2)
+    b = it.next()
+    assert b.features.shape == (2, 3)
+    np.testing.assert_array_equal(b.labels, [[0, 1], [1, 0]])
+
+
+def test_classification_requires_num_labels():
+    rr = CollectionRecordReader([[1.0, 0]])
+    it = RecordReaderDataSetIterator(rr, 1)
+    with pytest.raises(ValueError):
+        it.next()
+
+
+def test_line_reader():
+    lr = LineRecordReader(["hello", "world"])
+    assert [r for r in lr] == [["hello"], ["world"]]
+
+
+def test_sequence_iterator_single_reader_padding_and_masks():
+    seqs = [
+        [[0.1, 0.2, 0], [0.3, 0.4, 1], [0.5, 0.6, 2]],
+        [[0.7, 0.8, 1]],
+    ]
+    sr = CollectionSequenceRecordReader(seqs)
+    it = SequenceRecordReaderDataSetIterator(sr, batch_size=2,
+                                             num_possible_labels=3)
+    b = it.next()
+    assert b.features.shape == (2, 3, 2)
+    assert b.labels.shape == (2, 3, 3)
+    np.testing.assert_array_equal(b.features_mask, [[1, 1, 1], [1, 0, 0]])
+    np.testing.assert_array_equal(b.labels[0, 2], [0, 0, 1])
+    # padded region zeroed
+    np.testing.assert_array_equal(b.features[1, 1:], np.zeros((2, 2)))
+
+
+def test_sequence_iterator_align_end():
+    f = CollectionSequenceRecordReader([[[1.0], [2.0], [3.0]]])
+    l = CollectionSequenceRecordReader([[[2]]])  # one label for the sequence
+    it = SequenceRecordReaderDataSetIterator(
+        f, 1, num_possible_labels=3, labels_reader=l, alignment="align_end")
+    b = it.next()
+    np.testing.assert_array_equal(b.labels_mask, [[0, 0, 1]])
+    np.testing.assert_array_equal(b.labels[0, 2], [0, 0, 1])
+    np.testing.assert_array_equal(b.features_mask, [[1, 1, 1]])
+
+
+def test_csv_sequence_reader(tmp_path):
+    p = tmp_path / "seqs.csv"
+    p.write_text("1,10\n2,20\n\n3,30\n")
+    sr = CSVSequenceRecordReader(p)
+    assert sr.next_sequence() == [[1.0, 10.0], [2.0, 20.0]]
+    assert sr.next_sequence() == [[3.0, 30.0]]
+    assert not sr.has_next()
+
+
+def test_multi_dataset_iterator():
+    rr = CollectionRecordReader(
+        [[0.1, 0.2, 0.3, 1], [0.4, 0.5, 0.6, 0], [0.7, 0.8, 0.9, 2]])
+    it = (RecordReaderMultiDataSetIterator.Builder(batch_size=2)
+          .add_reader("r", rr)
+          .add_input("r", 0, 1)
+          .add_input("r", 2, 2)
+          .add_output_one_hot("r", 3, 3)
+          .build())
+    m = it.next()
+    assert len(m.features) == 2 and len(m.labels) == 1
+    assert m.features[0].shape == (2, 2)
+    assert m.features[1].shape == (2, 1)
+    np.testing.assert_array_equal(m.labels[0], [[0, 1, 0], [1, 0, 0]])
+    assert it.has_next()
+    it.next()
+    assert not it.has_next()
+
+
+def test_multi_builder_unknown_reader():
+    with pytest.raises(ValueError):
+        (RecordReaderMultiDataSetIterator.Builder(2)
+         .add_input("nope").build())
+
+
+def test_image_record_reader_npy(tmp_path):
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(2):
+            np.save(d / f"{i}.npy",
+                    np.full((4, 4, 3), 10.0 * (cls == "dog") + i, np.float32))
+    rr = ImageRecordReader(tmp_path, 4, 4, 3)
+    assert rr.labels == ["cat", "dog"]
+    it = RecordReaderDataSetIterator(rr, batch_size=4)
+    b = it.next()
+    assert b.features.shape == (4, 4, 4, 3)
+    assert b.labels.shape == (4, 2)
+    assert b.labels.sum() == 4
+
+
+def test_cifar_iterator():
+    it = CifarDataSetIterator(batch_size=32, num_examples=64)
+    b = it.next()
+    assert b.features.shape == (32, 32, 32, 3)
+    assert b.labels.shape == (32, 10)
+    assert it.total_examples() == 64
+    assert isinstance(it.is_synthetic, bool)
+
+
+def test_lfw_iterator():
+    it = LFWDataSetIterator(batch_size=16, num_examples=32, height=32,
+                            width=32, classes=5)
+    b = it.next()
+    assert b.features.shape == (16, 32, 32, 3)
+    assert b.labels.shape[1] >= 2
+
+
+def test_curves_iterator():
+    it = CurvesDataSetIterator(batch_size=10, num_examples=20)
+    b = it.next()
+    assert b.features.shape == (10, 784)
+    np.testing.assert_array_equal(b.features, b.labels)
+    assert b.features.max() == 1.0
+
+
+def test_csv_sequence_header_skip_once(tmp_path):
+    """skip_lines is a per-source header skip, not per-sequence."""
+    p = tmp_path / "s.csv"
+    p.write_text("h1,h2\n1,2\n3,4\n\n5,6\n7,8\n")
+    sr = CSVSequenceRecordReader(p, skip_lines=1)
+    assert sr.next_sequence() == [[1.0, 2.0], [3.0, 4.0]]
+    assert sr.next_sequence() == [[5.0, 6.0], [7.0, 8.0]]
+
+
+def test_image_reader_grayscale_expand(tmp_path):
+    d = tmp_path / "x"
+    d.mkdir()
+    np.save(d / "g.npy", np.ones((4, 4), np.float32))
+    rr = ImageRecordReader(tmp_path, 4, 4, 3)
+    rec = rr.next_record()
+    assert len(rec) == 4 * 4 * 3 + 1
+
+
+def test_sequence_iterator_validates_num_labels():
+    sr = CollectionSequenceRecordReader([[[1.0, 0]]])
+    with pytest.raises(ValueError, match="num_possible_labels"):
+        SequenceRecordReaderDataSetIterator(sr, 1)
+
+
+def test_sequence_two_reader_exhaustion():
+    f = CollectionSequenceRecordReader([[[1.0]], [[2.0]], [[3.0]]])
+    l = CollectionSequenceRecordReader([[[0]], [[1]]])
+    it = SequenceRecordReaderDataSetIterator(
+        f, 1, num_possible_labels=2, labels_reader=l)
+    it.next()
+    it.next()
+    assert not it.has_next()

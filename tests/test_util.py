@@ -1,0 +1,95 @@
+"""Tests for util/{math_utils,time_series,viterbi}
+(ref behaviors from deeplearning4j-nn/.../util/)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.util import math_utils as mu
+from deeplearning4j_tpu.util import time_series as ts
+from deeplearning4j_tpu.util.viterbi import Viterbi, viterbi_decode
+
+
+def test_math_basics():
+    assert mu.normalize(5, 0, 10) == 0.5
+    assert mu.clamp(15, 0, 10) == 10
+    assert mu.discretize(0.5, 0, 1, 11) == 5
+    assert mu.next_pow_of_2(17) == 32
+    assert mu.next_pow_of_2(16) == 16
+    assert abs(mu.sigmoid(0.0) - 0.5) < 1e-12
+    assert abs(mu.log2(8) - 3) < 1e-12
+    assert abs(mu.entropy([0.5, 0.5]) - 1.0) < 1e-12
+
+
+def test_math_regression_stats():
+    y = [1.0, 2.0, 3.0, 4.0]
+    pred = [1.1, 1.9, 3.2, 3.8]
+    assert mu.correlation(y, y) == pytest.approx(1.0)
+    assert mu.ss_error(pred, y) == pytest.approx(
+        sum((a - b) ** 2 for a, b in zip(pred, y)))
+    assert mu.ss_total(y, y) == pytest.approx(5.0)
+    # perfect prediction → R^2 == 1
+    assert mu.determination_coefficient(y, y, 4) == pytest.approx(1.0)
+    assert mu.root_means_squared_error(y, y) == 0.0
+    assert mu.variance([1.0, 2.0, 3.0]) == pytest.approx(1.0)
+
+
+def test_math_distances_tfidf():
+    assert mu.euclidean_distance([0, 0], [3, 4]) == pytest.approx(5.0)
+    assert mu.manhattan_distance([0, 0], [3, 4]) == pytest.approx(7.0)
+    assert mu.idf(100, 10) == pytest.approx(np.log(10))
+    assert mu.tf(3, 12) == pytest.approx(0.25)
+    assert mu.tfidf(0.25, np.log(10)) == pytest.approx(0.25 * np.log(10))
+
+
+def test_moving_average():
+    out = ts.moving_average(np.array([1.0, 2, 3, 4, 5]), 2)
+    np.testing.assert_allclose(out, [1.5, 2.5, 3.5, 4.5])
+    # batched
+    out2 = ts.moving_average(np.array([[1.0, 2, 3], [4.0, 5, 6]]), 3)
+    np.testing.assert_allclose(out2, [[2.0], [5.0]])
+
+
+def test_reshapes_roundtrip():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    two_d = ts.reshape_3d_to_2d(x)
+    assert two_d.shape == (6, 4)
+    np.testing.assert_array_equal(ts.reshape_2d_to_3d(two_d, 2), x)
+    m = np.array([[1, 1, 0], [1, 0, 0]], np.float32)
+    v = ts.reshape_time_series_mask_to_vector(m)
+    assert v.shape == (6,)
+    np.testing.assert_array_equal(ts.reshape_vector_to_time_series_mask(v, 2), m)
+
+
+def test_viterbi_decode_lattice():
+    # two states, strongly self-transitioning; emissions favor 0,0,1
+    em = np.log(np.array([[0.9, 0.1], [0.8, 0.2], [0.1, 0.9]]))
+    tr = np.log(np.array([[0.7, 0.3], [0.3, 0.7]]))
+    logp, path = viterbi_decode(em, tr)
+    np.testing.assert_array_equal(path, [0, 0, 1])
+    assert logp < 0
+
+
+def test_viterbi_smoother_fixes_blip():
+    """A single contradictory observation inside a stable run is smoothed
+    away — the noisy-channel use case of the reference's Viterbi."""
+    v = Viterbi([0, 1], meta_stability=0.95, p_correct=0.9)
+    obs = np.array([0, 0, 1, 0, 0])
+    _, smoothed = v.decode(obs)
+    np.testing.assert_array_equal(smoothed, [0, 0, 0, 0, 0])
+    # one-hot input path
+    onehot = np.eye(2)[obs]
+    _, smoothed2 = v.decode(onehot)
+    np.testing.assert_array_equal(smoothed2, smoothed)
+    # a sustained change of state survives smoothing
+    obs2 = np.array([0, 0, 1, 1, 1, 1])
+    _, sm3 = v.decode(obs2)
+    np.testing.assert_array_equal(sm3, [0, 0, 1, 1, 1, 1])
+
+
+def test_viterbi_noncontiguous_labels():
+    """possible_labels need not be 0..S-1; values map through a lookup."""
+    v = Viterbi([1, 2], meta_stability=0.95, p_correct=0.9)
+    _, out = v.decode(np.array([1, 1, 2, 1, 1]))
+    np.testing.assert_array_equal(out, [1, 1, 1, 1, 1])
+    with pytest.raises(ValueError, match="not in possible_labels"):
+        v.decode(np.array([1, 3]))
