@@ -12,3 +12,6 @@ from deeplearning4j_tpu.earlystopping.config import (  # noqa: F401
     LocalFileModelSaver,
 )
 from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingTrainer  # noqa: F401
+from deeplearning4j_tpu.earlystopping.parallel_trainer import (  # noqa: F401
+    EarlyStoppingParallelTrainer,
+)

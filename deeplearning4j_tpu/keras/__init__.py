@@ -2,3 +2,8 @@
 
 from deeplearning4j_tpu.keras.hdf5 import Hdf5Archive  # noqa: F401
 from deeplearning4j_tpu.keras.keras_import import KerasModelImport  # noqa: F401
+from deeplearning4j_tpu.keras.server import (  # noqa: F401
+    HDF5MiniBatchDataSetIterator,
+    KerasClient,
+    KerasServer,
+)

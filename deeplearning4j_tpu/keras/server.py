@@ -1,0 +1,191 @@
+"""Keras-backend gateway server.
+
+Ref: deeplearning4j-keras/.../Server.java:15-22 (py4j GatewayServer
+exposing DeepLearning4jEntryPoint to a Python Keras client),
+DeepLearning4jEntryPoint.java (fit(model, train dirs, epochs)), and
+HDF5MiniBatchDataSetIterator.java (one .h5 file per minibatch in a
+directory). The capability bar (SURVEY §2.2): "usable as a Keras-style
+backend" — an external process drives training/inference over a socket.
+
+This framework is already Python, so the py4j JVM gateway collapses to a
+newline-delimited JSON-over-TCP protocol:
+
+    {"op": "fit", "model": <keras .h5 path>, "features_dir": ...,
+     "labels_dir": ..., "nb_epoch": N}
+    {"op": "predict", "features": <.npy path>}  -> {"predictions": [...]}
+    {"op": "evaluate", "features_dir": ..., "labels_dir": ...}
+    {"op": "shutdown"}
+
+Batch files: ``.npy`` or ``.h5`` (one array per file, sorted order), the
+HDF5MiniBatchDataSetIterator layout.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+from pathlib import Path
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+
+
+def _load_array(path: Path) -> np.ndarray:
+    if path.suffix == ".npy":
+        return np.load(path)
+    from deeplearning4j_tpu.keras.hdf5 import Hdf5Archive
+    h5 = Hdf5Archive(str(path))
+    names = h5.dataset_names()
+    if not names:
+        raise ValueError(f"{path}: no datasets")
+    return np.asarray(h5.read_dataset(names[0]))
+
+
+class HDF5MiniBatchDataSetIterator(DataSetIterator):
+    """One file per minibatch, features/labels in parallel directories,
+    loaded lazily per next() — the dataset need not fit in RAM
+    (ref: HDF5MiniBatchDataSetIterator.java)."""
+
+    def __init__(self, features_dir: str, labels_dir: str):
+        self._f_files = sorted(p for p in Path(features_dir).iterdir()
+                               if p.suffix in (".npy", ".h5"))
+        self._l_files = sorted(p for p in Path(labels_dir).iterdir()
+                               if p.suffix in (".npy", ".h5"))
+        if len(self._f_files) != len(self._l_files):
+            raise ValueError(f"{len(self._f_files)} feature files vs "
+                             f"{len(self._l_files)} label files")
+        self._pos = 0
+
+    def reset(self):
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self._f_files)
+
+    def next(self) -> DataSet:
+        f, l = self._f_files[self._pos], self._l_files[self._pos]
+        self._pos += 1
+        return DataSet(_load_array(f).astype(np.float32),
+                       _load_array(l).astype(np.float32))
+
+    def batch_size(self):
+        if not self._f_files:
+            return 0
+        return int(_load_array(self._f_files[0]).shape[0])
+
+
+class KerasServer:
+    """The gateway. A loaded model is cached per model path; ``fit`` /
+    ``predict`` / ``evaluate`` operate on it. Runs in a daemon thread."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._models = {}
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    try:
+                        req = json.loads(line)
+                        resp = outer._dispatch(req)
+                    except Exception as e:  # report, keep serving
+                        resp = {"error": f"{type(e).__name__}: {e}"}
+                    self.wfile.write((json.dumps(resp) + "\n").encode())
+                    self.wfile.flush()
+                    if isinstance(resp, dict) and resp.get("shutdown"):
+                        threading.Thread(target=outer.stop,
+                                         daemon=True).start()
+                        return
+
+        self._server = socketserver.ThreadingTCPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.host, self.port = host, self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    # -- ops ----------------------------------------------------------
+    def _get_model(self, path: Optional[str]):
+        if path is not None:
+            if path not in self._models:
+                if path.endswith(".zip"):
+                    from deeplearning4j_tpu.util.serializer import (
+                        ModelSerializer)
+                    self._models[path] = \
+                        ModelSerializer.restore_multi_layer_network(path)
+                else:
+                    from deeplearning4j_tpu.keras.keras_import import (
+                        KerasModelImport)
+                    self._models[path] = (KerasModelImport
+                                          .import_keras_sequential_model_and_weights(path))
+            self._last = path
+            return self._models[path]
+        if not self._models:
+            raise ValueError("no model loaded; pass 'model'")
+        return self._models[self._last]
+
+    def _dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "shutdown":
+            return {"ok": True, "shutdown": True}
+        if op not in ("fit", "predict", "evaluate"):
+            raise ValueError(f"unknown op {op!r}")
+        model = self._get_model(req.get("model"))
+        if op == "fit":
+            it = HDF5MiniBatchDataSetIterator(req["features_dir"],
+                                              req["labels_dir"])
+            for _ in range(int(req.get("nb_epoch", 1))):
+                model.fit(it)
+            return {"ok": True, "score": float(model.score())}
+        if op == "predict":
+            x = _load_array(Path(req["features"])).astype(np.float32)
+            return {"ok": True,
+                    "predictions": np.asarray(model.output(x)).tolist()}
+        if op == "evaluate":
+            it = HDF5MiniBatchDataSetIterator(req["features_dir"],
+                                              req["labels_dir"])
+            ev = model.evaluate(it)
+            return {"ok": True, "accuracy": ev.accuracy(), "f1": ev.f1()}
+        raise AssertionError("unreachable")  # ops validated above
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class KerasClient:
+    """Convenience client for the gateway (what the Python Keras side of
+    the reference's py4j bridge would use)."""
+
+    def __init__(self, host: str, port: int):
+        self._sock = socket.create_connection((host, port))
+        self._file = self._sock.makefile("rwb")
+
+    def request(self, **req) -> dict:
+        self._file.write((json.dumps(req) + "\n").encode())
+        self._file.flush()
+        line = self._file.readline()
+        if not line:
+            raise ConnectionError("server closed")
+        resp = json.loads(line)
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp
+
+    def fit(self, model: str, features_dir: str, labels_dir: str,
+            nb_epoch: int = 1) -> dict:
+        return self.request(op="fit", model=model, features_dir=features_dir,
+                            labels_dir=labels_dir, nb_epoch=nb_epoch)
+
+    def predict(self, features: str, model: Optional[str] = None) -> np.ndarray:
+        resp = self.request(op="predict", features=features,
+                            **({"model": model} if model else {}))
+        return np.asarray(resp["predictions"])
+
+    def close(self) -> None:
+        self._sock.close()

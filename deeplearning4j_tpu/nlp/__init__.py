@@ -37,6 +37,13 @@ from deeplearning4j_tpu.nlp.distributed import (  # noqa: F401
     SparkSequenceVectors,
     SparkWord2Vec,
 )
+from deeplearning4j_tpu.nlp.tokenization_ext import (  # noqa: F401
+    JapaneseTokenizerFactory,
+    KoreanTokenizerFactory,
+    PosFilterTokenizerFactory,
+    RegexSentenceIterator,
+    pos_tag,
+)
 from deeplearning4j_tpu.nlp.vectorizers import (  # noqa: F401
     BagOfWordsVectorizer,
     TfidfVectorizer,

@@ -277,6 +277,7 @@ class MultiLayerNetwork:
                 jnp.asarray(dataset.features), jnp.asarray(dataset.labels),
                 fmask, lmask, step_rng)
         self.last_batch_size = dataset.num_examples()
+        self.last_input = dataset.features  # for visualization listeners
         self.score_value = float(loss)
         self.iteration_count += 1
         for listener in self.listeners:

@@ -1,0 +1,21 @@
+"""Streaming ingestion and serving.
+
+TPU-native replacement for ``dl4j-streaming`` (ref: dl4j-streaming/.../
+kafka/{NDArrayKafkaClient,NDArrayPublisher,NDArrayConsumer}.java, Camel
+route routes/DL4jServeRouteBuilder.java, pipeline/StreamingPipeline.java).
+The reference moves serialized NDArrays over Kafka topics; here the
+transport is a length-prefixed npy wire format over TCP sockets (the
+brokerless equivalent — no Kafka in the image), with the same roles:
+publisher, consumer, and a serve route that runs a model over each
+incoming batch and publishes predictions.
+"""
+
+from deeplearning4j_tpu.streaming.ndarray_channel import (  # noqa: F401
+    NDArrayConsumer,
+    NDArrayPublisher,
+    NDArrayServer,
+)
+from deeplearning4j_tpu.streaming.pipeline import (  # noqa: F401
+    ServeRoute,
+    StreamingPipeline,
+)

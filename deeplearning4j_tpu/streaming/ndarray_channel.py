@@ -1,0 +1,165 @@
+"""NDArray pub/sub over TCP — the Kafka-client equivalent.
+
+Ref: dl4j-streaming/.../kafka/{NDArrayPublisher,NDArrayConsumer,
+NDArrayKafkaClient}.java (NDArrays base64-serialized onto Kafka topics).
+Wire format here: 8-byte big-endian length + ``np.save`` bytes per array;
+a topic is one server socket. ``NDArrayServer`` is the broker stand-in —
+it buffers published arrays per topic and hands them to consumers in
+FIFO order.
+"""
+
+from __future__ import annotations
+
+import collections
+import io
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class _Topic:
+    """FIFO queue supporting head-requeue (a consumer that vanishes
+    mid-send must not reorder the stream)."""
+
+    def __init__(self):
+        self._dq: "collections.deque[np.ndarray]" = collections.deque()
+        self._cond = threading.Condition()
+
+    def put(self, arr: np.ndarray) -> None:
+        with self._cond:
+            self._dq.append(arr)
+            self._cond.notify()
+
+    def put_front(self, arr: np.ndarray) -> None:
+        with self._cond:
+            self._dq.appendleft(arr)
+            self._cond.notify()
+
+    def get(self) -> np.ndarray:
+        with self._cond:
+            while not self._dq:
+                self._cond.wait()
+            return self._dq.popleft()
+
+
+def _send_array(sock: socket.socket, arr: np.ndarray) -> None:
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+    data = buf.getvalue()
+    sock.sendall(struct.pack(">Q", len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    while n:
+        c = sock.recv(min(n, 1 << 20))
+        if not c:
+            return None
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
+
+
+def _recv_array(sock: socket.socket) -> Optional[np.ndarray]:
+    hdr = _recv_exact(sock, 8)
+    if hdr is None:
+        return None
+    (length,) = struct.unpack(">Q", hdr)
+    data = _recv_exact(sock, length)
+    if data is None:
+        return None
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+class NDArrayServer:
+    """Broker: topics -> FIFO queues. Protocol per connection:
+    first line ``PUB <topic>\\n`` or ``SUB <topic>\\n``; then arrays flow
+    (PUB: client->server; SUB: server->client)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._topics: Dict[str, _Topic] = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                line = b""
+                while not line.endswith(b"\n"):
+                    c = self.request.recv(1)
+                    if not c:
+                        return
+                    line += c
+                mode, topic = line.decode().strip().split(None, 1)
+                q = outer._queue(topic)
+                if mode == "PUB":
+                    while True:
+                        arr = _recv_array(self.request)
+                        if arr is None:
+                            return
+                        q.put(arr)
+                elif mode == "SUB":
+                    while True:
+                        arr = q.get()
+                        try:
+                            _send_array(self.request, arr)
+                        except OSError:
+                            # consumer vanished mid-send: requeue at the
+                            # HEAD so stream order is preserved
+                            q.put_front(arr)
+                            return
+
+        self._server = socketserver.ThreadingTCPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self.host = host
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def _queue(self, topic: str) -> _Topic:
+        with self._lock:
+            return self._topics.setdefault(topic, _Topic())
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class NDArrayPublisher:
+    """ref: NDArrayPublisher.java — publish(arr) onto a topic."""
+
+    def __init__(self, host: str, port: int, topic: str):
+        self._sock = socket.create_connection((host, port))
+        self._sock.sendall(f"PUB {topic}\n".encode())
+
+    def publish(self, arr: np.ndarray) -> None:
+        _send_array(self._sock, np.asarray(arr))
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+class NDArrayConsumer:
+    """ref: NDArrayConsumer.java — getArrays(count) off a topic."""
+
+    def __init__(self, host: str, port: int, topic: str,
+                 timeout: Optional[float] = 10.0):
+        self._sock = socket.create_connection((host, port))
+        self._sock.settimeout(timeout)
+        self._sock.sendall(f"SUB {topic}\n".encode())
+
+    def get_array(self) -> np.ndarray:
+        arr = _recv_array(self._sock)
+        if arr is None:
+            raise ConnectionError("stream closed")
+        return arr
+
+    def get_arrays(self, count: int) -> List[np.ndarray]:
+        return [self.get_array() for _ in range(count)]
+
+    def close(self) -> None:
+        self._sock.close()

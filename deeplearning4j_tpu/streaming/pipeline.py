@@ -1,0 +1,82 @@
+"""Streaming serve/train routes.
+
+Ref: dl4j-streaming/.../routes/DL4jServeRouteBuilder.java (consume
+feature arrays → model.output → publish predictions) and
+pipeline/StreamingPipeline.java (streaming feed into training).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.streaming.ndarray_channel import (
+    NDArrayConsumer, NDArrayPublisher,
+)
+
+
+class ServeRoute:
+    """Model-serving route: consume feature batches from ``in_topic``,
+    run ``model.output``, publish predictions to ``out_topic``.
+    ``start()`` runs the loop in a daemon thread until ``stop()``."""
+
+    def __init__(self, model, host: str, port: int,
+                 in_topic: str = "features", out_topic: str = "predictions",
+                 transform: Optional[Callable[[np.ndarray], np.ndarray]] = None):
+        self._model = model
+        # no socket timeout: the route idles indefinitely between batches
+        self._consumer = NDArrayConsumer(host, port, in_topic, timeout=None)
+        self._publisher = NDArrayPublisher(host, port, out_topic)
+        self._transform = transform
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                x = self._consumer.get_array()
+            except (ConnectionError, OSError):
+                return
+            if self._transform is not None:
+                x = self._transform(x)
+            y = np.asarray(self._model.output(x))
+            self._publisher.publish(y)
+
+    def start(self) -> "ServeRoute":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._consumer.close()
+        self._publisher.close()
+
+
+class StreamingPipeline:
+    """Training feed: consume (features, labels) array pairs from two
+    topics and fit the model batch-by-batch (ref: StreamingPipeline.java —
+    Spark streaming → fit). ``run(n_batches)`` is synchronous; returns the
+    per-batch scores."""
+
+    def __init__(self, model, host: str, port: int,
+                 features_topic: str = "train.features",
+                 labels_topic: str = "train.labels"):
+        self._model = model
+        self._fx = NDArrayConsumer(host, port, features_topic)
+        self._fy = NDArrayConsumer(host, port, labels_topic)
+
+    def run(self, n_batches: int):
+        scores = []
+        for _ in range(n_batches):
+            x = self._fx.get_array()
+            y = self._fy.get_array()
+            scores.append(float(self._model.fit_batch(DataSet(x, y))))
+        return scores
+
+    def close(self) -> None:
+        self._fx.close()
+        self._fy.close()

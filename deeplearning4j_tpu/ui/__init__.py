@@ -16,10 +16,23 @@ from deeplearning4j_tpu.ui.storage import (FileStatsStorage,
                                            RemoteStatsStorageRouter,
                                            StatsStorage)
 from deeplearning4j_tpu.ui.server import UIServer
+from deeplearning4j_tpu.ui.components import (ChartHistogram,
+                                              ChartHorizontalBar, ChartLine,
+                                              ChartScatter, Component,
+                                              ComponentDiv, ComponentTable,
+                                              ComponentText, render_html)
+from deeplearning4j_tpu.ui.listeners import (ConvolutionalIterationListener,
+                                             FlowIterationListener,
+                                             tile_activations)
 
 __all__ = [
     "StatsReport", "StatsInitializationReport", "StatsListener",
     "StatsStorage", "InMemoryStatsStorage", "FileStatsStorage",
     "RemoteStatsStorageRouter", "UIServer",
     "encode_report", "decode_report",
+    "Component", "ComponentText", "ComponentTable", "ComponentDiv",
+    "ChartLine", "ChartScatter", "ChartHistogram", "ChartHorizontalBar",
+    "render_html",
+    "ConvolutionalIterationListener", "FlowIterationListener",
+    "tile_activations",
 ]

@@ -1,0 +1,129 @@
+"""Tests for the streaming NDArray channel / serve routes
+(ref: dl4j-streaming kafka + camel routes) and the Keras-backend gateway
+(ref: deeplearning4j-keras py4j Server)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator, load_iris
+from deeplearning4j_tpu.keras.server import (HDF5MiniBatchDataSetIterator,
+                                             KerasClient, KerasServer)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.streaming import (NDArrayConsumer, NDArrayPublisher,
+                                          NDArrayServer, ServeRoute,
+                                          StreamingPipeline)
+from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+
+@pytest.fixture(scope="module")
+def iris_net():
+    conf = (NeuralNetConfiguration.builder().updater("adam")
+            .learning_rate(0.05).seed(7).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(IrisDataSetIterator(50), epochs=20)
+    return net
+
+
+def test_ndarray_pubsub_roundtrip():
+    srv = NDArrayServer()
+    try:
+        pub = NDArrayPublisher(srv.host, srv.port, "t1")
+        sub = NDArrayConsumer(srv.host, srv.port, "t1")
+        arrs = [np.arange(6, dtype=np.float32).reshape(2, 3),
+                np.ones((3, 1), np.float64)]
+        for a in arrs:
+            pub.publish(a)
+        got = sub.get_arrays(2)
+        for a, b in zip(arrs, got):
+            np.testing.assert_array_equal(a, b)
+            assert a.dtype == b.dtype
+        pub.close()
+        sub.close()
+    finally:
+        srv.stop()
+
+
+def test_serve_route(iris_net):
+    srv = NDArrayServer()
+    try:
+        route = ServeRoute(iris_net, srv.host, srv.port).start()
+        pub = NDArrayPublisher(srv.host, srv.port, "features")
+        sub = NDArrayConsumer(srv.host, srv.port, "predictions")
+        x = load_iris().features[:8]
+        pub.publish(x)
+        preds = sub.get_array()
+        assert preds.shape == (8, 3)
+        np.testing.assert_allclose(preds.sum(axis=1), 1.0, atol=1e-5)
+        route.stop()
+    finally:
+        srv.stop()
+
+
+def test_streaming_pipeline_trains(iris_net):
+    srv = NDArrayServer()
+    try:
+        conf = (NeuralNetConfiguration.builder().updater("sgd")
+                .learning_rate(0.1).seed(3).list()
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        px = NDArrayPublisher(srv.host, srv.port, "train.features")
+        py = NDArrayPublisher(srv.host, srv.port, "train.labels")
+        ds = load_iris()
+        for _ in range(6):
+            px.publish(ds.features[:64])
+            py.publish(ds.labels[:64])
+        pipe = StreamingPipeline(net, srv.host, srv.port)
+        scores = pipe.run(6)
+        assert scores[-1] < scores[0]
+        pipe.close()
+    finally:
+        srv.stop()
+
+
+def test_hdf5_minibatch_iterator(tmp_path):
+    fd, ld = tmp_path / "f", tmp_path / "l"
+    fd.mkdir(), ld.mkdir()
+    ds = load_iris()
+    for i in range(3):
+        np.save(fd / f"b{i}.npy", ds.features[i * 50:(i + 1) * 50])
+        np.save(ld / f"b{i}.npy", ds.labels[i * 50:(i + 1) * 50])
+    it = HDF5MiniBatchDataSetIterator(str(fd), str(ld))
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].features.shape == (50, 4)
+    with pytest.raises(ValueError, match="feature files"):
+        np.save(fd / "extra.npy", ds.features[:1])
+        HDF5MiniBatchDataSetIterator(str(fd), str(ld))
+
+
+def test_keras_gateway_fit_predict(tmp_path, iris_net):
+    ModelSerializer.write_model(iris_net, str(tmp_path / "m.zip"))
+    fd, ld = tmp_path / "f", tmp_path / "l"
+    fd.mkdir(), ld.mkdir()
+    ds = load_iris()
+    np.save(fd / "b0.npy", ds.features[:100])
+    np.save(ld / "b0.npy", ds.labels[:100])
+    np.save(tmp_path / "x.npy", ds.features[:5])
+
+    srv = KerasServer()
+    try:
+        cli = KerasClient(srv.host, srv.port)
+        r = cli.fit(str(tmp_path / "m.zip"), str(fd), str(ld), nb_epoch=2)
+        assert r["ok"]
+        preds = cli.predict(str(tmp_path / "x.npy"))
+        assert preds.shape == (5, 3)
+        ev = cli.request(op="evaluate", features_dir=str(fd),
+                         labels_dir=str(ld))
+        assert ev["accuracy"] > 0.8
+        with pytest.raises(RuntimeError, match="unknown op"):
+            cli.request(op="nope")
+        cli.close()
+    finally:
+        srv.stop()

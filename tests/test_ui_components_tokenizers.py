@@ -1,0 +1,190 @@
+"""Tests for the UI component model + visualization listeners (ref:
+deeplearning4j-ui-components, ConvolutionalIterationListener,
+FlowIterationListener) and the tokenizer add-ons + parallel early
+stopping."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DenseLayer,
+                                          OutputLayer)
+from deeplearning4j_tpu.ui import (ChartHistogram, ChartLine, Component,
+                                   ComponentDiv, ComponentTable,
+                                   ComponentText,
+                                   ConvolutionalIterationListener,
+                                   FlowIterationListener, render_html,
+                                   tile_activations)
+
+# ---------------------------------------------------------------- components
+
+
+def test_component_json_roundtrip():
+    div = ComponentDiv().add(
+        ComponentText(text="hello"),
+        ComponentTable(header=["a", "b"], content=[["1", "2"]], title="t"),
+        ChartLine(title="score").add_series("s", [0, 1, 2], [3.0, 2.0, 1.0]),
+        ChartHistogram(title="h").add_bin(0, 1, 5).add_bin(1, 2, 3),
+    )
+    d = div.to_dict()
+    rebuilt = Component.from_dict(json.loads(json.dumps(d)))
+    assert rebuilt.to_dict() == d
+
+
+def test_component_validation():
+    with pytest.raises(ValueError, match="x vs"):
+        ChartLine().add_series("s", [1, 2], [1.0])
+    with pytest.raises(ValueError, match="Unknown component"):
+        Component.from_dict({"type": "Nope"})
+
+
+def test_render_html(tmp_path):
+    page = render_html(
+        [ComponentText(text="<script>x</script>"),
+         ChartLine(title="t").add_series("a", [0, 1], [1.0, 2.0]),
+         ComponentTable(header=["h"], content=[["v"]])],
+        title="Report", path=str(tmp_path / "r.html"))
+    assert "&lt;script&gt;" in page          # escaped
+    assert "<polyline" in page
+    assert (tmp_path / "r.html").exists()
+
+
+# ----------------------------------------------------------------- listeners
+
+
+def test_tile_activations():
+    act = np.zeros((4, 4, 5), np.float32)
+    for c in range(5):
+        act[..., c] = c
+    grid = tile_activations(act)
+    # 5 channels -> 3x2 grid with 1px padding
+    assert grid.shape == (2 * 5 - 1, 3 * 5 - 1)
+    assert grid.max() == 1.0 and grid.min() == 0.0
+
+
+def test_conv_listener_and_flow_listener():
+    conf = (NeuralNetConfiguration.builder().updater("adam")
+            .learning_rate(0.01).seed(1).list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    activation="relu"))
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1)).build())
+    net = MultiLayerNetwork(conf).init()
+    conv_l = ConvolutionalIterationListener(frequency=1)
+    flow_l = FlowIterationListener()
+    net.set_listeners(conv_l, flow_l)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 8, 8, 1)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)]
+    net.fit_batch(DataSet(x, y))
+
+    assert conv_l.renders, "conv activations captured"
+    grid = next(iter(conv_l.renders.values()))
+    assert grid.ndim == 2
+
+    snap = json.loads(flow_l.to_json())
+    names = [n["name"] for n in snap["nodes"]]
+    assert names[0] == "input" and len(names) == 4
+    assert {"from": "layer0", "to": "layer1"} in snap["edges"]
+    assert "score" in snap
+
+
+# ---------------------------------------------------------------- tokenizers
+
+
+def test_japanese_script_runs():
+    from deeplearning4j_tpu.nlp import JapaneseTokenizerFactory
+    toks = JapaneseTokenizerFactory().create(
+        "私はJAXでモデルを書く。").get_tokens()
+    assert "JAX" in toks
+    assert "モデル" in toks          # katakana run kept whole
+    assert all("。" not in t for t in toks)
+
+
+def test_korean_particle_strip():
+    from deeplearning4j_tpu.nlp import KoreanTokenizerFactory
+    toks = KoreanTokenizerFactory().create("나는 학교에 간다").get_tokens()
+    assert "학교" in toks            # 에 particle stripped
+    raw = KoreanTokenizerFactory(strip_particles=False).create(
+        "나는 학교에 간다").get_tokens()
+    assert "학교에" in raw
+
+
+def test_pos_filter():
+    from deeplearning4j_tpu.nlp import PosFilterTokenizerFactory, pos_tag
+    assert pos_tag("running") == "VB"
+    assert pos_tag("quickly") == "RB"
+    assert pos_tag("the") == "DT"
+    f = PosFilterTokenizerFactory(allowed_tags=["NN", "CD"])
+    toks = f.create("the movement measured 42 units quickly").get_tokens()
+    assert "movement" in toks and "42" in toks
+    assert "the" not in toks and "quickly" not in toks
+
+
+def test_sentence_iterator():
+    from deeplearning4j_tpu.nlp import RegexSentenceIterator
+    it = RegexSentenceIterator("One sentence. Two! Three? 四つ目。 Five")
+    sents = list(it)
+    assert sents[0] == "One sentence."
+    assert len(sents) == 5
+
+
+# ------------------------------------------------- parallel early stopping
+
+
+def test_early_stopping_parallel_trainer():
+    import jax
+    from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator
+    from deeplearning4j_tpu.earlystopping import (
+        EarlyStoppingConfiguration, EarlyStoppingParallelTrainer,
+        InMemoryModelSaver, MaxEpochsTerminationCondition)
+    from deeplearning4j_tpu.parallel import MeshContext
+
+    conf = (NeuralNetConfiguration.builder().updater("adam")
+            .learning_rate(0.05).seed(5).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    ctx = MeshContext.create(n_data=min(4, len(jax.devices())), n_model=1)
+    es_conf = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(8)],
+        model_saver=InMemoryModelSaver(),
+        evaluate_every_n_epochs=1)
+    # batches must divide the data axis (SPMD static shapes): 144 = 3 x 48
+    result = EarlyStoppingParallelTrainer(
+        es_conf, net, IrisDataSetIterator(48, num_examples=144),
+        mesh=ctx).fit()
+    assert result.total_epochs == 8
+    assert result.best_model is not None
+    assert result.best_model_score < 1.0  # learned something
+
+
+def test_sentence_iterator_cjk_no_spaces():
+    from deeplearning4j_tpu.nlp import RegexSentenceIterator
+    sents = list(RegexSentenceIterator("これはペンです。それは本です。"))
+    assert sents == ["これはペンです。", "それは本です。"]
+
+
+def test_flow_listener_graph_no_duplicate_inputs():
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    conf = (NeuralNetConfiguration.builder().updater("sgd")
+            .learning_rate(0.1).seed(1).graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=4, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                          loss="mcxent"), "d")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(3)).build())
+    net = ComputationGraph(conf).init()
+    fl = FlowIterationListener()
+    fl.iteration_done(net, 0, 1.0)
+    snap = json.loads(fl.to_json())
+    names = [n["name"] for n in snap["nodes"]]
+    assert names.count("in") == 1
+    assert all(n["layerType"] != "NoneType" for n in snap["nodes"])
