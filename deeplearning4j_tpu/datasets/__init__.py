@@ -6,6 +6,7 @@ from deeplearning4j_tpu.datasets.iterator import (  # noqa: F401
     DataSetIterator,
     ListDataSetIterator,
     AsyncDataSetIterator,
+    DevicePrefetchIterator,
     SamplingDataSetIterator,
     MultipleEpochsIterator,
     ExistingDataSetIterator,

@@ -245,7 +245,9 @@ class ComputationGraph:
             return (new_params, new_opt, new_states, loss,
                     grads if collect_grads else None)
 
-        return jax.jit(train_step)
+        # donate params/opt/states: ResNet-scale nets must not copy their
+        # whole state every step (HBM traffic + footprint)
+        return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
     def fit_batch(self, data: Union[DataSet, MultiDataSet]) -> float:
         self._check_init()

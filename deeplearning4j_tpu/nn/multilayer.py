@@ -252,7 +252,7 @@ class MultiLayerNetwork:
             return (new_params, new_opt, new_states, loss,
                     grads if collect_grads else None)
 
-        return jax.jit(train_step)
+        return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
     def fit_batch(self, dataset: DataSet) -> float:
         """One optimization step on one minibatch (ref: fit(DataSet))."""
@@ -309,7 +309,7 @@ class MultiLayerNetwork:
             new_carries = jax.tree.map(jax.lax.stop_gradient, new_carries)
             return new_params, new_opt, new_states, new_carries, loss
 
-        return jax.jit(step)
+        return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def _fit_tbptt(self, dataset: DataSet) -> float:
         """Truncated BPTT over time slices, carrying RNN state

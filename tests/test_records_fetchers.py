@@ -207,3 +207,25 @@ def test_sequence_two_reader_exhaustion():
     it.next()
     it.next()
     assert not it.has_next()
+
+
+def test_device_prefetch_iterator():
+    """Batches come back device-resident with the requested float dtype;
+    masks and ints are untouched (datasets/iterator.py)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import (
+        DevicePrefetchIterator, ListDataSetIterator)
+    base = ListDataSetIterator([
+        DataSet(np.ones((4, 3), np.float32), np.ones((4, 2), np.float32),
+                np.ones((4,), np.float32), None)
+        for _ in range(3)])
+    it = DevicePrefetchIterator(base, dtype="bfloat16")
+    got = list(it)
+    assert len(got) == 3
+    assert got[0].features.dtype == jnp.bfloat16
+    assert got[0].labels.dtype == jnp.bfloat16
+    assert got[0].features_mask.dtype == np.float32  # masks not cast
+    # reset + second epoch works
+    got2 = list(it)
+    assert len(got2) == 3
