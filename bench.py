@@ -8,6 +8,17 @@ first run).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+On unrecoverable backend failure it still prints one structured JSON line
+with an "error" record instead of dying with a bare traceback (round-1
+burned its one shot on a transient "UNAVAILABLE: TPU backend setup" raised
+by ``jax.devices()`` before any framework code ran).
+
+Architecture: the process doubles as supervisor and worker. The supervisor
+(default entry) re-execs itself with BENCH_CHILD=1; backend-init failures
+are retried with exponential backoff in a FRESH process each time (JAX
+caches a failed backend for the life of the process, so in-process retry
+can never recover). The child runs the actual measurement and prints the
+JSON line, which the supervisor passes through verbatim.
 
 Runs on whatever device jax selects (TPU under the driver; CPU fallback for
 local smoke with BENCH_SMALL=1).
@@ -17,21 +28,50 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
-# First recorded full-size value (round 1). Update when a round improves it
-# so vs_baseline tracks cumulative speedup over the first measurement.
+# First recorded full-size value. Update when a round improves it so
+# vs_baseline tracks cumulative speedup over the first measurement.
+# Round 1 produced no TPU number (backend init failure), so the first
+# successful full-size run of round >= 2 sets the baseline.
 BENCH_HISTORY = {
-    "resnet50_b64_bf16_samples_per_sec_per_chip": None,  # round 1 fills this
+    "resnet50_b64_bf16_samples_per_sec_per_chip": None,
 }
 
+# Peak bf16 matmul FLOP/s per chip, by device_kind substring (public cloud
+# specs), for the MFU estimate. Conservative default when unknown.
+_CHIP_PEAK_FLOPS = (
+    ("v6", 918e12),       # TPU v6e (Trillium)
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),  # v5e reports device_kind "TPU v5 lite"
+    ("v5e", 197e12),
+    ("v5litepod", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
 
-def main() -> None:
+
+def _chip_peak(device_kind: str):
+    kind = device_kind.lower()
+    for key, peak in _CHIP_PEAK_FLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def _acquire_backend():
+    """Import jax and initialize the backend, raising on failure.
+
+    Called only in the child process; a failure here is retried by the
+    supervisor in a fresh process.
+    """
     import jax
 
-    small = os.environ.get("BENCH_SMALL", "0") == "1"
     if "cpu" == os.environ.get("JAX_PLATFORMS", ""):
         # the environment's sitecustomize pins jax_platforms to the TPU
         # tunnel; an explicit CPU request must override it via config
@@ -40,8 +80,20 @@ def main() -> None:
             jax.config.update("jax_platforms", "cpu")
         except Exception:
             pass
-    platform = jax.devices()[0].platform
-    if small or platform == "cpu":
+    devices = jax.devices()  # may raise RuntimeError("UNAVAILABLE: ...")
+    return jax, devices
+
+
+def _run_child() -> int:
+    t_init = time.perf_counter()
+    jax, devices = _acquire_backend()
+    init_s = time.perf_counter() - t_init
+    platform = devices[0].platform
+    device_kind = getattr(devices[0], "device_kind", platform)
+
+    small = os.environ.get("BENCH_SMALL", "0") == "1"
+    on_accel = platform not in ("cpu",)
+    if small or not on_accel:
         # smoke configuration for hosts without a TPU
         height = width = 64
         batch = 8
@@ -84,11 +136,13 @@ def main() -> None:
     # smoke runs — XLA:CPU emulates bf16 orders of magnitude slower.
     staged = list(DevicePrefetchIterator(
         ListDataSetIterator(batches(4)),
-        dtype="bfloat16" if platform == "tpu" else None))
+        dtype="bfloat16" if on_accel else None))
 
+    t_compile = time.perf_counter()
     for i in range(warmup):
         net.fit_batch(staged[i % len(staged)])
     jax.block_until_ready(net.params)
+    compile_s = time.perf_counter() - t_compile
 
     t0 = time.perf_counter()
     for i in range(steps):
@@ -97,17 +151,87 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     sps = batch * steps / dt
+
+    # MFU estimate: analytic training FLOPs per image (fwd conv/matmul
+    # FLOPs x3 for fwd+bwd) over chip peak. ResNet-50 @224 fwd ~= 4.09e9
+    # FLOPs/image (scaled by area for other input sizes).
+    fwd_flops_per_image = 4.09e9 * (height * width) / (224 * 224)
+    train_flops_per_sec = 3.0 * fwd_flops_per_image * sps
+    peak = _chip_peak(str(device_kind))
+    mfu = round(train_flops_per_sec / peak, 4) if peak else None
+
     name = "resnet50_b64_bf16_samples_per_sec_per_chip"
     base = BENCH_HISTORY.get(name)
     vs = (sps / base) if base else 1.0
-    print(json.dumps({
-        "metric": name if not (small or platform == "cpu")
-        else name + "_SMOKE",
+    record = {
+        "metric": name if (on_accel and not small) else name + "_SMOKE",
         "value": round(sps, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": round(vs, 3),
+        "mfu": mfu,
+        "device_kind": str(device_kind),
+        "platform": platform,
+        "batch": batch,
+        "steps": steps,
+        "step_ms": round(1000 * dt / steps, 2),
+        "backend_init_s": round(init_s, 1),
+        "warmup_compile_s": round(compile_s, 1),
+    }
+    print(json.dumps(record))
+    return 0
+
+
+def _supervise() -> int:
+    """Run the benchmark in child processes, retrying backend failures."""
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "4"))
+    timeout_s = float(os.environ.get("BENCH_TIMEOUT", "1500"))
+    env = dict(os.environ, BENCH_CHILD="1")
+    last_err = None
+    for attempt in range(1, attempts + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True, timeout=timeout_s)
+        except subprocess.TimeoutExpired as e:
+            last_err = {"attempt": attempt, "kind": "timeout",
+                        "detail": f"child exceeded {timeout_s}s"}
+            print(f"bench attempt {attempt}: timeout", file=sys.stderr)
+            continue
+        sys.stderr.write(proc.stderr[-4000:])
+        line = next((ln for ln in reversed(proc.stdout.splitlines())
+                     if ln.startswith("{")), None)
+        if proc.returncode == 0 and line:
+            print(line)  # the ONE JSON line, passed through
+            return 0
+        last_err = {
+            "attempt": attempt, "kind": "child_failure",
+            "returncode": proc.returncode,
+            "detail": (proc.stderr.strip().splitlines() or ["<no stderr>"]
+                       )[-1][:400],
+        }
+        print(f"bench attempt {attempt} failed "
+              f"(rc={proc.returncode}): {last_err['detail']}",
+              file=sys.stderr)
+        # transient backend-init failures ("UNAVAILABLE", tunnel hiccups)
+        # deserve backoff; anything else likely fails again fast, but a
+        # fresh process costs little so retry uniformly.
+        if attempt < attempts:
+            time.sleep(min(15.0 * attempt, 60.0))
+    print(json.dumps({
+        "metric": "resnet50_b64_bf16_samples_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "samples/sec/chip",
+        "vs_baseline": 0.0,
+        "error": last_err or {"kind": "unknown"},
     }))
+    return 1
+
+
+def main() -> int:
+    if os.environ.get("BENCH_CHILD") == "1":
+        return _run_child()
+    return _supervise()
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

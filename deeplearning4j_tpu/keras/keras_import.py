@@ -34,7 +34,9 @@ from deeplearning4j_tpu.keras.hdf5 import Hdf5Archive
 from deeplearning4j_tpu.nn.conf.builder import (
     MultiLayerConfiguration, NeuralNetConfiguration,
 )
+from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex, MergeVertex
 from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.graph import ComputationGraph
 from deeplearning4j_tpu.nn.layers import (
     ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
     DropoutLayer, EmbeddingLayer, GlobalPoolingLayer, LSTM, OutputLayer,
@@ -143,6 +145,94 @@ def _input_type_from_config(cfg: dict) -> Optional[InputType]:
     return None
 
 
+# Keras merge-layer class -> vertex factory. Keras 1.x used a single
+# "Merge" layer with a mode string; Keras 2.x has one class per op
+# (ref: KerasMerge.java mapping to DL4J MergeVertex/ElementWiseVertex).
+def _concat_vertex(cfg: dict) -> MergeVertex:
+    axis = cfg.get("axis", cfg.get("concat_axis", -1))
+    if axis not in (-1, 3):
+        # MergeVertex concatenates along the feature (last) axis; Keras
+        # channels-last models use axis=-1 (default) or axis=3 (NHWC
+        # channel axis, e.g. keras.applications Inception/ResNet). Anything
+        # else (channels_first retrain, time-axis concat) has no mapping.
+        raise ValueError(
+            f"Concatenate axis={axis} unsupported (only the last/feature "
+            "axis maps to MergeVertex)")
+    return MergeVertex()
+
+
+_MERGE_CLASSES = {
+    "Add": lambda cfg: ElementWiseVertex(op="add"),
+    "Subtract": lambda cfg: ElementWiseVertex(op="subtract"),
+    "Multiply": lambda cfg: ElementWiseVertex(op="product"),
+    "Average": lambda cfg: ElementWiseVertex(op="average"),
+    "Maximum": lambda cfg: ElementWiseVertex(op="max"),
+    "Concatenate": _concat_vertex,
+}
+
+_KERAS1_MERGE_MODES = {
+    "sum": lambda: ElementWiseVertex(op="add"),
+    "mul": lambda: ElementWiseVertex(op="product"),
+    "ave": lambda: ElementWiseVertex(op="average"),
+    "max": lambda: ElementWiseVertex(op="max"),
+    "concat": lambda: MergeVertex(),
+}
+
+
+def _inbound_names(inbound_nodes) -> List[str]:
+    """Source-layer names of a layer's first inbound node.
+
+    Handles the nested-list format (Keras 1.x/2.x:
+    ``[[["src", 0, 0, {}], ...]]``) and the dict format (TF-Keras 2.13+ /
+    Keras 3: ``[{"args": [<keras tensors with keras_history>], ...}]``).
+    Ref: KerasModel.java inbound-node graph walk.
+    """
+    if not inbound_nodes:
+        return []
+    node0 = inbound_nodes[0]
+    names: List[str] = []
+    if isinstance(node0, dict):
+        def walk(obj):
+            if isinstance(obj, dict):
+                if obj.get("class_name") == "__keras_tensor__":
+                    hist = obj.get("config", {}).get("keras_history")
+                    if hist:
+                        names.append(hist[0])
+                    return
+                for v in obj.values():
+                    walk(v)
+            elif isinstance(obj, (list, tuple)):
+                for v in obj:
+                    walk(v)
+        walk(node0)
+    else:
+        for entry in node0:
+            if isinstance(entry, (list, tuple)) and entry:
+                names.append(entry[0])
+            elif isinstance(entry, str):
+                names.append(entry)
+    return names
+
+
+def _layer_ref_name(ref) -> str:
+    """'fc1000' from an input_layers/output_layers entry (list or str)."""
+    if isinstance(ref, (list, tuple)):
+        return ref[0]
+    return ref
+
+
+def _layer_refs(val) -> List[str]:
+    """Normalize input_layers/output_layers: either a list of refs
+    (``[["a",0,0], ["b",0,0]]`` or ``["a","b"]``) or ONE flat ref
+    (``["a", 0, 0]`` — Keras 3 single-input form)."""
+    if not val:
+        return []
+    if (isinstance(val, (list, tuple)) and isinstance(val[0], str)
+            and len(val) == 3 and isinstance(val[1], int)):
+        return [val[0]]
+    return [_layer_ref_name(r) for r in val]
+
+
 class KerasModelImport:
     """Static entry points (ref: KerasModelImport.java:101
     importKerasSequentialModelAndWeights / importKerasModelAndWeights)."""
@@ -170,6 +260,156 @@ class KerasModelImport:
     importKerasSequentialModelAndWeights = import_keras_sequential_model_and_weights
 
     @staticmethod
+    def import_keras_model_and_weights(path: str,
+                                       enforce_training_config: bool = False
+                                       ):
+        """Functional ``Model`` -> ComputationGraph; Sequential models are
+        delegated to the sequential path (ref: KerasModelImport.java:101,
+        KerasModel.java getComputationGraphConfiguration/getComputationGraph).
+        """
+        with Hdf5Archive(path) as h5:
+            cfg_json = h5.read_attribute_as_string("model_config")
+            if cfg_json is None:
+                raise ValueError(f"{path!r} has no model_config attribute")
+            model_cfg = json.loads(cfg_json)
+            cls = model_cfg.get("class_name")
+            if cls == "Sequential":
+                net = None  # delegate below (reopens the archive once)
+            elif cls in ("Model", "Functional"):
+                net = KerasModelImport._build_graph(model_cfg["config"])
+                KerasModelImport._load_graph_weights(h5, net)
+            else:
+                raise ValueError(f"Unsupported Keras model class {cls!r}")
+        if net is None:
+            return KerasModelImport.import_keras_sequential_model_and_weights(
+                path, enforce_training_config)
+        return net
+
+    # alias with the reference's naming
+    importKerasModelAndWeights = import_keras_model_and_weights
+
+    @staticmethod
+    def _build_graph(cfg: dict) -> ComputationGraph:
+        """Functional-config DAG -> ComputationGraphConfiguration.
+
+        InputLayer nodes become network inputs; merge layers become
+        Merge/ElementWise vertices; Flatten collapses into the auto
+        CnnToFeedForward preprocessor (alias to its upstream node); the
+        Dense feeding each network output becomes an OutputLayer so the
+        imported net is trainable (ref: KerasModel.java:1-647).
+        """
+        layer_cfgs: List[dict] = cfg["layers"]
+        input_names = _layer_refs(cfg.get("input_layers", []))
+        output_names = _layer_refs(cfg.get("output_layers", []))
+
+        b = NeuralNetConfiguration.builder().seed(12345)
+        gb = b.graph_builder()
+
+        # alias: keras layer name -> graph node name that produces its output
+        alias: Dict[str, str] = {}
+        input_types: Dict[str, InputType] = {}
+        # pre-scan: which keras names feed a network output (for OutputLayer
+        # conversion) — a Dense is a loss head only if it IS an output
+        out_set = set(output_names)
+        kept_names: List[str] = []  # layer nodes that own weights, in order
+
+        # Network inputs MUST follow cfg["input_layers"] order, not the
+        # layers-list encounter order (Keras stores layers in traversal
+        # order) — callers zip positional inputs against this order.
+        by_name = {(_cfg(lc).get("name", lc.get("name"))): lc
+                   for lc in layer_cfgs}
+        if not input_names:  # older configs: fall back to encounter order
+            input_names = [_cfg(lc).get("name", lc.get("name"))
+                           for lc in layer_cfgs
+                           if lc["class_name"] == "InputLayer"]
+        for iname in input_names:
+            kcfg = _cfg(by_name[iname])
+            it = _input_type_from_config(kcfg)
+            if it is None:
+                raise ValueError(f"InputLayer {iname!r} has no "
+                                 "batch_input_shape")
+            gb.add_inputs(iname)
+            input_types[iname] = it
+            alias[iname] = iname
+
+        for lc in layer_cfgs:
+            cls = lc["class_name"]
+            kcfg = _cfg(lc)
+            name = kcfg.get("name", lc.get("name"))
+            inbound = lc.get("inbound_nodes", [])
+            if len(inbound) > 1:
+                raise ValueError(
+                    f"Layer {name!r} is shared (called {len(inbound)} "
+                    "times); shared-layer import is unsupported")
+            srcs = [alias[s] for s in _inbound_names(inbound)]
+            if cls == "InputLayer":
+                continue  # added above, in input_layers order
+            if cls in _MERGE_CLASSES:
+                gb.add_vertex(name, _MERGE_CLASSES[cls](kcfg), *srcs)
+                alias[name] = name
+                continue
+            if cls == "Merge":  # Keras 1.x
+                mode = kcfg.get("mode", "sum")
+                if mode not in _KERAS1_MERGE_MODES:
+                    raise ValueError(f"Unsupported Merge mode {mode!r}")
+                gb.add_vertex(name, _KERAS1_MERGE_MODES[mode](), *srcs)
+                alias[name] = name
+                continue
+            mapped = KerasLayerMapper.map(cls, kcfg)
+            if mapped in ("flatten", "input"):
+                # collapses into the auto preprocessor of the consumer
+                alias[name] = srcs[0]
+                continue
+            if name in out_set and isinstance(mapped, DenseLayer) \
+                    and not isinstance(mapped, OutputLayer):
+                loss = "mcxent" if mapped.activation == "softmax" else "mse"
+                mapped = OutputLayer(n_out=mapped.n_out,
+                                     activation=mapped.activation, loss=loss)
+            gb.add_layer(name, mapped, *srcs)
+            alias[name] = name
+            kept_names.append(name)
+            if isinstance(mapped, LSTM) and not kcfg.get("return_sequences",
+                                                         False):
+                # Keras LSTM default emits only the final step; ours emits
+                # the sequence — append a LastTimeStepVertex
+                from deeplearning4j_tpu.nn.conf.graph import LastTimeStepVertex
+                gb.add_vertex(name + "__last", LastTimeStepVertex(), name)
+                alias[name] = name + "__last"
+
+        gb.set_outputs(*[alias[o] for o in output_names])
+        gb.set_input_types(*[input_types[i] for i in input_types])
+        conf = gb.build()
+        net = ComputationGraph(conf)
+        net.init()
+        net._keras_names = kept_names  # node name == keras layer name
+        return net
+
+    @staticmethod
+    def _layer_datasets(h5: Hdf5Archive, group: str) -> Dict[str, np.ndarray]:
+        """{param name: array} for one layer's weight group, via the
+        ``weight_names`` attr (Keras save_weights layout) or, absent that,
+        the group's direct dataset children."""
+        wnames = h5.read_attribute_as_string_list("weight_names", group)
+        if wnames is None:
+            children = h5.list_children(group)
+            wnames = [n for k, n in children if k == "d"]
+        return {
+            wn.split("/")[-1].split(":")[0]:
+                h5.read_dataset(f"{group}/{wn}".replace("//", "/"))
+            for wn in wnames}
+
+    @staticmethod
+    def _load_graph_weights(h5: Hdf5Archive, net: ComputationGraph) -> None:
+        root = KerasModelImport._weights_root(h5)
+        for name in net._keras_names:
+            layer = net.conf.nodes[name].layer
+            group = f"{root}/{name}".replace("//", "/")
+            datasets = KerasModelImport._layer_datasets(h5, group)
+            if not datasets:
+                continue
+            KerasModelImport._set_layer_weights(net, name, layer, datasets)
+
+    @staticmethod
     def _build_sequential(layer_cfgs: List[dict]) -> MultiLayerNetwork:
         b = NeuralNetConfiguration.builder().seed(12345)
         lb = b.list()
@@ -186,6 +426,16 @@ class KerasModelImport:
             if mapped in ("flatten", "input"):
                 continue  # flatten == our auto CnnToFeedForward preprocessor
             kept.append((lc, mapped))
+            if isinstance(mapped, LSTM) and not cfg.get("return_sequences",
+                                                        False):
+                # Keras LSTM default emits only the final step; ours emits
+                # the sequence — append a param-free LastTimeStepLayer whose
+                # synthetic name has no weight group in the h5 (skipped by
+                # the weight loader)
+                from deeplearning4j_tpu.nn.layers import LastTimeStepLayer
+                synth = {"config": {"name": (cfg.get("name", "lstm")
+                                             + "__last")}}
+                kept.append((synth, LastTimeStepLayer()))
         if input_type is None:
             raise ValueError("Cannot infer input shape (no batch_input_shape)")
         # final Dense becomes an OutputLayer so the net is trainable
@@ -216,19 +466,7 @@ class KerasModelImport:
         root = KerasModelImport._weights_root(h5)
         for li, (layer, name) in enumerate(zip(net.layers, net._keras_names)):
             group = f"{root}/{name}".replace("//", "/")
-            wnames = h5.read_attribute_as_string_list("weight_names", group)
-            if wnames is None:
-                children = h5.list_children(group)
-                wnames = [n for k, n in children if k == "d"]
-                datasets = {
-                    n.split("/")[-1].split(":")[0]:
-                        h5.read_dataset(f"{group}/{n}")
-                    for n in wnames}
-            else:
-                datasets = {}
-                for wn in wnames:
-                    arr = h5.read_dataset(f"{group}/{wn}".replace("//", "/"))
-                    datasets[wn.split("/")[-1].split(":")[0]] = arr
+            datasets = KerasModelImport._layer_datasets(h5, group)
             if not datasets:
                 continue
             KerasModelImport._set_layer_weights(net, li, layer, datasets)

@@ -45,7 +45,7 @@ _CNN_LAYERS = {"ConvolutionLayer", "SubsamplingLayer", "ZeroPaddingLayer",
                "LocalResponseNormalization"}
 _RNN_LAYERS = {"LSTM", "GravesLSTM", "GravesBidirectionalLSTM", "SimpleRnn",
                "RnnOutputLayer", "Convolution1DLayer", "Subsampling1DLayer",
-               "SelfAttentionLayer"}
+               "SelfAttentionLayer", "LastTimeStepLayer"}
 _ANY_LAYERS = {"BatchNormalization", "GlobalPoolingLayer", "ActivationLayer",
                "DropoutLayer", "LossLayer"}
 

@@ -282,9 +282,10 @@ class LastTimeStepVertex(GraphVertex):
         if mask is None:
             return self.apply(inputs)
         x = inputs[0]
-        # index of last step where mask==1, per example
-        idx = jnp.sum(mask > 0, axis=1).astype(jnp.int32) - 1
-        idx = jnp.maximum(idx, 0)
+        # index of the LAST step where mask == 1 (works for pre- and
+        # post-padding: scan the reversed mask for its first 1)
+        T = mask.shape[1]
+        idx = T - 1 - jnp.argmax(jnp.flip(mask, axis=1) > 0, axis=1)
         return x[jnp.arange(x.shape[0]), idx]
 
 

@@ -42,6 +42,7 @@ from deeplearning4j_tpu.nn.layers.recurrent import (  # noqa: F401
     LSTM,
     GravesLSTM,
     GravesBidirectionalLSTM,
+    LastTimeStepLayer,
     RnnOutputLayer,
     SimpleRnn,
 )

@@ -310,3 +310,34 @@ class RnnOutputLayer(BaseLayerConf):
         per = get_loss(self.loss)(flat_lab, flat_pre, self.activation, flat_mask)
         per_ex = per.reshape(B, T).sum(axis=1)
         return jnp.mean(per_ex) if average else per.reshape(B, T)
+
+
+@register_layer
+@dataclass
+class LastTimeStepLayer(BaseLayerConf):
+    """[B, T, F] -> [B, F]: the last time step, or with a mask the last
+    UNMASKED step per example (ref: the reference's graph-side
+    nn/conf/graph/rnn/LastTimeStepVertex.java; later DL4J added the
+    equivalent feed-forward wrapper layer nn/conf/layers/recurrent/
+    LastTimeStep for Keras return_sequences=False import parity)."""
+
+    def set_n_in(self, in_type: InputType) -> None:
+        if in_type.kind != "rnn":
+            raise ValueError(f"LastTimeStepLayer expects RNN input, got {in_type}")
+        self.n_in = in_type.size
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        return InputType.feed_forward(in_type.size)
+
+    def param_order(self) -> List[str]:
+        return []
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        if mask is None:
+            return x[:, -1, :], state
+        # index of the LAST step where mask == 1 (works for pre- and
+        # post-padding: scan the reversed mask for its first 1)
+        T = mask.shape[1]
+        idx = T - 1 - jnp.argmax(jnp.flip(mask, axis=1) > 0, axis=1)
+        out = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :]
+        return out, state

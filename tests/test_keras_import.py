@@ -141,6 +141,7 @@ def test_import_lstm_keras2(tmp_path):
             {"class_name": "LSTM",
              "config": {"name": "lstm", "units": H, "activation": "tanh",
                         "recurrent_activation": "sigmoid",
+                        "return_sequences": True,  # GAP1D consumes sequences
                         "batch_input_shape": [None, 7, F]}},
             {"class_name": "GlobalAveragePooling1D", "config": {"name": "gap"}},
             {"class_name": "Dense",

@@ -393,3 +393,28 @@ def test_weighted_walk_zero_weight_vertex_isolated():
                 counts[walk[1]] += 1
     frac5 = counts[5] / max(sum(counts.values()), 1)
     assert 0.6 < frac5 < 0.9, counts  # 3:1 weights => ~0.75
+
+
+def test_last_time_step_pre_padded_mask():
+    """Round-2 review: last-unmasked-step selection must handle PRE-padded
+    masks ([0,0,1,1] — keras pad_sequences default), not just post-padded:
+    sum(mask)-1 picks a zeroed step for pre-padding."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.nn.conf.graph import LastTimeStepVertex
+    from deeplearning4j_tpu.nn.layers import LastTimeStepLayer
+
+    x = np.arange(2 * 4 * 3, dtype=np.float32).reshape(2, 4, 3)
+    mask = np.array([[0, 0, 1, 1],    # pre-padded: last unmasked idx 3
+                     [1, 1, 1, 0]],   # post-padded: last unmasked idx 2
+                    dtype=np.float32)
+    want = np.stack([x[0, 3], x[1, 2]])
+
+    out = LastTimeStepVertex().apply_masked([jnp.asarray(x)], jnp.asarray(mask))
+    np.testing.assert_array_equal(np.asarray(out), want)
+
+    layer = LastTimeStepLayer()
+    out2, _ = layer.apply({}, jnp.asarray(x), state={}, train=False,
+                          rng=None, mask=jnp.asarray(mask))
+    np.testing.assert_array_equal(np.asarray(out2), want)
