@@ -24,7 +24,9 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 
 
 class DataSetIterator:
-    """Iterator contract (ref: ND4J DataSetIterator interface)."""
+    """Iterator contract (ref: ND4J DataSetIterator interface, incl.
+    setPreProcessor — a DataSetPreProcessor applied to every emitted
+    batch, e.g. the VGG16 mean-subtraction preprocessor)."""
 
     def reset(self) -> None:
         raise NotImplementedError
@@ -43,6 +45,28 @@ class DataSetIterator:
 
     def async_supported(self) -> bool:
         return True
+
+    def set_pre_processor(self, pre_processor) -> "DataSetIterator":
+        """(ref: DataSetIterator.setPreProcessor) ``pre_processor`` is a
+        callable DataSet -> DataSet-or-None (None = mutated in place).
+
+        Wraps this instance's ``next`` so EVERY consumption path applies
+        it — direct ``next()`` calls, ``__next__``, and ``__iter__``."""
+        self._pre_processor = pre_processor
+        if not getattr(self, "_pp_wrapped", False):
+            raw_next = self.next
+
+            def wrapped() -> DataSet:
+                ds = raw_next()
+                pp = getattr(self, "_pre_processor", None)
+                if pp is not None:
+                    out = pp(ds)
+                    ds = ds if out is None else out
+                return ds
+
+            self.next = wrapped  # instance attr shadows the class method
+            self._pp_wrapped = True
+        return self
 
     # Python iteration protocol
     def __iter__(self) -> Iterator[DataSet]:

@@ -49,6 +49,13 @@ def _find(names) -> Optional[Path]:
 
 
 def _read_idx(path: Path) -> np.ndarray:
+    # native fast path (native/dataloader.cc via datasets/native_io.py);
+    # returns u8-valued float32 with scale=1 — cast back for callers that
+    # expect raw bytes. Python fallback covers gz and missing .so.
+    from deeplearning4j_tpu.datasets import native_io
+    native = native_io.idx_read(path, scale=1.0)
+    if native is not None:
+        return native.astype(np.uint8)
     opener = gzip.open if path.suffix == ".gz" else open
     with opener(path, "rb") as f:
         data = f.read()

@@ -1,0 +1,94 @@
+"""Native host-side IO: ctypes bindings for native/dataloader.cc
+(libdataloader.so — IDX and numeric-CSV parsers).
+
+Role parity: the reference's ingestion hot path runs in native code
+(ref: deeplearning4j-core/.../datasets/fetchers/MnistDataFetcher.java:65-83
+IDX parsing into native-backed ND4J buffers; DataVec CSV record readers).
+Python callers fall back to the pure-Python parsers when the shared library
+is unavailable (``idx_read``/``csv_read`` return None) — same seam as the
+reference's helper-discovery pattern.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from pathlib import Path
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.native_loader import load_native
+
+_lib = None
+_checked = False
+
+
+def _load():
+    global _lib, _checked
+    if not _checked:
+        _checked = True
+        lib = load_native("dataloader")
+        if lib is not None:
+            lib.idx_read.restype = ctypes.c_int
+            lib.idx_read.argtypes = [
+                ctypes.c_char_p, ctypes.c_double,
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+                ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+            lib.csv_read.restype = ctypes.c_int64
+            lib.csv_read.argtypes = [
+                ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32)]
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def idx_read(path: Union[str, Path],
+             scale: float = 1.0) -> Optional[np.ndarray]:
+    """Parse an IDX (MNIST-format) file into float32, scaled by ``scale``
+    (1/255 for images). None when the native library is unavailable or the
+    file is not plain IDX (e.g. gzip — caller falls back to Python)."""
+    lib = _load()
+    path = Path(path)
+    if lib is None or path.suffix == ".gz":
+        return None
+    # size the output from the file length (IDX header is tiny; u8 payload)
+    capacity = max(path.stat().st_size, 16)
+    out = np.empty(capacity, dtype=np.float32)
+    dims = (ctypes.c_int64 * 8)()
+    nd = lib.idx_read(str(path).encode(), float(scale), dims, 8,
+                      out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                      capacity)
+    if nd <= 0:
+        return None
+    shape = tuple(int(dims[i]) for i in range(nd))
+    n = int(np.prod(shape))
+    return out[:n].reshape(shape)
+
+
+def csv_read(path: Union[str, Path], delimiter: str = ",",
+             skip_rows: int = 0) -> Optional[Tuple[np.ndarray, int]]:
+    """Parse a numeric CSV into a row-major float64 [rows, cols] matrix
+    (double precision: strtod and Python's float() agree exactly, so the
+    native and fallback paths yield identical values). None when
+    unavailable/unparseable (ragged or non-numeric rows fall back to the
+    Python reader, which handles strings and quoting)."""
+    lib = _load()
+    path = Path(path)
+    if lib is None or not path.exists():
+        return None
+    # upper bound: every byte a 1-char number -> bytes/2 values + slack
+    capacity = max(path.stat().st_size, 64)
+    out = np.empty(capacity, dtype=np.float64)
+    ncols = ctypes.c_int32(0)
+    rows = lib.csv_read(str(path).encode(), delimiter.encode()[:1],
+                        int(skip_rows),
+                        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                        capacity, ctypes.byref(ncols))
+    if rows < 0 or ncols.value <= 0:
+        return None
+    return out[:rows * ncols.value].reshape(int(rows), ncols.value), ncols.value

@@ -76,7 +76,20 @@ class CSVRecordReader(RecordReader):
 
     def __init__(self, source: Union[str, Path, Iterable[str]],
                  skip_lines: int = 0, delimiter: str = ","):
+        self._rows = None  # native numeric fast path: float32 [rows, cols]
         if isinstance(source, (str, Path)):
+            # all-numeric files parse in native code
+            # (native/dataloader.cc csv_read); mixed/string content falls
+            # back to the Python tokenizer below
+            from deeplearning4j_tpu.datasets import native_io
+            parsed = native_io.csv_read(source, delimiter=delimiter,
+                                        skip_rows=skip_lines)
+            if parsed is not None:
+                self._rows = parsed[0]
+                self._lines = []
+                self._delim = delimiter
+                self._pos = 0
+                return
             with open(source) as f:
                 lines = f.read().splitlines()
         else:
@@ -94,9 +107,14 @@ class CSVRecordReader(RecordReader):
             return tok
 
     def has_next(self):
-        return self._pos < len(self._lines)
+        n = len(self._rows) if self._rows is not None else len(self._lines)
+        return self._pos < n
 
     def next_record(self):
+        if self._rows is not None:
+            row = self._rows[self._pos]
+            self._pos += 1
+            return [float(v) for v in row]
         toks = self._lines[self._pos].split(self._delim)
         self._pos += 1
         return [self._parse(t) for t in toks]

@@ -85,6 +85,10 @@ class KerasServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._models = {}
+        # handler threads (ThreadingTCPServer) share _models/_last; without
+        # the lock a predict that omits 'model' could resolve _last mid-swap
+        # from another connection and run against the wrong model
+        self._state_lock = threading.Lock()
         outer = self
 
         class Handler(socketserver.StreamRequestHandler):
@@ -111,23 +115,25 @@ class KerasServer:
 
     # -- ops ----------------------------------------------------------
     def _get_model(self, path: Optional[str]):
-        if path is not None:
-            if path not in self._models:
-                if path.endswith(".zip"):
-                    from deeplearning4j_tpu.util.serializer import (
-                        ModelSerializer)
-                    self._models[path] = \
-                        ModelSerializer.restore_multi_layer_network(path)
-                else:
-                    from deeplearning4j_tpu.keras.keras_import import (
-                        KerasModelImport)
-                    self._models[path] = (KerasModelImport
-                                          .import_keras_sequential_model_and_weights(path))
-            self._last = path
-            return self._models[path]
-        if not self._models:
-            raise ValueError("no model loaded; pass 'model'")
-        return self._models[self._last]
+        with self._state_lock:
+            if path is not None:
+                if path not in self._models:
+                    if path.endswith(".zip"):
+                        from deeplearning4j_tpu.util.serializer import (
+                            ModelSerializer)
+                        # container-agnostic: MLN or ComputationGraph
+                        self._models[path] = \
+                            ModelSerializer.restore_model(path)
+                    else:
+                        from deeplearning4j_tpu.keras.keras_import import (
+                            KerasModelImport)
+                        self._models[path] = (KerasModelImport
+                                              .import_keras_model_and_weights(path))
+                self._last = path
+                return self._models[path]
+            if not self._models:
+                raise ValueError("no model loaded; pass 'model'")
+            return self._models[self._last]
 
     def _dispatch(self, req: dict) -> dict:
         op = req.get("op")

@@ -1,0 +1,92 @@
+"""Pretrained-model flow: TrainedModels + input preprocessors.
+
+Ref: deeplearning4j-modelimport/.../trainedmodels/TrainedModels.java:16-40
+(the VGG16 enum entry with its mean-subtraction preprocessor and
+decodePredictions helper) and utils/VGG16ImagePreProcessor.
+
+Zero-egress environment: weights are never downloaded here — callers point
+``load`` at a locally available Keras .h5 (e.g. keras.applications VGG16
+saved to disk); the architecture/preprocessing/decoding flow is what this
+module provides.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class VGG16ImagePreProcessor:
+    """Subtract the ImageNet per-channel mean (RGB) from NHWC images —
+    exactly the reference's VGG16 preprocessing
+    (ref: TrainedModels.java getMeanSubtractionPreProcessor /
+    VGG16ImagePreProcessor: mean = [123.68, 116.779, 103.939])."""
+
+    MEAN_RGB = np.array([123.68, 116.779, 103.939], dtype=np.float32)
+
+    def __call__(self, ds: DataSet) -> DataSet:
+        return self.pre_process(ds)
+
+    def pre_process(self, ds: DataSet) -> DataSet:
+        f = np.asarray(ds.features, dtype=np.float32)
+        if f.ndim != 4 or f.shape[-1] != 3:
+            raise ValueError(
+                f"VGG16 preprocessor expects NHWC RGB images, got {f.shape}")
+        return DataSet(f - self.MEAN_RGB, ds.labels,
+                       features_mask=ds.features_mask,
+                       labels_mask=ds.labels_mask)
+
+    def transform(self, features: np.ndarray) -> np.ndarray:
+        return np.asarray(features, dtype=np.float32) - self.MEAN_RGB
+
+
+class _TrainedModel:
+    """One pretrained-model entry (ref: the TrainedModels enum constants)."""
+
+    def __init__(self, name: str, pre_processor, height: int, width: int,
+                 n_classes: int):
+        self.name = name
+        self._pre = pre_processor
+        self.height, self.width, self.n_classes = height, width, n_classes
+
+    def get_pre_processor(self):
+        """(ref: TrainedModels.getPreProcessor)"""
+        return self._pre
+
+    def load(self, h5_path: str):
+        """Import architecture + weights from a locally saved Keras .h5
+        (ref: the reference resolves the VGG16 enum to an .h5 fetched from
+        its CDN — this environment is zero-egress, so the file must exist
+        locally; ``keras.applications.VGG16().save(path)`` produces it)."""
+        from deeplearning4j_tpu.keras.keras_import import KerasModelImport
+        return KerasModelImport.import_keras_model_and_weights(h5_path)
+
+    def decode_predictions(self, predictions: np.ndarray, top: int = 5,
+                           labels: Optional[Sequence[str]] = None) -> str:
+        """Human-readable top-N table
+        (ref: TrainedModels.decodePredictions — formats class name +
+        probability per example). Without a labels list, classes print as
+        their indices."""
+        predictions = np.asarray(predictions)
+        if predictions.ndim == 1:
+            predictions = predictions[None, :]
+        lines: List[str] = []
+        for bi, row in enumerate(predictions):
+            order = np.argsort(row)[::-1][:top]
+            lines.append(f"Predictions for batch item {bi}:")
+            for ci in order:
+                name = labels[ci] if labels is not None else f"class {ci}"
+                lines.append(f"  {row[ci]:8.3%}  {name}")
+        return "\n".join(lines)
+
+
+class TrainedModels:
+    """(ref: trainedmodels/TrainedModels.java enum)"""
+
+    VGG16 = _TrainedModel("VGG16", VGG16ImagePreProcessor(),
+                          height=224, width=224, n_classes=1000)
+    VGG16NOTOP = _TrainedModel("VGG16NOTOP", VGG16ImagePreProcessor(),
+                               height=224, width=224, n_classes=0)

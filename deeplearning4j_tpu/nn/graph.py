@@ -51,6 +51,8 @@ class ComputationGraph:
         self.last_grads = None  # most recent gradient pytree (for listeners)
         self._tx = build_optimizer(conf.training)
         self._train_step_fn = None
+        self._jit_infer = None          # cached jitted inference forward
+        self._infer_traces = 0          # trace counter (tests)
         self._rng = jax.random.PRNGKey(conf.training.seed)
         # layer nodes in topological order (the trainable walk)
         self._layer_nodes = [n for n in conf.topological_order
@@ -148,12 +150,29 @@ class ComputationGraph:
             new_states[name] = s
         return acts, out_masks, new_states
 
+    def _infer_fn(self):
+        """Cached JITTED inference forward (ref: the reference's output()
+        reuses the same compiled-graph machinery as fit — CG.java:1006 /
+        MultiLayerNetwork.java:1512); jax.jit re-traces per input shape and
+        ``_infer_traces`` counts traces for tests."""
+        if self._jit_infer is None:
+            def infer(params, states, in_map):
+                self._infer_traces += 1  # python side effect: runs per TRACE
+                acts, _, _ = self._forward(params, states, in_map,
+                                           train=False, rng=None,
+                                           stop_before_loss=False)
+                return [acts[o] for o in self.conf.network_outputs]
+            self._jit_infer = jax.jit(infer)
+        return self._jit_infer
+
     def outputs(self, inputs: Union[Array, Sequence[Array], Dict[str, Array]],
                 train: bool = False) -> List[Array]:
         """Final activations of all output nodes
         (ref: ComputationGraph.output(...))."""
         self._check_init()
         in_map = self._to_input_map(inputs)
+        if not train:
+            return self._infer_fn()(self.params, self.states, in_map)
         acts, _, _ = self._forward(self.params, self.states, in_map,
                                    train=train, rng=None, stop_before_loss=False)
         return [acts[o] for o in self.conf.network_outputs]
@@ -250,6 +269,11 @@ class ComputationGraph:
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
     def fit_batch(self, data: Union[DataSet, MultiDataSet]) -> float:
+        """One optimization step (ref: ComputationGraph.fit).
+
+        NOTE: previous ``params``/``opt_state``/``states`` buffers are
+        DONATED to the jitted step — external aliases held across a step
+        raise "Array has been deleted"; ``np.asarray``-copy first."""
         self._check_init()
         algo = self.conf.training.optimization_algo
         if algo not in ("sgd", "stochastic_gradient_descent"):

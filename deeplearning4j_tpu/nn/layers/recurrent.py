@@ -115,12 +115,28 @@ class LSTM(BaseLayerConf):
     def _fused_kernel_ok(self, mask) -> bool:
         """Helper-discovery decision (the reference's cuDNN-helper seam,
         ref: ConvolutionLayer.java:55-77): use the Pallas fused kernel when
-        the configuration matches what the kernel hardcodes."""
+        the configuration matches what the kernel hardcodes.
+
+        Compiled (Mosaic) mode additionally requires tile-aligned shapes
+        (H % 128 == 0 — the TPU lane width): the in-kernel gate
+        concatenate/slice on non-(8x128)-aligned block dims is exactly
+        where compiled lowering can fail or mispad, and CI only exercises
+        interpret mode on CPU. DL4J_TPU_PALLAS=force overrides the shape
+        gate for hardware validation runs; once those pass, the gate can
+        be relaxed."""
+        import os
+
         from deeplearning4j_tpu.ops import pallas_kernels
-        return (pallas_kernels.lstm_mode() != "off"
-                and mask is None
-                and self.gate_activation == "sigmoid"
-                and (self.activation or "tanh") == "tanh")
+        mode = pallas_kernels.lstm_mode()
+        if (mode == "off" or mask is not None
+                or self.gate_activation != "sigmoid"
+                or (self.activation or "tanh") != "tanh"):
+            return False
+        if (mode == "compiled"
+                and os.environ.get("DL4J_TPU_PALLAS") != "force"
+                and (self.n_out or 0) % 128 != 0):
+            return False
+        return True
 
     def scan(self, params: Params, x: Array, carry, mask: Optional[Array],
              reverse: bool = False):

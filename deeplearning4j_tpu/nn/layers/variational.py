@@ -27,13 +27,173 @@ from deeplearning4j_tpu.nn.layers.base import (
 from deeplearning4j_tpu.ops.activations import get_activation
 
 
+# ---------------------------------------------------------------------------
+# Reconstruction distributions
+# (ref: nn/conf/layers/variational/{GaussianReconstructionDistribution,
+#  BernoulliReconstructionDistribution, ExponentialReconstructionDistribution,
+#  CompositeReconstructionDistribution}.java)
+# ---------------------------------------------------------------------------
+
+class ReconstructionDistribution:
+    """p(x|z) family: sizes its decoder-output parameters, scores data, and
+    maps parameters to a mean reconstruction."""
+
+    tag = "base"
+
+    def param_size(self, data_size: int) -> int:
+        raise NotImplementedError
+
+    def log_prob(self, recon_params: Array, x: Array) -> Array:
+        """log p(x|z) summed over features -> [batch]."""
+        raise NotImplementedError
+
+    def mean(self, recon_params: Array) -> Array:
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        return {"@dist": self.tag}
+
+    @staticmethod
+    def from_dict(d) -> "ReconstructionDistribution":
+        if isinstance(d, str):
+            return _named_distribution(d)
+        tag = d["@dist"]
+        if tag == "composite":
+            return CompositeReconstructionDistribution([
+                (int(s), ReconstructionDistribution.from_dict(sub))
+                for s, sub in d["components"]])
+        return _named_distribution(tag)
+
+
+class GaussianReconstructionDistribution(ReconstructionDistribution):
+    """mean + log-variance per visible unit
+    (ref: GaussianReconstructionDistribution.java)."""
+
+    tag = "gaussian"
+
+    def param_size(self, data_size):
+        return 2 * data_size
+
+    def log_prob(self, recon_params, x):
+        mean, logvar = jnp.split(recon_params, 2, axis=-1)
+        var = jnp.exp(logvar)
+        lp = -0.5 * (jnp.log(2 * jnp.pi) + logvar + (x - mean) ** 2 / var)
+        return jnp.sum(lp, axis=-1)
+
+    def mean(self, recon_params):
+        mean, _ = jnp.split(recon_params, 2, axis=-1)
+        return mean
+
+
+class BernoulliReconstructionDistribution(ReconstructionDistribution):
+    """one logit per visible unit (ref: Bernoulli...Distribution.java)."""
+
+    tag = "bernoulli"
+
+    def param_size(self, data_size):
+        return data_size
+
+    def log_prob(self, recon_params, x):
+        z = recon_params
+        lp = x * jax.nn.log_sigmoid(z) + (1 - x) * jax.nn.log_sigmoid(-z)
+        return jnp.sum(lp, axis=-1)
+
+    def mean(self, recon_params):
+        return jax.nn.sigmoid(recon_params)
+
+
+class ExponentialReconstructionDistribution(ReconstructionDistribution):
+    """p(x) = lambda exp(-lambda x) with gamma = log(lambda) as the
+    network output: log p = gamma - x * exp(gamma)
+    (ref: ExponentialReconstructionDistribution.java — parameterized in
+    gamma for unconstrained optimization; mean = 1/lambda)."""
+
+    tag = "exponential"
+
+    def param_size(self, data_size):
+        return data_size
+
+    def log_prob(self, recon_params, x):
+        gamma = recon_params
+        return jnp.sum(gamma - x * jnp.exp(gamma), axis=-1)
+
+    def mean(self, recon_params):
+        return jnp.exp(-recon_params)  # 1 / lambda
+
+
+class CompositeReconstructionDistribution(ReconstructionDistribution):
+    """Different distributions over slices of the data vector — e.g.
+    [0:784] bernoulli pixels + [784:794] gaussian extras
+    (ref: CompositeReconstructionDistribution.java — distributionSizes +
+    per-slice parameter offsets)."""
+
+    tag = "composite"
+
+    def __init__(self, components):
+        """components: list of (data_size, ReconstructionDistribution)."""
+        self.components = [(int(s), d if isinstance(d, ReconstructionDistribution)
+                            else _named_distribution(d))
+                           for s, d in components]
+
+    def param_size(self, data_size):
+        total_data = sum(s for s, _ in self.components)
+        if total_data != data_size:
+            raise ValueError(
+                f"Composite distribution covers {total_data} dims but the "
+                f"data has {data_size}")
+        return sum(d.param_size(s) for s, d in self.components)
+
+    def log_prob(self, recon_params, x):
+        out = 0.0
+        data_off = param_off = 0
+        for size, dist in self.components:
+            psize = dist.param_size(size)
+            out = out + dist.log_prob(
+                recon_params[..., param_off:param_off + psize],
+                x[..., data_off:data_off + size])
+            data_off += size
+            param_off += psize
+        return out
+
+    def mean(self, recon_params):
+        outs = []
+        param_off = 0
+        for size, dist in self.components:
+            psize = dist.param_size(size)
+            outs.append(dist.mean(
+                recon_params[..., param_off:param_off + psize]))
+            param_off += psize
+        return jnp.concatenate(outs, axis=-1)
+
+    def to_dict(self):
+        return {"@dist": "composite",
+                "components": [[s, d.to_dict()] for s, d in self.components]}
+
+
+_NAMED = {
+    "gaussian": GaussianReconstructionDistribution,
+    "bernoulli": BernoulliReconstructionDistribution,
+    "exponential": ExponentialReconstructionDistribution,
+}
+
+
+def _named_distribution(name: str) -> ReconstructionDistribution:
+    if name not in _NAMED:
+        raise ValueError(f"Unknown reconstruction distribution {name!r}; "
+                         f"available: {sorted(_NAMED)} or a "
+                         "CompositeReconstructionDistribution")
+    return _NAMED[name]()
+
+
 @register_layer
 @dataclass
 class VariationalAutoencoder(BaseLayerConf):
     n_out: int = 0                                # size of latent z
     encoder_layer_sizes: Tuple[int, ...] = (100,)
     decoder_layer_sizes: Tuple[int, ...] = (100,)
-    reconstruction_distribution: str = "gaussian"  # gaussian | bernoulli
+    # "gaussian" | "bernoulli" | "exponential" | a ReconstructionDistribution
+    # instance (e.g. CompositeReconstructionDistribution)
+    reconstruction_distribution: object = "gaussian"
     pzx_activation: str = "identity"               # activation on q(z|x) mean
     num_samples: int = 1
 
@@ -42,6 +202,28 @@ class VariationalAutoencoder(BaseLayerConf):
 
     def infer_output_type(self, in_type: InputType) -> InputType:
         return InputType.feed_forward(self.n_out)
+
+    def _dist(self) -> ReconstructionDistribution:
+        rd = self.reconstruction_distribution
+        return rd if isinstance(rd, ReconstructionDistribution) \
+            else _named_distribution(rd)
+
+    # serde: the distribution may be an object — encode via its dict form
+    def to_dict(self) -> dict:
+        d = super().to_dict()
+        rd = self.reconstruction_distribution
+        if isinstance(rd, ReconstructionDistribution):
+            d["reconstruction_distribution"] = rd.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "VariationalAutoencoder":
+        d = dict(d)
+        rd = d.get("reconstruction_distribution")
+        if isinstance(rd, dict):
+            d["reconstruction_distribution"] = \
+                ReconstructionDistribution.from_dict(rd)
+        return super().from_dict(d)
 
     # ---- param layout: e{i}W/e{i}b encoder stack, zMeanW/b, zLogVarW/b,
     #      d{i}W/d{i}b decoder stack, outW/outb (reconstruction params) ----
@@ -56,8 +238,7 @@ class VariationalAutoencoder(BaseLayerConf):
         return names
 
     def _recon_param_size(self) -> int:
-        # gaussian needs mean+logvar per visible unit; bernoulli one prob
-        return 2 * self.n_in if self.reconstruction_distribution == "gaussian" else self.n_in
+        return self._dist().param_size(self.n_in)
 
     def init_params(self, rng, dtype=jnp.float32) -> Params:
         p: Params = {}
@@ -106,16 +287,7 @@ class VariationalAutoencoder(BaseLayerConf):
 
     def _recon_log_prob(self, recon_params: Array, x: Array) -> Array:
         """log p(x|z), summed over features -> [batch]."""
-        if self.reconstruction_distribution == "gaussian":
-            mean, logvar = jnp.split(recon_params, 2, axis=-1)
-            var = jnp.exp(logvar)
-            lp = -0.5 * (jnp.log(2 * jnp.pi) + logvar + (x - mean) ** 2 / var)
-            return jnp.sum(lp, axis=-1)
-        if self.reconstruction_distribution == "bernoulli":
-            z = recon_params
-            lp = x * jax.nn.log_sigmoid(z) + (1 - x) * jax.nn.log_sigmoid(-z)
-            return jnp.sum(lp, axis=-1)
-        raise ValueError(self.reconstruction_distribution)
+        return self._dist().log_prob(recon_params, x)
 
     # ---------------------------------------------------------------- forward
     def apply(self, params, x, *, state, train, rng, mask=None):
@@ -151,8 +323,4 @@ class VariationalAutoencoder(BaseLayerConf):
 
     def generate(self, params, z):
         """Decode latent samples to reconstruction-distribution means."""
-        rp = self.decode(params, z)
-        if self.reconstruction_distribution == "gaussian":
-            mean, _ = jnp.split(rp, 2, axis=-1)
-            return mean
-        return jax.nn.sigmoid(rp)
+        return self._dist().mean(self.decode(params, z))

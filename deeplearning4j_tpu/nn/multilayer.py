@@ -76,6 +76,8 @@ class MultiLayerNetwork:
         self.last_grads = None  # most recent gradient pytree (for listeners)
         self._tx = build_optimizer(conf.training)
         self._train_step_fn = None
+        self._jit_infer = None          # cached jitted inference forward
+        self._infer_traces = 0          # trace counter (tests)
         self._rnn_carries: Optional[List[Any]] = None  # rnnTimeStep state
         self._rng = jax.random.PRNGKey(conf.training.seed)
 
@@ -179,9 +181,35 @@ class MultiLayerNetwork:
             acts.append(final)
         return acts
 
-    def output(self, x, train: bool = False) -> Array:
+    def _infer_fn(self):
+        """Cached JITTED inference forward — the reference's output() runs
+        through the same compiled machinery as fit
+        (MultiLayerNetwork.java:1512-1594); an eager per-op walk here would
+        make evaluate() orders slower than training per example. jax.jit
+        re-traces per input shape; ``_infer_traces`` counts traces (tests
+        assert one trace for repeated same-shape calls)."""
+        if self._jit_infer is None:
+            def infer(params, states, x, mask):
+                self._infer_traces += 1  # python side effect: runs per TRACE
+                h, _, _, _, _ = self._forward(params, states, x, train=False,
+                                              rng=None, mask=mask)
+                out_layer = self.layers[-1]
+                if hasattr(out_layer, "compute_loss"):
+                    h, _ = out_layer.apply(params[-1], h,
+                                           state=states[-1], train=False,
+                                           rng=None)
+                return h
+            self._jit_infer = jax.jit(infer)
+        return self._jit_infer
+
+    def output(self, x, train: bool = False, mask=None) -> Array:
         """Final network output (ref: MultiLayerNetwork.output:1512-1594)."""
-        return self.feed_forward(x, train=train)[-1]
+        if train:
+            return self.feed_forward(x, train=True)[-1]
+        self._check_init()
+        x = jnp.asarray(x)
+        mask = None if mask is None else jnp.asarray(mask)
+        return self._infer_fn()(self.params, self.states, x, mask)
 
     def predict(self, x) -> np.ndarray:
         """Argmax class predictions (ref: MultiLayerNetwork.predict)."""
@@ -255,7 +283,13 @@ class MultiLayerNetwork:
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
     def fit_batch(self, dataset: DataSet) -> float:
-        """One optimization step on one minibatch (ref: fit(DataSet))."""
+        """One optimization step on one minibatch (ref: fit(DataSet)).
+
+        NOTE: the previous ``net.params`` / ``net.opt_state`` /
+        ``net.states`` device buffers are DONATED to the step (ResNet-scale
+        nets must not copy their whole state every step). External aliases
+        held across a step raise "Array has been deleted" on access — copy
+        with ``np.asarray`` first if you need before/after snapshots."""
         self._check_init()
         algo = self.conf.training.optimization_algo
         if algo not in ("sgd", "stochastic_gradient_descent"):
@@ -288,16 +322,57 @@ class MultiLayerNetwork:
     def _build_tbptt_step(self):
         tx = self._tx
         training = self.conf.training
+        fwd = training.tbptt_fwd_length
+        bwd = training.tbptt_bwd_length or fwd
 
         def step(params, opt_state, states, features, labels, fmask, lmask,
                  carries, rng):
+            # When bwd < fwd the reference's backward time-loop only visits
+            # the LAST bwd steps of each fwd slice
+            # (MultiLayerNetwork.java:1119 + LSTMHelpers.java:333
+            # "iTimeIndex > timeSeriesLength - tbpttBackwardLength"): early
+            # steps still contribute loss (and output-layer grads via their
+            # epsilons) but no gradient flows through the recurrence there.
+            # Here: run the slice head forward-only (stopped activations +
+            # carries), backprop through the tail. T is static under trace,
+            # so the short last slice recompiles with its own split.
+            T = features.shape[1]
+            split = max(T - bwd, 0) if bwd < fwd else 0
+
+            def seg(x, lo, hi):
+                return None if x is None else x[:, lo:hi]
+
             def loss_for_grad(p):
-                h, _, new_states, new_carries, cur_mask = self._forward(
-                    p, states, features, train=True, rng=rng, mask=fmask,
-                    carries=carries)
                 out_layer = self.layers[-1]
-                mask = lmask if lmask is not None else cur_mask
-                data_loss = out_layer.compute_loss(p[-1], h, labels, mask=mask)
+                if split == 0:
+                    h, _, new_states, new_carries, cur_mask = self._forward(
+                        p, states, features, train=True, rng=rng, mask=fmask,
+                        carries=carries)
+                    mask = lmask if lmask is not None else cur_mask
+                    data_loss = out_layer.compute_loss(p[-1], h, labels,
+                                                       mask=mask)
+                else:
+                    rng1, rng2 = jax.random.split(rng)
+                    h1, _, states1, carries1, m1 = self._forward(
+                        p, states, seg(features, 0, split), train=True,
+                        rng=rng1, mask=seg(fmask, 0, split), carries=carries)
+                    h1 = jax.lax.stop_gradient(h1)
+                    carries1 = jax.tree.map(jax.lax.stop_gradient, carries1)
+                    h2, _, new_states, new_carries, m2 = self._forward(
+                        p, states1, seg(features, split, T), train=True,
+                        rng=rng2, mask=seg(fmask, split, T),
+                        carries=carries1)
+                    mask1 = seg(lmask, 0, split) if lmask is not None else m1
+                    mask2 = seg(lmask, split, T) if lmask is not None else m2
+                    # per-timestep losses SUM over time, so head + tail ==
+                    # the single-call slice loss
+                    data_loss = (
+                        out_layer.compute_loss(p[-1], h1,
+                                               seg(labels, 0, split),
+                                               mask=mask1)
+                        + out_layer.compute_loss(p[-1], h2,
+                                                 seg(labels, split, T),
+                                                 mask=mask2))
                 reg = l1_l2_penalty(p, self.layers)
                 return data_loss + reg, (new_states, new_carries)
 

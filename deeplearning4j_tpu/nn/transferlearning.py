@@ -159,9 +159,137 @@ class TransferLearning:
                     net.states[i] = s
             return net
 
+    class GraphBuilder:
+        """Transfer learning on a ComputationGraph
+        (ref: TransferLearning.java:34-129 GraphBuilder —
+        setFeatureExtractor / nOutReplace / removeVertexAndConnections /
+        addLayer / addVertex / setOutputs)."""
+
+        def __init__(self, net):
+            net._check_init()
+            self._src = net
+            self._conf = copy.deepcopy(net.conf)
+            self._params = {k: dict(v) for k, v in net.params.items()}
+            self._states = {k: dict(v) for k, v in net.states.items()}
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._freeze_at: List[str] = []
+            self._reinit: List[str] = []
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, *names: str):
+            """Freeze the named vertices and everything upstream of them
+            (ref: GraphBuilder.setFeatureExtractor)."""
+            self._freeze_at = list(names)
+            return self
+
+        def n_out_replace(self, layer_name: str, n_out: int,
+                          weight_init: Optional[str] = None):
+            """Change a layer's n_out and re-initialize it; downstream
+            layers whose input widths change re-initialize via the shape
+            pass + shape-mismatch detection at build
+            (ref: GraphBuilder.nOutReplace)."""
+            node = self._conf.nodes[layer_name]
+            if node.layer is None:
+                raise ValueError(f"{layer_name!r} is not a layer node")
+            node.layer.n_out = n_out
+            if weight_init is not None:
+                node.layer.weight_init = weight_init
+            self._reinit.append(layer_name)
+            return self
+
+        def remove_vertex_and_connections(self, name: str):
+            """Drop a node and every edge referencing it
+            (ref: GraphBuilder.removeVertexAndConnections). Consumers of
+            the removed node must be rewired (add new layers/outputs)
+            before build()."""
+            self._conf.nodes.pop(name)
+            self._params.pop(name, None)
+            self._states.pop(name, None)
+            for node in self._conf.nodes.values():
+                node.inputs = [i for i in node.inputs if i != name]
+            self._conf.network_outputs = [
+                o for o in self._conf.network_outputs if o != name]
+            return self
+
+        def add_layer(self, name: str, layer: BaseLayerConf, *inputs: str):
+            from deeplearning4j_tpu.nn.conf.graph_builder import NodeConf
+            from deeplearning4j_tpu.nn.layers.base import GlobalConf
+            if name in self._conf.nodes:
+                raise ValueError(f"Duplicate node name {name!r}")
+            layer.name = name
+            layer.apply_global_defaults(GlobalConf())
+            self._conf.nodes[name] = NodeConf(name=name, kind="layer",
+                                              inputs=list(inputs),
+                                              layer=layer)
+            self._reinit.append(name)
+            return self
+
+        def add_vertex(self, name: str, vertex, *inputs: str):
+            from deeplearning4j_tpu.nn.conf.graph_builder import NodeConf
+            if name in self._conf.nodes:
+                raise ValueError(f"Duplicate node name {name!r}")
+            self._conf.nodes[name] = NodeConf(name=name, kind="vertex",
+                                              inputs=list(inputs),
+                                              vertex=vertex)
+            return self
+
+        def set_outputs(self, *names: str):
+            for n in names:
+                if n not in self._conf.nodes:
+                    raise ValueError(f"Unknown output {n!r}")
+            self._conf.network_outputs = list(names)
+            return self
+
+        def _ancestors(self, names: List[str]) -> set:
+            """The named nodes plus everything upstream of them."""
+            out = set()
+            stack = list(names)
+            while stack:
+                n = stack.pop()
+                if n in out:
+                    continue
+                out.add(n)
+                stack.extend(self._conf.nodes[n].inputs)
+            return out
+
+        def build(self):
+            from deeplearning4j_tpu.nn.graph import ComputationGraph
+            layer_confs = [n.layer for n in self._conf.nodes.values()
+                           if n.layer is not None]
+            if self._fine_tune is not None:
+                self._fine_tune.apply(self._conf.training, layer_confs)
+            if self._freeze_at:
+                for n in self._ancestors(self._freeze_at):
+                    node = self._conf.nodes[n]
+                    if node.layer is not None:
+                        node.layer.frozen = True
+            self._conf._resolve_shapes()  # re-infer n_in after edits
+            net = ComputationGraph(self._conf)
+            net.init()
+            # keep pretrained params wherever shapes still match and the
+            # node wasn't explicitly re-initialized
+            reinit = set(self._reinit)
+            for name, p in net.params.items():
+                if name in reinit or name not in self._params:
+                    continue
+                old = self._params[name]
+                if (set(old) == set(p)
+                        and all(old[k].shape == p[k].shape for k in p)):
+                    net.params[name] = old
+                    if name in self._states and self._states[name]:
+                        net.states[name] = self._states[name]
+            return net
+
     @staticmethod
-    def builder(net: MultiLayerNetwork) -> "TransferLearning.Builder":
+    def builder(net) -> "TransferLearning.Builder":
         return TransferLearning.Builder(net)
+
+    @staticmethod
+    def graph_builder(net) -> "TransferLearning.GraphBuilder":
+        return TransferLearning.GraphBuilder(net)
 
 
 class TransferLearningHelper:

@@ -1,0 +1,222 @@
+"""Sharded checkpointing for mesh-distributed params/optimizer state.
+
+The reference's checkpoint is a single flat buffer inside a zip
+(ref: util/ModelSerializer.java:79-110) — fine for one host, wrong for a
+pod: gathering TB-scale sharded params to one host serializes the job on a
+single HBM->host link. Here each PROCESS writes only its addressable
+shards; restore reassembles and re-places arrays onto the (possibly
+different) target mesh. This is the role orbax plays in large JAX
+deployments, hand-rolled to keep the format inspectable:
+
+    <dir>/
+      manifest.json      — leaf paths, shapes, dtypes, PartitionSpecs,
+                           mesh axis names/sizes, process count
+      shards_p<K>.npz    — process K's addressable shards, keyed
+                           "<leaf>|<shard-linear-index>"
+
+Restore modes:
+- ``restore_sharded(dir, mesh_ctx)``   -> pytree placed on mesh per the
+  SAVED specs (mapped onto the target mesh's axes).
+- ``restore_sharded(dir, None)``       -> host numpy pytree (fully
+  assembled), for single-host use or inspection.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any, Dict, Optional, Union
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import MeshContext
+
+MANIFEST = "manifest.json"
+
+
+def _leaf_key(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                    for p in path)
+
+
+def _index_to_slices(index, shape):
+    """jax shard .index (tuple of slices) -> JSON-able [[start, stop], ...]."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def save_sharded(ckpt_dir: Union[str, Path], pytree: Any,
+                 mesh_ctx: Optional[MeshContext] = None) -> None:
+    """Write this process's addressable shards + (on process 0) the manifest.
+
+    Works for host numpy / single-device arrays too (one "shard" covering
+    the full array), so the same call site serves laptop and pod.
+    """
+    ckpt_dir = Path(ckpt_dir)
+    ckpt_dir.mkdir(parents=True, exist_ok=True)
+    proc = jax.process_index()
+    nproc = jax.process_count()
+
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(pytree)
+    manifest: Dict[str, Any] = {
+        "format": "deeplearning4j_tpu/sharded-checkpoint",
+        "version": 1,
+        "process_count": nproc,
+        "treedef": None,  # reconstructed from leaf paths on restore
+        "leaves": {},
+    }
+    shard_arrays: Dict[str, np.ndarray] = {}
+    for path, leaf in leaves_with_paths:
+        key = _leaf_key(path)
+        shape = tuple(np.shape(leaf))
+        dtype = str(np.asarray(leaf).dtype if not hasattr(leaf, "dtype")
+                    else leaf.dtype)
+        spec = None
+        shards_meta = []
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
+            sh = leaf.sharding
+            if isinstance(sh, NamedSharding):
+                spec = [list(p) if isinstance(p, tuple) else p
+                        for p in sh.spec]
+            for i, shard in enumerate(leaf.addressable_shards):
+                skey = f"{key}|{i}"
+                shard_arrays[skey] = np.asarray(shard.data)
+                shards_meta.append({
+                    "file": f"shards_p{proc}.npz", "key": skey,
+                    "index": _index_to_slices(shard.index, shape)})
+        else:
+            skey = f"{key}|0"
+            shard_arrays[skey] = np.asarray(leaf)
+            shards_meta.append({
+                "file": f"shards_p{proc}.npz", "key": skey,
+                "index": _index_to_slices(
+                    tuple(slice(None) for _ in shape), shape)})
+        manifest["leaves"][key] = {
+            "shape": list(shape), "dtype": dtype, "spec": spec,
+            "shards": shards_meta,
+        }
+    np.savez(ckpt_dir / f"shards_p{proc}.npz", **shard_arrays)
+
+    if nproc > 1:
+        # every process contributes its shard metadata; process files are
+        # disjoint, so merge via per-process manifests
+        with open(ckpt_dir / f"manifest_p{proc}.json", "w") as f:
+            json.dump(manifest, f)
+    if proc == 0:
+        with open(ckpt_dir / MANIFEST, "w") as f:
+            json.dump(manifest, f, indent=1)
+
+
+def _merge_manifests(ckpt_dir: Path) -> dict:
+    with open(ckpt_dir / MANIFEST) as f:
+        manifest = json.load(f)
+    if manifest.get("process_count", 1) > 1:
+        for pf in sorted(ckpt_dir.glob("manifest_p*.json")):
+            with open(pf) as f:
+                part = json.load(f)
+            for key, meta in part["leaves"].items():
+                known = {(s["file"], s["key"])
+                         for s in manifest["leaves"][key]["shards"]}
+                for s in meta["shards"]:
+                    if (s["file"], s["key"]) not in known:
+                        manifest["leaves"][key]["shards"].append(s)
+    return manifest
+
+
+def _assemble(ckpt_dir: Path, meta: dict, npz_cache: Dict[str, Any]) -> np.ndarray:
+    out = np.zeros(tuple(meta["shape"]), dtype=meta["dtype"])
+    covered = np.zeros(tuple(meta["shape"]), dtype=bool) if meta["shape"] else None
+    for s in meta["shards"]:
+        if s["file"] not in npz_cache:
+            npz_cache[s["file"]] = np.load(ckpt_dir / s["file"])
+        data = npz_cache[s["file"]][s["key"]]
+        idx = tuple(slice(a, b) for a, b in s["index"])
+        out[idx] = data
+        if covered is not None:
+            covered[idx] = True
+    if covered is not None and not covered.all():
+        raise IOError(
+            f"Checkpoint shard coverage incomplete for a leaf of shape "
+            f"{meta['shape']} — missing process shard files?")
+    return out
+
+
+def restore_sharded(ckpt_dir: Union[str, Path],
+                    mesh_ctx: Optional[MeshContext] = None) -> Dict[str, Any]:
+    """Read a sharded checkpoint into a nested-dict pytree.
+
+    With ``mesh_ctx``, each leaf is device_put with its SAVED PartitionSpec
+    on the target mesh (axis names must exist there; unknown axes fall back
+    to replication). Without, returns host numpy arrays.
+    """
+    ckpt_dir = Path(ckpt_dir)
+    manifest = _merge_manifests(ckpt_dir)
+    npz_cache: Dict[str, Any] = {}
+    flat: Dict[str, np.ndarray] = {}
+    for key, meta in manifest["leaves"].items():
+        arr = _assemble(ckpt_dir, meta, npz_cache)
+        if mesh_ctx is not None:
+            spec_elems = []
+            axes = set(mesh_ctx.mesh.axis_names)
+            for p in (meta["spec"] or []):
+                if isinstance(p, list):
+                    p = tuple(x for x in p if x in axes) or None
+                elif p is not None and p not in axes:
+                    p = None
+                spec_elems.append(p)
+            sharding = NamedSharding(mesh_ctx.mesh, P(*spec_elems))
+            arr = jax.device_put(arr, sharding)
+        flat[key] = arr
+    # rebuild nesting from '/'-joined leaf paths
+    tree: Dict[str, Any] = {}
+    for key, arr in flat.items():
+        parts = key.split("/")
+        cur = tree
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[parts[-1]] = arr
+    return tree
+
+
+def restore_sharded_into(ckpt_dir: Union[str, Path], template: Any,
+                         mesh_ctx: Optional[MeshContext] = None) -> Any:
+    """Restore into the exact structure of ``template`` (lists stay lists,
+    custom pytree nodes stay themselves) — leaf lookup by flattened path.
+    Shapes must match the saved checkpoint."""
+    ckpt_dir = Path(ckpt_dir)
+    manifest = _merge_manifests(ckpt_dir)
+    npz_cache: Dict[str, Any] = {}
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    new_leaves = []
+    for path, leaf in leaves_with_paths:
+        key = _leaf_key(path)
+        if key not in manifest["leaves"]:
+            raise KeyError(f"Checkpoint has no leaf {key!r}")
+        meta = manifest["leaves"][key]
+        if tuple(meta["shape"]) != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"Leaf {key!r}: checkpoint shape {tuple(meta['shape'])} != "
+                f"template shape {tuple(np.shape(leaf))}")
+        arr = _assemble(ckpt_dir, meta, npz_cache)
+        if mesh_ctx is not None:
+            axes = set(mesh_ctx.mesh.axis_names)
+            spec_elems = []
+            for p in (meta["spec"] or []):
+                if isinstance(p, list):
+                    p = tuple(x for x in p if x in axes) or None
+                elif p is not None and p not in axes:
+                    p = None
+                spec_elems.append(p)
+            arr = jax.device_put(arr, NamedSharding(mesh_ctx.mesh,
+                                                    P(*spec_elems)))
+        elif isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
+            arr = jax.device_put(arr, leaf.sharding)
+        new_leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)

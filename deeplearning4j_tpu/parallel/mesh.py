@@ -78,10 +78,22 @@ class MeshContext:
         return jax.tree_util.tree_map_with_path(put, params)
 
     def shard_batch(self, *arrays):
+        """Place batch arrays sharded over 'data'.
+
+        Single-process: device_put of the (full) host batch. Multi-process:
+        each host passes its process-LOCAL batch shard and the global array
+        is assembled without any host ever holding the full batch
+        (jax.make_array_from_process_local_data) — the per-host input
+        sharding the reference's Spark tier did by RDD partitioning.
+        """
+        multi = jax.process_count() > 1
         out = []
         for a in arrays:
             if a is None:
                 out.append(None)
+            elif multi:
+                out.append(jax.make_array_from_process_local_data(
+                    self.batch_sharding(np.ndim(a)), np.asarray(a)))
             else:
                 out.append(jax.device_put(a, self.batch_sharding(np.ndim(a))))
         return tuple(out) if len(out) > 1 else out[0]

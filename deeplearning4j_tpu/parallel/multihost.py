@@ -1,0 +1,85 @@
+"""Multi-host (multi-process) SPMD support.
+
+The reference's multi-node tier is Spark parameter averaging
+(ref: spark/dl4j-spark/.../paramavg/ParameterAveragingTrainingMaster.java:
+358-420 — driver splits the RDD, executors fit, tree-aggregate averages).
+TPU-native, the cluster program IS the single jitted step: every host runs
+the same program, `jax.distributed` wires the processes into one global
+device mesh, per-host input pipelines feed process-local batch shards, and
+XLA's collectives ride ICI within a slice / DCN across slices.
+
+Usage (one call per process, before any jax computation):
+
+    from deeplearning4j_tpu.parallel import multihost
+    multihost.initialize(coordinator="host0:1234",
+                         num_processes=8, process_id=k)   # TPU pods: no-op
+    ctx = MeshContext.create()          # global mesh over all processes
+    trainer = ParallelTrainer(net, ctx) # feed process-LOCAL batches
+
+On TPU pods jax.distributed auto-detects everything, so ``initialize()``
+with no args is correct there too.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+_initialized = False
+
+
+def initialize(coordinator: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_ids=None) -> None:
+    """Bring this process into the global runtime
+    (wraps jax.distributed.initialize; safe to call once per process).
+
+    The Spark-era analog is the driver/executor bootstrap; here every
+    process is a peer and process 0 hosts the coordination service.
+    """
+    global _initialized
+    if _initialized:
+        return
+    kwargs = {}
+    if coordinator is not None:
+        kwargs["coordinator_address"] = coordinator
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def local_batch_slice(global_batch: int) -> slice:
+    """This host's slice of a [0, global_batch) range — the per-host input
+    shard (the reference's RDD split -> executor partition mapping)."""
+    n = jax.process_count()
+    if global_batch % n != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by process count {n}")
+    per = global_batch // n
+    k = jax.process_index()
+    return slice(k * per, (k + 1) * per)
+
+
+def global_array(local_data, sharding):
+    """Assemble a GLOBAL jax.Array from this process's LOCAL batch shard
+    (jax.make_array_from_process_local_data) — the host-boundary crossing
+    the Spark tier did with broadcast/collect, done zero-copy per host."""
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(local_data))

@@ -1,0 +1,88 @@
+"""Pluggable training-strategy SPI.
+
+Ref: spark/dl4j-spark/.../api/TrainingMaster.java:29-220 +
+TrainingWorker.java + TrainingHook.java — the reference exposes a strategy
+interface so synchronization schemes other than parameter averaging could
+plug in (param averaging is its only impl). Here the registry maps
+strategy names onto the two TPU-native schemes, plus hook points
+(ref: TrainingHook pre/post-update) invoked around each step:
+
+- ``"allreduce"``       -> ParallelTrainer — synchronous gradient
+  all-reduce over the mesh (the correct default; optimizer state stays
+  replicated & consistent, SURVEY §5.8)
+- ``"param_averaging"`` -> ParallelWrapper — the reference's
+  average-every-k-iterations semantics, kept for convergence parity
+
+``create_trainer(strategy, net, ...)`` is the factory
+(ref: SparkDl4jMultiLayer taking a TrainingMaster instance).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.parallel.mesh import MeshContext
+from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+TRAINING_STRATEGIES: Dict[str, Callable] = {}
+
+
+def register_strategy(name: str):
+    def deco(factory: Callable) -> Callable:
+        TRAINING_STRATEGIES[name.lower()] = factory
+        return factory
+    return deco
+
+
+class TrainingHook:
+    """Pre/post-update hook (ref: api/TrainingHook.java — preUpdate /
+    postUpdate around each worker fit)."""
+
+    def pre_update(self, batch, trainer) -> None:
+        pass
+
+    def post_update(self, batch, trainer) -> None:
+        pass
+
+
+class _HookedTrainer:
+    """Wraps any trainer's fit_batch with TrainingHook dispatch."""
+
+    def __init__(self, trainer, hooks: List[TrainingHook]):
+        self._trainer = trainer
+        self._hooks = hooks
+
+    def __getattr__(self, name):
+        return getattr(self._trainer, name)
+
+    def fit_batch(self, batch):
+        for h in self._hooks:
+            h.pre_update(batch, self._trainer)
+        out = self._trainer.fit_batch(batch)
+        for h in self._hooks:
+            h.post_update(batch, self._trainer)
+        return out
+
+
+@register_strategy("allreduce")
+def _allreduce(net, mesh: Optional[MeshContext] = None, **kw):
+    return ParallelTrainer(net, mesh, **kw)
+
+
+@register_strategy("param_averaging")
+def _param_averaging(net, mesh: Optional[MeshContext] = None, **kw):
+    return ParallelWrapper(net, mesh=mesh, **kw)
+
+
+def create_trainer(strategy: str, net, mesh: Optional[MeshContext] = None,
+                   hooks: Optional[List[TrainingHook]] = None, **kw):
+    """Factory over the strategy registry (ref: TrainingMaster SPI)."""
+    key = strategy.lower()
+    if key not in TRAINING_STRATEGIES:
+        raise ValueError(f"Unknown training strategy {strategy!r}; "
+                         f"available: {sorted(TRAINING_STRATEGIES)}")
+    trainer = TRAINING_STRATEGIES[key](net, mesh, **kw)
+    if hooks:
+        return _HookedTrainer(trainer, list(hooks))
+    return trainer

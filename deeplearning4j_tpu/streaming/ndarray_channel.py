@@ -39,11 +39,21 @@ class _Topic:
             self._dq.appendleft(arr)
             self._cond.notify()
 
-    def get(self) -> np.ndarray:
+    def get(self, closing: Optional[threading.Event] = None
+            ) -> Optional[np.ndarray]:
+        """Block for the next array; returns None once ``closing`` is set
+        (woken by NDArrayServer.stop's notify_all) so idle SUB handler
+        threads exit on shutdown instead of parking forever."""
         with self._cond:
             while not self._dq:
-                self._cond.wait()
+                if closing is not None and closing.is_set():
+                    return None
+                self._cond.wait(timeout=0.5)
             return self._dq.popleft()
+
+    def wake_all(self) -> None:
+        with self._cond:
+            self._cond.notify_all()
 
 
 def _send_array(sock: socket.socket, arr: np.ndarray) -> None:
@@ -83,6 +93,7 @@ class NDArrayServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._topics: Dict[str, _Topic] = {}
         self._lock = threading.Lock()
+        self._closing = threading.Event()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -103,7 +114,9 @@ class NDArrayServer:
                         q.put(arr)
                 elif mode == "SUB":
                     while True:
-                        arr = q.get()
+                        arr = q.get(closing=outer._closing)
+                        if arr is None:  # server shutting down
+                            return
                         try:
                             _send_array(self.request, arr)
                         except OSError:
@@ -125,6 +138,10 @@ class NDArrayServer:
             return self._topics.setdefault(topic, _Topic())
 
     def stop(self) -> None:
+        self._closing.set()
+        with self._lock:
+            for topic in self._topics.values():
+                topic.wake_all()  # unpark idle SUB handler threads
         self._server.shutdown()
         self._server.server_close()
 

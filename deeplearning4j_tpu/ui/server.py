@@ -11,8 +11,12 @@ Endpoints:
   GET  /                      dashboard page
   GET  /api/sessions          list of session ids
   GET  /api/session?id=S      {init: {...}, reports: [...]} (scalars only)
+  GET  /api/histograms?id=S   latest param/grad histograms
+                              {param: {name: {counts, edges}}, grad: {...}}
+  GET  /api/tsne              latest posted embedding {x, y, labels}
   POST /api/init              register session (JSON init report)
   POST /api/post?session=S    ingest one binary StatsReport record
+  POST /api/tsne              post a 2-d embedding for the t-SNE view
 """
 
 from __future__ import annotations
@@ -41,6 +45,12 @@ _PAGE = """<!doctype html>
 <h2>Score vs iteration</h2><svg id="score" class="chart" width="860" height="220"></svg>
 <h2>log10 update:parameter ratio</h2><svg id="ratio" class="chart" width="860" height="220"></svg>
 <h2>Throughput (samples/sec)</h2><svg id="sps" class="chart" width="860" height="220"></svg>
+<h2>Histograms <select id="histsel"></select> <span id="histiter"></span></h2>
+<div>
+ <svg id="histp" class="chart" width="424" height="200"></svg>
+ <svg id="histg" class="chart" width="424" height="200"></svg>
+</div>
+<h2>t-SNE embedding</h2><svg id="tsne" class="chart" width="560" height="420"></svg>
 <script>
 const COLORS=['#1f77b4','#ff7f0e','#2ca02c','#d62728','#9467bd','#8c564b',
               '#e377c2','#7f7f7f','#bcbd22','#17becf'];
@@ -75,6 +85,55 @@ function line(svg, seriesMap){
     i++;
   }
 }
+function bars(svg, hist, title){
+  svg.innerHTML='';
+  const ns='http://www.w3.org/2000/svg';
+  const W=svg.width.baseVal.value,H=svg.height.baseVal.value,P=26;
+  const t=document.createElementNS(ns,'text');
+  t.setAttribute('x',P);t.setAttribute('y',14);t.setAttribute('font-size',11);
+  t.textContent=title;svg.appendChild(t);
+  if(!hist||!hist.counts||!hist.counts.length) return;
+  const c=hist.counts,m=Math.max(...c,1);
+  const bw=(W-2*P)/c.length;
+  for(let i=0;i<c.length;i++){
+    const r=document.createElementNS(ns,'rect');
+    r.setAttribute('x',P+i*bw);
+    r.setAttribute('y',H-P-(H-2*P-14)*c[i]/m);
+    r.setAttribute('width',Math.max(bw-1,1));
+    r.setAttribute('height',(H-2*P-14)*c[i]/m);
+    r.setAttribute('fill','#1f77b4');svg.appendChild(r);
+  }
+  if(hist.edges&&hist.edges.length){
+    [[hist.edges[0],P],[hist.edges[hist.edges.length-1],W-P-40]]
+    .forEach(([v,px])=>{
+      const e=document.createElementNS(ns,'text');
+      e.setAttribute('x',px);e.setAttribute('y',H-8);
+      e.setAttribute('font-size',9);
+      e.textContent=Number(v).toPrecision(3);svg.appendChild(e);});
+  }
+}
+function scatter(svg, d){
+  svg.innerHTML='';
+  if(!d||!d.x||!d.x.length) return;
+  const ns='http://www.w3.org/2000/svg';
+  const W=svg.width.baseVal.value,H=svg.height.baseVal.value,P=20;
+  const x0=Math.min(...d.x),x1=Math.max(...d.x);
+  const y0=Math.min(...d.y),y1=Math.max(...d.y);
+  const labs=[...new Set(d.labels)];
+  for(let i=0;i<d.x.length;i++){
+    const c=document.createElementNS(ns,'circle');
+    c.setAttribute('cx',P+(W-2*P)*(x1>x0?(d.x[i]-x0)/(x1-x0):0.5));
+    c.setAttribute('cy',H-P-(H-2*P)*(y1>y0?(d.y[i]-y0)/(y1-y0):0.5));
+    c.setAttribute('r',3);
+    c.setAttribute('fill',COLORS[labs.indexOf(d.labels[i]||'')%COLORS.length]);
+    svg.appendChild(c);
+  }
+  labs.forEach((l,i)=>{const t=document.createElementNS(ns,'text');
+    t.setAttribute('x',W-70);t.setAttribute('y',14+12*i);
+    t.setAttribute('font-size',10);
+    t.setAttribute('fill',COLORS[i%COLORS.length]);
+    t.textContent=l;svg.appendChild(t);});
+}
 async function refresh(){
   const sel=document.getElementById('sess');
   const sessions=await (await fetch('api/sessions')).json();
@@ -105,6 +164,28 @@ async function refresh(){
   line(document.getElementById('score'),{score});
   line(document.getElementById('ratio'),ratios);
   line(document.getElementById('sps'),{'samples/sec':sps});
+
+  const h=await (await fetch('api/histograms?id='
+                             +encodeURIComponent(sel.value))).json();
+  const hsel=document.getElementById('histsel');
+  const names=Object.keys(h.param||{});
+  const curH=[...hsel.options].map(o=>o.value);
+  if(JSON.stringify(curH)!==JSON.stringify(names)){
+    const keep=hsel.value; hsel.innerHTML='';
+    for(const n of names){const o=document.createElement('option');
+      o.textContent=n;o.value=n;hsel.appendChild(o);}
+    if(names.includes(keep)) hsel.value=keep;
+  }
+  document.getElementById('histiter').textContent=
+    h.iteration==null?'(no histograms yet)':'@ iter '+h.iteration;
+  if(hsel.value){
+    bars(document.getElementById('histp'),h.param[hsel.value],
+         'param '+hsel.value);
+    bars(document.getElementById('histg'),(h.grad||{})[hsel.value],
+         'gradient '+hsel.value);
+  }
+  scatter(document.getElementById('tsne'),
+          await (await fetch('api/tsne')).json());
 }
 setInterval(refresh,2000); refresh();
 </script></body></html>
@@ -113,6 +194,7 @@ setInterval(refresh,2000); refresh();
 
 class _Handler(BaseHTTPRequestHandler):
     storage: StatsStorage = None  # set by UIServer
+    tsne_data: Optional[dict] = None  # latest posted 2-d embedding
 
     def log_message(self, *args):  # quiet
         pass
@@ -159,6 +241,27 @@ class _Handler(BaseHTTPRequestHandler):
                         "model": init.model},
                     "reports": reports}
             self._send(200, json.dumps(body).encode())
+        elif url.path == "/api/histograms":
+            q = urllib.parse.parse_qs(url.query)
+            sid = q.get("id", [""])[0]
+            # latest report carrying histogram series (they're emitted
+            # every histogram_frequency iterations, not every report)
+            out = {"param": {}, "grad": {}, "iteration": None}
+            for r in reversed(self.storage.get_reports(sid)):
+                hists = {k: v for k, v in r.series.items()
+                         if k.startswith(("hist_param:", "hist_grad:"))}
+                if not hists:
+                    continue
+                for k, v in hists.items():
+                    kind = "param" if k.startswith("hist_param:") else "grad"
+                    name, part = k.split(":", 1)[1].rsplit("#", 1)
+                    out[kind].setdefault(name, {})[part] = \
+                        [float(x) for x in v]
+                out["iteration"] = r.iteration
+                break
+            self._send(200, json.dumps(out).encode())
+        elif url.path == "/api/tsne":
+            self._send(200, json.dumps(self.tsne_data or {}).encode())
         else:
             self._send(404, b"{}")
 
@@ -179,6 +282,13 @@ class _Handler(BaseHTTPRequestHandler):
             q = urllib.parse.parse_qs(url.query)
             sid = q.get("session", ["default"])[0]
             self.storage.put_report(sid, StatsReport.decode(body))
+            self._send(200, b"{}")
+        elif url.path == "/api/tsne":
+            d = json.loads(body.decode())
+            type(self).tsne_data = {
+                "x": [float(v) for v in d.get("x", [])],
+                "y": [float(v) for v in d.get("y", [])],
+                "labels": [str(v) for v in d.get("labels", [])]}
             self._send(200, b"{}")
         else:
             self._send(404, b"{}")
@@ -210,6 +320,18 @@ class UIServer:
         """Serve an existing storage (ref: UIServer.attach(StatsStorage))."""
         self.storage = storage
         self._httpd.RequestHandlerClass.storage = storage
+
+    def post_tsne(self, coords, labels=None) -> None:
+        """Feed the t-SNE view a [N, 2] embedding (e.g. the output of
+        clustering/tsne.py) — the Play UI's tsne module equivalent
+        (ref: deeplearning4j-play/.../module/tsne/)."""
+        import numpy as np
+        coords = np.asarray(coords)
+        self._httpd.RequestHandlerClass.tsne_data = {
+            "x": [float(v) for v in coords[:, 0]],
+            "y": [float(v) for v in coords[:, 1]],
+            "labels": [str(v) for v in (labels if labels is not None
+                                        else [""] * len(coords))]}
 
     def start(self) -> "UIServer":
         self._thread.start()

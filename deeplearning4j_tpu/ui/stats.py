@@ -176,6 +176,10 @@ class StatsListener(TrainingListener):
                 counts, edges = np.histogram(arr, bins=self.n_bins)
                 series[f"hist_param:{name}#counts"] = counts.astype(np.float32)
                 series[f"hist_param:{name}#edges"] = edges.astype(np.float32)
+            for name, arr in _flat_params(grads).items():
+                counts, edges = np.histogram(arr, bins=self.n_bins)
+                series[f"hist_grad:{name}#counts"] = counts.astype(np.float32)
+                series[f"hist_grad:{name}#edges"] = edges.astype(np.float32)
         self._mem_stats(series)
         report = StatsReport(iteration=iteration,
                              timestamp_ms=int(time.time() * 1000),

@@ -23,8 +23,10 @@ def clamp(value: int, minimum: int, maximum: int) -> int:
 
 def discretize(value: float, minimum: float, maximum: float,
                bin_count: int) -> int:
-    """Map a continuous value to a bin index (MathUtils.java:84)."""
-    return int(normalize(value, minimum, maximum) * (bin_count - 1))
+    """Map a continuous value to a bin index (MathUtils.java:84:
+    ``int(binCount * normalize)`` clamped to [0, binCount - 1])."""
+    return clamp(int(bin_count * normalize(value, minimum, maximum)),
+                 0, bin_count - 1)
 
 
 def next_pow_of_2(v: int) -> int:
@@ -121,9 +123,10 @@ def manhattan_distance(a: Sequence[float], b: Sequence[float]) -> float:
 # -- tf-idf (used by the bag-of-words vectorizers, MathUtils.java:258-283) --
 
 def idf(total_docs: float, docs_containing: float) -> float:
-    if docs_containing == 0:
+    """(MathUtils.java idf: log10, not natural log)"""
+    if docs_containing == 0 or total_docs == 0:
         return 0.0
-    return math.log(total_docs / docs_containing)
+    return math.log10(total_docs / docs_containing)
 
 
 def tf(count: int, document_length: int) -> float:

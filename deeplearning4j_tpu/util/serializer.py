@@ -3,15 +3,19 @@
 Ref: util/ModelSerializer.java:79-110 — the reference writes a **zip** with
 ``configuration.json`` (full conf DSL), ``coefficients.bin`` (the single
 flattened param buffer) and ``updaterState.bin`` (flattened optimizer
-state). We keep the same three-part logical format:
+state); ``restoreMultiLayerNetwork`` / ``restoreComputationGraph`` cover
+both containers. We keep the same three-part logical format:
 
-- ``configuration.json`` — MultiLayerConfiguration JSON round-trip
+- ``configuration.json`` — MultiLayerConfiguration OR
+  ComputationGraphConfiguration JSON round-trip (discriminated by the
+  embedded ``format`` tag)
 - ``coefficients.bin``   — float32 little-endian flat param vector in the
-  documented layer/param order (``MultiLayerNetwork.params_flat``)
+  documented layer/param order (``params_flat`` on either container)
 - ``updaterState.bin``   — flattened optax state leaves (+ a JSON manifest
   of leaf shapes/dtypes so the pytree is reconstructable)
 
-For sharded multi-host checkpoints use parallel/checkpoint.py (orbax); this
+For sharded multi-host checkpoints use
+``deeplearning4j_tpu.parallel.checkpoint`` (per-process shard files); this
 zip format is the single-host interchange format matching the reference.
 """
 
@@ -43,10 +47,13 @@ class ModelSerializer:
             flat = net.params_flat().astype("<f4")
             z.writestr(ModelSerializer.COEFFICIENTS_NAME, flat.tobytes())
             # layer states (BN running stats) — the reference stores these as
-            # params; we keep them as a separate npz member
+            # params; we keep them as a separate npz member. MLN states are a
+            # list (key = layer index); CG states a dict (key = node name).
             state_buf = io.BytesIO()
             state_arrays = {}
-            for i, s in enumerate(net.states or []):
+            state_items = (net.states.items() if isinstance(net.states, dict)
+                           else enumerate(net.states or []))
+            for i, s in state_items:
                 for k, v in s.items():
                     state_arrays[f"{i}:{k}"] = np.asarray(v)
             np.savez(state_buf, **state_arrays)
@@ -65,46 +72,93 @@ class ModelSerializer:
                            json.dumps(manifest))
 
     @staticmethod
+    def _restore_into(z: zipfile.ZipFile, net, load_updater: bool):
+        """Shared param/state/updater restore for both containers."""
+        flat = np.frombuffer(
+            z.read(ModelSerializer.COEFFICIENTS_NAME), dtype="<f4")
+        net.set_params_flat(flat)
+        if "layerStates.npz" in z.namelist():
+            with z.open("layerStates.npz") as f:
+                data = np.load(io.BytesIO(f.read()))
+                for key in data.files:
+                    i_s, name = key.split(":", 1)
+                    idx = i_s if isinstance(net.states, dict) else int(i_s)
+                    net.states[idx][name] = jnp.asarray(data[key])
+        if load_updater and ModelSerializer.UPDATER_NAME in z.namelist():
+            manifest = json.loads(
+                z.read(ModelSerializer.UPDATER_MANIFEST).decode())
+            blob = np.frombuffer(z.read(ModelSerializer.UPDATER_NAME),
+                                 dtype="<f4")
+            leaves, treedef = jax.tree_util.tree_flatten(net.opt_state)
+            pos = 0
+            mi = 0
+            new_leaves = []
+            for leaf in leaves:
+                if hasattr(leaf, "shape"):
+                    spec = manifest[mi]
+                    n = int(np.prod(spec["shape"])) if spec["shape"] else 1
+                    arr = blob[pos:pos + n].reshape(spec["shape"])
+                    new_leaves.append(jnp.asarray(arr, spec["dtype"]))
+                    pos += n
+                    mi += 1
+                else:
+                    new_leaves.append(leaf)
+            net.opt_state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        return net
+
+    @staticmethod
+    def _config_json(path: Union[str, Path]) -> dict:
+        with zipfile.ZipFile(Path(path), "r") as z:
+            return json.loads(z.read(ModelSerializer.CONFIG_NAME).decode())
+
+    @staticmethod
     def restore_multi_layer_network(path: Union[str, Path],
                                     load_updater: bool = True):
         """(ref: ModelSerializer.restoreMultiLayerNetwork)"""
         from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
-        path = Path(path)
-        with zipfile.ZipFile(path, "r") as z:
-            conf = MultiLayerConfiguration.from_json(
-                z.read(ModelSerializer.CONFIG_NAME).decode())
+        with zipfile.ZipFile(Path(path), "r") as z:
+            cfg = json.loads(z.read(ModelSerializer.CONFIG_NAME).decode())
+            if "ComputationGraph" in cfg.get("format", ""):
+                raise ValueError(
+                    "Archive holds a ComputationGraph; use "
+                    "restore_computation_graph")
+            conf = MultiLayerConfiguration.from_dict(cfg)
             net = MultiLayerNetwork(conf)
             net.init()
-            flat = np.frombuffer(
-                z.read(ModelSerializer.COEFFICIENTS_NAME), dtype="<f4")
-            net.set_params_flat(flat)
-            if "layerStates.npz" in z.namelist():
-                with z.open("layerStates.npz") as f:
-                    data = np.load(io.BytesIO(f.read()))
-                    for key in data.files:
-                        i_s, name = key.split(":", 1)
-                        net.states[int(i_s)][name] = jnp.asarray(data[key])
-            if (load_updater
-                    and ModelSerializer.UPDATER_NAME in z.namelist()):
-                manifest = json.loads(
-                    z.read(ModelSerializer.UPDATER_MANIFEST).decode())
-                blob = np.frombuffer(z.read(ModelSerializer.UPDATER_NAME),
-                                     dtype="<f4")
-                leaves, treedef = jax.tree_util.tree_flatten(net.opt_state)
-                pos = 0
-                mi = 0
-                new_leaves = []
-                for leaf in leaves:
-                    if hasattr(leaf, "shape"):
-                        spec = manifest[mi]
-                        n = int(np.prod(spec["shape"])) if spec["shape"] else 1
-                        arr = blob[pos:pos + n].reshape(spec["shape"])
-                        new_leaves.append(jnp.asarray(arr, spec["dtype"]))
-                        pos += n
-                        mi += 1
-                    else:
-                        new_leaves.append(leaf)
-                net.opt_state = jax.tree_util.tree_unflatten(treedef, new_leaves)
-        return net
+            return ModelSerializer._restore_into(z, net, load_updater)
+
+    @staticmethod
+    def restore_computation_graph(path: Union[str, Path],
+                                  load_updater: bool = True):
+        """(ref: ModelSerializer.restoreComputationGraph:79-110 — the
+        reference's single entry covers both containers; here a dedicated
+        restore using the CG conf + topological param order from
+        nn/graph.py params_flat)."""
+        from deeplearning4j_tpu.nn.conf.graph_builder import (
+            ComputationGraphConfiguration,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        with zipfile.ZipFile(Path(path), "r") as z:
+            cfg = json.loads(z.read(ModelSerializer.CONFIG_NAME).decode())
+            if "ComputationGraph" not in cfg.get("format", ""):
+                raise ValueError(
+                    "Archive holds a MultiLayerNetwork; use "
+                    "restore_multi_layer_network")
+            conf = ComputationGraphConfiguration.from_dict(cfg)
+            net = ComputationGraph(conf)
+            net.init()
+            return ModelSerializer._restore_into(z, net, load_updater)
+
+    @staticmethod
+    def restore_model(path: Union[str, Path], load_updater: bool = True):
+        """Container-agnostic restore, discriminating on the config's
+        ``format`` tag (mirrors the reference's restore helpers that accept
+        either archive kind)."""
+        cfg = ModelSerializer._config_json(path)
+        if "ComputationGraph" in cfg.get("format", ""):
+            return ModelSerializer.restore_computation_graph(
+                path, load_updater)
+        return ModelSerializer.restore_multi_layer_network(path, load_updater)

@@ -72,10 +72,12 @@ int idx_read(const char *path, double scale, int64_t *dims_out, int max_dims,
   return -2;
 }
 
-// Parses a numeric CSV (no quoting) into a row-major float32 matrix.
-// Returns number of rows, fills *n_cols; negative on error.
+// Parses a numeric CSV (no quoting) into a row-major float64 matrix
+// (double, so values match Python's float() parse exactly regardless of
+// whether the native path is used). Returns number of rows, fills
+// *n_cols; negative on error.
 int64_t csv_read(const char *path, char delimiter, int skip_rows,
-                 float *out, int64_t capacity, int32_t *n_cols) {
+                 double *out, int64_t capacity, int32_t *n_cols) {
   FILE *f = fopen(path, "rb");
   if (!f) return -1;
   char line[65536];
@@ -91,7 +93,7 @@ int64_t csv_read(const char *path, char delimiter, int skip_rows,
     char *p = line;
     while (*p && *p != '\n' && *p != '\r') {
       char *endp = nullptr;
-      float v = strtof(p, &endp);
+      double v = strtod(p, &endp);
       if (endp == p) break;
       if (written >= capacity) {
         fclose(f);
@@ -101,6 +103,13 @@ int64_t csv_read(const char *path, char delimiter, int skip_rows,
       ++c;
       p = endp;
       while (*p == delimiter || *p == ' ') ++p;
+    }
+    if (*p && *p != '\n' && *p != '\r') {
+      // trailing non-numeric content: this is NOT an all-numeric CSV.
+      // Refuse (rather than silently dropping the string columns) so the
+      // Python reader handles it.
+      fclose(f);
+      return -4;
     }
     if (c == 0) continue;
     if (cols < 0) cols = c;

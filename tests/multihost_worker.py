@@ -1,0 +1,71 @@
+"""Worker script for the 2-process multi-host test (run by
+test_multihost.py in two subprocesses).
+
+Each process: join the distributed runtime, build a GLOBAL mesh over both
+processes' CPU devices, train a small net on process-LOCAL batch shards,
+print the per-step losses. The parent asserts both processes print
+identical losses (the SPMD program is deterministic and synchronized) and
+that they match the single-process run on the full batch.
+"""
+
+import os
+import sys
+
+proc_id = int(sys.argv[1])
+num_procs = int(sys.argv[2])
+port = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize (axon TPU tunnel) overrides jax_platforms
+# via an explicit config update, which beats the env var — override it back
+# the same way (cf. tests/conftest.py belt-and-braces).
+jax.config.update("jax_platforms", "cpu")
+
+from deeplearning4j_tpu.parallel import multihost  # noqa: E402
+
+import faulthandler  # noqa: E402
+
+faulthandler.dump_traceback_later(120, exit=False)
+print(f"worker {proc_id}: initializing distributed", flush=True)
+multihost.initialize(coordinator=f"localhost:{port}",
+                     num_processes=num_procs, process_id=proc_id)
+
+print(f"worker {proc_id}: devices {len(jax.devices())}", flush=True)
+assert jax.process_count() == num_procs, jax.process_count()
+assert len(jax.devices()) == 4 * num_procs, jax.devices()
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,  # noqa: E402
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet  # noqa: E402
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer  # noqa: E402
+from deeplearning4j_tpu.parallel import MeshContext, ParallelTrainer  # noqa: E402
+
+net = MultiLayerNetwork(
+    NeuralNetConfiguration.builder().seed(99)
+    .updater("sgd").learning_rate(0.1)
+    .list()
+    .layer(DenseLayer(n_out=16, activation="relu"))
+    .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+    .set_input_type(InputType.feed_forward(10)).build()).init()
+
+ctx = MeshContext.create(n_data=4 * num_procs, n_model=1)
+trainer = ParallelTrainer(net, ctx)
+
+GLOBAL_BATCH = 16
+rng = np.random.default_rng(0)  # same data on every process
+x = rng.normal(size=(GLOBAL_BATCH, 10)).astype(np.float32)
+y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, GLOBAL_BATCH)]
+
+sl = multihost.local_batch_slice(GLOBAL_BATCH)
+losses = []
+for _ in range(3):
+    # each process feeds only ITS slice of the global batch
+    losses.append(trainer.fit_batch(DataSet(x[sl], y[sl])))
+print("LOSSES", " ".join(f"{l:.8f}" for l in losses), flush=True)

@@ -101,3 +101,41 @@ def test_tsne_separates_blobs():
                   for i in range(3) for j in range(i + 1, 3))
     assert min_gap > 2 * spread, (min_gap, spread)
     assert ts.kl_divergence_ is not None and ts.kl_divergence_ < 1.5
+
+
+def test_kdtree_real_tree_matches_bruteforce():
+    """Round-2: KDTree is a genuine k-d tree (median build + pruned
+    search + insert), not a brute-force alias — results must match the
+    VPTree brute-force kernel exactly."""
+    import numpy as np
+
+    from deeplearning4j_tpu.clustering.knn import KDTree, VPTree
+
+    rng = np.random.default_rng(0)
+    pts = rng.normal(size=(200, 5)).astype(np.float32)
+    tree = KDTree(pts)
+    brute = VPTree(pts)
+    for qi in range(10):
+        q = rng.normal(size=5).astype(np.float32)
+        ti, td = tree.search(q, 7)
+        bi, bd = brute.search(q, 7)
+        np.testing.assert_allclose(np.sort(td), np.sort(bd), rtol=1e-5)
+        assert set(ti.tolist()) == set(bi.tolist())
+
+
+def test_kdtree_insert_and_nn():
+    import numpy as np
+
+    from deeplearning4j_tpu.clustering.knn import KDTree
+
+    tree = KDTree(dims=2)
+    for p in ([0.0, 0.0], [5.0, 5.0], [1.0, 1.0], [-3.0, 2.0]):
+        tree.insert(np.array(p, np.float32))
+    assert len(tree) == 4
+    idx, d = tree.nn(np.array([0.9, 0.9], np.float32))
+    np.testing.assert_allclose(tree.points[idx], [1.0, 1.0])
+    # insert after build-from-items also works
+    tree2 = KDTree(np.array([[0, 0], [2, 2]], np.float32))
+    tree2.insert(np.array([0.4, 0.4], np.float32))
+    idx2, _ = tree2.nn(np.array([0.5, 0.5], np.float32))
+    np.testing.assert_allclose(tree2.points[idx2], [0.4, 0.4])

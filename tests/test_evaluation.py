@@ -1,0 +1,98 @@
+"""Evaluation-suite parity: top-N accuracy, MCC, per-class stats, masking
+(ref: eval/Evaluation.java:441-587 and the reference's EvalTest asserts).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+from deeplearning4j_tpu.eval.roc import ROC
+
+
+def _onehot(idx, n):
+    return np.eye(n, dtype=np.float32)[idx]
+
+
+def test_basic_counts_and_metrics():
+    e = Evaluation()
+    actual = np.array([0, 0, 1, 1, 2, 2])
+    pred_cls = np.array([0, 1, 1, 1, 2, 0])
+    preds = _onehot(pred_cls, 3)
+    e.eval(_onehot(actual, 3), preds)
+    assert e.examples == 6
+    assert e.accuracy() == pytest.approx(4 / 6)
+    assert e.true_positives() == {0: 1, 1: 2, 2: 1}
+    assert e.false_positives() == {0: 1, 1: 1, 2: 0}
+    assert e.false_negatives() == {0: 1, 1: 0, 2: 1}
+    # per-class precision: tp / predicted-as
+    assert e.precision(1) == pytest.approx(2 / 3)
+    assert e.recall(1) == pytest.approx(1.0)
+    assert e.false_negative_rate(2) == pytest.approx(0.5)
+
+
+def test_top_n_accuracy():
+    """True class within the top-N scores counts for top-N accuracy but not
+    plain accuracy (ref: Evaluation.java topNCorrectCount)."""
+    e = Evaluation(top_n=2)
+    labels = _onehot(np.array([0, 1, 2, 1]), 3)
+    preds = np.array([
+        [0.6, 0.3, 0.1],   # top1 = 0 (correct)
+        [0.5, 0.4, 0.1],   # top1 = 0, top2 includes 1
+        [0.4, 0.35, 0.25], # top1 = 0, top2 = {0,1} — class 2 missed
+        [0.1, 0.8, 0.1],   # correct
+    ], dtype=np.float32)
+    e.eval(labels, preds)
+    assert e.accuracy() == pytest.approx(2 / 4)
+    assert e.top_n_accuracy() == pytest.approx(3 / 4)
+    # top_n == 1 degenerates to accuracy
+    assert Evaluation().top_n_accuracy() == 0.0
+
+
+def test_matthews_correlation_binary_matches_formula():
+    e = Evaluation()
+    actual = np.array([0, 0, 0, 1, 1, 1, 1, 0])
+    pred = np.array([0, 0, 1, 1, 1, 0, 1, 0])
+    e.eval(_onehot(actual, 2), _onehot(pred, 2))
+    tp = 3; tn = 3; fp = 1; fn = 1  # class-1-vs-rest
+    want = (tp * tn - fp * fn) / np.sqrt(
+        (tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+    assert e.matthews_correlation(1) == pytest.approx(want)
+    # binary multiclass-MCC == binary MCC
+    assert e.matthews_correlation() == pytest.approx(want)
+
+
+def test_matthews_correlation_perfect_and_random():
+    e = Evaluation()
+    a = np.array([0, 1, 2, 0, 1, 2])
+    e.eval(_onehot(a, 3), _onehot(a, 3))
+    assert e.matthews_correlation() == pytest.approx(1.0)
+
+
+def test_masked_time_series_eval():
+    """Masked timesteps are excluded (ref: evalTimeSeries + labels mask)."""
+    e = Evaluation()
+    B, T, C = 2, 3, 2
+    labels = np.zeros((B, T, C), np.float32)
+    preds = np.zeros((B, T, C), np.float32)
+    # ex0: all steps class 0, predicted correct at t0/t1, WRONG at t2 (masked)
+    labels[0, :, 0] = 1
+    preds[0, 0, 0] = 1; preds[0, 1, 0] = 1; preds[0, 2, 1] = 1
+    # ex1: class 1 at t0 (correct), t1/t2 masked with wrong predictions
+    labels[1, :, 1] = 1
+    preds[1, 0, 1] = 1; preds[1, 1, 0] = 1; preds[1, 2, 0] = 1
+    mask = np.array([[1, 1, 0], [1, 0, 0]], np.float32)
+    e.eval(labels, preds, mask=mask)
+    assert e.examples == 3
+    assert e.accuracy() == pytest.approx(1.0)
+
+
+def test_stats_renders_per_class_table():
+    e = Evaluation(labels=["cat", "dog"], top_n=3)
+    a = np.array([0, 1, 0, 1])
+    e.eval(_onehot(a, 2), _onehot(np.array([0, 1, 1, 1]), 2))
+    s = e.stats()
+    assert "cat" in s and "dog" in s
+    assert "MCC" in s
+    assert "Top 3 Accuracy" in s
+    assert "Per-class" in s

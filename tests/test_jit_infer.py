@@ -1,0 +1,73 @@
+"""Jitted inference path: output/evaluate/predict reuse ONE compiled
+forward per input shape (ref: the reference's output() reuses the same
+compiled-graph machinery as fit — MultiLayerNetwork.java:1512-1594).
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+RNG = np.random.default_rng(0)
+
+
+def _mln():
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder().seed(5).list()
+        .layer(DenseLayer(n_out=8, activation="relu"))
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(6)).build()).init()
+
+
+def _batches(n, b):
+    out = []
+    for _ in range(n):
+        x = RNG.normal(size=(b, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[RNG.integers(0, 3, b)]
+        out.append(DataSet(x, y))
+    return out
+
+
+def test_mln_one_trace_for_repeated_same_shape():
+    net = _mln()
+    for ds in _batches(5, 4):
+        net.output(ds.features)
+    assert net._infer_traces == 1
+    # new shape -> exactly one more trace
+    net.output(RNG.normal(size=(9, 6)).astype(np.float32))
+    assert net._infer_traces == 2
+    # evaluate() rides the same cache
+    net.evaluate(ListDataSetIterator(_batches(6, 4)))
+    assert net._infer_traces == 2
+
+
+def test_mln_jitted_matches_eager():
+    net = _mln()
+    x = RNG.normal(size=(7, 6)).astype(np.float32)
+    jitted = np.asarray(net.output(x))
+    eager = np.asarray(net.feed_forward(x, train=False)[-1])
+    np.testing.assert_allclose(jitted, eager, rtol=1e-6)
+
+
+def test_cg_one_trace_for_repeated_same_shape():
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=8, activation="relu"), "in")
+            .add_layer("d2", DenseLayer(n_out=8, activation="identity"), "d1")
+            .add_vertex("add", ElementWiseVertex(op="add"), "d1", "d2")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "add")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(6)).build())
+    net = ComputationGraph(conf).init()
+    for ds in _batches(4, 5):
+        net.output(ds.features)
+    assert net._infer_traces == 1
+    net.predict(RNG.normal(size=(2, 6)).astype(np.float32))
+    assert net._infer_traces == 2

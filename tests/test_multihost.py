@@ -1,0 +1,79 @@
+"""Multi-host data parallelism WITHOUT a cluster: two coordinated
+processes on localhost CPU (the Spark-local-mode analog — ref test pattern:
+spark/dl4j-spark/src/test/.../BaseSparkTest.java:89 `local[N]`).
+
+Each process owns 4 virtual CPU devices and feeds its half of the global
+batch; jax.distributed glues them into one 8-device mesh. Losses must be
+bitwise-identical across processes (synchronous SPMD) and match a
+single-process run on the same global batch.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "multihost_worker.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_training_matches_single():
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (os.path.dirname(HERE)
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    import tempfile
+    logdir = tempfile.mkdtemp(prefix="multihost")
+    logs = [open(os.path.join(logdir, f"w{i}.log"), "w+") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(i), "2", str(port)],
+            stdout=logs[i], stderr=subprocess.STDOUT,
+            env=env, cwd=os.path.dirname(HERE))
+        for i in range(2)
+    ]
+    outs = []
+    for i, p in enumerate(procs):
+        try:
+            p.wait(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            logs[i].seek(0)
+            pytest.fail("multihost worker timed out:\n" + logs[i].read()[-3000:])
+        logs[i].seek(0)
+        out = logs[i].read()
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+        outs.append(out)
+
+    losses = []
+    for out in outs:
+        line = next(ln for ln in out.splitlines() if ln.startswith("LOSSES"))
+        losses.append([float(v) for v in line.split()[1:]])
+    # both processes observed the same global losses
+    np.testing.assert_array_equal(losses[0], losses[1])
+    assert losses[0][-1] < losses[0][0]  # and training progressed
+
+    # single-process run over the same global batch gives the same losses
+    single = subprocess.run(
+        [sys.executable, WORKER, "0", "1", str(_free_port())],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=os.path.dirname(HERE))
+    assert single.returncode == 0, single.stderr[-3000:]
+    line = next(ln for ln in single.stdout.splitlines()
+                if ln.startswith("LOSSES"))
+    single_losses = [float(v) for v in line.split()[1:]]
+    np.testing.assert_allclose(losses[0], single_losses, rtol=1e-5)

@@ -418,3 +418,64 @@ def test_last_time_step_pre_padded_mask():
     out2, _ = layer.apply({}, jnp.asarray(x), state={}, train=False,
                           rng=None, mask=jnp.asarray(mask))
     np.testing.assert_array_equal(np.asarray(out2), want)
+
+
+def test_eval_stats_before_any_eval():
+    """Round-2 review: stats()/metrics on a fresh Evaluation must not
+    crash (all metrics read 0.0 from an empty confusion matrix)."""
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+    e = Evaluation(num_classes=3)
+    s = e.stats()
+    assert "Accuracy" in s
+    assert e.precision() == 0.0 and e.matthews_correlation() == 0.0
+
+
+def test_kdtree_sorted_insert_chain_no_recursion_error():
+    """Round-2 review: a chain-shaped insert-built tree (sorted inserts,
+    no rebalancing) must still answer queries (iterative search)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.clustering.knn import KDTree
+
+    tree = KDTree(dims=1)
+    for i in range(3000):
+        tree.insert(np.array([float(i)], np.float32))
+    idx, d = tree.nn(np.array([1500.2], np.float32))
+    np.testing.assert_allclose(tree.points[idx], [1500.0])
+
+
+def test_native_csv_matches_python_float_parse(tmp_path):
+    """Round-2 review: native (strtod/double) and Python (float()) parses
+    must agree exactly for the same file."""
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets import native_io
+    from deeplearning4j_tpu.datasets.records import CSVRecordReader
+
+    p = tmp_path / "prec.csv"
+    p.write_text("5.1,0.30000000000000004,1e-3\n2.675,3.14159265358979,7\n")
+    rr = CSVRecordReader(str(p))
+    rows = [rr.next_record(), rr.next_record()]
+    assert rows[0] == [5.1, 0.30000000000000004, 1e-3]
+    assert rows[1] == [2.675, 3.14159265358979, 7.0]
+    if native_io.available():
+        assert rr._rows is not None  # and that WAS the native path
+
+
+def test_preprocessor_applies_on_direct_next():
+    """Round-2 review: set_pre_processor must cover the DL4J-style
+    has_next()/next() consumption loop, not just Python iteration."""
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.models.trainedmodels import TrainedModels
+
+    x = np.full((4, 2, 2, 3), 200.0, np.float32)
+    y = np.eye(4, dtype=np.float32)
+    it = ListDataSetIterator([DataSet(x, y)])
+    it.set_pre_processor(TrainedModels.VGG16.get_pre_processor())
+    it.reset()
+    batch = it.next()  # direct call, not __iter__
+    assert batch.features.max() < 100.0

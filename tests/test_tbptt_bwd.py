@@ -1,0 +1,93 @@
+"""tbptt_bwd_length semantics (ref: MultiLayerNetwork.doTruncatedBPTT:1119
++ LSTMHelpers.java:333 — the backward time-loop visits only the last
+tbpttBackwardLength steps of each forward slice).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+
+RNG = np.random.default_rng(0)
+
+
+def _rnn_net(backprop_type="standard", fwd=20, bwd=20, seed=11):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater("sgd").learning_rate(0.05)
+         .list()
+         .layer(LSTM(n_out=6, activation="tanh"))
+         .layer(RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent")))
+    b.backprop_type(backprop_type, fwd, bwd)
+    return MultiLayerNetwork(
+        b.set_input_type(InputType.recurrent(4, 6)).build()).init()
+
+
+def _seq_batch(B=3, T=6, F=4, C=3):
+    x = RNG.normal(size=(B, T, F)).astype(np.float32)
+    y = np.eye(C, dtype=np.float32)[RNG.integers(0, C, (B, T))]
+    return DataSet(x, y)
+
+
+def test_tbptt_equals_full_bptt_when_window_covers_sequence():
+    """fwd=bwd >= T: one slice, full backward — must match the standard
+    backprop step bit-for-bit in update semantics."""
+    ds = _seq_batch(T=6)
+    full = _rnn_net("standard")
+    tb = _rnn_net("truncated_bptt", fwd=10, bwd=10)
+    np.testing.assert_allclose(full.params_flat(), tb.params_flat())
+    full.fit_batch(ds)
+    tb.fit_batch(ds)
+    np.testing.assert_allclose(full.params_flat(), tb.params_flat(),
+                               rtol=2e-6, atol=1e-7)
+
+
+def test_tbptt_bwd_shorter_than_fwd_changes_recurrent_grads():
+    """bwd < fwd must actually truncate: params diverge from the full-window
+    run (if tbptt_bwd_length were ignored, these would be identical)."""
+    ds = _seq_batch(T=8)
+    win_full = _rnn_net("truncated_bptt", fwd=8, bwd=8)
+    win_trunc = _rnn_net("truncated_bptt", fwd=8, bwd=3)
+    np.testing.assert_allclose(win_full.params_flat(),
+                               win_trunc.params_flat())
+    win_full.fit_batch(ds)
+    win_trunc.fit_batch(ds)
+    assert not np.allclose(win_full.params_flat(), win_trunc.params_flat())
+
+
+def test_tbptt_bwd_gradient_equivalence():
+    """The bwd<fwd step must equal the manual construction: head of the
+    slice forward-only (stopped carry + activations), loss summed over head
+    (stopped) + tail, SGD applied."""
+    T, bwd = 8, 3
+    split = T - bwd
+    ds = _seq_batch(T=T)
+    lr = 0.05
+
+    net = _rnn_net("truncated_bptt", fwd=8, bwd=bwd)
+    # fit_batch donates param buffers — hold host copies, not aliases
+    p0 = [{k: np.asarray(v) for k, v in p.items()} for p in net.params]
+
+    feats = jnp.asarray(ds.features)
+    labels = jnp.asarray(ds.labels)
+    lstm, out = net.layers
+
+    def manual_loss(p):
+        c0 = lstm.initial_carry(feats.shape[0])
+        h1, c1 = lstm.scan(p[0], feats[:, :split], c0, None)
+        h1 = jax.lax.stop_gradient(h1)
+        c1 = jax.tree.map(jax.lax.stop_gradient, c1)
+        h2, _ = lstm.scan(p[0], feats[:, split:], c1, None)
+        return (out.compute_loss(p[1], h1, labels[:, :split])
+                + out.compute_loss(p[1], h2, labels[:, split:]))
+
+    grads = jax.grad(manual_loss)(p0)
+    net.fit_batch(ds)
+    for li in range(2):
+        for k in p0[li]:
+            want = np.asarray(p0[li][k]) - lr * np.asarray(grads[li][k])
+            np.testing.assert_allclose(np.asarray(net.params[li][k]), want,
+                                       rtol=2e-5, atol=1e-6)

@@ -1,0 +1,58 @@
+"""TrainedModels pretrained-model flow
+(ref: trainedmodels/TrainedModels.java:16-40 + VGG16ImagePreProcessor).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.models.trainedmodels import (TrainedModels,
+                                                     VGG16ImagePreProcessor)
+
+
+def test_mean_subtraction_preprocessor():
+    pp = TrainedModels.VGG16.get_pre_processor()
+    assert isinstance(pp, VGG16ImagePreProcessor)
+    x = np.full((2, 4, 4, 3), 130.0, np.float32)
+    y = np.eye(2, dtype=np.float32)
+    out = pp.pre_process(DataSet(x, y))
+    want = 130.0 - np.array([123.68, 116.779, 103.939], np.float32)
+    np.testing.assert_allclose(out.features[0, 0, 0], want, rtol=1e-5)
+    with pytest.raises(ValueError, match="NHWC"):
+        pp.pre_process(DataSet(np.zeros((2, 5)), y))
+
+
+def test_iterator_set_pre_processor_applies():
+    """(ref: DataSetIterator.setPreProcessor wiring)"""
+    x = np.full((4, 2, 2, 3), 200.0, np.float32)
+    y = np.eye(4, dtype=np.float32)
+    it = ListDataSetIterator([DataSet(x, y)])
+    it.set_pre_processor(TrainedModels.VGG16.get_pre_processor())
+    batch = next(iter(it))
+    assert batch.features.max() < 100.0  # mean subtracted
+
+
+def test_decode_predictions_formats_top5():
+    probs = np.zeros((1, 10), np.float32)
+    probs[0, 3] = 0.7
+    probs[0, 7] = 0.2
+    s = TrainedModels.VGG16.decode_predictions(
+        probs, top=2, labels=[f"name{i}" for i in range(10)])
+    assert "name3" in s and "name7" in s
+    assert s.index("name3") < s.index("name7")  # sorted by probability
+    assert "70.000%" in s
+
+
+def test_vgg16_load_via_keras_import(tmp_path):
+    """load() rides the functional Keras importer — exercised with a small
+    VGG-block-shaped .h5 produced by real Keras (full VGG16 weights are not
+    available in a zero-egress environment)."""
+    import os
+    fx = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "fixtures", "keras_cnn.h5")
+    if not os.path.exists(fx):
+        pytest.skip("fixture missing")
+    net = TrainedModels.VGG16.load(fx)
+    out = np.asarray(net.output(np.zeros((1, 10, 10, 3), np.float32)))
+    assert out.shape == (1, 7)

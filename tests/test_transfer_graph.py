@@ -1,0 +1,81 @@
+"""TransferLearning.GraphBuilder (ref: TransferLearning.java:34-129, the
+GraphBuilder variant for ComputationGraph).
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu import (InputType, NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.transferlearning import (FineTuneConfiguration,
+                                                    TransferLearning)
+
+RNG = np.random.default_rng(0)
+
+
+def _base_graph():
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater("sgd").learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=10, activation="relu"), "in")
+            .add_layer("d2", DenseLayer(n_out=8, activation="relu"), "d1")
+            .add_layer("out", OutputLayer(n_out=4, activation="softmax",
+                                          loss="mcxent"), "d2")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(5))
+            .build())
+    return ComputationGraph(conf).init()
+
+
+def test_graph_nout_replace_keeps_upstream_params():
+    src = _base_graph()
+    d1_w = np.asarray(src.params["d1"]["W"]).copy()
+    net = (TransferLearning.graph_builder(src)
+           .n_out_replace("out", 7)
+           .build())
+    assert net.conf.nodes["out"].layer.n_out == 7
+    np.testing.assert_array_equal(np.asarray(net.params["d1"]["W"]), d1_w)
+    assert net.params["out"]["W"].shape == (8, 7)
+    x = RNG.normal(size=(3, 5)).astype(np.float32)
+    assert np.asarray(net.output(x)).shape == (3, 7)
+
+
+def test_graph_feature_extractor_freezes_ancestors():
+    src = _base_graph()
+    net = (TransferLearning.graph_builder(src)
+           .set_feature_extractor("d2")
+           .build())
+    assert net.conf.nodes["d1"].layer.frozen
+    assert net.conf.nodes["d2"].layer.frozen
+    assert not net.conf.nodes["out"].layer.frozen
+    d1_w = np.asarray(net.params["d1"]["W"]).copy()
+    x = RNG.normal(size=(6, 5)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[RNG.integers(0, 4, 6)]
+    for _ in range(3):
+        net.fit_batch(DataSet(x, y))
+    np.testing.assert_array_equal(np.asarray(net.params["d1"]["W"]), d1_w)
+    # unfrozen head trained
+
+
+def test_graph_remove_and_add_new_head():
+    src = _base_graph()
+    d2_w = np.asarray(src.params["d2"]["W"]).copy()
+    net = (TransferLearning.graph_builder(src)
+           .remove_vertex_and_connections("out")
+           .add_layer("new_out", OutputLayer(n_out=2, activation="softmax",
+                                             loss="mcxent"), "d2")
+           .set_outputs("new_out")
+           .fine_tune_configuration(FineTuneConfiguration(learning_rate=0.01))
+           .build())
+    assert net.conf.network_outputs == ["new_out"]
+    assert net.conf.training.updater.learning_rate == 0.01
+    np.testing.assert_array_equal(np.asarray(net.params["d2"]["W"]), d2_w)
+    x = RNG.normal(size=(3, 5)).astype(np.float32)
+    assert np.asarray(net.output(x)).shape == (3, 2)
+    y = np.eye(2, dtype=np.float32)[RNG.integers(0, 2, 3)]
+    first = net.fit_batch(DataSet(x, y))
+    for _ in range(10):
+        last = net.fit_batch(DataSet(x, y))
+    assert last < first

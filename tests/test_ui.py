@@ -186,3 +186,38 @@ def test_stats_listener_frequency_interval_norms():
     assert [r.iteration for r in reports] == [3, 6, 9]
     # update norm over the 3-step interval is present from the 2nd report
     assert "update_norm:0.W" in reports[1].series
+
+
+def test_histogram_endpoint_and_tsne_view():
+    """Round-2: the dashboard renders collected histograms + a t-SNE view
+    (ref: deeplearning4j-play/.../train/TrainModule.java histograms,
+    module/tsne/)."""
+    server = UIServer(port=0).start()
+    try:
+        storage = server.storage
+        net = _tiny_net()
+        net.set_listeners(StatsListener(storage, session_id="h1",
+                                        histogram_frequency=1))
+        ds = _tiny_data()
+        for _ in range(2):
+            net.fit_batch(ds)
+        h = json.loads(urllib.request.urlopen(
+            server.url + "/api/histograms?id=h1", timeout=5).read())
+        assert h["iteration"] == 2
+        assert "0.W" in h["param"]
+        assert len(h["param"]["0.W"]["counts"]) == 20
+        assert len(h["param"]["0.W"]["edges"]) == 21
+        assert "0.W" in h["grad"]  # gradient histograms collected too
+
+        # t-SNE: post an embedding, read it back
+        coords = np.array([[0.0, 1.0], [2.0, 3.0], [4.0, 5.0]])
+        server.post_tsne(coords, labels=["a", "b", "a"])
+        t = json.loads(urllib.request.urlopen(
+            server.url + "/api/tsne", timeout=5).read())
+        assert t["x"] == [0.0, 2.0, 4.0]
+        assert t["labels"] == ["a", "b", "a"]
+
+        page = urllib.request.urlopen(server.url + "/", timeout=5).read()
+        assert b"Histograms" in page and b"t-SNE" in page
+    finally:
+        server.stop()

@@ -36,9 +36,14 @@ def test_math_regression_stats():
 def test_math_distances_tfidf():
     assert mu.euclidean_distance([0, 0], [3, 4]) == pytest.approx(5.0)
     assert mu.manhattan_distance([0, 0], [3, 4]) == pytest.approx(7.0)
-    assert mu.idf(100, 10) == pytest.approx(np.log(10))
+    # reference MathUtils.idf uses log10 (round-2 advisor fix)
+    assert mu.idf(100, 10) == pytest.approx(np.log10(10))
     assert mu.tf(3, 12) == pytest.approx(0.25)
-    assert mu.tfidf(0.25, np.log(10)) == pytest.approx(0.25 * np.log(10))
+    assert mu.tfidf(0.25, np.log10(10)) == pytest.approx(0.25 * np.log10(10))
+    # discretize: binCount multiplier with clamp (MathUtils.java:84)
+    assert mu.discretize(0.5, 0.0, 1.0, 4) == 2
+    assert mu.discretize(1.0, 0.0, 1.0, 4) == 3   # clamped top edge
+    assert mu.discretize(-9.0, 0.0, 1.0, 4) == 0  # clamped below
 
 
 def test_moving_average():
