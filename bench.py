@@ -1,27 +1,44 @@
-"""Benchmark: ResNet-50 ImageNet training throughput, samples/sec/chip.
+"""Benchmark: training throughput ladder, samples/sec/chip.
 
-The BASELINE north-star metric (BASELINE.json: "samples/sec/chip, ResNet-50
-ImageNet, MultiLayerNetwork.fit equivalent"). The reference publishes no
-numbers (BASELINE.md), so ``vs_baseline`` is the ratio against the first
-recorded value of this benchmark (kept in BENCH_HISTORY below; 1.0 on the
-first run).
+North-star metric (BASELINE.json): samples/sec/chip, ResNet-50 ImageNet,
+``fit()`` equivalent. The reference publishes no numbers (BASELINE.md), so
+``vs_baseline`` is the ratio against the first recorded value of the same
+metric (BENCH_HISTORY below; 1.0 on the first successful run).
 
-Prints ONE JSON line:
+Prints ONE JSON line (the supervisor's final selection):
   {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
-On unrecoverable backend failure it still prints one structured JSON line
-with an "error" record instead of dying with a bare traceback (round-1
-burned its one shot on a transient "UNAVAILABLE: TPU backend setup" raised
-by ``jax.devices()`` before any framework code ran).
 
-Architecture: the process doubles as supervisor and worker. The supervisor
-(default entry) re-execs itself with BENCH_CHILD=1; backend-init failures
-are retried with exponential backoff in a FRESH process each time (JAX
-caches a failed backend for the life of the process, so in-process retry
-can never recover). The child runs the actual measurement and prints the
-JSON line, which the supervisor passes through verbatim.
+Post-mortem of rounds 1-2 (r01: transient backend UNAVAILABLE; r02: 1500s
+timeout with zero diagnostics) plus a direct probe of this environment
+(jax.devices() over the axon TPU tunnel can take >10 minutes or hang)
+drove this design:
 
-Runs on whatever device jax selects (TPU under the driver; CPU fallback for
-local smoke with BENCH_SMALL=1).
+- ONE child process pays backend init ONCE, then climbs a rung ladder,
+  printing a complete JSON record after EVERY rung. A later rung hanging
+  can never lose an earlier rung's banked number: on timeout the
+  supervisor harvests the partial stdout.
+    1. ``lenet``  — LeNet-5 MNIST b128: compiles in seconds; proves
+       backend health and banks *a* real TPU number.
+    2. ``small``  — ResNet-50 @96x96 b16 bf16, 5 steps: flagship model at
+       a size whose compile must fit the budget.
+    3. ``full``   — ResNet-50 @224 b64 bf16, 20 steps: BASELINE config.
+- Every phase is stamped to stderr, which the child INHERITS from the
+  supervisor (streams straight to the driver log, survives any kill), so
+  a timeout is attributable to a named phase.
+- The supervisor's single child timeout is BENCH_WALL (default 1350s,
+  under the ~25-minute driver budget r02 revealed) minus slack; it
+  retries once, in a fresh process, on any non-timeout failure with no
+  banked record while >180s of budget remains (the r01 UNAVAILABLE
+  transient can take minutes to raise; hangs are never retried). It
+  always gets to print a final JSON line — a harvested record or a
+  structured error naming the last phase.
+- After the first successful rung on TPU, the child runs a
+  compiled-Pallas-vs-scan LSTM parity check (VERDICT r2 #2) and stamps
+  ``pallas_lstm_parity`` into subsequent records.
+
+Model init is one jitted program (nn/graph.py ``init``): eager per-tensor
+init would compile+dispatch hundreds of tiny programs — minutes over a
+remote-TPU link.
 """
 
 from __future__ import annotations
@@ -31,19 +48,22 @@ import os
 import subprocess
 import sys
 import time
+import traceback
 
 import numpy as np
 
-# First recorded full-size value. Update when a round improves it so
+# First recorded value per metric. Update when a round improves it so
 # vs_baseline tracks cumulative speedup over the first measurement.
-# Round 1 produced no TPU number (backend init failure), so the first
-# successful full-size run of round >= 2 sets the baseline.
+# No TPU number has ever been banked (r01 backend failure, r02 timeout),
+# so the first successful run of each rung sets its baseline (vs=1.0).
 BENCH_HISTORY = {
     "resnet50_b64_bf16_samples_per_sec_per_chip": None,
+    "resnet50_96px_b16_bf16_samples_per_sec_per_chip": None,
+    "lenet_mnist_b128_samples_per_sec_per_chip": None,
 }
 
 # Peak bf16 matmul FLOP/s per chip, by device_kind substring (public cloud
-# specs), for the MFU estimate. Conservative default when unknown.
+# specs), for the MFU estimate.
 _CHIP_PEAK_FLOPS = (
     ("v6", 918e12),       # TPU v6e (Trillium)
     ("v5p", 459e12),
@@ -55,6 +75,16 @@ _CHIP_PEAK_FLOPS = (
     ("v2", 45e12),
 )
 
+T0 = time.perf_counter()
+
+
+def _stamp(msg: str) -> None:
+    """Phase-progress line on stderr, flushed immediately, so a timeout is
+    attributable to the phase after the last stamp."""
+    who = "child" if os.environ.get("BENCH_CHILD") == "1" else "super"
+    print(f"[bench {who} {time.perf_counter() - T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
 
 def _chip_peak(device_kind: str):
     kind = device_kind.lower()
@@ -64,18 +94,45 @@ def _chip_peak(device_kind: str):
     return None
 
 
-def _acquire_backend():
-    """Import jax and initialize the backend, raising on failure.
+# ---------------------------------------------------------------------------
+# rung configurations
+# ---------------------------------------------------------------------------
 
-    Called only in the child process; a failure here is retried by the
-    supervisor in a fresh process.
-    """
+_RUNGS = ("lenet", "small", "full")
+
+
+def _rung_config(rung: str, smoke: bool):
+    if rung == "lenet":
+        return dict(model="lenet", height=28, width=28, channels=1,
+                    classes=10, batch=8 if smoke else 128,
+                    steps=3 if smoke else 20, warmup=1 if smoke else 2,
+                    dtype="float32",
+                    metric="lenet_mnist_b128_samples_per_sec_per_chip")
+    if rung == "small":
+        return dict(model="resnet50", height=32 if smoke else 96,
+                    width=32 if smoke else 96, channels=3, classes=1000,
+                    batch=2 if smoke else 16, steps=2 if smoke else 5,
+                    warmup=1, dtype="bfloat16",
+                    metric="resnet50_96px_b16_bf16_samples_per_sec_per_chip")
+    if rung == "full":
+        return dict(model="resnet50", height=32 if smoke else 224,
+                    width=32 if smoke else 224, channels=3, classes=1000,
+                    batch=2 if smoke else 64, steps=2 if smoke else 20,
+                    warmup=1 if smoke else 2, dtype="bfloat16",
+                    metric="resnet50_b64_bf16_samples_per_sec_per_chip")
+    raise ValueError(f"unknown rung {rung!r}; valid: {_RUNGS}")
+
+
+# ---------------------------------------------------------------------------
+# child: climb the ladder, one JSON record per completed rung
+# ---------------------------------------------------------------------------
+
+def _acquire_backend():
     import jax
 
-    if "cpu" == os.environ.get("JAX_PLATFORMS", ""):
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         # the environment's sitecustomize pins jax_platforms to the TPU
         # tunnel; an explicit CPU request must override it via config
-        # (env alone doesn't stick — see __graft_entry__.py)
         try:
             jax.config.update("jax_platforms", "cpu")
         except Exception:
@@ -84,146 +141,282 @@ def _acquire_backend():
     return jax, devices
 
 
-def _run_child() -> int:
-    t_init = time.perf_counter()
-    jax, devices = _acquire_backend()
-    init_s = time.perf_counter() - t_init
-    platform = devices[0].platform
-    device_kind = getattr(devices[0], "device_kind", platform)
+def _pallas_parity_check(jax) -> str:
+    """Compiled Pallas LSTM vs lax.scan on a tiny tile-aligned problem.
 
-    small = os.environ.get("BENCH_SMALL", "0") == "1"
-    on_accel = platform not in ("cpu",)
-    if small or not on_accel:
-        # smoke configuration for hosts without a TPU
-        height = width = 64
-        batch = 8
-        steps = 3
-        warmup = 1
-    else:
-        height = width = 224
-        batch = int(os.environ.get("BENCH_BATCH", "64"))
-        steps = int(os.environ.get("BENCH_STEPS", "20"))
-        warmup = 3
+    The kernel's compiled (Mosaic) path had never run on hardware before
+    round 3; CI exercises interpret mode only (VERDICT r2 weak #2). Any
+    failure is recorded in the bench JSON, never fatal.
+    """
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.pallas_kernels import fused_lstm
+
+    B, T, F, H = 8, 16, 128, 128
+    rng = np.random.default_rng(7)
+    args = [rng.normal(size=s).astype(np.float32) * 0.1
+            for s in ((B, T, F), (F, 4 * H), (H, 4 * H), (4 * H,),
+                      (B, H), (B, H))]
+    x, w, rw, b, h0, c0 = [jnp.asarray(a) for a in args]
+
+    ys_k, hT_k, cT_k = fused_lstm(x, w, rw, b, None, h0, c0,
+                                  forget_bias=1.0, interpret=False)
+
+    def scan_ref():
+        xz = (x.reshape(B * T, F) @ w + b).reshape(B, T, 4 * H)
+
+        def step(carry, z_t):
+            h, c = carry
+            z = z_t + h @ rw
+            i = jax.nn.sigmoid(z[:, :H])
+            f = jax.nn.sigmoid(z[:, H:2 * H] + 1.0)
+            g = jnp.tanh(z[:, 2 * H:3 * H])
+            o = jax.nn.sigmoid(z[:, 3 * H:])
+            c2 = f * c + i * g
+            h2 = o * jnp.tanh(c2)
+            return (h2, c2), h2
+
+        (hT, cT), ys = jax.lax.scan(step, (h0, c0),
+                                    jnp.swapaxes(xz, 0, 1))
+        return jnp.swapaxes(ys, 0, 1), hT, cT
+
+    ys_s, hT_s, cT_s = jax.jit(scan_ref)()
+    err = max(float(jnp.max(jnp.abs(a - b)))
+              for a, b in ((ys_k, ys_s), (hT_k, hT_s), (cT_k, cT_s)))
+    return "ok" if err < 1e-4 else f"fail: max_abs_err={err:.3e}"
+
+
+def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
+              platform: str, parity: str):
+    cfg = _rung_config(rung, smoke)
+    batch, steps, warmup = cfg["batch"], cfg["steps"], cfg["warmup"]
+    height, width = cfg["height"], cfg["width"]
+    _stamp(f"rung '{rung}': {cfg}")
 
     from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.datasets.iterator import (
         DevicePrefetchIterator, ListDataSetIterator)
-    from deeplearning4j_tpu.models.resnet import resnet50
-    from deeplearning4j_tpu.nn.graph import ComputationGraph
 
-    conf = resnet50(height=height, width=width, dtype="bfloat16",
-                    updater="nesterovs", learning_rate=0.1)
-    net = ComputationGraph(conf).init()
+    t = time.perf_counter()
+    if cfg["model"] == "lenet":
+        from deeplearning4j_tpu.models.lenet import lenet_mnist
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        net = MultiLayerNetwork(lenet_mnist(
+            height=height, width=width, updater="nesterovs",
+            learning_rate=0.01)).init()
+    else:
+        from deeplearning4j_tpu.models.resnet import resnet50
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        net = ComputationGraph(resnet50(
+            height=height, width=width, dtype=cfg["dtype"],
+            updater="nesterovs", learning_rate=0.1)).init()
+    jax.block_until_ready(net.params)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(net.params))
+    _stamp(f"model built, init'd on device in {time.perf_counter() - t:.1f}s "
+           f"({n_params / 1e6:.1f}M params)")
 
     rng = np.random.default_rng(0)
+    C, K = cfg["channels"], cfg["classes"]
 
     def batches(n):
         out = []
         for _ in range(n):
-            x = rng.normal(size=(batch, height, width, 3)).astype(np.float32)
-            y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]
+            x = rng.normal(size=(batch, height, width, C)).astype(np.float32)
+            y = np.eye(K, dtype=np.float32)[rng.integers(0, K, batch)]
             out.append(DataSet(x, y))
         return out
 
     # Stage a small rotation of distinct batches in DEVICE memory once
-    # (bf16, via the DevicePrefetchIterator host-cast path), then time the
-    # training step cycling through them — MLPerf-style synthetic-input
-    # measurement of samples/sec/chip. Production feeds use the same
-    # DevicePrefetchIterator double-buffered against a real source; staging
-    # up front keeps the measurement about the chip, not this harness's
-    # host link (a tunneled chip here: ~40 MB/s would otherwise dominate).
-    # bf16 staging on TPU (halves link bytes, native MXU dtype); f32 on CPU
-    # smoke runs — XLA:CPU emulates bf16 orders of magnitude slower.
+    # (bf16 on TPU via the DevicePrefetchIterator host-cast path — halves
+    # tunnel bytes and is the native MXU dtype), then time the training
+    # step cycling through them: MLPerf-style synthetic-input measurement
+    # of samples/sec/chip, independent of this harness's slow host link.
+    t = time.perf_counter()
+    n_stage = 2 if smoke else 4
     staged = list(DevicePrefetchIterator(
-        ListDataSetIterator(batches(4)),
-        dtype="bfloat16" if on_accel else None))
+        ListDataSetIterator(batches(n_stage)),
+        dtype="bfloat16" if on_accel and cfg["dtype"] == "bfloat16"
+        else None))
+    jax.block_until_ready([d.features for d in staged])
+    mb = n_stage * batch * height * width * C * (
+        2 if cfg["dtype"] == "bfloat16" else 4) / 1e6
+    _stamp(f"{n_stage} batches staged on device in "
+           f"{time.perf_counter() - t:.1f}s ({mb:.0f}MB)")
 
-    t_compile = time.perf_counter()
+    t = time.perf_counter()
     for i in range(warmup):
-        net.fit_batch(staged[i % len(staged)])
-    jax.block_until_ready(net.params)
-    compile_s = time.perf_counter() - t_compile
+        loss = net.fit_batch(staged[i % len(staged)])
+        jax.block_until_ready(net.params)
+        _stamp(f"warmup step {i + 1}/{warmup} done "
+               f"(+{time.perf_counter() - t:.1f}s, loss={float(loss):.3f})")
+    compile_s = time.perf_counter() - t
 
+    # timed region: pure async dispatch + ONE final sync — any stamp or
+    # block_until_ready inside would serialize the pipeline (a device
+    # round-trip per step on a remote-TPU link) and bias the number low
+    _stamp(f"timing {steps} steps...")
     t0 = time.perf_counter()
     for i in range(steps):
         net.fit_batch(staged[i % len(staged)])
     jax.block_until_ready(net.params)
     dt = time.perf_counter() - t0
-
     sps = batch * steps / dt
+    _stamp(f"timed {steps} steps in {dt:.2f}s -> {sps:.1f} samples/s")
 
-    # MFU estimate: analytic training FLOPs per image (fwd conv/matmul
-    # FLOPs x3 for fwd+bwd) over chip peak. ResNet-50 @224 fwd ~= 4.09e9
-    # FLOPs/image (scaled by area for other input sizes).
-    fwd_flops_per_image = 4.09e9 * (height * width) / (224 * 224)
-    train_flops_per_sec = 3.0 * fwd_flops_per_image * sps
-    peak = _chip_peak(str(device_kind))
-    mfu = round(train_flops_per_sec / peak, 4) if peak else None
+    # MFU estimate: analytic fwd FLOPs x3 (fwd+bwd) over chip peak.
+    # ResNet-50 @224 fwd ~= 4.09e9 FLOPs/image, scaled by area; LeNet is
+    # too small for a meaningful MFU.
+    mfu = None
+    if cfg["model"] == "resnet50":
+        fwd = 4.09e9 * (height * width) / (224 * 224)
+        peak = _chip_peak(device_kind)
+        if peak:
+            mfu = round(3.0 * fwd * sps / peak, 4)
 
-    name = "resnet50_b64_bf16_samples_per_sec_per_chip"
-    base = BENCH_HISTORY.get(name)
-    vs = (sps / base) if base else 1.0
-    record = {
-        "metric": name if (on_accel and not small) else name + "_SMOKE",
+    base = BENCH_HISTORY.get(cfg["metric"])
+    return {
+        "metric": cfg["metric"] + ("" if on_accel and not smoke
+                                   else "_SMOKE"),
         "value": round(sps, 2),
         "unit": "samples/sec/chip",
-        "vs_baseline": round(vs, 3),
+        "vs_baseline": round(sps / base, 3) if base else 1.0,
         "mfu": mfu,
-        "device_kind": str(device_kind),
+        "device_kind": device_kind,
         "platform": platform,
+        "rung": rung,
         "batch": batch,
         "steps": steps,
         "step_ms": round(1000 * dt / steps, 2),
-        "backend_init_s": round(init_s, 1),
         "warmup_compile_s": round(compile_s, 1),
+        "pallas_lstm_parity": parity,
     }
-    print(json.dumps(record))
-    return 0
+
+
+def _run_child() -> int:
+    smoke = os.environ.get("BENCH_SMOKE", os.environ.get("BENCH_SMALL",
+                                                         "0")) == "1"
+    only = os.environ.get("BENCH_RUNGS", "")
+    rungs = [r for r in (only.split(",") if only else _RUNGS) if r]
+    _stamp(f"ladder {rungs}; importing jax + initializing backend "
+           "(a remote-TPU tunnel can take minutes here)")
+
+    t = time.perf_counter()
+    jax, devices = _acquire_backend()
+    platform = devices[0].platform
+    device_kind = str(getattr(devices[0], "device_kind", platform))
+    _stamp(f"backend up in {time.perf_counter() - t:.1f}s: "
+           f"{len(devices)}x {device_kind} ({platform})")
+    on_accel = platform not in ("cpu",)
+
+    # tiny sanity op: separates "tunnel dead" from "model too big"
+    t = time.perf_counter()
+    val = float(jax.jit(lambda a: (a @ a.T).sum())(
+        jax.numpy.ones((8, 128))).block_until_ready())
+    _stamp(f"tiny matmul compile+run {time.perf_counter() - t:.1f}s "
+           f"(= {val:.0f})")
+
+    parity = ("skipped (not tpu)" if platform != "tpu"
+              else "pending (check did not complete — see stamps)")
+    banked = []
+    for rung in rungs:
+        try:
+            rec = _run_rung(jax, rung, smoke, on_accel, device_kind,
+                            platform, parity)
+            print(json.dumps(rec), flush=True)  # banked — a later hang
+            banked.append(rec)                  # cannot lose this
+        except Exception:  # noqa: BLE001 — keep climbing on rung failure
+            _stamp(f"rung '{rung}' FAILED:\n"
+                   + traceback.format_exc(limit=20))
+    _stamp(f"ladder done: {len(banked)}/{len(rungs)} rungs banked")
+
+    if platform == "tpu" and banked:
+        # LAST, after every number is banked: a Mosaic-compile hang here
+        # (the exact failure class the check exists to catch — the
+        # compiled kernel has never run on hardware before round 3) can
+        # cost only the tail of the budget, never a measurement. The
+        # final record is re-printed with the verdict attached; the
+        # supervisor keeps the last JSON line.
+        t = time.perf_counter()
+        _stamp("pallas LSTM parity check (compiled vs scan)...")
+        try:
+            parity = _pallas_parity_check(jax)
+        except Exception as e:  # noqa: BLE001
+            parity = f"error: {type(e).__name__}: {e}"[:300]
+        _stamp(f"pallas parity: {parity} ({time.perf_counter() - t:.1f}s)")
+        banked[-1]["pallas_lstm_parity"] = parity
+        print(json.dumps(banked[-1]), flush=True)
+    return 0 if banked else 1
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+def _json_lines(text: str):
+    out = []
+    for ln in (text or "").splitlines():
+        if ln.startswith("{"):
+            try:
+                out.append(json.loads(ln))
+            except ValueError:
+                pass
+    return out
+
+
+def _launch_child(timeout_s: float):
+    """Child stderr is inherited (streams live); stdout captured for the
+    per-rung JSON records. Returns (records, note)."""
+    env = dict(os.environ, BENCH_CHILD="1", PYTHONUNBUFFERED="1")
+    _stamp(f"launching ladder child (timeout {timeout_s:.0f}s)")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, stderr=None, text=True,
+            timeout=timeout_s)
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout or b""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        recs = _json_lines(out)
+        _stamp(f"child TIMED OUT at {timeout_s:.0f}s with "
+               f"{len(recs)} rung(s) banked; the last child stamp above "
+               "names the hanging phase")
+        return recs, "timeout"
+    recs = _json_lines(proc.stdout)
+    note = "ok" if proc.returncode == 0 else f"rc={proc.returncode}"
+    _stamp(f"child exited {note} with {len(recs)} rung(s) banked")
+    return recs, note
 
 
 def _supervise() -> int:
-    """Run the benchmark in child processes, retrying backend failures."""
-    attempts = int(os.environ.get("BENCH_ATTEMPTS", "4"))
-    timeout_s = float(os.environ.get("BENCH_TIMEOUT", "1500"))
-    env = dict(os.environ, BENCH_CHILD="1")
-    last_err = None
-    for attempt in range(1, attempts + 1):
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env, capture_output=True, text=True, timeout=timeout_s)
-        except subprocess.TimeoutExpired as e:
-            last_err = {"attempt": attempt, "kind": "timeout",
-                        "detail": f"child exceeded {timeout_s}s"}
-            print(f"bench attempt {attempt}: timeout", file=sys.stderr)
-            continue
-        sys.stderr.write(proc.stderr[-4000:])
-        line = next((ln for ln in reversed(proc.stdout.splitlines())
-                     if ln.startswith("{")), None)
-        if proc.returncode == 0 and line:
-            print(line)  # the ONE JSON line, passed through
-            return 0
-        last_err = {
-            "attempt": attempt, "kind": "child_failure",
-            "returncode": proc.returncode,
-            "detail": (proc.stderr.strip().splitlines() or ["<no stderr>"]
-                       )[-1][:400],
-        }
-        print(f"bench attempt {attempt} failed "
-              f"(rc={proc.returncode}): {last_err['detail']}",
-              file=sys.stderr)
-        # transient backend-init failures ("UNAVAILABLE", tunnel hiccups)
-        # deserve backoff; anything else likely fails again fast, but a
-        # fresh process costs little so retry uniformly.
-        if attempt < attempts:
-            time.sleep(min(15.0 * attempt, 60.0))
+    wall = float(os.environ.get("BENCH_WALL", "1350"))
+    recs, note = _launch_child(wall - (time.perf_counter() - T0) - 20.0)
+    remaining = wall - (time.perf_counter() - T0) - 40.0
+    if not recs and note != "timeout" and remaining > 180.0:
+        # r01-style transient (backend UNAVAILABLE — probes show it can
+        # take minutes to raise): one retry in a FRESH process (JAX
+        # caches a failed backend for the life of a process). Never after
+        # a timeout — a hang would just repeat and eat the error report.
+        _stamp("child failed with nothing banked — retrying once in 20s")
+        time.sleep(20.0)
+        recs, note = _launch_child(remaining - 20.0)
+    if recs:
+        best = recs[-1]  # later rungs are strictly more representative
+        best["ladder"] = {r.get("rung", f"#{i}"): r.get("value")
+                          for i, r in enumerate(recs)}
+        best["child_exit"] = note
+        print(json.dumps(best), flush=True)
+        return 0
     print(json.dumps({
         "metric": "resnet50_b64_bf16_samples_per_sec_per_chip",
         "value": 0.0,
         "unit": "samples/sec/chip",
         "vs_baseline": 0.0,
-        "error": last_err or {"kind": "unknown"},
-    }))
+        "error": {"child_exit": note,
+                  "detail": "no rung completed; child stderr stamps above "
+                            "name the phase that hung or failed"},
+    }), flush=True)
     return 1
 
 

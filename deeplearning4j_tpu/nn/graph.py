@@ -26,6 +26,7 @@ from deeplearning4j_tpu.datasets.iterator import (
 )
 from deeplearning4j_tpu.nn.conf.graph import LastTimeStepVertex
 from deeplearning4j_tpu.nn.conf.graph_builder import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.netcommon import LazyScoreMixin, jit_init
 from deeplearning4j_tpu.nn.updater import build_optimizer, compute_updates
 from deeplearning4j_tpu.optimize.listeners import IterationListener, TrainingListener
 
@@ -37,7 +38,7 @@ def _dtype_of(name: str):
             "float16": jnp.float16, "float64": jnp.float64}[name]
 
 
-class ComputationGraph:
+class ComputationGraph(LazyScoreMixin):
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
         self.params: Optional[Dict[str, Dict[str, Array]]] = None
@@ -64,22 +65,31 @@ class ComputationGraph:
         dtype = _dtype_of(self.conf.training.dtype)
         if params is not None:
             self.params = params
+            self.opt_state = jax.jit(self._tx.init)(self.params)
         else:
-            key = jax.random.PRNGKey(self.conf.training.seed)
-            keys = jax.random.split(key, max(len(self._layer_nodes), 1))
-            self.params = {}
-            for name, k in zip(self._layer_nodes, keys):
-                layer = self.conf.nodes[name].layer
-                self.params[name] = (layer.init_params(k, dtype)
-                                     if layer.has_params() else {})
+            # One jitted program for the whole init: eager per-tensor
+            # jax.random calls would compile + dispatch hundreds of tiny
+            # device programs (one per shape), which is pathological over
+            # a remote-TPU link (round-trip each). Jitted, it is a single
+            # compile and a single device execution.
+            def _build(key):
+                keys = jax.random.split(key, max(len(self._layer_nodes), 1))
+                p = {}
+                for name, k in zip(self._layer_nodes, keys):
+                    layer = self.conf.nodes[name].layer
+                    p[name] = (layer.init_params(k, dtype)
+                               if layer.has_params() else {})
+                return p, self._tx.init(p)
+            self.params, self.opt_state = jit_init(
+                _build, self.conf.training.seed)
         self.states = {name: self.conf.nodes[name].layer.init_state()
                        for name in self._layer_nodes}
-        self.opt_state = self._tx.init(self.params)
         return self
 
     def _check_init(self):
         if self.params is None:
             raise RuntimeError("Call init() before using the network")
+
 
     def set_listeners(self, *listeners: IterationListener):
         self.listeners = list(listeners)
@@ -290,11 +300,13 @@ class ComputationGraph:
                 self.params, self.opt_state, self.states, inputs, labels,
                 masks, lmasks, step_rng)
         self.last_batch_size = data.num_examples()
-        self.score_value = float(loss)
+        # raw device scalar — see MultiLayerNetwork.fit_batch: converting
+        # eagerly would sync the pipeline every step
+        self.score_value = loss
         self.iteration_count += 1
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration_count, self.score_value)
-        return self.score_value
+        return self._score_raw
 
     def fit(self, data, epochs: int = 1, use_async: bool = True) -> "ComputationGraph":
         """(ref: ComputationGraph.fit(DataSetIterator):701-771)"""

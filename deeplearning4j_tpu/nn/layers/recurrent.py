@@ -112,13 +112,14 @@ class LSTM(BaseLayerConf):
                             self.forget_gate_bias_init, self._peephole)
         return h2, (h2, c2)
 
-    def _fused_kernel_ok(self, mask) -> bool:
+    def _fused_kernel_ok(self, mask, batch=None) -> bool:
         """Helper-discovery decision (the reference's cuDNN-helper seam,
         ref: ConvolutionLayer.java:55-77): use the Pallas fused kernel when
         the configuration matches what the kernel hardcodes.
 
         Compiled (Mosaic) mode additionally requires tile-aligned shapes
-        (H % 128 == 0 — the TPU lane width): the in-kernel gate
+        (H % 128 == 0 — the TPU lane width — and B % 8 == 0, the sublane
+        count): the in-kernel gate
         concatenate/slice on non-(8x128)-aligned block dims is exactly
         where compiled lowering can fail or mispad, and CI only exercises
         interpret mode on CPU. DL4J_TPU_PALLAS=force overrides the shape
@@ -134,14 +135,15 @@ class LSTM(BaseLayerConf):
             return False
         if (mode == "compiled"
                 and os.environ.get("DL4J_TPU_PALLAS") != "force"
-                and (self.n_out or 0) % 128 != 0):
+                and ((self.n_out or 0) % 128 != 0
+                     or (batch is not None and batch % 8 != 0))):
             return False
         return True
 
     def scan(self, params: Params, x: Array, carry, mask: Optional[Array],
              reverse: bool = False):
         """Run the full sequence [B, T, F] -> ([B, T, H], final_carry)."""
-        if self._fused_kernel_ok(mask):
+        if self._fused_kernel_ok(mask, batch=x.shape[0]):
             from deeplearning4j_tpu.ops.pallas_kernels import (
                 fused_lstm, lstm_mode)
             h0, c0 = carry

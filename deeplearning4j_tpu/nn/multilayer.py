@@ -35,6 +35,7 @@ from deeplearning4j_tpu.datasets.iterator import (
 )
 from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers.base import BaseLayerConf
+from deeplearning4j_tpu.nn.netcommon import LazyScoreMixin, jit_init
 from deeplearning4j_tpu.nn.updater import (
     build_optimizer, compute_updates, l1_l2_penalty,
 )
@@ -61,7 +62,7 @@ def _sum_aux_losses(states) -> Array:
     return total
 
 
-class MultiLayerNetwork:
+class MultiLayerNetwork(LazyScoreMixin):
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
         self.layers: List[BaseLayerConf] = conf.layers
@@ -87,13 +88,19 @@ class MultiLayerNetwork:
         dtype = _dtype_of(self.conf.training.dtype)
         if params is not None:
             self.params = params
+            self.opt_state = jax.jit(self._tx.init)(self.params)
         else:
-            key = jax.random.PRNGKey(self.conf.training.seed)
-            keys = jax.random.split(key, max(len(self.layers), 1))
-            self.params = [l.init_params(k, dtype) if l.has_params() else {}
-                           for l, k in zip(self.layers, keys)]
+            # single jitted program — see ComputationGraph.init for why
+            # (eager init is one tiny compile+dispatch per tensor, which a
+            # remote-TPU link turns into minutes)
+            def _build(key):
+                keys = jax.random.split(key, max(len(self.layers), 1))
+                p = [l.init_params(k, dtype) if l.has_params() else {}
+                     for l, k in zip(self.layers, keys)]
+                return p, self._tx.init(p)
+            self.params, self.opt_state = jit_init(
+                _build, self.conf.training.seed)
         self.states = [l.init_state() for l in self.layers]
-        self.opt_state = self._tx.init(self.params)
         return self
 
     def _check_init(self):
@@ -312,11 +319,16 @@ class MultiLayerNetwork:
                 fmask, lmask, step_rng)
         self.last_batch_size = dataset.num_examples()
         self.last_input = dataset.features  # for visualization listeners
-        self.score_value = float(loss)
+        # store the RAW device scalar: converting here would force a
+        # device sync every step (a full round-trip on a remote-TPU link),
+        # serializing the dispatch pipeline. The score_value property
+        # converts on first read (listeners below, score(), callers that
+        # float() the return value).
+        self.score_value = loss
         self.iteration_count += 1
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration_count, self.score_value)
-        return self.score_value
+        return self._score_raw
 
     # ------------------------------------------------------------------ tBPTT
     def _build_tbptt_step(self):
@@ -412,10 +424,10 @@ class MultiLayerNetwork:
             self.params, self.opt_state, self.states, carries, loss = \
                 self._tbptt_step_fn(self.params, self.opt_state, self.states,
                                     feats, labs, fm, lm, carries, step_rng)
-            total += float(loss)
+            total = total + loss  # device accumulate — no per-slice sync
             slices += 1
             self.iteration_count += 1
-            self.score_value = float(loss)
+            self.score_value = loss
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration_count, self.score_value)
         self.last_batch_size = dataset.num_examples()
@@ -483,7 +495,7 @@ class MultiLayerNetwork:
                     p, layer_opt, loss = step(self.params[idx], layer_opt, x,
                                               self._next_rng())
                     self.params[idx] = p
-                    self.score_value = float(loss)
+                    self.score_value = loss
 
     def _next_rng(self):
         self._rng, k = jax.random.split(self._rng)

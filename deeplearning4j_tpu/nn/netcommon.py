@@ -1,0 +1,49 @@
+"""Shared container plumbing for MultiLayerNetwork and ComputationGraph.
+
+Both containers (the reference's two model types, ref:
+nn/multilayer/MultiLayerNetwork.java and nn/graph/ComputationGraph.java)
+need the same device-friendly mechanics; keeping them here prevents the
+two copies from drifting:
+
+- ``LazyScoreMixin``: ``fit_batch`` stores the RAW device scalar loss so
+  back-to-back training steps dispatch asynchronously — converting to
+  float eagerly would force a device round-trip per step, which on a
+  remote-TPU link serializes the whole pipeline. The first read of
+  ``score_value`` synchronizes and caches the float.
+- ``jit_init``: run a param-building closure as ONE jitted program. Eager
+  per-tensor init compiles + dispatches hundreds of tiny device programs
+  (one per shape) — minutes over a remote-TPU link; jitted it is a single
+  compile and a single execution.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class LazyScoreMixin:
+    """Lazy float conversion of the last minibatch loss.
+
+    Containers assign ``self.score_value = <device scalar or float>`` and
+    read ``self.score_value`` as a float; ``self._score_raw`` holds
+    whatever was last assigned (listener-free training never syncs).
+    """
+
+    _score_raw = float("nan")
+
+    @property
+    def score_value(self) -> float:
+        v = self._score_raw
+        if not isinstance(v, float):
+            v = float(v)  # device sync happens here, on first read
+            self._score_raw = v
+        return v
+
+    @score_value.setter
+    def score_value(self, v) -> None:
+        self._score_raw = v
+
+
+def jit_init(build, seed: int):
+    """Run ``build(key) -> (params, opt_state)`` as one jitted program."""
+    return jax.jit(build)(jax.random.PRNGKey(seed))

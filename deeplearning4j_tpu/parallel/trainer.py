@@ -125,11 +125,13 @@ class ParallelTrainer:
             net.params, net.opt_state, net.states, feats, labels, fmask,
             lmask, step_rng)
         net.last_batch_size = batch.num_examples()
-        net.score_value = float(loss)
+        # raw device scalar: converting here would sync the SPMD pipeline
+        # every step (see MultiLayerNetwork.score_value)
+        net.score_value = loss
         net.iteration_count += 1
         for listener in net.listeners:
             listener.iteration_done(net, net.iteration_count, net.score_value)
-        return net.score_value
+        return net._score_raw
 
     def fit(self, data: Union[DataSet, DataSetIterator], epochs: int = 1,
             use_async: bool = True) -> "ParallelTrainer":
