@@ -38,6 +38,18 @@ def _dtype_of(name: str):
             "float16": jnp.float16, "float64": jnp.float64}[name]
 
 
+def _time_slice(d: Optional[Dict[str, Array]], lo: int, hi: int,
+                min_ndim: int = 3) -> Optional[Dict[str, Array]]:
+    """Slice the time axis (dim 1) of every time-distributed array in a
+    name->array dict. ``min_ndim=3`` for features/labels ([B, T, ...];
+    static [B, F] side inputs pass through unsliced), ``min_ndim=2`` for
+    masks ([B, T])."""
+    if d is None:
+        return None
+    return {k: (v if v is None or v.ndim < min_ndim else v[:, lo:hi])
+            for k, v in d.items()}
+
+
 class ComputationGraph(LazyScoreMixin):
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
@@ -55,6 +67,8 @@ class ComputationGraph(LazyScoreMixin):
         self._jit_infer = None          # cached jitted inference forward
         self._infer_traces = 0          # trace counter (tests)
         self._rng = jax.random.PRNGKey(conf.training.seed)
+        self._rnn_carries: Optional[Dict[str, Any]] = None  # rnnTimeStep
+        self._tbptt_step_fn = None
         # layer nodes in topological order (the trainable walk)
         self._layer_nodes = [n for n in conf.topological_order
                              if conf.nodes[n].kind == "layer"]
@@ -103,17 +117,24 @@ class ComputationGraph(LazyScoreMixin):
     # ---------------------------------------------------------------- forward
     def _forward(self, params, states, inputs: Dict[str, Array], *,
                  train: bool, rng, masks: Optional[Dict[str, Array]] = None,
-                 stop_before_loss: bool = True):
+                 stop_before_loss: bool = True,
+                 carries: Optional[Dict[str, Any]] = None):
         """Walk the DAG in topological order.
 
         Returns (activations dict, masks dict, new_states). For output-layer
         nodes with a loss head, the stored activation is the node's INPUT
         (pre-head) when stop_before_loss — compute_loss consumes it —
         mirroring feedForward(excludeOutput=true) (ref: CG.java:1006).
+
+        ``carries``: optional per-layer-node RNN carry dict (tBPTT /
+        rnnTimeStep — ref: CG.java rnnTimeStep:1868 keeps per-vertex state
+        maps). When given, recurrent layers run ``scan`` from their carry
+        and the return is a 4-tuple (acts, masks, states, new_carries).
         """
         acts: Dict[str, Array] = {}
         out_masks: Dict[str, Optional[Array]] = {}
         new_states: Dict[str, Dict[str, Array]] = {}
+        new_carries: Dict[str, Any] = {}
         output_set = set(self.conf.network_outputs)
         for name in self.conf.topological_order:
             node = self.conf.nodes[name]
@@ -148,16 +169,26 @@ class ComputationGraph(LazyScoreMixin):
                 out_masks[name] = cur_mask
                 new_states[name] = states[name]
                 continue
-            layer_train = train and not layer.frozen
-            h, s = layer.apply(params[name], h, state=states[name],
-                               train=layer_train, rng=sub, mask=cur_mask)
-            if layer.frozen:
+            if carries is not None and getattr(layer, "supports_carry", False):
+                c_in = carries.get(name)
+                if c_in is None:
+                    c_in = layer.initial_carry(h.shape[0], h.dtype)
+                h, c_out = layer.scan(params[name], h, c_in, cur_mask)
+                new_carries[name] = c_out
                 s = states[name]
+            else:
+                layer_train = train and not layer.frozen
+                h, s = layer.apply(params[name], h, state=states[name],
+                                   train=layer_train, rng=sub, mask=cur_mask)
+                if layer.frozen:
+                    s = states[name]
             acts[name] = h
             # layers that reduce away the time axis consume the mask
             from deeplearning4j_tpu.nn.layers.pooling import GlobalPoolingLayer
             out_masks[name] = None if isinstance(layer, GlobalPoolingLayer) else cur_mask
             new_states[name] = s
+        if carries is not None:
+            return acts, out_masks, new_states, new_carries
         return acts, out_masks, new_states
 
     def _infer_fn(self):
@@ -291,6 +322,22 @@ class ComputationGraph(LazyScoreMixin):
             # same Solver serves ComputationGraph)
             from deeplearning4j_tpu.optimize.solvers import solver_fit_batch
             return solver_fit_batch(self, data)
+        if self.conf.training.backprop_type == "truncated_bptt":
+            first = (data.features if isinstance(data, DataSet)
+                     else data.features[0])
+            first_l = (data.labels if isinstance(data, DataSet)
+                       else data.labels[0])
+            # labels must be time-distributed too: slicing 2D [B, C]
+            # labels per time-slice would silently train every slice
+            # against the full-sequence target (the reference falls back
+            # to standard BPTT with a warning in the same case)
+            if first.ndim == 3 and first_l.ndim == 3:
+                return self._fit_tbptt(data)
+            if first.ndim == 3:
+                import warnings
+                warnings.warn(
+                    "truncated_bptt requires rank-3 (time-distributed) "
+                    "labels; falling back to standard BPTT for this batch")
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
         inputs, labels, masks, lmasks = self._split(data)
@@ -332,6 +379,199 @@ class ComputationGraph(LazyScoreMixin):
                 if isinstance(listener, TrainingListener):
                     listener.on_epoch_end(self)
         return self
+
+    # ------------------------------------------------------------------ tBPTT
+    def _build_tbptt_step(self):
+        tx = self._tx
+        training = self.conf.training
+        fwd = training.tbptt_fwd_length
+        bwd = training.tbptt_bwd_length or fwd
+        outs = self.conf.network_outputs
+
+        def data_loss_of(p, acts_map, out_masks, lbls, lms):
+            total = jnp.zeros(())
+            for out_name in outs:
+                layer = self.conf.nodes[out_name].layer
+                lm = (lms or {}).get(out_name)
+                if lm is None:
+                    lbl = lbls[out_name]
+                    lm = out_masks.get(out_name) if lbl.ndim > 2 else None
+                total = total + layer.compute_loss(
+                    p[out_name], acts_map[out_name], lbls[out_name], mask=lm)
+            return total
+
+        def step(params, opt_state, states, inputs, labels, masks, lmasks,
+                 carries, rng):
+            # bwd < fwd: run the slice head forward-only (stop-gradded
+            # activations + carries), backprop through the last bwd steps
+            # only — same semantics as MultiLayerNetwork._build_tbptt_step
+            # (ref: ComputationGraph.doTruncatedBPTT:2042 shares the MLN
+            # backward time-loop truncation via LSTMHelpers.java:333)
+            T = next(v.shape[1] for v in inputs.values() if v.ndim >= 3)
+            split = max(T - bwd, 0) if bwd < fwd else 0
+
+            def loss_for_grad(p):
+                if split == 0:
+                    acts, om, new_states, new_carries = self._forward(
+                        p, states, inputs, train=True, rng=rng, masks=masks,
+                        carries=carries)
+                    data_loss = data_loss_of(p, acts, om, labels, lmasks)
+                else:
+                    rng1, rng2 = (jax.random.split(rng) if rng is not None
+                                  else (None, None))
+                    head = lambda d, m=3: _time_slice(d, 0, split, m)
+                    tail = lambda d, m=3: _time_slice(d, split, T, m)
+                    acts1, om1, states1, carries1 = self._forward(
+                        p, states, head(inputs), train=True, rng=rng1,
+                        masks=head(masks, 2), carries=carries)
+                    acts1 = jax.tree.map(jax.lax.stop_gradient, acts1)
+                    carries1 = jax.tree.map(jax.lax.stop_gradient, carries1)
+                    acts2, om2, new_states, new_carries = self._forward(
+                        p, states1, tail(inputs), train=True, rng=rng2,
+                        masks=tail(masks, 2), carries=carries1)
+                    # per-timestep losses SUM over time: head + tail ==
+                    # the single-call slice loss
+                    data_loss = (
+                        data_loss_of(p, acts1, om1, head(labels),
+                                     head(lmasks, 2))
+                        + data_loss_of(p, acts2, om2, tail(labels),
+                                       tail(lmasks, 2)))
+                from deeplearning4j_tpu.nn.updater import l1_l2_penalty
+                layer_list = [self.conf.nodes[n].layer
+                              for n in self._layer_nodes]
+                param_list = [p[n] for n in self._layer_nodes]
+                from deeplearning4j_tpu.nn.multilayer import _sum_aux_losses
+                return (data_loss + l1_l2_penalty(param_list, layer_list)
+                        + _sum_aux_losses(new_states),
+                        (new_states, new_carries))
+
+            (loss, (new_states, new_carries)), grads = jax.value_and_grad(
+                loss_for_grad, has_aux=True)(params)
+            layer_list = [self.conf.nodes[n].layer for n in self._layer_nodes]
+            new_params, new_opt = compute_updates(
+                tx, grads, opt_state, params, layer_list, training)
+            # stop gradients across tBPTT boundaries
+            new_carries = jax.tree.map(jax.lax.stop_gradient, new_carries)
+            return new_params, new_opt, new_states, new_carries, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _fit_tbptt(self, data: Union[DataSet, MultiDataSet]) -> float:
+        """Truncated BPTT over time slices, carrying per-node RNN state
+        (ref: ComputationGraph.doTruncatedBPTT:2042-2103)."""
+        if self._tbptt_step_fn is None:
+            self._tbptt_step_fn = self._build_tbptt_step()
+        fwd = self.conf.training.tbptt_fwd_length
+        inputs, labels, masks, lmasks = self._split(data)
+        T = next(v.shape[1] for v in inputs.values() if v.ndim >= 3)
+        B = next(iter(inputs.values())).shape[0]
+        # materialize initial carries so the jit signature is stable
+        carries = {name: self.conf.nodes[name].layer.initial_carry(B)
+                   for name in self._layer_nodes
+                   if getattr(self.conf.nodes[name].layer,
+                              "supports_carry", False)}
+        total, slices = 0.0, 0
+        for start in range(0, T, fwd):
+            end = min(start + fwd, T)
+            self._rng, step_rng = jax.random.split(self._rng)
+            (self.params, self.opt_state, self.states, carries, loss) = \
+                self._tbptt_step_fn(
+                    self.params, self.opt_state, self.states,
+                    _time_slice(inputs, start, end),
+                    _time_slice(labels, start, end),
+                    _time_slice(masks, start, end, 2),
+                    _time_slice(lmasks, start, end, 2),
+                    carries, step_rng)
+            total = total + loss  # device accumulate — no per-slice sync
+            slices += 1
+            self.iteration_count += 1
+            self.score_value = loss
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration_count,
+                                        self.score_value)
+        self.last_batch_size = data.num_examples()
+        return total / max(slices, 1)
+
+    # ------------------------------------------------------- rnn statefulness
+    def rnn_clear_previous_state(self) -> None:
+        self._rnn_carries = None
+
+    def rnn_time_step(self, inputs):
+        """Stateful streaming inference (ref: ComputationGraph.rnnTimeStep:
+        1868 — keeps per-vertex state maps between calls).
+
+        Inputs as in ``outputs()``; [B, F] inputs are treated as one
+        timestep and squeezed back. Returns the single output activation,
+        or a list for multi-output graphs."""
+        self._check_init()
+        in_map = self._to_input_map(inputs)
+        squeeze = all(v.ndim == 2 for v in in_map.values())
+        if squeeze:
+            in_map = {k: v[:, None, :] for k, v in in_map.items()}
+        if self._rnn_carries is None:
+            self._rnn_carries = {}
+        acts, _, _, new_carries = self._forward(
+            self.params, self.states, in_map, train=False, rng=None,
+            stop_before_loss=False, carries=self._rnn_carries)
+        self._rnn_carries = {**self._rnn_carries, **new_carries}
+        outs = [acts[o] for o in self.conf.network_outputs]
+        if squeeze:
+            outs = [o[:, 0] if o.ndim == 3 else o for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    # --------------------------------------------------------------- pretrain
+    def _activations_to(self, target: str, in_map: Dict[str, Array],
+                        masks: Optional[Dict[str, Array]] = None) -> Array:
+        """Inference activations feeding node ``target`` (after its
+        preprocessor) — the graph analog of feedForwardToLayer. Reuses the
+        full mask-aware forward walk so masked sequences see the same
+        activations pretraining as they do training."""
+        node = self.conf.nodes[target]
+        if node.kind != "layer":
+            raise ValueError(f"Node {target!r} is not a layer node")
+        acts, _, _ = self._forward(self.params, self.states, in_map,
+                                   train=False, rng=None, masks=masks,
+                                   stop_before_loss=True)
+        h = acts[node.inputs[0]]
+        if node.preprocessor is not None:
+            h = node.preprocessor.transform(h, None)
+        return h
+
+    def pretrain(self, iterator, epochs: int = 1) -> None:
+        """Greedy layerwise pretraining over the topological order
+        (ref: ComputationGraph.pretrain:527-545)."""
+        self._check_init()
+        for name in self._layer_nodes:
+            self.pretrain_layer(name, iterator, epochs=epochs)
+
+    def pretrain_layer(self, name: str, iterator, epochs: int = 1) -> None:
+        """Pretrain one layer node on the activations of the subgraph
+        below it (ref: ComputationGraph.pretrainLayer:547-579). Layers
+        that are not pretrainable (no AE/RBM/VAE objective) are skipped,
+        as the reference does."""
+        self._check_init()
+        from deeplearning4j_tpu.nn.layers.core import RBM, AutoEncoder
+        from deeplearning4j_tpu.nn.layers.variational import (
+            VariationalAutoencoder)
+
+        layer = self.conf.nodes[name].layer
+        if not isinstance(layer, (RBM, AutoEncoder, VariationalAutoencoder)):
+            return
+        from deeplearning4j_tpu.nn.netcommon import make_pretrain_step
+        tx = build_optimizer(self.conf.training)
+        layer_opt = tx.init(self.params[name])
+        step = make_pretrain_step(layer, tx)
+
+        p = self.params[name]
+        for _ in range(epochs):
+            iterator.reset()
+            for batch in iterator:
+                inputs, _, masks, _ = self._split(batch)
+                x = self._activations_to(name, inputs, masks)
+                self._rng, k = jax.random.split(self._rng)
+                p, layer_opt, loss = step(p, layer_opt, x, k)
+                self.score_value = loss
+        self.params[name] = p
 
     # ----------------------------------------------------------- param access
     def num_params(self) -> int:

@@ -308,7 +308,14 @@ class MultiLayerNetwork(LazyScoreMixin):
             self._train_step_fn = self._build_train_step()
         if (self.conf.training.backprop_type == "truncated_bptt"
                 and dataset.features.ndim == 3):
-            return self._fit_tbptt(dataset)
+            if dataset.labels.ndim == 3:
+                return self._fit_tbptt(dataset)
+            # 2D labels would be sliced on the class axis — see the
+            # ComputationGraph.fit_batch gate
+            import warnings
+            warnings.warn(
+                "truncated_bptt requires rank-3 (time-distributed) labels; "
+                "falling back to standard BPTT for this batch")
         self._rng, step_rng = jax.random.split(self._rng)
         fmask = None if dataset.features_mask is None else jnp.asarray(dataset.features_mask)
         lmask = None if dataset.labels_mask is None else jnp.asarray(dataset.labels_mask)
@@ -470,21 +477,10 @@ class MultiLayerNetwork(LazyScoreMixin):
             is_pretrainable = isinstance(layer, (RBM, AutoEncoder, VariationalAutoencoder))
             if not is_pretrainable:
                 continue
+            from deeplearning4j_tpu.nn.netcommon import make_pretrain_step
             tx = build_optimizer(self.conf.training)
             layer_opt = tx.init(self.params[idx])
-
-            if isinstance(layer, RBM):
-                def step(p, opt, x, rng, _layer=layer, _tx=tx):
-                    grads, err = _layer.cd_gradients(p, x, rng=rng)
-                    updates, opt = _tx.update(grads, opt, p)
-                    return jax.tree.map(lambda a, u: a + u, p, updates), opt, err
-            else:
-                def step(p, opt, x, rng, _layer=layer, _tx=tx):
-                    loss, grads = jax.value_and_grad(
-                        lambda pp: _layer.pretrain_loss(pp, x, rng=rng))(p)
-                    updates, opt = _tx.update(grads, opt, p)
-                    return jax.tree.map(lambda a, u: a + u, p, updates), opt, loss
-            step = jax.jit(step)
+            step = make_pretrain_step(layer, tx)
 
             for _ in range(epochs):
                 iterator.reset()

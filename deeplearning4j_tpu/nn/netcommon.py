@@ -47,3 +47,26 @@ class LazyScoreMixin:
 def jit_init(build, seed: int):
     """Run ``build(key) -> (params, opt_state)`` as one jitted program."""
     return jax.jit(build)(jax.random.PRNGKey(seed))
+
+
+def make_pretrain_step(layer, tx):
+    """Jitted single-layer pretraining step for the greedy layerwise walk
+    both containers run (ref: MultiLayerNetwork.pretrain /
+    ComputationGraph.pretrainLayer:547-579): RBM layers step on CD
+    gradients, AE/VAE layers on grad of their reconstruction/ELBO loss.
+
+    Returns ``step(params, opt_state, x, rng) -> (params, opt_state,
+    loss)``.
+    """
+    if hasattr(layer, "cd_gradients"):  # RBM: contrastive divergence
+        def step(p, opt, x, rng):
+            grads, err = layer.cd_gradients(p, x, rng=rng)
+            updates, opt = tx.update(grads, opt, p)
+            return jax.tree.map(lambda a, u: a + u, p, updates), opt, err
+    else:
+        def step(p, opt, x, rng):
+            loss, grads = jax.value_and_grad(
+                lambda pp: layer.pretrain_loss(pp, x, rng=rng))(p)
+            updates, opt = tx.update(grads, opt, p)
+            return jax.tree.map(lambda a, u: a + u, p, updates), opt, loss
+    return jax.jit(step)
