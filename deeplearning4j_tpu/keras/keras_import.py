@@ -38,9 +38,10 @@ from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex, MergeVertex
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.graph import ComputationGraph
 from deeplearning4j_tpu.nn.layers import (
-    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
-    DropoutLayer, EmbeddingLayer, GlobalPoolingLayer, LSTM, OutputLayer,
-    SubsamplingLayer, ZeroPaddingLayer,
+    GRU, LSTM, ActivationLayer, BatchNormalization, ConvolutionLayer,
+    DenseLayer, DropoutLayer, EmbeddingLayer, GlobalPoolingLayer,
+    OutputLayer, PermuteLayer, RepeatVectorLayer, ReshapeLayer, SimpleRnn,
+    SubsamplingLayer, TimeDistributedLayer, ZeroPaddingLayer,
 )
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
@@ -121,6 +122,34 @@ class KerasLayerMapper:
                                                      cfg.get("inner_activation",
                                                              "sigmoid"))),
                         forget_gate_bias_init=0.0)
+        if class_name == "GRU":
+            units = cfg.get("units", cfg.get("output_dim"))
+            # Keras >= 2.1 always writes reset_after; its absence means a
+            # legacy (Keras 1.x) config whose math is reset-BEFORE
+            return GRU(n_out=int(units),
+                       activation=_act(cfg.get("activation", "tanh")),
+                       gate_activation=_act(cfg.get("recurrent_activation",
+                                                    cfg.get("inner_activation",
+                                                            "sigmoid"))),
+                       reset_after=bool(cfg.get("reset_after", False)))
+        if class_name == "SimpleRNN":
+            units = cfg.get("units", cfg.get("output_dim"))
+            return SimpleRnn(n_out=int(units),
+                             activation=_act(cfg.get("activation", "tanh")))
+        if class_name == "Reshape":
+            return ReshapeLayer(target_shape=tuple(cfg["target_shape"]))
+        if class_name == "Permute":
+            return PermuteLayer(dims=tuple(cfg["dims"]))
+        if class_name == "RepeatVector":
+            return RepeatVectorLayer(n=int(cfg["n"]))
+        if class_name == "TimeDistributed":
+            inner_cfg = cfg["layer"]
+            inner = KerasLayerMapper.map(inner_cfg["class_name"],
+                                         _cfg(inner_cfg))
+            if isinstance(inner, str) or not hasattr(inner, "apply"):
+                raise ValueError(
+                    f"TimeDistributed({inner_cfg['class_name']}) unsupported")
+            return TimeDistributedLayer(inner=inner)
         if class_name == "Embedding":
             return EmbeddingLayer(n_out=int(cfg.get("output_dim")),
                                   n_in=int(cfg.get("input_dim")),
@@ -368,8 +397,8 @@ class KerasModelImport:
             gb.add_layer(name, mapped, *srcs)
             alias[name] = name
             kept_names.append(name)
-            if isinstance(mapped, LSTM) and not kcfg.get("return_sequences",
-                                                         False):
+            if isinstance(mapped, (LSTM, GRU, SimpleRnn)) \
+                    and not kcfg.get("return_sequences", False):
                 # Keras LSTM default emits only the final step; ours emits
                 # the sequence — append a LastTimeStepVertex
                 from deeplearning4j_tpu.nn.conf.graph import LastTimeStepVertex
@@ -426,8 +455,8 @@ class KerasModelImport:
             if mapped in ("flatten", "input"):
                 continue  # flatten == our auto CnnToFeedForward preprocessor
             kept.append((lc, mapped))
-            if isinstance(mapped, LSTM) and not cfg.get("return_sequences",
-                                                        False):
+            if isinstance(mapped, (LSTM, GRU, SimpleRnn)) \
+                    and not cfg.get("return_sequences", False):
                 # Keras LSTM default emits only the final step; ours emits
                 # the sequence — append a param-free LastTimeStepLayer whose
                 # synthetic name has no weight group in the h5 (skipped by
@@ -514,6 +543,33 @@ class KerasModelImport:
                 put("W", W)
                 put("RW", U)
                 put("b", bvec)
+        elif isinstance(layer, GRU):
+            if "kernel" in ds:  # Keras 2+: fused (z, r, h) == our order
+                put("W", ds["kernel"])
+                put("RW", ds["recurrent_kernel"])
+                bias = ds.get("bias")
+                if bias is not None:
+                    if bias.ndim == 2:  # reset_after: [input; recurrent]
+                        put("b", bias[0])
+                        put("b2", bias[1])
+                    else:
+                        put("b", bias)
+            else:  # Keras 1: per-gate W_z/U_z/b_z...
+                put("W", np.concatenate([ds["W_z"], ds["W_r"], ds["W_h"]],
+                                        axis=-1))
+                put("RW", np.concatenate([ds["U_z"], ds["U_r"], ds["U_h"]],
+                                         axis=-1))
+                put("b", np.concatenate([ds["b_z"], ds["b_r"], ds["b_h"]]))
+        elif isinstance(layer, SimpleRnn):
+            put("W", ds.get("kernel", ds.get("W")))
+            put("RW", ds.get("recurrent_kernel", ds.get("U")))
+            if "bias" in ds or "b" in ds:
+                put("b", ds.get("bias", ds.get("b")))
+        elif isinstance(layer, TimeDistributedLayer):
+            # Keras nests the wrapped layer's weights directly under the
+            # TimeDistributed group; our param dict IS the inner layer's
+            KerasModelImport._set_layer_weights(net, li, layer.inner, ds)
+            return
         elif isinstance(layer, EmbeddingLayer):
             put("W", ds.get("embeddings", ds.get("W")))
             # Keras embeddings have no bias; ours stays zero

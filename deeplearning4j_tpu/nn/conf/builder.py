@@ -44,10 +44,11 @@ from deeplearning4j_tpu.nn.weights import Distribution
 _CNN_LAYERS = {"ConvolutionLayer", "SubsamplingLayer", "ZeroPaddingLayer",
                "LocalResponseNormalization"}
 _RNN_LAYERS = {"LSTM", "GravesLSTM", "GravesBidirectionalLSTM", "SimpleRnn",
-               "RnnOutputLayer", "Convolution1DLayer", "Subsampling1DLayer",
-               "SelfAttentionLayer", "LastTimeStepLayer"}
+               "GRU", "RnnOutputLayer", "Convolution1DLayer",
+               "Subsampling1DLayer", "SelfAttentionLayer",
+               "LastTimeStepLayer", "TimeDistributedLayer"}
 _ANY_LAYERS = {"BatchNormalization", "GlobalPoolingLayer", "ActivationLayer",
-               "DropoutLayer", "LossLayer"}
+               "DropoutLayer", "LossLayer", "ReshapeLayer", "PermuteLayer"}
 
 
 def expected_input_kind(layer: BaseLayerConf) -> str:

@@ -118,7 +118,8 @@ class ComputationGraph(LazyScoreMixin):
     def _forward(self, params, states, inputs: Dict[str, Array], *,
                  train: bool, rng, masks: Optional[Dict[str, Array]] = None,
                  stop_before_loss: bool = True,
-                 carries: Optional[Dict[str, Any]] = None):
+                 carries: Optional[Dict[str, Any]] = None,
+                 subset: Optional[set] = None):
         """Walk the DAG in topological order.
 
         Returns (activations dict, masks dict, new_states). For output-layer
@@ -137,6 +138,8 @@ class ComputationGraph(LazyScoreMixin):
         new_carries: Dict[str, Any] = {}
         output_set = set(self.conf.network_outputs)
         for name in self.conf.topological_order:
+            if subset is not None and name not in subset:
+                continue
             node = self.conf.nodes[name]
             if node.kind == "input":
                 acts[name] = inputs[name]
@@ -173,6 +176,9 @@ class ComputationGraph(LazyScoreMixin):
                 c_in = carries.get(name)
                 if c_in is None:
                     c_in = layer.initial_carry(h.shape[0], h.dtype)
+                # scan() bypasses apply(): input dropout must still fire
+                # so tBPTT training regularizes like standard BPTT
+                h = layer._dropout_input(h, train and not layer.frozen, sub)
                 h, c_out = layer.scan(params[name], h, c_in, cur_mask)
                 new_carries[name] = c_out
                 s = states[name]
@@ -230,10 +236,10 @@ class ComputationGraph(LazyScoreMixin):
         return {names[0]: jnp.asarray(inputs)}
 
     # ------------------------------------------------------------------- loss
-    def _loss_fn(self, params, states, inputs, labels: Dict[str, Array],
-                 masks, label_masks, rng, train=True):
-        acts, out_masks, new_states = self._forward(
-            params, states, inputs, train=train, rng=rng, masks=masks)
+    def _data_loss(self, params, acts, out_masks, labels: Dict[str, Array],
+                   label_masks) -> Array:
+        """Sum of output-head losses (shared by the standard and tBPTT
+        steps so the mask-fallback semantics cannot diverge)."""
         total = jnp.zeros(())
         for out_name in self.conf.network_outputs:
             layer = self.conf.nodes[out_name].layer
@@ -243,8 +249,16 @@ class ComputationGraph(LazyScoreMixin):
             if lm is None:
                 lbl = labels[out_name]
                 lm = out_masks.get(out_name) if lbl.ndim > 2 else None
-            total = total + layer.compute_loss(params[out_name], acts[out_name],
+            total = total + layer.compute_loss(params[out_name],
+                                               acts[out_name],
                                                labels[out_name], mask=lm)
+        return total
+
+    def _loss_fn(self, params, states, inputs, labels: Dict[str, Array],
+                 masks, label_masks, rng, train=True):
+        acts, out_masks, new_states = self._forward(
+            params, states, inputs, train=train, rng=rng, masks=masks)
+        total = self._data_loss(params, acts, out_masks, labels, label_masks)
         # L1/L2 over all layer params (score = Σ output losses + reg;
         # ref: CG.computeGradientAndScore:1016-1028)
         from deeplearning4j_tpu.nn.updater import l1_l2_penalty
@@ -325,19 +339,20 @@ class ComputationGraph(LazyScoreMixin):
         if self.conf.training.backprop_type == "truncated_bptt":
             first = (data.features if isinstance(data, DataSet)
                      else data.features[0])
-            first_l = (data.labels if isinstance(data, DataSet)
-                       else data.labels[0])
-            # labels must be time-distributed too: slicing 2D [B, C]
-            # labels per time-slice would silently train every slice
-            # against the full-sequence target (the reference falls back
-            # to standard BPTT with a warning in the same case)
-            if first.ndim == 3 and first_l.ndim == 3:
+            all_labels = ([data.labels] if isinstance(data, DataSet)
+                          else list(data.labels))
+            # EVERY label must be time-distributed: a rank-2 [B, C] label
+            # would pass through _time_slice unsliced and silently train
+            # its head every slice against the full-sequence target (the
+            # reference falls back to standard BPTT with a warning here)
+            if first.ndim == 3 and all(l.ndim == 3 for l in all_labels):
                 return self._fit_tbptt(data)
             if first.ndim == 3:
                 import warnings
                 warnings.warn(
                     "truncated_bptt requires rank-3 (time-distributed) "
-                    "labels; falling back to standard BPTT for this batch")
+                    "labels on every output; falling back to standard "
+                    "BPTT for this batch")
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
         inputs, labels, masks, lmasks = self._split(data)
@@ -386,19 +401,7 @@ class ComputationGraph(LazyScoreMixin):
         training = self.conf.training
         fwd = training.tbptt_fwd_length
         bwd = training.tbptt_bwd_length or fwd
-        outs = self.conf.network_outputs
-
-        def data_loss_of(p, acts_map, out_masks, lbls, lms):
-            total = jnp.zeros(())
-            for out_name in outs:
-                layer = self.conf.nodes[out_name].layer
-                lm = (lms or {}).get(out_name)
-                if lm is None:
-                    lbl = lbls[out_name]
-                    lm = out_masks.get(out_name) if lbl.ndim > 2 else None
-                total = total + layer.compute_loss(
-                    p[out_name], acts_map[out_name], lbls[out_name], mask=lm)
-            return total
+        data_loss_of = self._data_loss
 
         def step(params, opt_state, states, inputs, labels, masks, lmasks,
                  carries, rng):
@@ -520,22 +523,45 @@ class ComputationGraph(LazyScoreMixin):
         return outs[0] if len(outs) == 1 else outs
 
     # --------------------------------------------------------------- pretrain
+    def _ancestors(self, target: str) -> set:
+        """Ancestor closure of ``target`` (exclusive), for partial walks."""
+        seen: set = set()
+        stack = list(self.conf.nodes[target].inputs)
+        while stack:
+            n = stack.pop()
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(self.conf.nodes[n].inputs)
+        return seen
+
     def _activations_to(self, target: str, in_map: Dict[str, Array],
                         masks: Optional[Dict[str, Array]] = None) -> Array:
         """Inference activations feeding node ``target`` (after its
-        preprocessor) — the graph analog of feedForwardToLayer. Reuses the
-        full mask-aware forward walk so masked sequences see the same
-        activations pretraining as they do training."""
+        preprocessor) — the graph analog of feedForwardToLayer. Walks only
+        the target's ancestor subgraph, mask-aware, as ONE jitted program
+        per target (eager per-op dispatch would be pathological on a
+        remote-TPU link; see init())."""
         node = self.conf.nodes[target]
         if node.kind != "layer":
             raise ValueError(f"Node {target!r} is not a layer node")
-        acts, _, _ = self._forward(self.params, self.states, in_map,
-                                   train=False, rng=None, masks=masks,
-                                   stop_before_loss=True)
-        h = acts[node.inputs[0]]
-        if node.preprocessor is not None:
-            h = node.preprocessor.transform(h, None)
-        return h
+        cache = getattr(self, "_act_to_fns", None)
+        if cache is None:
+            cache = self._act_to_fns = {}
+        if target not in cache:
+            subset = self._ancestors(target)
+
+            def fn(params, states, inputs, msks, _subset=subset):
+                acts, _, _ = self._forward(params, states, inputs,
+                                           train=False, rng=None, masks=msks,
+                                           stop_before_loss=True,
+                                           subset=_subset)
+                h = acts[node.inputs[0]]
+                if node.preprocessor is not None:
+                    h = node.preprocessor.transform(h, None)
+                return h
+            cache[target] = jax.jit(fn)
+        return cache[target](self.params, self.states, in_map, masks)
 
     def pretrain(self, iterator, epochs: int = 1) -> None:
         """Greedy layerwise pretraining over the topological order

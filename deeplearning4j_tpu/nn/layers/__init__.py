@@ -39,12 +39,19 @@ from deeplearning4j_tpu.nn.layers.normalization import (  # noqa: F401
 )
 from deeplearning4j_tpu.nn.layers.pooling import GlobalPoolingLayer  # noqa: F401
 from deeplearning4j_tpu.nn.layers.recurrent import (  # noqa: F401
+    GRU,
     LSTM,
     GravesLSTM,
     GravesBidirectionalLSTM,
     LastTimeStepLayer,
     RnnOutputLayer,
     SimpleRnn,
+)
+from deeplearning4j_tpu.nn.layers.shape import (  # noqa: F401
+    PermuteLayer,
+    RepeatVectorLayer,
+    ReshapeLayer,
+    TimeDistributedLayer,
 )
 from deeplearning4j_tpu.nn.layers.variational import VariationalAutoencoder  # noqa: F401
 from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer  # noqa: F401

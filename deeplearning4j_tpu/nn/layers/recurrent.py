@@ -287,6 +287,96 @@ class SimpleRnn(BaseLayerConf):
 
 @register_layer
 @dataclass
+class GRU(BaseLayerConf):
+    """Gated recurrent unit, Keras-compatible gate layout (z, r, h blocks
+    in ``W``/``RW``/``b``).
+
+    ``reset_after=True`` (Keras >= 2.1 default, what CuDNN implements)
+    applies the reset gate AFTER the recurrent matmul and keeps a second
+    recurrent bias ``b2``; ``False`` is the classic formulation. The
+    reference imports Keras GRUs through KerasLayer.java's recurrent
+    mapping (ref: deeplearning4j-modelimport/.../KerasLayer.java).
+    """
+    n_out: int = 0
+    gate_activation: str = "sigmoid"
+    reset_after: bool = True
+
+    supports_carry = True
+
+    def set_n_in(self, in_type: InputType) -> None:
+        if in_type.kind != "rnn":
+            raise ValueError(f"GRU expects RNN input, got {in_type}")
+        self.n_in = in_type.size
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, in_type.timesteps)
+
+    def param_order(self) -> List[str]:
+        return ["W", "RW", "b"] + (["b2"] if self.reset_after else [])
+
+    def init_params(self, rng, dtype=jnp.float32) -> Params:
+        H = self.n_out
+        k1, k2 = jax.random.split(rng)
+        fan_in, fan_out = self.n_in + H, 3 * H
+        p = {
+            "W": self._init_w(k1, (self.n_in, 3 * H), fan_in, fan_out, dtype),
+            "RW": self._init_w(k2, (H, 3 * H), fan_in, fan_out, dtype),
+            "b": jnp.zeros((3 * H,), dtype),
+        }
+        if self.reset_after:
+            p["b2"] = jnp.zeros((3 * H,), dtype)
+        return p
+
+    def initial_carry(self, batch: int, dtype=jnp.float32):
+        return jnp.zeros((batch, self.n_out), dtype)
+
+    def _cell(self, params, x_t, h):
+        H = self.n_out
+        gate = get_activation(self.gate_activation)
+        act = get_activation(self.activation or "tanh")
+        xz = x_t @ params["W"] + params["b"]
+        if self.reset_after:
+            hz = h @ params["RW"] + params["b2"]
+            z = gate(xz[:, :H] + hz[:, :H])
+            r = gate(xz[:, H:2 * H] + hz[:, H:2 * H])
+            hh = act(xz[:, 2 * H:] + r * hz[:, 2 * H:])
+        else:
+            hz = h @ params["RW"][:, :2 * H]
+            z = gate(xz[:, :H] + hz[:, :H])
+            r = gate(xz[:, H:2 * H] + hz[:, H:])
+            hh = act(xz[:, 2 * H:] + (r * h) @ params["RW"][:, 2 * H:])
+        return z * h + (1.0 - z) * hh  # Keras update convention
+
+    def step(self, params, x_t, carry):
+        h = self._cell(params, x_t, carry)
+        return h, h
+
+    def scan(self, params, x, carry, mask: Optional[Array] = None,
+             reverse: bool = False):
+        def body(h, inp):
+            if mask is None:
+                h2 = self._cell(params, inp, h)
+                return h2, h2
+            x_t, m_t = inp
+            h2 = self._cell(params, x_t, h)
+            m = m_t[:, None]
+            h2 = m * h2 + (1 - m) * h
+            return h2, m * h2
+
+        xs = jnp.swapaxes(x, 0, 1)
+        inputs = xs if mask is None else (xs, jnp.swapaxes(mask, 0, 1))
+        final, ys = jax.lax.scan(body, carry, inputs, reverse=reverse)
+        return jnp.swapaxes(ys, 0, 1), final
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        x = self._dropout_input(x, train, rng)
+        ys, _ = self.scan(params, x,
+                          self.initial_carry(x.shape[0], x.dtype), mask)
+        return ys, state
+
+
+@register_layer
+@dataclass
 class RnnOutputLayer(BaseLayerConf):
     """Per-timestep dense + loss over [B, T, F]
     (ref: nn/layers/recurrent/RnnOutputLayer.java — 2D reshape + OutputLayer;

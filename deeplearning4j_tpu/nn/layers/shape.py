@@ -1,0 +1,172 @@
+"""Shape-manipulation layers (Keras-import parity).
+
+The reference's Keras importer maps Reshape/Permute/RepeatVector and the
+TimeDistributed wrapper (ref: deeplearning4j-modelimport/.../keras/
+KerasLayer.java — the "preprocessor/wrapper" section of its 1189 lines);
+DL4J models them as InputPreProcessors or wrapper layers. Here each is a
+param-free (or delegating) layer conf so both containers and the graph
+builder's shape resolution can use them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import (
+    BaseLayerConf, layer_from_dict, register_layer,
+)
+
+
+def _type_from_dims(dims: Tuple[int, ...]) -> InputType:
+    """Keras semantics: (F) -> ff, (T, F) -> rnn, (H, W, C) -> cnn."""
+    if len(dims) == 1:
+        return InputType.feed_forward(dims[0])
+    if len(dims) == 2:
+        return InputType.recurrent(dims[1], dims[0])
+    if len(dims) == 3:
+        return InputType.convolutional(dims[0], dims[1], dims[2])
+    raise ValueError(f"Cannot type a rank-{len(dims)} per-example shape")
+
+
+def _dims_of(t: InputType) -> Tuple[int, ...]:
+    if t.kind in ("ff", "cnnflat"):
+        return (t.flat_size(),)
+    if t.kind == "rnn":
+        return (t.timesteps, t.size)
+    if t.kind == "cnn":
+        return (t.height, t.width, t.channels)
+    raise ValueError(t.kind)
+
+
+@register_layer
+@dataclass
+class ReshapeLayer(BaseLayerConf):
+    """Per-example reshape (Keras ``Reshape(target_shape)``)."""
+    target_shape: Tuple[int, ...] = ()
+
+    def set_n_in(self, in_type: InputType) -> None:
+        self.n_in = in_type.flat_size()
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        n = 1
+        for d in self.target_shape:
+            n *= int(d)
+        if in_type.kind in ("ff", "cnnflat", "cnn") \
+                and in_type.flat_size() != n:
+            raise ValueError(
+                f"Reshape {self.target_shape} has {n} elements, input "
+                f"has {in_type.flat_size()}")
+        return _type_from_dims(tuple(self.target_shape))
+
+    def param_order(self) -> List[str]:
+        return []
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        return x.reshape((x.shape[0],) + tuple(self.target_shape)), state
+
+
+@register_layer
+@dataclass
+class PermuteLayer(BaseLayerConf):
+    """Per-example axis permutation (Keras ``Permute(dims)``, 1-indexed
+    over the non-batch axes)."""
+    dims: Tuple[int, ...] = ()
+
+    def set_n_in(self, in_type: InputType) -> None:
+        self.n_in = in_type.flat_size()
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        src = _dims_of(in_type)
+        if len(self.dims) != len(src):
+            raise ValueError(
+                f"Permute dims {self.dims} rank != input rank {len(src)}")
+        return _type_from_dims(tuple(src[d - 1] for d in self.dims))
+
+    def param_order(self) -> List[str]:
+        return []
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        perm = (0,) + tuple(d for d in self.dims)
+        return jnp.transpose(x, perm), state
+
+
+@register_layer
+@dataclass
+class RepeatVectorLayer(BaseLayerConf):
+    """[B, F] -> [B, n, F] (Keras ``RepeatVector(n)``)."""
+    n: int = 1
+
+    def set_n_in(self, in_type: InputType) -> None:
+        if in_type.kind not in ("ff", "cnnflat"):
+            raise ValueError(f"RepeatVector expects 2D input, got {in_type}")
+        self.n_in = in_type.flat_size()
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        return InputType.recurrent(in_type.flat_size(), self.n)
+
+    def param_order(self) -> List[str]:
+        return []
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        return jnp.repeat(x[:, None, :], self.n, axis=1), state
+
+
+@register_layer
+@dataclass
+class TimeDistributedLayer(BaseLayerConf):
+    """Apply an inner feed-forward layer independently per timestep
+    (Keras ``TimeDistributed(layer)``): [B, T, ...] -> flatten time into
+    batch -> inner -> unflatten."""
+    inner: Optional[BaseLayerConf] = None
+
+    def __post_init__(self):
+        # JSON round-trip: inner arrives as a plain dict
+        if isinstance(self.inner, dict):
+            self.inner = layer_from_dict(self.inner)
+
+    def apply_global_defaults(self, g) -> None:
+        super().apply_global_defaults(g)
+        self.inner.apply_global_defaults(g)
+
+    def set_n_in(self, in_type: InputType) -> None:
+        if in_type.kind != "rnn":
+            raise ValueError(
+                f"TimeDistributed expects RNN input, got {in_type}")
+        self.n_in = in_type.size
+        self.inner.set_n_in(InputType.feed_forward(in_type.size))
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        inner_out = self.inner.infer_output_type(
+            InputType.feed_forward(in_type.size))
+        return InputType.recurrent(inner_out.flat_size(), in_type.timesteps)
+
+    def has_params(self) -> bool:
+        return self.inner.has_params()
+
+    def param_order(self) -> List[str]:
+        return self.inner.param_order()
+
+    def init_params(self, rng, dtype=jnp.float32):
+        return self.inner.init_params(rng, dtype)
+
+    def init_state(self):
+        return self.inner.init_state()
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        B, T = x.shape[0], x.shape[1]
+        flat = x.reshape((B * T,) + x.shape[2:])
+        out, new_state = self.inner.apply(params, flat, state=state,
+                                          train=train, rng=rng, mask=None)
+        out = out.reshape((B, T) + out.shape[1:])
+        if mask is not None:
+            out = out * mask[..., None]
+        return out, new_state
+
+    def to_dict(self) -> dict:
+        d = super().to_dict()
+        d["inner"] = self.inner.to_dict()
+        return d

@@ -161,6 +161,9 @@ class MultiLayerNetwork(LazyScoreMixin):
                 c_in = carries[i]
                 if c_in is None:
                     c_in = layer.initial_carry(h.shape[0], h.dtype)
+                # scan() bypasses apply(): input dropout must still fire
+                # so tBPTT training regularizes like standard BPTT
+                h = layer._dropout_input(h, train and not layer.frozen, sub)
                 h, c_out = layer.scan(params[i], h, c_in, cur_mask)
                 new_carries[i] = c_out
                 s = states[i]

@@ -118,6 +118,48 @@ def main():
     goldens["two_y"] = two.predict([xa, xb], verbose=0)
     two.save(os.path.join(HERE, "keras_two_input.h5"))
 
+    # --- GRU + SimpleRNN (Sequential) --------------------------------------
+    gru = keras.Sequential(name="grunet", layers=[
+        layers.Input(shape=(7, 5), name="in_gru"),
+        layers.GRU(10, return_sequences=True, name="gru_1"),
+        layers.SimpleRNN(8, return_sequences=False, name="srnn_1"),
+        layers.Dense(4, activation="softmax", name="gru_out"),
+    ])
+    x = np.random.default_rng(5).normal(size=(4, 7, 5)).astype(np.float32)
+    goldens["gru_x"] = x
+    goldens["gru_y"] = gru.predict(x, verbose=0)
+    gru.save(os.path.join(HERE, "keras_gru.h5"))
+
+    # --- shape ops: Reshape/Permute/TimeDistributed (Sequential) ------------
+    shp = keras.Sequential(name="shapes", layers=[
+        layers.Input(shape=(12,), name="in_s"),
+        layers.Dense(12, activation="relu", name="s_d1"),
+        layers.Reshape((3, 4), name="s_rs"),
+        layers.Permute((2, 1), name="s_pm"),
+        layers.TimeDistributed(layers.Dense(5, activation="tanh"),
+                               name="s_td"),
+        layers.LSTM(6, return_sequences=False, name="s_lstm",
+                    unit_forget_bias=False),
+        layers.Dense(3, activation="softmax", name="s_out"),
+    ])
+    x = np.random.default_rng(6).normal(size=(4, 12)).astype(np.float32)
+    goldens["shapes_x"] = x
+    goldens["shapes_y"] = shp.predict(x, verbose=0)
+    shp.save(os.path.join(HERE, "keras_shapes.h5"))
+
+    # --- RepeatVector -> GRU (Sequential) -----------------------------------
+    rep = keras.Sequential(name="repeatnet", layers=[
+        layers.Input(shape=(6,), name="in_r"),
+        layers.Dense(8, activation="relu", name="r_d1"),
+        layers.RepeatVector(4, name="r_rv"),
+        layers.GRU(7, return_sequences=False, name="r_gru"),
+        layers.Dense(3, activation="softmax", name="r_out"),
+    ])
+    x = np.random.default_rng(7).normal(size=(4, 6)).astype(np.float32)
+    goldens["repeat_x"] = x
+    goldens["repeat_y"] = rep.predict(x, verbose=0)
+    rep.save(os.path.join(HERE, "keras_repeat.h5"))
+
     np.savez(os.path.join(HERE, "keras_goldens.npz"), **goldens)
     print("wrote fixtures:", sorted(goldens.keys()))
 

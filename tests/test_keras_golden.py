@@ -72,6 +72,30 @@ def test_two_input_functional_golden(goldens):
     np.testing.assert_allclose(out, goldens["two_y"], atol=1e-5)
 
 
+def test_gru_simplernn_sequential_golden(goldens):
+    """GRU (reset_after, fused 2x3H bias) + SimpleRNN + last-step squeeze."""
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        _fixture("keras_gru.h5"))
+    out = np.asarray(net.output(goldens["gru_x"]))
+    np.testing.assert_allclose(out, goldens["gru_y"], atol=1e-4)
+
+
+def test_shape_layers_sequential_golden(goldens):
+    """Reshape -> Permute -> TimeDistributed(Dense) -> LSTM chain."""
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        _fixture("keras_shapes.h5"))
+    out = np.asarray(net.output(goldens["shapes_x"]))
+    np.testing.assert_allclose(out, goldens["shapes_y"], atol=1e-4)
+
+
+def test_repeat_vector_sequential_golden(goldens):
+    """Dense -> RepeatVector -> GRU."""
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        _fixture("keras_repeat.h5"))
+    out = np.asarray(net.output(goldens["repeat_x"]))
+    np.testing.assert_allclose(out, goldens["repeat_y"], atol=1e-4)
+
+
 def test_functional_entry_delegates_sequential(goldens):
     """import_keras_model_and_weights on a Sequential file delegates."""
     net = KerasModelImport.import_keras_model_and_weights(

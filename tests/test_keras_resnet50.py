@@ -1,0 +1,52 @@
+"""Import a REAL keras.applications.ResNet50 (BASELINE config #3,
+VERDICT r2 #4).
+
+The fixture is generated at test time with the environment's genuine
+Keras (seeded, weights=None — ~100MB of weights stay out of git); golden
+predictions come from Keras itself. Ref:
+deeplearning4j-modelimport/.../keras/KerasModelEndToEndTest.java (the
+reference's importKerasModelAndWeights end-to-end goldens).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.keras.keras_import import KerasModelImport
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+
+@pytest.fixture(scope="module")
+def resnet50_h5(tmp_path_factory):
+    keras = pytest.importorskip("keras")
+    keras.utils.set_random_seed(42)
+    model = keras.applications.ResNet50(weights=None)
+    path = str(tmp_path_factory.mktemp("rn50") / "resnet50.h5")
+    model.save(path)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 224, 224, 3)).astype(np.float32)
+    y = model.predict(x, verbose=0)
+    return path, x, y
+
+
+def test_resnet50_import_matches_keras(resnet50_h5):
+    path, x, y = resnet50_h5
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    assert isinstance(net, ComputationGraph)
+    # keras counts 25,636,712 incl. BN moving stats (53,120), which live
+    # in net.states here, not params
+    assert net.num_params() == 25_583_592
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 1000)
+    np.testing.assert_allclose(out, y, atol=1e-3)
+
+
+def test_resnet50_import_is_trainable(resnet50_h5):
+    """The imported graph takes a finite training step (OutputLayer
+    conversion of the fc1000 head)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    path, x, _ = resnet50_h5
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    labels = np.eye(1000, dtype=np.float32)[[3, 7]]
+    loss = net.fit_batch(DataSet(x, labels))
+    assert np.isfinite(float(loss))
