@@ -76,6 +76,12 @@ def _synthetic_mnist(n: int, seed: int = 123) -> Tuple[np.ndarray, np.ndarray]:
         templates = (templates
                      + np.roll(templates, 1, axis=1) + np.roll(templates, -1, axis=1)
                      + np.roll(templates, 1, axis=2) + np.roll(templates, -1, axis=2)) / 5.0
+    # stretch each template to full [0, 1] contrast — blurring uniform
+    # noise collapses everything toward 0.5, leaving class signal far
+    # below the additive noise and making the fallback task unlearnable
+    tmin = templates.min(axis=(1, 2), keepdims=True)
+    tmax = templates.max(axis=(1, 2), keepdims=True)
+    templates = (templates - tmin) / np.maximum(tmax - tmin, 1e-6)
     labels = rng.integers(0, 10, size=n)
     imgs = templates[labels] + 0.35 * rng.normal(size=(n, 28, 28)).astype(np.float32)
     imgs = np.clip(imgs, 0.0, 1.0).astype(np.float32)

@@ -189,9 +189,8 @@ class ComputationGraph(LazyScoreMixin):
                 if layer.frozen:
                     s = states[name]
             acts[name] = h
-            # layers that reduce away the time axis consume the mask
-            from deeplearning4j_tpu.nn.layers.pooling import GlobalPoolingLayer
-            out_masks[name] = None if isinstance(layer, GlobalPoolingLayer) else cur_mask
+            # layers that consume or rearrange the time axis drop the mask
+            out_masks[name] = layer.propagate_mask(cur_mask)
             new_states[name] = s
         if carries is not None:
             return acts, out_masks, new_states, new_carries

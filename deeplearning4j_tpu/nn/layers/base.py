@@ -127,6 +127,14 @@ class BaseLayerConf:
             self.dropout = g.dropout
 
     # ------------------------------------------------------------- shape plan
+    def propagate_mask(self, mask):
+        """The time mask downstream layers should see after this layer:
+        passthrough by default; layers that consume or rearrange the time
+        axis (pooling over time, last-step, reshape/permute) override to
+        return None so a stale [B, T] mask is never zipped against a
+        differently-shaped activation."""
+        return mask
+
     def set_n_in(self, in_type: InputType) -> None:
         self.n_in = in_type.flat_size()
 

@@ -23,6 +23,9 @@ class GlobalPoolingLayer(BaseLayerConf):
     pnorm: int = 2
     collapse_dimensions: bool = True
 
+    def propagate_mask(self, mask):
+        return None  # pools away the time axis; the mask is consumed
+
     def set_n_in(self, in_type: InputType) -> None:
         self.n_in = in_type.flat_size()
 

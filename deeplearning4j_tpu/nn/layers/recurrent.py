@@ -440,6 +440,9 @@ class LastTimeStepLayer(BaseLayerConf):
     def param_order(self) -> List[str]:
         return []
 
+    def propagate_mask(self, mask):
+        return None  # output is [B, F]; the time mask is consumed here
+
     def apply(self, params, x, *, state, train, rng, mask=None):
         if mask is None:
             return x[:, -1, :], state

@@ -65,6 +65,9 @@ class ReshapeLayer(BaseLayerConf):
     def param_order(self) -> List[str]:
         return []
 
+    def propagate_mask(self, mask):
+        return None  # time axis rearranged/created; a [B, T] mask is stale
+
     def apply(self, params, x, *, state, train, rng, mask=None):
         return x.reshape((x.shape[0],) + tuple(self.target_shape)), state
 
@@ -89,6 +92,9 @@ class PermuteLayer(BaseLayerConf):
     def param_order(self) -> List[str]:
         return []
 
+    def propagate_mask(self, mask):
+        return None  # time axis rearranged/created; a [B, T] mask is stale
+
     def apply(self, params, x, *, state, train, rng, mask=None):
         perm = (0,) + tuple(d for d in self.dims)
         return jnp.transpose(x, perm), state
@@ -110,6 +116,9 @@ class RepeatVectorLayer(BaseLayerConf):
 
     def param_order(self) -> List[str]:
         return []
+
+    def propagate_mask(self, mask):
+        return None  # time axis rearranged/created; a [B, T] mask is stale
 
     def apply(self, params, x, *, state, train, rng, mask=None):
         return jnp.repeat(x[:, None, :], self.n, axis=1), state

@@ -173,6 +173,8 @@ class MultiLayerNetwork(LazyScoreMixin):
                                    train=layer_train, rng=sub, mask=cur_mask)
                 if layer.frozen:
                     s = states[i]  # frozen: BN running stats don't move
+            # layers that consume or rearrange the time axis drop the mask
+            cur_mask = layer.propagate_mask(cur_mask)
             new_states.append(s)
             if collect:
                 acts.append(h)

@@ -75,6 +75,15 @@ def _param_averaging(net, mesh: Optional[MeshContext] = None, **kw):
     return ParallelWrapper(net, mesh=mesh, **kw)
 
 
+@register_strategy("delayed_sync")
+def _delayed_sync(net, mesh: Optional[MeshContext] = None, **kw):
+    """DP-2 parameter-server analog: local gradient accumulation with a
+    param-sized all-reduce only every sync_frequency steps (ref:
+    ParameterServerParallelWrapper.java:289-345; SURVEY §2.3 DP-2)."""
+    from deeplearning4j_tpu.parallel.delayed import DelayedSyncTrainer
+    return DelayedSyncTrainer(net, mesh=mesh, **kw)
+
+
 def create_trainer(strategy: str, net, mesh: Optional[MeshContext] = None,
                    hooks: Optional[List[TrainingHook]] = None, **kw):
     """Factory over the strategy registry (ref: TrainingMaster SPI)."""

@@ -30,14 +30,15 @@ from deeplearning4j_tpu.parallel.mesh import MeshContext
 
 
 class ParallelTrainer:
-    """Data/tensor-parallel trainer for a MultiLayerNetwork.
+    """Data/tensor-parallel trainer for a MultiLayerNetwork or
+    ComputationGraph.
 
     The model's params are resharded onto the mesh; each ``fit`` step feeds a
     global batch (sharded over 'data') through ONE jitted step compiled for
     the mesh. Collectives ride ICI automatically.
     """
 
-    def __init__(self, net: MultiLayerNetwork, mesh: Optional[MeshContext] = None,
+    def __init__(self, net, mesh: Optional[MeshContext] = None,
                  gradient_accumulation: int = 1,
                  donate_params: bool = True):
         self.net = net
@@ -46,6 +47,10 @@ class ParallelTrainer:
         self._step = None
         self._donate = donate_params
         net._check_init()
+        self._is_graph = not hasattr(net, "layers")
+        self._layers = (
+            [net.conf.nodes[n].layer for n in net._layer_nodes]
+            if self._is_graph else net.layers)
         # reshard model state onto the mesh
         net.params = self.mesh.shard_params(net.params)
         net.states = jax.tree.map(
@@ -59,6 +64,11 @@ class ParallelTrainer:
         tx = net._tx
         accum = self.gradient_accumulation
 
+        layers = self._layers
+
+        # both containers' _loss_fn share the positional signature
+        # (params, states, inputs, labels, masks, label_masks) — inputs/
+        # labels/masks are arrays for MLN, name-keyed dicts for a graph
         def loss_fn(p, states, feats, labels, fmask, lmask, rng):
             return net._loss_fn(p, states, feats, labels, fmask, lmask,
                                 rng=rng, train=True)
@@ -81,7 +91,8 @@ class ParallelTrainer:
                     g_acc = jax.tree.map(lambda a, b: a + b, g_acc, g)
                     return (g_acc, l_acc + loss, st2), None
 
-                B = feats.shape[0]
+                leaves = jax.tree_util.tree_leaves(feats)
+                B = leaves[0].shape[0]
                 if B % accum != 0:
                     raise ValueError(
                         f"batch size {B} not divisible by "
@@ -89,8 +100,9 @@ class ParallelTrainer:
                 mb_size = B // accum
 
                 def split(x):
-                    return (None if x is None else
-                            x.reshape((accum, mb_size) + x.shape[1:]))
+                    return jax.tree.map(
+                        lambda a: a.reshape((accum, mb_size) + a.shape[1:]),
+                        x)
 
                 rngs = jax.random.split(rng, accum)
                 zero_g = jax.tree.map(jnp.zeros_like, params)
@@ -101,25 +113,35 @@ class ParallelTrainer:
                 grads = jax.tree.map(lambda g: g / accum, grads)
                 loss = loss / accum
             new_params, new_opt = compute_updates(
-                tx, grads, opt_state, params, net.layers, training)
+                tx, grads, opt_state, params, layers, training)
             return new_params, new_opt, new_states, loss
 
         donate = (0, 1, 2) if self._donate else ()
         return jax.jit(step, donate_argnums=donate)
 
     # ------------------------------------------------------------------- fit
-    def fit_batch(self, batch: DataSet) -> float:
+    def fit_batch(self, batch) -> float:
         if self._step is None:
             self._step = self._build_step()
         net = self.net
-        feats = jnp.asarray(batch.features)
-        labels = jnp.asarray(batch.labels)
-        feats, labels = self.mesh.shard_batch(feats, labels)
-        fmask = lmask = None
-        if batch.features_mask is not None:
-            fmask = self.mesh.shard_batch(jnp.asarray(batch.features_mask))
-        if batch.labels_mask is not None:
-            lmask = self.mesh.shard_batch(jnp.asarray(batch.labels_mask))
+        if self._is_graph:
+            # name-keyed dicts (DataSet or MultiDataSet), every leaf
+            # sharded over the data axis
+            inputs, lbls, masks, lmasks_d = net._split(batch)
+            shard = lambda t: jax.tree.map(self.mesh.shard_batch, t)
+            feats, labels = shard(inputs), shard(lbls)
+            fmask, lmask = shard(masks), shard(lmasks_d)
+        else:
+            feats = jnp.asarray(batch.features)
+            labels = jnp.asarray(batch.labels)
+            feats, labels = self.mesh.shard_batch(feats, labels)
+            fmask = lmask = None
+            if batch.features_mask is not None:
+                fmask = self.mesh.shard_batch(
+                    jnp.asarray(batch.features_mask))
+            if batch.labels_mask is not None:
+                lmask = self.mesh.shard_batch(
+                    jnp.asarray(batch.labels_mask))
         net._rng, step_rng = jax.random.split(net._rng)
         net.params, net.opt_state, net.states, loss = self._step(
             net.params, net.opt_state, net.states, feats, labels, fmask,

@@ -1,0 +1,154 @@
+"""Delayed-sync DP (the DP-2 parameter-server analog, VERDICT r2 #8) and
+ParallelWrapper convergence parity vs a single worker (VERDICT r2 #9).
+
+Ref: ParameterServerParallelWrapper.java:289-345 (delayed/stale sync
+cadence); ParallelWrapperTest.java (k-worker averaging must converge like
+a single-threaded run).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel import (DelayedSyncTrainer, MeshContext,
+                                         ParallelTrainer, ParallelWrapper)
+from deeplearning4j_tpu.parallel.strategy import create_trainer
+
+RNG = np.random.default_rng(0)
+
+
+def _mnist_net(seed=7, lr=0.05, updater="sgd"):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(updater, learning_rate=lr)
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(784))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _mnist_batches(n=512, batch=64, seed=3):
+    it = MnistDataSetIterator(batch, num_examples=n, seed=seed,
+                              shuffle=False)
+    return list(it)
+
+
+def _train_test_split(batch=64, seed=3):
+    """Train batches + held-out test DataSet drawn from the SAME pool
+    (the synthetic-MNIST fallback keys its class templates on the seed,
+    so train/test must share it)."""
+    batches = _mnist_batches(n=768, batch=batch, seed=seed)
+    return batches[:8], DataSet.merge(batches[8:])
+
+
+def test_delayed_sync_freq1_matches_allreduce():
+    """sync_frequency=1 degenerates to synchronous data parallelism: the
+    per-step update must match ParallelTrainer's (mean of per-worker
+    grads == full-batch grad for equal shards)."""
+    batches = _mnist_batches(n=256, batch=64)
+    a = _mnist_net()
+    b = _mnist_net()
+    ta = ParallelTrainer(a, MeshContext.create(n_data=4, n_model=1))
+    tb = DelayedSyncTrainer(b, MeshContext.create(n_data=4, n_model=1),
+                            sync_frequency=1)
+    for ds in batches:
+        ta.fit_batch(ds)
+        tb.fit_batch(ds)
+    np.testing.assert_allclose(a.params_flat(), b.params_flat(),
+                               rtol=2e-4, atol=2e-6)
+
+
+def test_delayed_sync_k4_equals_gradient_accumulation():
+    """The exact semantics: k=4 delayed sync applies the same update as
+    synchronous training with gradient_accumulation=4 over the merged
+    batches (one optimizer step per 4 microbatches, mean gradient) —
+    delayed sync trades collective FREQUENCY, not math."""
+    batches, test_ds = _train_test_split()
+    groups = [batches[i:i + 4] for i in range(0, len(batches), 4)]
+    merged = [DataSet.merge(g) for g in groups]
+
+    a = _mnist_net(lr=0.1)
+    b = _mnist_net(lr=0.1)
+    ta = ParallelTrainer(a, MeshContext.create(n_data=4, n_model=1),
+                         gradient_accumulation=4)
+    tb = create_trainer("delayed_sync", b,
+                        MeshContext.create(n_data=4, n_model=1),
+                        sync_frequency=4)
+    # 4x epochs: one optimizer step per 4 microbatches, so this matches
+    # the synchronous tests' update count
+    for _ in range(24):
+        for group, big in zip(groups, merged):
+            ta.fit_batch(big)
+            for ds in group:
+                tb.fit_batch(ds)
+    np.testing.assert_allclose(a.params_flat(), b.params_flat(),
+                               rtol=3e-4, atol=3e-6)
+
+    it = ListDataSetIterator([test_ds])
+    acc_sync = a.evaluate(it).accuracy()
+    acc_delay = b.evaluate(it).accuracy()
+    assert acc_sync > 0.8, acc_sync
+    assert acc_delay > acc_sync - 0.05, (acc_delay, acc_sync)
+
+
+def test_delayed_sync_defers_param_updates():
+    """Between syncs params must NOT move (stale-pull semantics); at the
+    k-th step they must."""
+    net = _mnist_net()
+    t = DelayedSyncTrainer(net, MeshContext.create(n_data=4, n_model=1),
+                           sync_frequency=3)
+    batches = _mnist_batches(n=256, batch=64)
+    p0 = net.params_flat()
+    t.fit_batch(batches[0])
+    t.fit_batch(batches[1])
+    np.testing.assert_array_equal(net.params_flat(), p0)  # stale
+    t.fit_batch(batches[2])  # 3rd step -> sync
+    assert not np.allclose(net.params_flat(), p0)
+
+
+def test_delayed_sync_flush_applies_partial_accumulation():
+    net = _mnist_net()
+    t = DelayedSyncTrainer(net, MeshContext.create(n_data=4, n_model=1),
+                           sync_frequency=10)
+    batches = _mnist_batches(n=128, batch=64)
+    p0 = net.params_flat()
+    for ds in batches:
+        t.fit_batch(ds)
+    np.testing.assert_array_equal(net.params_flat(), p0)
+    t.flush()
+    assert not np.allclose(net.params_flat(), p0)
+
+
+def test_parallel_wrapper_convergence_parity_vs_single_worker():
+    """The reference's ParallelWrapperTest contract: k-worker parameter
+    averaging reaches (within tolerance) the accuracy of a single-worker
+    run on the same data."""
+    batches, test_ds = _train_test_split()
+    it_test = ListDataSetIterator([test_ds])
+
+    single = _mnist_net(lr=0.1)
+    for _ in range(6):
+        for ds in batches:
+            single.fit_batch(ds)
+    acc_single = single.evaluate(it_test).accuracy()
+
+    wrapped_net = _mnist_net(lr=0.1)
+    wrapper = ParallelWrapper(wrapped_net, workers=4,
+                              averaging_frequency=2,
+                              mesh=MeshContext.create(n_data=4, n_model=1))
+    # each parallel iteration spreads 4 batches over 4 workers, so one
+    # wrapper epoch applies 1/4 the sequential updates — train 4x epochs
+    # for an update-count-matched comparison
+    wrapper.fit(ListDataSetIterator(batches), epochs=24)
+    acc_avg = wrapped_net.evaluate(it_test).accuracy()
+
+    assert acc_single > 0.8, acc_single
+    assert acc_avg > acc_single - 0.1, (acc_avg, acc_single)
