@@ -32,7 +32,10 @@ def _search(env: str, *names: str) -> Optional[Path]:
             p = base / n
             if p.exists():
                 return p
-    return None
+    # cloud fallback (DL4J_TPU_DATA_URL=gs://... — ref: deeplearning4j-aws
+    # S3 dataset readers)
+    from deeplearning4j_tpu.datasets import cloud_io
+    return cloud_io.search_data_url(*names)
 
 
 def _synthetic_images(n: int, classes: int, h: int, w: int, c: int,

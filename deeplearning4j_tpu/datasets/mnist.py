@@ -45,7 +45,11 @@ def _find(names) -> Optional[Path]:
             p = base / n
             if p.exists():
                 return p
-    return None
+    # cloud fallback (ref: the deeplearning4j-aws S3 dataset readers):
+    # DL4J_TPU_DATA_URL=gs://bucket/prefix (or s3://...) fetches into the
+    # local cache once and reuses it thereafter
+    from deeplearning4j_tpu.datasets import cloud_io
+    return cloud_io.search_data_url(*names)
 
 
 def _read_idx(path: Path) -> np.ndarray:

@@ -32,6 +32,16 @@ Record = List[Union[float, int, str]]
 # readers
 # ---------------------------------------------------------------------------
 
+def _read_text(source) -> str:
+    """Read a text source: local path or cloud URL (gs:// s3:// http(s)://
+    via datasets/cloud_io — ref: deeplearning4j-aws s3 readers)."""
+    from deeplearning4j_tpu.datasets import cloud_io
+    if cloud_io.is_cloud_url(source):
+        return cloud_io.read_url(str(source)).decode("utf-8")
+    with open(source, encoding="utf-8") as f:
+        return f.read()
+
+
 class RecordReader:
     """One record per ``next_record()`` call; a record is a list of values
     (the Writable-list contract of the reference's readers)."""
@@ -78,20 +88,21 @@ class CSVRecordReader(RecordReader):
                  skip_lines: int = 0, delimiter: str = ","):
         self._rows = None  # native numeric fast path: float32 [rows, cols]
         if isinstance(source, (str, Path)):
-            # all-numeric files parse in native code
-            # (native/dataloader.cc csv_read); mixed/string content falls
-            # back to the Python tokenizer below
-            from deeplearning4j_tpu.datasets import native_io
-            parsed = native_io.csv_read(source, delimiter=delimiter,
-                                        skip_rows=skip_lines)
-            if parsed is not None:
-                self._rows = parsed[0]
-                self._lines = []
-                self._delim = delimiter
-                self._pos = 0
-                return
-            with open(source) as f:
-                lines = f.read().splitlines()
+            from deeplearning4j_tpu.datasets import cloud_io
+            if not cloud_io.is_cloud_url(source):
+                # all-numeric LOCAL files parse in native code
+                # (native/dataloader.cc csv_read); mixed/string content
+                # and cloud URLs fall back to the Python tokenizer below
+                from deeplearning4j_tpu.datasets import native_io
+                parsed = native_io.csv_read(source, delimiter=delimiter,
+                                            skip_rows=skip_lines)
+                if parsed is not None:
+                    self._rows = parsed[0]
+                    self._lines = []
+                    self._delim = delimiter
+                    self._pos = 0
+                    return
+            lines = _read_text(source).splitlines()
         else:
             lines = [l.rstrip("\n") for l in source]
         self._lines = [l for l in lines[skip_lines:] if l.strip()]
@@ -128,8 +139,7 @@ class LineRecordReader(RecordReader):
 
     def __init__(self, source: Union[str, Path, Iterable[str]]):
         if isinstance(source, (str, Path)):
-            with open(source) as f:
-                self._lines = f.read().splitlines()
+            self._lines = _read_text(source).splitlines()
         else:
             self._lines = [l.rstrip("\n") for l in source]
         self._pos = 0
@@ -187,8 +197,7 @@ class CSVSequenceRecordReader(SequenceRecordReader):
         if isinstance(sources, (str, Path)):
             sources = [sources]
         for src in sources:
-            with open(src) as f:
-                text = f.read()
+            text = _read_text(src)
             # header skip applies once per source, not per sequence chunk
             text = "\n".join(text.splitlines()[skip_lines:])
             for chunk in text.split("\n\n"):

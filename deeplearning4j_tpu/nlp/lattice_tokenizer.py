@@ -1,0 +1,357 @@
+"""Lattice-based Japanese morphological tokenizer (Kuromoji-style).
+
+Ref: deeplearning4j-nlp-japanese bundles a Kuromoji fork —
+com/atilika/kuromoji/viterbi/{ViterbiBuilder,ViterbiLattice,
+ViterbiSearcher}.java build a word lattice from dictionary lookups plus
+unknown-word candidates and run a min-cost Viterbi search with
+word costs + POS connection costs; TokenizerBase.java drives it and
+emits surface/POS/base-form tokens.
+
+This module is that pipeline with a compact bundled lexicon instead of
+the 12MB IPADIC binary (no external downloads in this image): a trie
+over ~300 high-frequency morphemes (particles, auxiliaries, copulas,
+verb/adjective stems and inflections, pronouns, common nouns), a coarse
+POS-class connection-cost matrix, and script-based unknown-word
+candidates (the unk.def analog). The search itself is the same dynamic
+program as ``util/viterbi.py`` specialized to a word lattice (nodes =
+dictionary hits, edges = adjacency), minimizing
+``sum(word_cost) + sum(connection_cost)``.
+
+The dictionary-free script-run segmenter
+(``tokenization_ext.JapaneseTokenizerFactory``) remains the fallback for
+text far outside the lexicon.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.nlp.tokenization import _Tokenizer
+from deeplearning4j_tpu.nlp.tokenization_ext import _script
+
+# ---------------------------------------------------------------------------
+# POS classes (coarse IPADIC top-level analogs)
+# ---------------------------------------------------------------------------
+
+NOUN = "noun"            # 名詞
+PRONOUN = "pronoun"      # 代名詞
+PARTICLE = "particle"    # 助詞
+VERB = "verb"            # 動詞 (stem/dictionary form)
+VERB_INFL = "verb_infl"  # 動詞活用語尾 / 連用形 continuations
+AUX = "aux"              # 助動詞 (ます/た/です/ない...)
+ADJ = "adjective"        # 形容詞
+ADV = "adverb"           # 副詞
+PREFIX = "prefix"        # 接頭詞
+SUFFIX = "suffix"        # 接尾辞 (人/都/県/さん...)
+NUMBER = "number"        # 数
+SYMBOL = "symbol"        # 記号
+UNK = "unk"              # unknown (script-run candidate)
+
+# ---------------------------------------------------------------------------
+# bundled lexicon: surface -> list of (pos, word_cost, base_form)
+# Lower cost = preferred. Costs roughly follow IPADIC's ordering: common
+# particles/auxiliaries are cheap; longer content words cheaper than
+# splitting them; unknowns expensive.
+# ---------------------------------------------------------------------------
+
+def _entries() -> Dict[str, List[Tuple[str, int, Optional[str]]]]:
+    lex: Dict[str, List[Tuple[str, int, Optional[str]]]] = {}
+
+    def add(surface, pos, cost, base=None):
+        lex.setdefault(surface, []).append((pos, cost, base or surface))
+
+    # particles (助詞) — the glue; very cheap
+    for p in ["は", "が", "を", "に", "で", "と", "も", "の", "へ", "や",
+              "から", "まで", "より", "ね", "よ", "か", "な", "ば",
+              "ても", "でも", "だけ", "しか", "など", "って", "ながら",
+              "けど", "のに", "ので"]:
+        add(p, PARTICLE, 200)
+    # auxiliaries / copulas (助動詞)
+    for a, base in [("です", "です"), ("でした", "です"), ("だ", "だ"),
+                    ("だった", "だ"), ("ます", "ます"), ("ました", "ます"),
+                    ("ません", "ます"), ("まし", "ます"), ("た", "た"),
+                    ("ない", "ない"), ("なかった", "ない"), ("れる", "れる"),
+                    ("られる", "られる"), ("たい", "たい"), ("う", "う"),
+                    ("よう", "よう"), ("そう", "そう"), ("らしい", "らしい")]:
+        add(a, AUX, 300, base)
+    # pronouns
+    for n in ["私", "僕", "君", "彼", "彼女", "これ", "それ", "あれ",
+              "ここ", "そこ", "どこ", "誰", "何"]:
+        add(n, PRONOUN, 700)
+    # common nouns
+    for n in ["学生", "先生", "学校", "会社", "日本", "東京", "京都",
+              "大阪", "すもも", "もも", "うち", "犬", "猫", "人", "本",
+              "水", "山", "川", "空", "海", "朝", "昼", "夜", "今日",
+              "明日", "昨日", "時間", "言葉", "勉強", "仕事", "電車",
+              "車", "道", "店", "家", "名前", "天気", "雨", "雪", "花",
+              "木", "音楽", "映画", "世界", "国", "町", "駅", "飯",
+              "ご飯", "肉", "魚", "野菜", "果物", "子供", "大人", "友達",
+              "問題", "質問", "答え", "心", "体", "頭", "目", "耳", "口",
+              "手", "足", "年", "月", "日", "週", "分", "秒", "円"]:
+        add(n, NOUN, 800)
+    # verbs: dictionary forms + common conjugated stems (連用形 etc.)
+    for v, base in [("住む", "住む"), ("住ん", "住む"), ("行く", "行く"),
+                    ("行っ", "行く"), ("行き", "行く"), ("来る", "来る"),
+                    ("来", "来る"), ("見る", "見る"), ("見", "見る"),
+                    ("食べる", "食べる"), ("食べ", "食べる"),
+                    ("飲む", "飲む"), ("飲み", "飲む"), ("する", "する"),
+                    ("し", "する"), ("やる", "やる"), ("いる", "いる"),
+                    ("い", "いる"), ("ある", "ある"), ("あり", "ある"),
+                    ("なる", "なる"), ("なり", "なる"), ("思う", "思う"),
+                    ("思い", "思う"), ("言う", "言う"), ("言い", "言う"),
+                    ("読む", "読む"), ("読み", "読む"), ("書く", "書く"),
+                    ("書き", "書く"), ("聞く", "聞く"), ("聞き", "聞く"),
+                    ("話す", "話す"), ("話し", "話す"), ("買う", "買う"),
+                    ("買い", "買う"), ("使う", "使う"), ("使い", "使う"),
+                    ("作る", "作る"), ("作り", "作る"), ("歩く", "歩く"),
+                    ("歩き", "歩く"), ("走る", "走る"), ("走り", "走る"),
+                    ("帰る", "帰る"), ("帰り", "帰る"), ("働く", "働く"),
+                    ("働き", "働く"), ("待つ", "待つ"), ("待ち", "待つ"),
+                    ("分かる", "分かる"), ("分かり", "分かる")]:
+        pos = VERB if v == base else VERB_INFL
+        add(v, pos, 900 if v == base else 950, base)
+    # て/で-form connective endings treated as inflections
+    for v, base in [("食べて", "食べる"), ("見て", "見る"), ("して", "する"),
+                    ("行って", "行く"), ("住んで", "住む"),
+                    ("飲んで", "飲む"), ("読んで", "読む")]:
+        add(v, VERB_INFL, 900, base)
+    # adjectives
+    for a, base in [("高い", "高い"), ("高く", "高い"), ("安い", "安い"),
+                    ("大きい", "大きい"), ("大きな", "大きい"),
+                    ("小さい", "小さい"), ("小さな", "小さい"),
+                    ("新しい", "新しい"), ("古い", "古い"),
+                    ("良い", "良い"), ("よく", "良い"), ("いい", "良い"),
+                    ("悪い", "悪い"), ("暑い", "暑い"), ("寒い", "寒い"),
+                    ("早い", "早い"), ("早く", "早い"), ("遅い", "遅い"),
+                    ("美しい", "美しい"), ("楽しい", "楽しい"),
+                    ("面白い", "面白い"), ("難しい", "難しい"),
+                    ("易しい", "易しい"), ("多い", "多い"), ("少ない", "少ない")]:
+        add(a, ADJ, 900, base)
+    # adverbs
+    for a in ["とても", "すごく", "もっと", "少し", "たくさん", "いつも",
+              "また", "まだ", "もう", "すぐ", "ゆっくり", "一緒に"]:
+        add(a, ADV, 900)
+    # prefixes / suffixes
+    for p in ["お", "ご"]:
+        add(p, PREFIX, 1200)
+    for s in ["さん", "ちゃん", "君", "様", "たち", "都", "府", "県",
+              "市", "区", "町", "村", "語", "人", "屋", "的", "者"]:
+        add(s, SUFFIX, 900)
+    return lex
+
+
+# connection costs between POS classes (left -> right); the unlisted
+# default is _DEFAULT_CONN. Cheap where Japanese grammar expects the
+# transition, expensive where it does not.
+_CONN: Dict[Tuple[str, str], int] = {}
+_DEFAULT_CONN = 800
+
+
+def _conn_init():
+    def c(a, b, cost):
+        _CONN[(a, b)] = cost
+
+    BOS, EOS = "BOS", "EOS"
+    for n in (NOUN, PRONOUN):
+        c(BOS, n, 100)
+        c(n, PARTICLE, 0)
+        c(n, AUX, 200)       # 学生です
+        c(n, SUFFIX, 100)    # 東京+都
+        c(n, NOUN, 700)      # compounds possible but not preferred
+        c(n, EOS, 400)
+    c(BOS, PREFIX, 300)
+    c(PREFIX, NOUN, 0)
+    c(SUFFIX, PARTICLE, 0)
+    c(SUFFIX, NOUN, 700)
+    c(SUFFIX, EOS, 400)
+    c(BOS, ADV, 300)
+    c(ADV, VERB, 100)
+    c(ADV, ADJ, 100)
+    c(ADV, PARTICLE, 400)
+    for p in (PARTICLE,):
+        c(p, NOUN, 0)        # もも の うち
+        c(p, PRONOUN, 100)
+        c(p, VERB, 100)
+        c(p, VERB_INFL, 100)
+        c(p, ADJ, 200)
+        c(p, ADV, 300)
+        c(p, PARTICLE, 500)  # compound particles exist but are rarer
+        c(p, EOS, 300)
+    c(BOS, VERB, 400)
+    c(BOS, VERB_INFL, 500)
+    for v in (VERB, VERB_INFL):
+        c(v, AUX, 0)         # 食べ+ました
+        c(v, PARTICLE, 200)
+        c(v, EOS, 200)
+        c(v, NOUN, 600)
+    c(AUX, AUX, 100)         # まし+た
+    c(AUX, EOS, 0)
+    c(AUX, PARTICLE, 300)
+    c(AUX, NOUN, 700)
+    c(BOS, ADJ, 300)
+    c(ADJ, AUX, 100)         # 高い+です
+    c(ADJ, NOUN, 200)        # 大きな猫
+    c(ADJ, PARTICLE, 200)
+    c(ADJ, EOS, 200)
+    c(NUMBER, SUFFIX, 0)     # 3+円
+    c(NUMBER, NOUN, 200)
+    c(NUMBER, PARTICLE, 100)
+    c(NUMBER, EOS, 300)
+    c(BOS, NUMBER, 200)
+    for s in (UNK,):
+        c(BOS, s, 600)
+        c(s, PARTICLE, 300)
+        c(s, AUX, 500)
+        c(s, EOS, 600)
+        c(s, NOUN, 800)
+        c(PARTICLE, s, 500)
+        c(NOUN, s, 800)
+
+
+_conn_init()
+
+
+@dataclass
+class Morpheme:
+    """A token with Kuromoji-style attributes (surface/POS/base form)."""
+    surface: str
+    pos: str
+    base_form: str
+    start: int
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"{self.surface}/{self.pos}"
+
+
+class _Trie:
+    """Prefix trie over the lexicon for common_prefix_search (the role of
+    kuromoji's DoubleArrayTrie)."""
+
+    def __init__(self, lex: Dict[str, List[Tuple[str, int, Optional[str]]]]):
+        self.root: dict = {}
+        for surface, entries in lex.items():
+            node = self.root
+            for ch in surface:
+                node = node.setdefault(ch, {})
+            node["__entries__"] = entries
+
+    def prefixes(self, text: str, start: int):
+        """Yield (surface, entries) for every lexicon word starting at
+        ``start``."""
+        node = self.root
+        for i in range(start, len(text)):
+            node = node.get(text[i])
+            if node is None:
+                return
+            entries = node.get("__entries__")
+            if entries:
+                yield text[start:i + 1], entries
+
+
+class JapaneseLatticeTokenizer:
+    """Min-cost lattice segmentation (ViterbiBuilder + ViterbiSearcher)."""
+
+    _UNK_COST_PER_CHAR = {"kanji": 2500, "katakana": 1400, "hiragana": 2800,
+                          "latin": 900, "digit": 700}
+
+    def __init__(self):
+        self._lex = _entries()
+        self._trie = _Trie(self._lex)
+
+    # ------------------------------------------------------------ lattice
+    def _unknown_candidates(self, text: str, start: int):
+        """Script-run unknown words (kuromoji unk.def analog): at ``start``
+        propose the maximal same-script run and its prefixes (capped)."""
+        s0 = _script(text[start])
+        if s0 == "space":
+            return
+        end = start + 1
+        while end < len(text) and _script(text[end]) == s0:
+            end += 1
+        run_len = min(end - start, 8)
+        per = self._UNK_COST_PER_CHAR.get(s0, 2000)
+        pos = NUMBER if s0 == "digit" else UNK
+        for ln in range(1, run_len + 1):
+            surface = text[start:start + ln]
+            # favor taking the whole run over splitting it
+            cost = per * ln + (600 if ln < run_len else 0)
+            yield surface, pos, cost
+
+    def tokenize(self, text: str) -> List[Morpheme]:
+        text = text.strip()
+        if not text:
+            return []
+        n = len(text)
+        # True lattice Viterbi, state = (boundary position, POS class of
+        # the word ENDING there) — collapsing to position alone (one best
+        # POS per boundary) is NOT the lattice minimum: a locally-cheaper
+        # POS can lose downstream via its connection row (kuromoji's
+        # ViterbiSearcher keys on the node's left/right ids the same way).
+        # best[i][pos] = (cost, backptr); backptr = (start, surface,
+        # left_pos, base) or, for a space carry, (start, None, left_pos,
+        # None) meaning "same state one char earlier, no token".
+        best: List[Dict[str, Tuple[int, Optional[tuple]]]] = \
+            [dict() for _ in range(n + 1)]
+        best[0]["BOS"] = (0, None)
+        for i in range(n):
+            if not best[i]:
+                continue
+            if _script(text[i]) == "space":
+                # spaces end the previous word and carry every state
+                for pos, (cost, _) in best[i].items():
+                    cur = best[i + 1].get(pos)
+                    if cur is None or cost < cur[0]:
+                        best[i + 1][pos] = (cost, (i, None, pos, None))
+                continue
+            candidates = [(surf, pos, cost, base)
+                          for surf, entries in self._trie.prefixes(text, i)
+                          for pos, cost, base in entries]
+            candidates += [(surf, pos, cost, surf)
+                           for surf, pos, cost in
+                           self._unknown_candidates(text, i)]
+            for surf, pos, wcost, base in candidates:
+                j = i + len(surf)
+                for left, (lcost, _) in best[i].items():
+                    total = (lcost + wcost
+                             + _CONN.get((left, pos), _DEFAULT_CONN))
+                    cur = best[j].get(pos)
+                    if cur is None or total < cur[0]:
+                        best[j][pos] = (total, (i, surf, left, base))
+        if not best[n]:  # pragma: no cover — unknown candidates are total
+            return [Morpheme(text, UNK, text, 0)]
+        # EOS connection picks the final state
+        pos = min(best[n],
+                  key=lambda p: best[n][p][0]
+                  + _CONN.get((p, "EOS"), _DEFAULT_CONN))
+        out: List[Morpheme] = []
+        j = n
+        while j > 0:
+            _, back = best[j][pos]
+            i, surf, left, base = back
+            if surf is not None:  # space carries emit nothing
+                out.append(Morpheme(surf, pos, base, i))
+            pos = left
+            j = i
+        out.reverse()
+        return out
+
+
+class JapaneseLatticeTokenizerFactory:
+    """TokenizerFactory over the lattice tokenizer (drop-in for
+    tokenization_ext.JapaneseTokenizerFactory where morphological
+    segmentation is wanted). ``pos_tags=True`` yields 'surface/pos'
+    strings; default yields surfaces."""
+
+    def __init__(self, pos_tags: bool = False):
+        self._tok = JapaneseLatticeTokenizer()
+        self.pos_tags = pos_tags
+
+    def tokenize(self, text: str) -> List[Morpheme]:
+        return self._tok.tokenize(text)
+
+    def create(self, text: str) -> _Tokenizer:
+        ms = self._tok.tokenize(text)
+        if self.pos_tags:
+            return _Tokenizer([f"{m.surface}/{m.pos}" for m in ms])
+        return _Tokenizer([m.surface for m in ms])

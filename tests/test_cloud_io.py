@@ -1,0 +1,139 @@
+"""Cloud-storage dataset loaders (VERDICT r2 #7).
+
+Ref: deeplearning4j-scaleout/deeplearning4j-aws/.../s3/reader/
+{S3Downloader,BucketIterator}.java. No egress in CI, so a mock client
+registered for the gs:// and s3:// schemes backs the tests; the
+HttpRangeClient's URL mapping is asserted separately without network.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import cloud_io
+from deeplearning4j_tpu.datasets.cloud_io import (
+    BucketIterator, HttpRangeClient, S3Downloader,
+)
+from deeplearning4j_tpu.datasets.records import (
+    CSVRecordReader, LineRecordReader, RecordReaderDataSetIterator,
+)
+
+
+class MockClient(cloud_io.CloudStorageClient):
+    def __init__(self, objects):
+        self.objects = dict(objects)
+        self.reads = []
+
+    def read(self, url, start=None, length=None):
+        self.reads.append((url, start, length))
+        data = self.objects[url]
+        if start is not None:
+            end = None if length is None else start + length
+            return data[start:end]
+        return data
+
+    def list(self, url):
+        return sorted(k for k in self.objects if k.startswith(url))
+
+
+@pytest.fixture()
+def store(monkeypatch):
+    csv = b"5.1,3.5,1.4,0.2,0\n4.9,3.0,1.4,0.2,0\n6.3,3.3,6.0,2.5,2\n"
+    client = MockClient({
+        "gs://data/iris.csv": csv,
+        "gs://data/lines.txt": b"alpha\nbeta\ngamma\n",
+        "gs://data/shard/a.bin": b"AAAA",
+        "gs://data/shard/b.bin": b"BBBB",
+    })
+    monkeypatch.setitem(cloud_io._CLIENTS, "gs", client)
+    monkeypatch.setitem(cloud_io._CLIENTS, "s3", client)
+    return client
+
+
+def test_csv_record_reader_from_cloud_url(store):
+    rr = CSVRecordReader("gs://data/iris.csv")
+    it = RecordReaderDataSetIterator(rr, batch_size=3, label_index=4,
+                                     num_possible_labels=3)
+    ds = next(iter(it))
+    assert ds.features.shape == (3, 4)
+    np.testing.assert_allclose(ds.features[0], [5.1, 3.5, 1.4, 0.2])
+    assert ds.labels.argmax(1).tolist() == [0, 0, 2]
+
+
+def test_line_record_reader_from_cloud_url(store):
+    rr = LineRecordReader("gs://data/lines.txt")
+    out = []
+    while rr.has_next():
+        out.extend(rr.next_record())
+    assert out == ["alpha", "beta", "gamma"]
+
+
+def test_range_read(store):
+    assert cloud_io.read_url("gs://data/lines.txt", start=6, length=4) \
+        == b"beta"
+    assert store.reads[-1] == ("gs://data/lines.txt", 6, 4)
+
+
+def test_bucket_iterator_and_downloader(store, tmp_path):
+    it = BucketIterator("gs://data/shard/")
+    assert it.keys() == ["gs://data/shard/a.bin", "gs://data/shard/b.bin"]
+    assert list(it) == [b"AAAA", b"BBBB"]
+    p = S3Downloader().download("gs://data/shard/a.bin",
+                                str(tmp_path / "a.bin"))
+    assert p.read_bytes() == b"AAAA"
+
+
+def test_fetch_to_cache_caches(store, tmp_path):
+    p1 = cloud_io.fetch_to_cache("gs://data/iris.csv", cache_dir=tmp_path)
+    n_reads = len(store.reads)
+    p2 = cloud_io.fetch_to_cache("gs://data/iris.csv", cache_dir=tmp_path)
+    assert p1 == p2 and p1.exists()
+    assert len(store.reads) == n_reads  # second hit came from disk
+
+
+def test_http_range_client_url_mapping():
+    c = HttpRangeClient()
+    assert c._endpoint("gs://bkt/path/f.bin") \
+        == "https://storage.googleapis.com/bkt/path/f.bin"
+    assert c._endpoint("s3://bkt/path/f.bin") \
+        == "https://bkt.s3.amazonaws.com/path/f.bin"
+    assert c._endpoint("https://x/y") == "https://x/y"
+    with pytest.raises(ValueError):
+        c._endpoint("ftp://x/y")
+
+
+def test_unregistered_scheme_raises():
+    with pytest.raises(ValueError, match="register_client"):
+        cloud_io.read_url("weird://bucket/key")
+
+
+def _idx_bytes(arr: np.ndarray) -> bytes:
+    arr = np.asarray(arr, np.uint8)
+    header = struct.pack(">BBBB", 0, 0, 0x08, arr.ndim)
+    header += b"".join(struct.pack(">I", d) for d in arr.shape)
+    return header + arr.tobytes()
+
+
+def test_mnist_fetcher_from_cloud_url(monkeypatch, tmp_path):
+    """MNIST fetcher falls back to DL4J_TPU_DATA_URL (the S3/GCS loader
+    path) when no local file exists."""
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 255, (32, 28, 28)).astype(np.uint8)
+    labels = rng.integers(0, 10, 32).astype(np.uint8)
+    client = MockClient({
+        "gs://mybucket/mnist/train-images-idx3-ubyte": _idx_bytes(imgs),
+        "gs://mybucket/mnist/train-labels-idx1-ubyte": _idx_bytes(labels),
+    })
+    monkeypatch.setitem(cloud_io._CLIENTS, "gs", client)
+    monkeypatch.setenv("DL4J_TPU_DATA_URL", "gs://mybucket/mnist")
+    monkeypatch.setenv("DL4J_TPU_CACHE", str(tmp_path))
+    monkeypatch.setenv("MNIST_DIR", str(tmp_path / "nope"))
+
+    from deeplearning4j_tpu.datasets.mnist import load_mnist
+    got_imgs, got_labels, synthetic = load_mnist(train=True,
+                                                 num_examples=32)
+    assert not synthetic
+    assert got_imgs.shape == (32, 28, 28)
+    np.testing.assert_allclose(got_imgs, imgs.astype(np.float32) / 255.0)
+    np.testing.assert_array_equal(got_labels, labels)
