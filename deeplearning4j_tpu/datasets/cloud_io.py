@@ -62,8 +62,9 @@ class CloudStorageClient:
         try:
             self.read(url, start=0, length=1)
             return True
-        except Exception:  # noqa: BLE001 — any transport error == absent
-            return False
+        except Exception as e:  # noqa: BLE001 — transport error == absent
+            # 416 Range Not Satisfiable = a real but ZERO-BYTE object
+            return getattr(e, "code", getattr(e, "status", None)) == 416
 
 
 class HttpRangeClient(CloudStorageClient):
@@ -99,7 +100,14 @@ class HttpRangeClient(CloudStorageClient):
             end = "" if length is None else str(start + length - 1)
             req.add_header("Range", f"bytes={start}-{end}")
         with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            return r.read()
+            data = r.read()
+            if start is not None and r.status == 200:
+                # server ignored the Range header (plain HTTP hosts,
+                # some redirect targets): slice the full body ourselves
+                # so the caller never mistakes bytes[0:N] for [start:..]
+                data = (data[start:start + length] if length is not None
+                        else data[start:])
+            return data
 
     def list(self, url) -> List[str]:
         """List object keys under a prefix via the buckets' XML listing
@@ -175,6 +183,11 @@ def fetch_to_cache(url: str, cache_dir: Optional[str] = None) -> Path:
     cache.mkdir(parents=True, exist_ok=True)
     _, bucket, key = _split_url(url)
     target = cache / bucket / key
+    # keys come from config/remote listings: never let ../ segments write
+    # outside the cache root
+    cache_root = cache.resolve()
+    if not target.resolve().is_relative_to(cache_root):
+        raise ValueError(f"Key {key!r} escapes the cache directory")
     if not target.exists():
         target.parent.mkdir(parents=True, exist_ok=True)
         tmp = target.with_suffix(target.suffix + ".part")

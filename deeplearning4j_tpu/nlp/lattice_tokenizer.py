@@ -279,7 +279,9 @@ class JapaneseLatticeTokenizer:
             yield surface, pos, cost
 
     def tokenize(self, text: str) -> List[Morpheme]:
-        text = text.strip()
+        # no strip: leading/trailing whitespace flows through the space-
+        # carry states, keeping Morpheme.start aligned with the CALLER's
+        # string (the attribute's whole purpose)
         if not text:
             return []
         n = len(text)

@@ -11,11 +11,17 @@ Endpoints:
   GET  /                      dashboard page
   GET  /api/sessions          list of session ids
   GET  /api/session?id=S      {init: {...}, reports: [...]} (scalars only)
-  GET  /api/histograms?id=S   latest param/grad histograms
-                              {param: {name: {counts, edges}}, grad: {...}}
+  GET  /api/histograms?id=S[&iter=N]
+                              param/grad histograms at the latest (or
+                              nearest-to-N) carrying iteration, plus the
+                              full ``iterations`` list for the scrubber
+  GET  /api/flow              network graph {nodes, edges, score}
+  GET  /api/activations       conv activation grids {layer: PNG data URL}
   GET  /api/tsne              latest posted embedding {x, y, labels}
   POST /api/init              register session (JSON init report)
   POST /api/post?session=S    ingest one binary StatsReport record
+  POST /api/flow              post a FlowIterationListener snapshot
+  POST /api/activations       post one {layer, grid} activation render
   POST /api/tsne              post a 2-d embedding for the t-SNE view
 """
 
@@ -45,11 +51,15 @@ _PAGE = """<!doctype html>
 <h2>Score vs iteration</h2><svg id="score" class="chart" width="860" height="220"></svg>
 <h2>log10 update:parameter ratio</h2><svg id="ratio" class="chart" width="860" height="220"></svg>
 <h2>Throughput (samples/sec)</h2><svg id="sps" class="chart" width="860" height="220"></svg>
-<h2>Histograms <select id="histsel"></select> <span id="histiter"></span></h2>
+<h2>Histograms <select id="histsel"></select>
+ <input type="range" id="histslider" min="0" max="0" value="0" style="width:240px">
+ <span id="histiter"></span></h2>
 <div>
  <svg id="histp" class="chart" width="424" height="200"></svg>
  <svg id="histg" class="chart" width="424" height="200"></svg>
 </div>
+<h2>Network graph (flow)</h2><svg id="flow" class="chart" width="860" height="80"></svg>
+<h2>Conv activations</h2><div id="acts"></div>
 <h2>t-SNE embedding</h2><svg id="tsne" class="chart" width="560" height="420"></svg>
 <script>
 const COLORS=['#1f77b4','#ff7f0e','#2ca02c','#d62728','#9467bd','#8c564b',
@@ -165,8 +175,17 @@ async function refresh(){
   line(document.getElementById('ratio'),ratios);
   line(document.getElementById('sps'),{'samples/sec':sps});
 
-  const h=await (await fetch('api/histograms?id='
-                             +encodeURIComponent(sel.value))).json();
+  let h=await (await fetch('api/histograms?id='
+                           +encodeURIComponent(sel.value))).json();
+  const slider=document.getElementById('histslider');
+  const iters=h.iterations||[];
+  slider.max=Math.max(iters.length-1,0);
+  if(!histPinned) slider.value=slider.max;
+  else if(iters.length && slider.value<iters.length-1){
+    // scrubbed into history: fetch that iteration's snapshot
+    h=await (await fetch('api/histograms?id='+encodeURIComponent(sel.value)
+             +'&iter='+iters[slider.value])).json();
+  }
   const hsel=document.getElementById('histsel');
   const names=Object.keys(h.param||{});
   const curH=[...hsel.options].map(o=>o.value);
@@ -177,24 +196,135 @@ async function refresh(){
     if(names.includes(keep)) hsel.value=keep;
   }
   document.getElementById('histiter').textContent=
-    h.iteration==null?'(no histograms yet)':'@ iter '+h.iteration;
+    h.iteration==null?'(no histograms yet)':'@ iter '+h.iteration
+      +(histPinned?' (scrubbed)':' (latest)');
   if(hsel.value){
     bars(document.getElementById('histp'),h.param[hsel.value],
          'param '+hsel.value);
     bars(document.getElementById('histg'),(h.grad||{})[hsel.value],
          'gradient '+hsel.value);
   }
+  flow(document.getElementById('flow'),
+       await (await fetch('api/flow')).json());
+  const acts=await (await fetch('api/activations')).json();
+  const actdiv=document.getElementById('acts');
+  for(const [name,url] of Object.entries(acts)){
+    let img=document.getElementById('act_'+name);
+    if(!img){
+      const wrap=document.createElement('div');
+      wrap.style.display='inline-block';wrap.style.margin='4px';
+      const cap=document.createElement('div');
+      cap.style.fontSize='10px';cap.textContent='layer '+name;
+      img=document.createElement('img');
+      img.id='act_'+name;img.className='chart';
+      wrap.appendChild(cap);wrap.appendChild(img);actdiv.appendChild(wrap);
+    }
+    if(img.src!==url) img.src=url;
+  }
   scatter(document.getElementById('tsne'),
           await (await fetch('api/tsne')).json());
+}
+let histPinned=false;
+document.getElementById('histslider').addEventListener('input',()=>{
+  const s=document.getElementById('histslider');
+  histPinned=Number(s.value)<Number(s.max);
+  refresh();
+});
+function flow(svg,f){
+  svg.innerHTML='';
+  if(!f||!f.nodes||!f.nodes.length) return;
+  const ns='http://www.w3.org/2000/svg';
+  const incoming={};f.nodes.forEach(n=>incoming[n.name]=[]);
+  (f.edges||[]).forEach(e=>{if(incoming[e.to])incoming[e.to].push(e.from);});
+  const level={};
+  function lv(n){
+    if(level[n]!=null) return level[n];
+    level[n]=-1; // cycle guard
+    const ins=incoming[n]||[];
+    level[n]=ins.length?1+Math.max(...ins.map(lv)):0;
+    return level[n];
+  }
+  f.nodes.forEach(n=>lv(n.name));
+  const byLevel={};
+  f.nodes.forEach(n=>{(byLevel[level[n.name]]=byLevel[level[n.name]]||[]).push(n);});
+  const BW=118,BH=30,GX=10,GY=18,P=10;
+  const nLevels=Math.max(...Object.keys(byLevel).map(Number))+1;
+  const H=P*2+nLevels*(BH+GY);
+  svg.setAttribute('height',H);
+  const posOf={};
+  for(const [l,nodes] of Object.entries(byLevel)){
+    nodes.forEach((n,i)=>{
+      posOf[n.name]=[P+i*(BW+GX),P+Number(l)*(BH+GY)];
+    });
+  }
+  (f.edges||[]).forEach(e=>{
+    const a=posOf[e.from],b=posOf[e.to];
+    if(!a||!b) return;
+    const p=document.createElementNS(ns,'path');
+    p.setAttribute('d','M'+(a[0]+BW/2)+','+(a[1]+BH)
+                   +' L'+(b[0]+BW/2)+','+b[1]);
+    p.setAttribute('stroke','#999');p.setAttribute('fill','none');
+    svg.appendChild(p);
+  });
+  f.nodes.forEach(n=>{
+    const [x,y]=posOf[n.name];
+    const r=document.createElementNS(ns,'rect');
+    r.setAttribute('x',x);r.setAttribute('y',y);
+    r.setAttribute('width',BW);r.setAttribute('height',BH);
+    r.setAttribute('rx',4);
+    r.setAttribute('fill',n.layerType==='Input'?'#fff3d6':'#e8f0fe');
+    r.setAttribute('stroke','#888');
+    svg.appendChild(r);
+    const t=document.createElementNS(ns,'text');
+    t.setAttribute('x',x+4);t.setAttribute('y',y+12);
+    t.setAttribute('font-size',9);
+    t.textContent=n.name+' ('+n.layerType+')';
+    svg.appendChild(t);
+    const t2=document.createElementNS(ns,'text');
+    t2.setAttribute('x',x+4);t2.setAttribute('y',y+24);
+    t2.setAttribute('font-size',8);t2.setAttribute('fill','#666');
+    t2.textContent=(n.nOut?'nOut '+n.nOut+' ':'')
+      +(n.numParams?n.numParams+' params':'');
+    svg.appendChild(t2);
+  });
 }
 setInterval(refresh,2000); refresh();
 </script></body></html>
 """
 
 
+def _grid_to_data_url(grid) -> str:
+    """[H, W] float grid in [0, 1] -> PNG data URL (the activation-grid
+    render the reference's ConvolutionalIterationListener writes as PNG,
+    ref: deeplearning4j-ui-parent ConvolutionalIterationListener.java)."""
+    import base64
+
+    import numpy as np
+    arr = np.asarray(grid, np.float32)
+    lo, hi = float(arr.min()), float(arr.max())
+    arr = (arr - lo) / (hi - lo) if hi > lo else arr * 0.0
+    img = (arr * 255).astype(np.uint8)
+    try:
+        import io as _io
+
+        from PIL import Image
+        buf = _io.BytesIO()
+        Image.fromarray(img, mode="L").save(buf, format="PNG")
+        payload = buf.getvalue()
+        mime = "image/png"
+    except Exception:  # PIL-free fallback: tiny PGM (browsers skip it,
+        payload = (b"P5 %d %d 255\n" % (img.shape[1], img.shape[0])  # tests
+                   + img.tobytes())                                  # don't)
+        mime = "image/x-portable-graymap"
+    return f"data:{mime};base64," + base64.b64encode(payload).decode()
+
+
 class _Handler(BaseHTTPRequestHandler):
     storage: StatsStorage = None  # set by UIServer
     tsne_data: Optional[dict] = None  # latest posted 2-d embedding
+    flow_data: Optional[dict] = None  # network graph (flow view)
+    activation_data: Optional[dict] = None  # layer -> PNG data URL
+    _hist_index: dict = {}  # sid -> [n_reports_seen, carrying_reports]
 
     def log_message(self, *args):  # quiet
         pass
@@ -244,22 +374,48 @@ class _Handler(BaseHTTPRequestHandler):
         elif url.path == "/api/histograms":
             q = urllib.parse.parse_qs(url.query)
             sid = q.get("id", [""])[0]
-            # latest report carrying histogram series (they're emitted
-            # every histogram_frequency iterations, not every report)
-            out = {"param": {}, "grad": {}, "iteration": None}
-            for r in reversed(self.storage.get_reports(sid)):
-                hists = {k: v for k, v in r.series.items()
-                         if k.startswith(("hist_param:", "hist_grad:"))}
-                if not hists:
-                    continue
-                for k, v in hists.items():
+            want = q.get("iter", [None])[0]
+            try:
+                want = None if want is None else int(want)
+            except ValueError:
+                want = None  # malformed scrub value -> latest
+            # histogram series are emitted every histogram_frequency
+            # iterations, not every report; expose every such iteration so
+            # the page's scrubber can navigate history (ref: the Play
+            # TrainModule's iteration-indexed histogram store). The
+            # carrying-report index is maintained INCREMENTALLY per
+            # session (storage is append-only): the 2s dashboard poll
+            # must not rescan every report's key set each time.
+            out = {"param": {}, "grad": {}, "iteration": None,
+                   "iterations": []}
+            reports = self.storage.get_reports(sid)
+            cache = type(self)._hist_index.setdefault(sid, [0, []])
+            seen, carrying = cache
+            for r in reports[seen:]:
+                if any(k.startswith(("hist_param:", "hist_grad:"))
+                       for k in r.series):
+                    carrying.append(r)
+            cache[0] = len(reports)
+            out["iterations"] = [r.iteration for r in carrying]
+            if carrying:
+                if want is None:
+                    pick = carrying[-1]
+                else:
+                    pick = min(carrying,
+                               key=lambda r: abs(r.iteration - want))
+                for k, v in pick.series.items():
+                    if not k.startswith(("hist_param:", "hist_grad:")):
+                        continue
                     kind = "param" if k.startswith("hist_param:") else "grad"
                     name, part = k.split(":", 1)[1].rsplit("#", 1)
                     out[kind].setdefault(name, {})[part] = \
                         [float(x) for x in v]
-                out["iteration"] = r.iteration
-                break
+                out["iteration"] = pick.iteration
             self._send(200, json.dumps(out).encode())
+        elif url.path == "/api/flow":
+            self._send(200, json.dumps(self.flow_data or {}).encode())
+        elif url.path == "/api/activations":
+            self._send(200, json.dumps(self.activation_data or {}).encode())
         elif url.path == "/api/tsne":
             self._send(200, json.dumps(self.tsne_data or {}).encode())
         else:
@@ -290,6 +446,18 @@ class _Handler(BaseHTTPRequestHandler):
                 "y": [float(v) for v in d.get("y", [])],
                 "labels": [str(v) for v in d.get("labels", [])]}
             self._send(200, b"{}")
+        elif url.path == "/api/flow":
+            d = json.loads(body.decode())
+            type(self).flow_data = {"nodes": d.get("nodes", []),
+                                    "edges": d.get("edges", []),
+                                    "score": d.get("score")}
+            self._send(200, b"{}")
+        elif url.path == "/api/activations":
+            d = json.loads(body.decode())
+            cur = dict(type(self).activation_data or {})
+            cur[str(d["layer"])] = _grid_to_data_url(d["grid"])
+            type(self).activation_data = cur
+            self._send(200, b"{}")
         else:
             self._send(404, b"{}")
 
@@ -303,7 +471,8 @@ class UIServer:
     def __init__(self, port: int = 9000,
                  storage: Optional[StatsStorage] = None):
         self.storage = storage or InMemoryStatsStorage()
-        handler = type("BoundHandler", (_Handler,), {"storage": self.storage})
+        handler = type("BoundHandler", (_Handler,),
+                       {"storage": self.storage, "_hist_index": {}})
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
@@ -320,6 +489,34 @@ class UIServer:
         """Serve an existing storage (ref: UIServer.attach(StatsStorage))."""
         self.storage = storage
         self._httpd.RequestHandlerClass.storage = storage
+        self._httpd.RequestHandlerClass._hist_index = {}  # new source
+
+    def post_flow(self, model_or_snapshot, score=None) -> None:
+        """Feed the network-graph (flow) view: a FlowIterationListener
+        snapshot dict, or a model to describe now (ref: the Play UI's
+        module/flow/ + FlowIterationListener)."""
+        from deeplearning4j_tpu.ui.listeners import FlowIterationListener
+        if isinstance(model_or_snapshot, dict):
+            snap = dict(model_or_snapshot)
+        else:
+            m = model_or_snapshot
+            if hasattr(m.conf, "nodes"):  # ComputationGraph
+                snap = FlowIterationListener._describe_graph(m)
+            else:
+                snap = FlowIterationListener._describe_multilayer(m)
+        if score is not None:
+            snap["score"] = float(score)
+        self._httpd.RequestHandlerClass.flow_data = snap
+
+    def post_conv_activations(self, renders) -> None:
+        """Publish ConvolutionalIterationListener activation grids
+        ({layer: [H, W] array}) as PNGs on the dashboard (ref:
+        ConvolutionalIterationListener.java's rendered grids)."""
+        handler = self._httpd.RequestHandlerClass
+        cur = dict(handler.activation_data or {})
+        for k, grid in renders.items():
+            cur[str(k)] = _grid_to_data_url(grid)
+        handler.activation_data = cur
 
     def post_tsne(self, coords, labels=None) -> None:
         """Feed the t-SNE view a [N, 2] embedding (e.g. the output of
