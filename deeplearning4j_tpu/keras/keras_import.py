@@ -361,6 +361,7 @@ class KerasModelImport:
             input_types[iname] = it
             alias[iname] = iname
 
+        groups: Dict[str, str] = {}  # node name -> h5 group path rel. root
         for lc in layer_cfgs:
             cls = lc["class_name"]
             kcfg = _cfg(lc)
@@ -373,37 +374,9 @@ class KerasModelImport:
             srcs = [alias[s] for s in _inbound_names(inbound)]
             if cls == "InputLayer":
                 continue  # added above, in input_layers order
-            if cls in _MERGE_CLASSES:
-                gb.add_vertex(name, _MERGE_CLASSES[cls](kcfg), *srcs)
-                alias[name] = name
-                continue
-            if cls == "Merge":  # Keras 1.x
-                mode = kcfg.get("mode", "sum")
-                if mode not in _KERAS1_MERGE_MODES:
-                    raise ValueError(f"Unsupported Merge mode {mode!r}")
-                gb.add_vertex(name, _KERAS1_MERGE_MODES[mode](), *srcs)
-                alias[name] = name
-                continue
-            mapped = KerasLayerMapper.map(cls, kcfg)
-            if mapped in ("flatten", "input"):
-                # collapses into the auto preprocessor of the consumer
-                alias[name] = srcs[0]
-                continue
-            if name in out_set and isinstance(mapped, DenseLayer) \
-                    and not isinstance(mapped, OutputLayer):
-                loss = "mcxent" if mapped.activation == "softmax" else "mse"
-                mapped = OutputLayer(n_out=mapped.n_out,
-                                     activation=mapped.activation, loss=loss)
-            gb.add_layer(name, mapped, *srcs)
-            alias[name] = name
-            kept_names.append(name)
-            if isinstance(mapped, (LSTM, GRU, SimpleRnn)) \
-                    and not kcfg.get("return_sequences", False):
-                # Keras LSTM default emits only the final step; ours emits
-                # the sequence — append a LastTimeStepVertex
-                from deeplearning4j_tpu.nn.conf.graph import LastTimeStepVertex
-                gb.add_vertex(name + "__last", LastTimeStepVertex(), name)
-                alias[name] = name + "__last"
+            alias[name] = KerasModelImport._emit_layer(
+                gb, kept_names, groups, name, cls, kcfg, srcs, out_set,
+                name)
 
         gb.set_outputs(*[alias[o] for o in output_names])
         gb.set_input_types(*[input_types[i] for i in input_types])
@@ -411,7 +384,141 @@ class KerasModelImport:
         net = ComputationGraph(conf)
         net.init()
         net._keras_names = kept_names  # node name == keras layer name
+        net._keras_groups = groups
         return net
+
+    @staticmethod
+    def _emit_layer(gb, kept, groups, node_name, cls, kcfg, srcs, out_set,
+                    h5_path, nested_ctx=None):
+        """Add one Keras layer (or merge vertex, or nested submodel) to the
+        graph builder; returns the node name producing its output.
+        ``h5_path`` is the weight-group path (or list of candidate paths)
+        relative to the weights root — the keras name at top level;
+        nested layers live at ``<outer>/<outer>/<inner>`` (Sequential
+        submodels) or ``<outer>/<inner>`` (functional submodels) in the
+        legacy HDF5 layout, so nested nodes carry both candidates.
+        ``nested_ctx``: (top outer name, relative prefix) when emitting
+        inside a submodel."""
+        if cls in _MERGE_CLASSES:
+            gb.add_vertex(node_name, _MERGE_CLASSES[cls](kcfg), *srcs)
+            return node_name
+        if cls == "Merge":  # Keras 1.x
+            mode = kcfg.get("mode", "sum")
+            if mode not in _KERAS1_MERGE_MODES:
+                raise ValueError(f"Unsupported Merge mode {mode!r}")
+            gb.add_vertex(node_name, _KERAS1_MERGE_MODES[mode](), *srcs)
+            return node_name
+        if cls in ("Sequential", "Functional", "Model"):
+            top, rel = nested_ctx or (node_name, "")
+            return KerasModelImport._inline_submodel(
+                gb, kept, groups, node_name, cls, kcfg, srcs, out_set,
+                top, rel)
+        mapped = KerasLayerMapper.map(cls, kcfg)
+        if mapped in ("flatten", "input"):
+            # collapses into the auto preprocessor of the consumer
+            return srcs[0]
+        if node_name in out_set and isinstance(mapped, DenseLayer) \
+                and not isinstance(mapped, OutputLayer):
+            loss = "mcxent" if mapped.activation == "softmax" else "mse"
+            mapped = OutputLayer(n_out=mapped.n_out,
+                                 activation=mapped.activation, loss=loss)
+        gb.add_layer(node_name, mapped, *srcs)
+        kept.append(node_name)
+        groups[node_name] = h5_path
+        if isinstance(mapped, (LSTM, GRU, SimpleRnn)) \
+                and not kcfg.get("return_sequences", False):
+            # Keras LSTM default emits only the final step; ours emits
+            # the sequence — append a LastTimeStepVertex
+            from deeplearning4j_tpu.nn.conf.graph import LastTimeStepVertex
+            gb.add_vertex(node_name + "__last", LastTimeStepVertex(),
+                          node_name)
+            return node_name + "__last"
+        return node_name
+
+    @staticmethod
+    def _inline_submodel(gb, kept, groups, outer_name, cls, kcfg, srcs,
+                         out_set, top, rel_prefix):
+        """Inline a nested Sequential/Functional model as prefixed graph
+        nodes (ref: KerasModel.java handles nested models by recursion).
+        ``top`` is the top-level submodel's keras name (the h5 group);
+        ``rel_prefix`` the path inside nested submodels so far."""
+        layers_cfg = kcfg["layers"]
+
+        def inner_emit(iname, icls, icfg, isrcs, inner_out_set):
+            # '.'-separated node names: '/' would collide with the
+            # sharded-checkpoint leaf-path join (parallel/checkpoint.py)
+            node = f"{outer_name}.{iname}"
+            rel = rel_prefix + iname
+            return KerasModelImport._emit_layer(
+                gb, kept, groups, node, icls, icfg, isrcs, inner_out_set,
+                [f"{top}/{top}/{rel}", f"{top}/{rel}"],
+                nested_ctx=(top, rel + "/"))
+
+        # the submodel's output should become a loss head only when the
+        # submodel itself IS a network output
+        convert_out = outer_name in out_set
+
+        if cls == "Sequential":
+            if len(srcs) != 1:
+                raise ValueError(
+                    f"Nested Sequential {outer_name!r} needs exactly one "
+                    f"input, got {len(srcs)}")
+            # convert to a loss head only when the submodel's FINAL
+            # emitting layer is a Dense (a mid-sequence Dense followed by
+            # Dropout/Activation must stay an inner layer)
+            fin = next((lc for lc in reversed(layers_cfg)
+                        if lc["class_name"] not in ("InputLayer",
+                                                    "Flatten")), None)
+            inner_out = frozenset()
+            if convert_out and fin is not None \
+                    and fin["class_name"] == "Dense":
+                fname = _cfg(fin).get("name", fin.get("name"))
+                inner_out = {f"{outer_name}.{fname}"}
+            prev = srcs[0]
+            for lc in layers_cfg:
+                icls = lc["class_name"]
+                icfg = _cfg(lc)
+                iname = icfg.get("name", lc.get("name"))
+                if icls == "InputLayer":
+                    continue
+                prev = inner_emit(iname, icls, icfg, [prev], inner_out)
+            return prev
+
+        # nested functional Model: positional inputs map onto the outer
+        # sources; single output only (multi-output submodels have no
+        # single downstream node to alias)
+        in_names = _layer_refs(kcfg.get("input_layers", []))
+        if not in_names:
+            in_names = [_cfg(lc).get("name", lc.get("name"))
+                        for lc in layers_cfg
+                        if lc["class_name"] == "InputLayer"]
+        out_refs = _layer_refs(kcfg.get("output_layers", []))
+        if len(out_refs) != 1:
+            raise ValueError(
+                f"Nested model {outer_name!r} has {len(out_refs)} "
+                "outputs; only single-output submodels import")
+        if len(in_names) != len(srcs):
+            raise ValueError(
+                f"Nested model {outer_name!r} takes {len(in_names)} "
+                f"inputs, got {len(srcs)}")
+        sub_alias = dict(zip(in_names, srcs))
+        inner_out = ({f"{outer_name}.{out_refs[0]}"} if convert_out
+                     else frozenset())
+        for lc in layers_cfg:
+            icls = lc["class_name"]
+            icfg = _cfg(lc)
+            iname = icfg.get("name", lc.get("name"))
+            if icls == "InputLayer":
+                continue
+            inbound = lc.get("inbound_nodes", [])
+            if len(inbound) > 1:
+                raise ValueError(
+                    f"Layer {iname!r} in nested model {outer_name!r} is "
+                    "shared; shared-layer import is unsupported")
+            isrcs = [sub_alias[s] for s in _inbound_names(inbound)]
+            sub_alias[iname] = inner_emit(iname, icls, icfg, isrcs,
+                                          inner_out)
+        return sub_alias[out_refs[0]]
 
     @staticmethod
     def _layer_datasets(h5: Hdf5Archive, group: str) -> Dict[str, np.ndarray]:
@@ -430,10 +537,16 @@ class KerasModelImport:
     @staticmethod
     def _load_graph_weights(h5: Hdf5Archive, net: ComputationGraph) -> None:
         root = KerasModelImport._weights_root(h5)
+        groups = getattr(net, "_keras_groups", {})
         for name in net._keras_names:
             layer = net.conf.nodes[name].layer
-            group = f"{root}/{name}".replace("//", "/")
-            datasets = KerasModelImport._layer_datasets(h5, group)
+            cand = groups.get(name, name)
+            datasets = {}
+            for c in ([cand] if isinstance(cand, str) else cand):
+                datasets = KerasModelImport._layer_datasets(
+                    h5, f"{root}/{c}".replace("//", "/"))
+                if datasets:
+                    break
             if not datasets:
                 continue
             KerasModelImport._set_layer_weights(net, name, layer, datasets)

@@ -119,6 +119,10 @@ class TrainingConfig:
     tbptt_fwd_length: int = 20
     tbptt_bwd_length: int = 20
     dtype: str = "float32"
+    # rematerialization: recompute per-layer activations in the backward
+    # pass instead of storing them (jax.checkpoint). Trades FLOPs for HBM
+    # — the standard TPU lever for batch sizes that don't otherwise fit.
+    remat: bool = False
 
     def to_dict(self) -> dict:
         d = dict(self.__dict__)
@@ -369,6 +373,12 @@ class NeuralNetConfiguration:
 
     def dtype(self, dt: str) -> "NeuralNetConfiguration":
         self._training.dtype = dt
+        return self
+
+    def gradient_checkpointing(self, flag: bool = True) -> "NeuralNetConfiguration":
+        """Rematerialize per-layer activations in backward (jax.checkpoint)
+        — trade recompute FLOPs for HBM so larger batches fit."""
+        self._training.remat = flag
         return self
 
     # ---- transition to layer stacking ----

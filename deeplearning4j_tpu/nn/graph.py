@@ -172,6 +172,8 @@ class ComputationGraph(LazyScoreMixin):
                 out_masks[name] = cur_mask
                 new_states[name] = states[name]
                 continue
+            # remat (conf.gradient_checkpointing): recompute in backward
+            remat = train and self.conf.training.remat
             if carries is not None and getattr(layer, "supports_carry", False):
                 c_in = carries.get(name)
                 if c_in is None:
@@ -179,13 +181,21 @@ class ComputationGraph(LazyScoreMixin):
                 # scan() bypasses apply(): input dropout must still fire
                 # so tBPTT training regularizes like standard BPTT
                 h = layer._dropout_input(h, train and not layer.frozen, sub)
-                h, c_out = layer.scan(params[name], h, c_in, cur_mask)
+                scan_fn = (jax.checkpoint(layer.scan) if remat
+                           else layer.scan)
+                h, c_out = scan_fn(params[name], h, c_in, cur_mask)
                 new_carries[name] = c_out
                 s = states[name]
             else:
                 layer_train = train and not layer.frozen
-                h, s = layer.apply(params[name], h, state=states[name],
-                                   train=layer_train, rng=sub, mask=cur_mask)
+
+                def apply_fn(p, hh, s_in, r, m, _l=layer, _t=layer_train):
+                    return _l.apply(p, hh, state=s_in, train=_t, rng=r,
+                                    mask=m)
+                if remat:
+                    apply_fn = jax.checkpoint(apply_fn)
+                h, s = apply_fn(params[name], h, states[name], sub,
+                                cur_mask)
                 if layer.frozen:
                     s = states[name]
             acts[name] = h

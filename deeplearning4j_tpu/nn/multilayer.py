@@ -157,6 +157,10 @@ class MultiLayerNetwork(LazyScoreMixin):
                 acts.append(h)
                 new_states.append(states[i])
                 break
+            # remat: recompute this layer's activations in backward
+            # instead of storing them (conf.gradient_checkpointing) —
+            # trades FLOPs for HBM on memory-bound models
+            remat = train and self.conf.training.remat
             if carries is not None and getattr(layer, "supports_carry", False):
                 c_in = carries[i]
                 if c_in is None:
@@ -164,13 +168,20 @@ class MultiLayerNetwork(LazyScoreMixin):
                 # scan() bypasses apply(): input dropout must still fire
                 # so tBPTT training regularizes like standard BPTT
                 h = layer._dropout_input(h, train and not layer.frozen, sub)
-                h, c_out = layer.scan(params[i], h, c_in, cur_mask)
+                scan_fn = (jax.checkpoint(layer.scan) if remat
+                           else layer.scan)
+                h, c_out = scan_fn(params[i], h, c_in, cur_mask)
                 new_carries[i] = c_out
                 s = states[i]
             else:
                 layer_train = train and not layer.frozen
-                h, s = layer.apply(params[i], h, state=states[i],
-                                   train=layer_train, rng=sub, mask=cur_mask)
+
+                def apply_fn(p, hh, s_in, r, m, _l=layer, _t=layer_train):
+                    return _l.apply(p, hh, state=s_in, train=_t, rng=r,
+                                    mask=m)
+                if remat:
+                    apply_fn = jax.checkpoint(apply_fn)
+                h, s = apply_fn(params[i], h, states[i], sub, cur_mask)
                 if layer.frozen:
                     s = states[i]  # frozen: BN running stats don't move
             # layers that consume or rearrange the time axis drop the mask

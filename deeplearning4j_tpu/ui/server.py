@@ -60,6 +60,7 @@ _PAGE = """<!doctype html>
 </div>
 <h2>Network graph (flow)</h2><svg id="flow" class="chart" width="860" height="80"></svg>
 <h2>Conv activations</h2><div id="acts"></div>
+<h2>System</h2><div id="system" style="font-size:12px;color:#333"></div>
 <h2>t-SNE embedding</h2><svg id="tsne" class="chart" width="560" height="420"></svg>
 <script>
 const COLORS=['#1f77b4','#ff7f0e','#2ca02c','#d62728','#9467bd','#8c564b',
@@ -221,6 +222,9 @@ async function refresh(){
     }
     if(img.src!==url) img.src=url;
   }
+  const sys=await (await fetch('api/system')).json();
+  document.getElementById('system').textContent=
+    Object.entries(sys).map(([k,v])=>k+': '+JSON.stringify(v)).join('  |  ');
   scatter(document.getElementById('tsne'),
           await (await fetch('api/tsne')).json());
 }
@@ -291,6 +295,55 @@ function flow(svg,f){
 setInterval(refresh,2000); refresh();
 </script></body></html>
 """
+
+
+def _system_info() -> dict:
+    """Live host stats for the system tab (ref: the Play TrainModule's
+    system tab — JVM memory / hardware utilization; here process RSS,
+    host memory, load average, device inventory)."""
+    import os
+    import resource
+    import sys
+
+    info = {
+        "python": sys.version.split()[0],
+        "pid": os.getpid(),
+        "load_avg": list(os.getloadavg()),
+        "cpus": os.cpu_count(),
+    }
+    try:  # live RSS (ru_maxrss is the lifetime PEAK, and byte-scaled on
+        with open("/proc/self/status") as f:  # macOS) — report both
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    info["rss_mb"] = round(int(line.split()[1]) / 1024, 1)
+                    break
+    except OSError:  # pragma: no cover - non-procfs platforms
+        pass
+    info["peak_rss_mb"] = round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1)
+    try:
+        mem = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, _, v = line.partition(":")
+                if k in ("MemTotal", "MemAvailable"):
+                    mem[k] = round(int(v.split()[0]) / 1024, 1)
+        info["mem_total_mb"] = mem.get("MemTotal")
+        info["mem_available_mb"] = mem.get("MemAvailable")
+    except OSError:  # pragma: no cover - non-procfs platforms
+        pass
+    try:  # device inventory — only if this process ALREADY initialized a
+        # jax backend (never import/init from the dashboard thread)
+        if "jax" in sys.modules:
+            import jax
+            from jax._src import xla_bridge
+            if xla_bridge._backends:
+                info["devices"] = [
+                    f"{getattr(d, 'device_kind', d.platform)} "
+                    f"({d.platform})" for d in jax.devices()]
+    except Exception:  # noqa: BLE001 — never fail the endpoint
+        pass
+    return info
 
 
 def _grid_to_data_url(grid) -> str:
@@ -418,6 +471,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, json.dumps(self.activation_data or {}).encode())
         elif url.path == "/api/tsne":
             self._send(200, json.dumps(self.tsne_data or {}).encode())
+        elif url.path == "/api/system":
+            self._send(200, json.dumps(_system_info()).encode())
         else:
             self._send(404, b"{}")
 

@@ -160,6 +160,25 @@ def main():
     goldens["repeat_y"] = rep.predict(x, verbose=0)
     rep.save(os.path.join(HERE, "keras_repeat.h5"))
 
+    # --- nested models: Sequential + functional submodels inside a Model --
+    feat = keras.Sequential(name="feat", layers=[
+        layers.Input(shape=(6,), name="n_in1"),
+        layers.Dense(8, activation="relu", name="n_d1"),
+        layers.Dense(4, activation="tanh", name="n_d2"),
+    ])
+    fi = layers.Input(shape=(4,), name="n_fin")
+    fd = layers.Dense(5, activation="relu", name="n_fd")(fi)
+    funsub = keras.Model(fi, fd, name="funsub")
+    inp = layers.Input(shape=(6,), name="n_outer_in")
+    h = feat(inp)
+    h = funsub(h)
+    out = layers.Dense(3, activation="softmax", name="n_out")(h)
+    nested = keras.Model(inp, out, name="nested")
+    x = np.random.default_rng(8).normal(size=(4, 6)).astype(np.float32)
+    goldens["nested_x"] = x
+    goldens["nested_y"] = nested.predict(x, verbose=0)
+    nested.save(os.path.join(HERE, "keras_nested.h5"))
+
     np.savez(os.path.join(HERE, "keras_goldens.npz"), **goldens)
     print("wrote fixtures:", sorted(goldens.keys()))
 

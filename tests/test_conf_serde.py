@@ -113,3 +113,72 @@ def test_restored_conf_builds_working_net():
     out = net.output(np.random.default_rng(0).normal(size=(5, 8)).astype(np.float32))
     assert out.shape == (5, 3)
     assert np.allclose(np.asarray(out).sum(axis=-1), 1.0, atol=1e-5)
+
+
+def test_json_round_trip_new_layers():
+    """GRU / Reshape / Permute / RepeatVector / TimeDistributed(inner)
+    survive the JSON round-trip (polymorphic registry incl. the nested
+    inner layer)."""
+    from deeplearning4j_tpu.nn.layers import (
+        GRU, DenseLayer, LastTimeStepLayer, OutputLayer, PermuteLayer,
+        RepeatVectorLayer, ReshapeLayer, TimeDistributedLayer,
+    )
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater("adam", learning_rate=0.01).weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=12, activation="relu"))
+            .layer(ReshapeLayer(target_shape=(3, 4)))
+            .layer(PermuteLayer(dims=(2, 1)))
+            .layer(TimeDistributedLayer(
+                inner=DenseLayer(n_out=5, activation="tanh")))
+            .layer(GRU(n_out=6))
+            .layer(LastTimeStepLayer())
+            .layer(OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.feed_forward(12))
+            .build())
+    j = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(j)
+    assert conf2.to_json() == j
+    net = MultiLayerNetwork(conf2).init()
+    out = net.output(np.zeros((2, 12), np.float32))
+    assert out.shape == (2, 3)
+    td = conf2.layers[3]
+    assert isinstance(td, TimeDistributedLayer)
+    assert isinstance(td.inner, DenseLayer) and td.inner.n_out == 5
+    assert conf2.layers[4].reset_after is True
+    assert isinstance(conf2.layers[5], LastTimeStepLayer)
+
+
+def test_gradient_checkpointing_same_result():
+    """remat recomputes activations in backward — identical updates, just
+    less memory (gradient equality is the contract)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.layers import ConvolutionLayer
+
+    def build(remat):
+        b = (NeuralNetConfiguration.builder().seed(3)
+             .updater("sgd").learning_rate(0.1).weight_init("xavier"))
+        if remat:
+            b = b.gradient_checkpointing()
+        return MultiLayerNetwork(
+            b.list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    activation="relu"))
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build()).init()
+
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(4, 8, 8, 1)).astype(np.float32),
+                 np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)])
+    a, b = build(False), build(True)
+    assert b.conf.training.remat is True
+    la = float(a.fit_batch(ds))
+    lb = float(b.fit_batch(ds))
+    assert abs(la - lb) < 1e-6
+    np.testing.assert_allclose(a.params_flat(), b.params_flat(),
+                               rtol=1e-6, atol=1e-7)
+    # round-trips through JSON too
+    conf2 = MultiLayerConfiguration.from_json(b.conf.to_json())
+    assert conf2.training.remat is True

@@ -96,6 +96,19 @@ def test_repeat_vector_sequential_golden(goldens):
     np.testing.assert_allclose(out, goldens["repeat_y"], atol=1e-4)
 
 
+def test_nested_submodels_golden(goldens):
+    """A functional model containing a nested Sequential AND a nested
+    functional submodel imports by inlining (prefixed nodes, nested h5
+    weight groups) and matches Keras predictions."""
+    net = KerasModelImport.import_keras_model_and_weights(
+        _fixture("keras_nested.h5"))
+    assert isinstance(net, ComputationGraph)
+    names = set(net.conf.nodes)
+    assert "feat.n_d1" in names and "funsub.n_fd" in names
+    out = np.asarray(net.output(goldens["nested_x"]))
+    np.testing.assert_allclose(out, goldens["nested_y"], atol=1e-5)
+
+
 def test_functional_entry_delegates_sequential(goldens):
     """import_keras_model_and_weights on a Sequential file delegates."""
     net = KerasModelImport.import_keras_model_and_weights(

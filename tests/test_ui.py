@@ -306,3 +306,17 @@ def test_activation_grid_endpoint():
         assert "x" in a2
     finally:
         server.stop()
+
+
+def test_system_endpoint():
+    """Live host stats (the Play TrainModule system-tab analog)."""
+    server = UIServer(port=0).start()
+    try:
+        s = json.loads(urllib.request.urlopen(
+            server.url + "/api/system", timeout=5).read())
+        assert s["cpus"] >= 1 and s["rss_mb"] > 0
+        assert "mem_total_mb" in s and "load_avg" in s
+        page = urllib.request.urlopen(server.url + "/", timeout=5).read()
+        assert b"System" in page
+    finally:
+        server.stop()
