@@ -60,6 +60,7 @@ BENCH_HISTORY = {
     "resnet50_b64_bf16_samples_per_sec_per_chip": None,
     "resnet50_96px_b16_bf16_samples_per_sec_per_chip": None,
     "lenet_mnist_b128_samples_per_sec_per_chip": None,
+    "charlstm_b32_t64_samples_per_sec_per_chip": None,
 }
 
 # Peak bf16 matmul FLOP/s per chip, by device_kind substring (public cloud
@@ -120,7 +121,17 @@ def _rung_config(rung: str, smoke: bool):
                     batch=2 if smoke else 64, steps=2 if smoke else 20,
                     warmup=1 if smoke else 2, dtype="bfloat16",
                     metric="resnet50_b64_bf16_samples_per_sec_per_chip")
-    raise ValueError(f"unknown rung {rung!r}; valid: {_RUNGS}")
+    if rung == "lstm":
+        # BASELINE config #4: GravesLSTM char-RNN (off the default ladder;
+        # opt in with BENCH_RUNGS=lenet,lstm,...). H=256 keeps the Pallas
+        # H%128 gate satisfied so TPU runs exercise the compiled kernel.
+        return dict(model="charlstm", height=0, width=0,
+                    channels=8 if smoke else 64,      # timesteps
+                    classes=16 if smoke else 96,      # charset
+                    batch=4 if smoke else 32, steps=2 if smoke else 20,
+                    warmup=1 if smoke else 2, dtype="float32",
+                    metric="charlstm_b32_t64_samples_per_sec_per_chip")
+    raise ValueError(f"unknown rung {rung!r}; valid: {_RUNGS} + ('lstm',)")
 
 
 # ---------------------------------------------------------------------------
@@ -204,6 +215,22 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
         net = MultiLayerNetwork(lenet_mnist(
             height=height, width=width, updater="nesterovs",
             learning_rate=0.01)).init()
+    elif cfg["model"] == "charlstm":
+        from deeplearning4j_tpu import (InputType,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import (GravesLSTM,
+                                                  RnnOutputLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        T, K = cfg["channels"], cfg["classes"]
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.builder().seed(7)
+            .updater("rmsprop", learning_rate=1e-3).weight_init("xavier")
+            .list()
+            .layer(GravesLSTM(n_out=256, activation="tanh"))
+            .layer(GravesLSTM(n_out=256, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=K, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(K, T)).build()).init()
     else:
         from deeplearning4j_tpu.models.resnet import resnet50
         from deeplearning4j_tpu.nn.graph import ComputationGraph
@@ -222,6 +249,11 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
     def batches(n):
         out = []
         for _ in range(n):
+            if cfg["model"] == "charlstm":  # one-hot char sequences
+                ids = rng.integers(0, K, (batch, C + 1))
+                eye = np.eye(K, dtype=np.float32)
+                out.append(DataSet(eye[ids[:, :-1]], eye[ids[:, 1:]]))
+                continue
             x = rng.normal(size=(batch, height, width, C)).astype(np.float32)
             y = np.eye(K, dtype=np.float32)[rng.integers(0, K, batch)]
             out.append(DataSet(x, y))
@@ -239,10 +271,9 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
         dtype="bfloat16" if on_accel and cfg["dtype"] == "bfloat16"
         else None))
     jax.block_until_ready([d.features for d in staged])
-    mb = n_stage * batch * height * width * C * (
-        2 if cfg["dtype"] == "bfloat16" else 4) / 1e6
+    mb = sum(d.features.nbytes + d.labels.nbytes for d in staged) / 1e6
     _stamp(f"{n_stage} batches staged on device in "
-           f"{time.perf_counter() - t:.1f}s ({mb:.0f}MB)")
+           f"{time.perf_counter() - t:.1f}s ({mb:.1f}MB)")
 
     t = time.perf_counter()
     for i in range(warmup):

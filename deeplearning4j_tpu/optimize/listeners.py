@@ -13,6 +13,8 @@ import logging
 import time
 from typing import List, Optional, Tuple
 
+import numpy as np
+
 logger = logging.getLogger("deeplearning4j_tpu")
 
 
@@ -86,3 +88,92 @@ class CollectScoresIterationListener(IterationListener):
     def iteration_done(self, model, iteration, score):
         if iteration % self.frequency == 0:
             self.scores.append((iteration, float(score)))
+
+class ComposableIterationListener(TrainingListener):
+    """Dispatch to a collection of listeners as one
+    (ref: ComposableIterationListener.java). Subclasses TrainingListener
+    and forwards every hook so wrapped TrainingListeners still receive
+    epoch callbacks (containers isinstance-check the TOP-level listener)."""
+
+    def __init__(self, *listeners: IterationListener):
+        self.listeners: List[IterationListener] = list(listeners)
+
+    @property
+    def collects_gradients(self) -> bool:
+        # containers scan top-level listeners for this flag when deciding
+        # whether the train step must emit gradients — forward the union
+        return any(getattr(l, "collects_gradients", False)
+                   for l in self.listeners)
+
+    def iteration_done(self, model, iteration, score):
+        for l in self.listeners:
+            l.iteration_done(model, iteration, score)
+
+    def _fan(self, hook, *args):
+        for l in self.listeners:
+            if isinstance(l, TrainingListener):
+                getattr(l, hook)(*args)
+
+    def on_epoch_start(self, model):
+        self._fan("on_epoch_start", model)
+
+    def on_epoch_end(self, model):
+        self._fan("on_epoch_end", model)
+
+    def on_forward_pass(self, model, activations):
+        self._fan("on_forward_pass", model, activations)
+
+    def on_gradient_calculation(self, model):
+        self._fan("on_gradient_calculation", model)
+
+    def on_backward_pass(self, model):
+        self._fan("on_backward_pass", model)
+
+
+class ParamAndGradientIterationListener(IterationListener):
+    """Per-iteration parameter/update magnitude statistics
+    (ref: ParamAndGradientIterationListener.java — mean magnitudes,
+    min/max, optionally written tab-separated to a file). Reads the
+    container's ``last_grads`` when a gradient-collecting listener (e.g.
+    StatsListener) made the train step emit them; otherwise reports
+    param stats only."""
+
+    collects_gradients = True  # ask the train step to output grads
+
+    def __init__(self, frequency: int = 1, output_file: Optional[str] = None):
+        self.frequency = max(1, frequency)
+        self.output_file = output_file
+        self.history: List[dict] = []
+        if output_file:
+            with open(output_file, "w") as f:
+                f.write("iteration\tscore\tparam_mean_mag\tparam_max\t"
+                        "grad_mean_mag\tgrad_max\n")
+
+    @staticmethod
+    def _stats(tree) -> Tuple[float, float]:
+        import jax
+        total, count, mx = 0.0, 0, 0.0
+        for x in jax.tree_util.tree_leaves(tree):
+            if not (hasattr(x, "shape") and np.size(x)):
+                continue
+            a = np.abs(np.asarray(x))  # per-leaf running reduction — no
+            total += float(a.sum())    # param-sized concatenated copy
+            count += a.size
+            mx = max(mx, float(a.max()))
+        return (total / count if count else 0.0), mx
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.frequency:
+            return
+        pm, px = self._stats(model.params)
+        grads = getattr(model, "last_grads", None)
+        gm, gx = self._stats(grads) if grads is not None else (float("nan"),) * 2
+        rec = {"iteration": iteration, "score": float(score),
+               "param_mean_mag": pm, "param_max": px,
+               "grad_mean_mag": gm, "grad_max": gx}
+        self.history.append(rec)
+        if self.output_file:
+            with open(self.output_file, "a") as f:
+                f.write(f"{iteration}\t{score}\t{pm}\t{px}\t{gm}\t{gx}\n")
+        logger.info("iter %d param |w| mean %.3e max %.3e; grad mean %.3e",
+                    iteration, pm, px, gm)

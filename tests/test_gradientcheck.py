@@ -9,10 +9,10 @@ import pytest
 from deeplearning4j_tpu import InputType, MultiLayerNetwork, NeuralNetConfiguration
 from deeplearning4j_tpu.gradientcheck import GradientCheckUtil
 from deeplearning4j_tpu.nn.layers import (
-    BatchNormalization, ConvolutionLayer, DenseLayer, EmbeddingLayer,
+    GRU, BatchNormalization, ConvolutionLayer, DenseLayer, EmbeddingLayer,
     GlobalPoolingLayer, GravesBidirectionalLSTM, GravesLSTM, LSTM,
-    LocalResponseNormalization, OutputLayer, RnnOutputLayer, SimpleRnn,
-    SubsamplingLayer,
+    LocalResponseNormalization, OutputLayer, PermuteLayer, ReshapeLayer,
+    RnnOutputLayer, SimpleRnn, SubsamplingLayer, TimeDistributedLayer,
 )
 
 RNG = np.random.default_rng(42)
@@ -107,7 +107,7 @@ def test_lrn_gradients():
 
 
 @pytest.mark.parametrize("layer_cls", [LSTM, GravesLSTM, GravesBidirectionalLSTM,
-                                       SimpleRnn])
+                                       SimpleRnn, GRU])
 def test_rnn_gradients(layer_cls):
     B, T, F, C = 3, 4, 3, 2
     labels = np.eye(C, dtype=np.float64)[RNG.integers(0, C, (B, T))]
@@ -164,3 +164,55 @@ def test_embedding_gradients():
             .build())
     feats = RNG.integers(0, V, (B, 1)).astype(np.float64)
     _check(conf, feats, labels)
+
+
+def test_gru_reset_before_gradients():
+    """The classic (reset_after=False) GRU formulation."""
+    B, T, F, C = 3, 4, 3, 2
+    labels = np.eye(C, dtype=np.float64)[RNG.integers(0, C, (B, T))]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .list()
+            .layer(GRU(n_out=4, activation="tanh", reset_after=False))
+            .layer(RnnOutputLayer(n_out=C, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(F))
+            .build())
+    _check(conf, RNG.normal(size=(B, T, F)), labels)
+
+
+def test_gru_masked_gradients():
+    B, T, F, C = 3, 5, 3, 2
+    labels = np.eye(C, dtype=np.float64)[RNG.integers(0, C, (B, T))]
+    mask = np.ones((B, T))
+    mask[0, 3:] = 0.0
+    mask[2, 1:] = 0.0
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .list()
+            .layer(GRU(n_out=4, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=C, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(F))
+            .build())
+    _check(conf, RNG.normal(size=(B, T, F)), labels,
+           features_mask=mask, labels_mask=mask)
+
+
+def test_shape_layers_gradients():
+    """Reshape -> Permute -> TimeDistributed(Dense) -> GRU chain: pure
+    shape ops must be gradient-transparent."""
+    B, C = 3, 2
+    labels = np.eye(C, dtype=np.float64)[RNG.integers(0, C, B)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .list()
+            .layer(DenseLayer(n_out=12, activation="tanh"))
+            .layer(ReshapeLayer(target_shape=(3, 4)))
+            .layer(PermuteLayer(dims=(2, 1)))
+            .layer(TimeDistributedLayer(
+                inner=DenseLayer(n_out=5, activation="tanh")))
+            .layer(GRU(n_out=4, activation="tanh"))
+            .layer(GlobalPoolingLayer(pooling_type="avg"))
+            .layer(OutputLayer(n_out=C, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+    _check(conf, RNG.normal(size=(B, 6)), labels)

@@ -98,3 +98,45 @@ def test_viterbi_noncontiguous_labels():
     np.testing.assert_array_equal(out, [1, 1, 1, 1, 1])
     with pytest.raises(ValueError, match="not in possible_labels"):
         v.decode(np.array([1, 3]))
+
+
+def test_composable_and_param_gradient_listeners(tmp_path):
+    """ComposableIterationListener fans out; ParamAndGradientIterationListener
+    records magnitude stats (and triggers gradient collection)
+    (ref: ComposableIterationListener.java,
+    ParamAndGradientIterationListener.java)."""
+    import numpy as np
+
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.optimize.listeners import (
+        CollectScoresIterationListener, ComposableIterationListener,
+        ParamAndGradientIterationListener,
+    )
+
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater("sgd").learning_rate(0.1).weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    collect = CollectScoresIterationListener()
+    pg = ParamAndGradientIterationListener(
+        output_file=str(tmp_path / "pg.tsv"))
+    # the composable forwards the nested collects_gradients flag, so the
+    # train step emits gradients even though pg is wrapped
+    net.set_listeners(ComposableIterationListener(collect, pg))
+
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(6, 4)).astype(np.float32),
+                 np.eye(3, dtype=np.float32)[rng.integers(0, 3, 6)])
+    for _ in range(3):
+        net.fit_batch(ds)
+    assert len(collect.scores) == 3
+    assert len(pg.history) == 3
+    assert pg.history[-1]["param_mean_mag"] > 0
+    assert np.isfinite(pg.history[-1]["grad_mean_mag"])  # grads collected
+    lines = (tmp_path / "pg.tsv").read_text().strip().splitlines()
+    assert len(lines) == 4 and lines[0].startswith("iteration")
