@@ -134,10 +134,18 @@ class LSTM(BaseLayerConf):
                 or (self.activation or "tanh") != "tanh"):
             return False
         if (mode == "compiled"
-                and os.environ.get("DL4J_TPU_PALLAS") != "force"
-                and ((self.n_out or 0) % 128 != 0
-                     or (batch is not None and batch % 8 != 0))):
-            return False
+                and os.environ.get("DL4J_TPU_PALLAS") != "force"):
+            H = self.n_out or 0
+            if H % 128 != 0 or (batch is not None and batch % 8 != 0):
+                return False
+            # VMEM residency gate: the kernel keeps RW [H, 4H] plus the
+            # (h, c) carries and one [B, 4H] slice on-chip; past ~12MB
+            # (of 16MB v5e VMEM) Mosaic spills or fails to allocate —
+            # fall back to scan rather than risk it un-validated
+            b = batch or 8
+            vmem = 4 * (H * 4 * H + 2 * b * H + 2 * b * 4 * H)
+            if vmem > 12 * 1024 * 1024:
+                return False
         return True
 
     def scan(self, params: Params, x: Array, carry, mask: Optional[Array],

@@ -56,12 +56,15 @@ class DelayedSyncTrainer:
                                   net.states)
         net.opt_state = net._tx.init(net.params)
         # per-worker gradient accumulator, worker axis sharded over 'data'
-        # — accumulation never crosses devices
+        # — accumulation never crosses devices. Each process contributes
+        # its local slice of the worker axis (shard_batch assembles the
+        # global array in the multi-process case).
         W = self.workers
+        w_local = W // max(jax.process_count(), 1)
         self._gbuf = jax.tree.map(
-            lambda x: jax.device_put(
-                jnp.zeros((W,) + x.shape, x.dtype),
-                self.mesh.batch_sharding(x.ndim + 1)),
+            lambda x: self.mesh.shard_batch(
+                jnp.zeros((w_local if jax.process_count() > 1 else W,)
+                          + x.shape, x.dtype)),
             net.params)
         self._since_sync = 0
         self._step = None
@@ -126,13 +129,23 @@ class DelayedSyncTrainer:
             lmask = (None if batch.labels_mask is None
                      else jnp.asarray(batch.labels_mask))
 
+        # multi-process: each host holds 1/process_count of the worker
+        # axis; reshape with the LOCAL worker count and let shard_batch
+        # assemble the global [W, ...] array
+        # (jax.make_array_from_process_local_data, as in multihost.py)
+        n_proc = max(jax.process_count(), 1)
+        if W % n_proc != 0:
+            raise ValueError(f"{W} workers not divisible by {n_proc} "
+                             "processes")
+        w_local = W // n_proc
+
         def to_workers(x):
-            B = x.shape[0]
-            if B % W != 0:
-                raise ValueError(f"global batch {B} not divisible by "
-                                 f"{W} workers")
-            x = x.reshape((W, B // W) + x.shape[1:])
-            return jax.device_put(x, self.mesh.batch_sharding(x.ndim))
+            B = x.shape[0]  # process-local batch
+            if B % w_local != 0:
+                raise ValueError(f"local batch {B} not divisible by "
+                                 f"{w_local} local workers")
+            x = x.reshape((w_local, B // w_local) + x.shape[1:])
+            return self.mesh.shard_batch(x)
 
         feats = jax.tree.map(to_workers, inputs)
         labels = jax.tree.map(to_workers, labels)

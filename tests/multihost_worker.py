@@ -69,3 +69,20 @@ for _ in range(3):
     # each process feeds only ITS slice of the global batch
     losses.append(trainer.fit_batch(DataSet(x[sl], y[sl])))
 print("LOSSES", " ".join(f"{l:.8f}" for l in losses), flush=True)
+
+# ---- phase 2: delayed-sync DP (the DP-2/DCN tier) over the same mesh ----
+from deeplearning4j_tpu.parallel import DelayedSyncTrainer  # noqa: E402
+
+net2 = MultiLayerNetwork(
+    NeuralNetConfiguration.builder().seed(99)
+    .updater("sgd").learning_rate(0.1)
+    .list()
+    .layer(DenseLayer(n_out=16, activation="relu"))
+    .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+    .set_input_type(InputType.feed_forward(10)).build()).init()
+ctx2 = MeshContext.create(n_data=4 * num_procs, n_model=1)
+dtrainer = DelayedSyncTrainer(net2, ctx2, sync_frequency=2)
+dlosses = []
+for _ in range(4):
+    dlosses.append(float(dtrainer.fit_batch(DataSet(x[sl], y[sl]))))
+print("DLOSSES", " ".join(f"{l:.8f}" for l in dlosses), flush=True)

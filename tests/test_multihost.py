@@ -77,3 +77,18 @@ def test_two_process_training_matches_single():
                 if ln.startswith("LOSSES"))
     single_losses = [float(v) for v in line.split()[1:]]
     np.testing.assert_allclose(losses[0], single_losses, rtol=1e-5)
+
+    # delayed-sync phase: per-worker gradient buffers sharded over a
+    # mesh that SPANS both processes; losses bitwise-equal across
+    # workers and equal (up to reduction order) to the single run
+    dl = []
+    for out in outs:
+        line = next(ln for ln in out.splitlines()
+                    if ln.startswith("DLOSSES"))
+        dl.append([float(v) for v in line.split()[1:]])
+    np.testing.assert_array_equal(dl[0], dl[1])
+    assert all(np.isfinite(dl[0]))
+    line = next(ln for ln in single.stdout.splitlines()
+                if ln.startswith("DLOSSES"))
+    single_dl = [float(v) for v in line.split()[1:]]
+    np.testing.assert_allclose(dl[0], single_dl, rtol=1e-5)
