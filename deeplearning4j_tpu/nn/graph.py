@@ -26,7 +26,8 @@ from deeplearning4j_tpu.datasets.iterator import (
 )
 from deeplearning4j_tpu.nn.conf.graph import LastTimeStepVertex
 from deeplearning4j_tpu.nn.conf.graph_builder import ComputationGraphConfiguration
-from deeplearning4j_tpu.nn.netcommon import LazyScoreMixin, jit_init
+from deeplearning4j_tpu.nn.netcommon import (EvalMixin, LazyScoreMixin,
+                                              jit_init)
 from deeplearning4j_tpu.nn.updater import build_optimizer, compute_updates
 from deeplearning4j_tpu.optimize.listeners import IterationListener, TrainingListener
 
@@ -50,7 +51,7 @@ def _time_slice(d: Optional[Dict[str, Array]], lo: int, hi: int,
             for k, v in d.items()}
 
 
-class ComputationGraph(LazyScoreMixin):
+class ComputationGraph(LazyScoreMixin, EvalMixin):
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
         self.params: Optional[Dict[str, Dict[str, Array]]] = None
@@ -521,12 +522,28 @@ class ComputationGraph(LazyScoreMixin):
         if squeeze:
             in_map = {k: v[:, None, :] for k, v in in_map.items()}
         if self._rnn_carries is None:
-            self._rnn_carries = {}
-        acts, _, _, new_carries = self._forward(
-            self.params, self.states, in_map, train=False, rng=None,
-            stop_before_loss=False, carries=self._rnn_carries)
+            # materialize all carries up front so the jit signature is
+            # stable from the first call (empty-dict -> populated-dict
+            # would force a second trace/compile)
+            B = next(iter(in_map.values())).shape[0]
+            self._rnn_carries = {
+                name: self.conf.nodes[name].layer.initial_carry(B)
+                for name in self._layer_nodes
+                if getattr(self.conf.nodes[name].layer,
+                           "supports_carry", False)}
+        if getattr(self, "_rnn_step_jit", None) is None:
+            # one jitted program per streaming step (see MLN.rnn_time_step)
+            def step(params, states, im, carries):
+                acts, _, _, new_carries = self._forward(
+                    params, states, im, train=False, rng=None,
+                    stop_before_loss=False, carries=carries)
+                return ([acts[o] for o in self.conf.network_outputs],
+                        new_carries)
+            self._rnn_step_jit = jax.jit(step)
+        outs_list, new_carries = self._rnn_step_jit(
+            self.params, self.states, in_map, self._rnn_carries)
         self._rnn_carries = {**self._rnn_carries, **new_carries}
-        outs = [acts[o] for o in self.conf.network_outputs]
+        outs = outs_list
         if squeeze:
             outs = [o[:, 0] if o.ndim == 3 else o for o in outs]
         return outs[0] if len(outs) == 1 else outs
@@ -642,11 +659,5 @@ class ComputationGraph(LazyScoreMixin):
     def predict(self, inputs) -> np.ndarray:
         return np.asarray(jnp.argmax(self.output(inputs), axis=-1))
 
-    def evaluate(self, iterator: DataSetIterator):
-        from deeplearning4j_tpu.eval.evaluation import Evaluation
-        e = Evaluation()
-        iterator.reset()
-        for batch in iterator:
-            out = self.output(batch.features)
-            e.eval(batch.labels, np.asarray(out), mask=batch.labels_mask)
-        return e
+    # evaluate / evaluate_roc / evaluate_roc_multi_class /
+    # evaluate_regression come from EvalMixin (netcommon.py)

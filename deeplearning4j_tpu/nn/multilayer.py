@@ -35,7 +35,8 @@ from deeplearning4j_tpu.datasets.iterator import (
 )
 from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers.base import BaseLayerConf
-from deeplearning4j_tpu.nn.netcommon import LazyScoreMixin, jit_init
+from deeplearning4j_tpu.nn.netcommon import (EvalMixin, LazyScoreMixin,
+                                              jit_init)
 from deeplearning4j_tpu.nn.updater import (
     build_optimizer, compute_updates, l1_l2_penalty,
 )
@@ -62,7 +63,7 @@ def _sum_aux_losses(states) -> Array:
     return total
 
 
-class MultiLayerNetwork(LazyScoreMixin):
+class MultiLayerNetwork(LazyScoreMixin, EvalMixin):
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
         self.layers: List[BaseLayerConf] = conf.layers
@@ -547,17 +548,26 @@ class MultiLayerNetwork(LazyScoreMixin):
                 l.initial_carry(x.shape[0])
                 if getattr(l, "supports_carry", False) else None
                 for l in self.layers]
-        h, _, _, new_carries, _ = self._forward(
-            self.params, self.states, x, train=False, rng=None,
-            carries=self._rnn_carries)
+        if getattr(self, "_rnn_step_jit", None) is None:
+            # one jitted program per streaming step — eager per-layer
+            # dispatch would pay a device round-trip per op per timestep
+            def step(params, states, xx, carries):
+                h, _, _, new_carries, _ = self._forward(
+                    params, states, xx, train=False, rng=None,
+                    carries=carries)
+                out_layer = self.layers[-1]
+                if hasattr(out_layer, "compute_loss"):
+                    h, _ = out_layer.apply(params[-1], h,
+                                           state=states[-1],
+                                           train=False, rng=None)
+                return h, new_carries
+            self._rnn_step_jit = jax.jit(step)
+        h, new_carries = self._rnn_step_jit(self.params, self.states, x,
+                                            self._rnn_carries)
         # keep existing carries for non-RNN layers
         self._rnn_carries = [
             nc if nc is not None else oc
             for nc, oc in zip(new_carries, self._rnn_carries)]
-        out_layer = self.layers[-1]
-        if hasattr(out_layer, "compute_loss"):
-            h, _ = out_layer.apply(self.params[-1], h, state=self.states[-1],
-                                   train=False, rng=None)
         return h[:, 0] if squeeze else h
 
     # ----------------------------------------------------------- param access
@@ -599,11 +609,5 @@ class MultiLayerNetwork(LazyScoreMixin):
         return net
 
     # ------------------------------------------------------------- evaluation
-    def evaluate(self, iterator: DataSetIterator):
-        from deeplearning4j_tpu.eval.evaluation import Evaluation
-        e = Evaluation()
-        iterator.reset()
-        for batch in iterator:
-            out = self.output(batch.features)
-            e.eval(batch.labels, np.asarray(out), mask=batch.labels_mask)
-        return e
+    # evaluate / evaluate_roc / evaluate_roc_multi_class /
+    # evaluate_regression come from EvalMixin (netcommon.py)

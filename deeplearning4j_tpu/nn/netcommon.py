@@ -49,6 +49,40 @@ def jit_init(build, seed: int):
     return jax.jit(build)(jax.random.PRNGKey(seed))
 
 
+class EvalMixin:
+    """Shared evaluation drivers (ref: MultiLayerNetwork.evaluate /
+    evaluateROC:2436 / evaluateROCMultiClass:2449 / evaluateRegression —
+    ComputationGraph mirrors the same four). Containers provide
+    ``output(features)``; every evaluator shares one drive loop so the
+    batch semantics cannot drift between the four."""
+
+    def _drive_eval(self, evaluator, iterator):
+        import numpy as np
+        iterator.reset()
+        for batch in iterator:
+            evaluator.eval(batch.labels,
+                           np.asarray(self.output(batch.features)),
+                           mask=batch.labels_mask)
+        return evaluator
+
+    def evaluate(self, iterator):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        return self._drive_eval(Evaluation(), iterator)
+
+    def evaluate_roc(self, iterator, threshold_steps: int = 100):
+        from deeplearning4j_tpu.eval.roc import ROC
+        return self._drive_eval(ROC(threshold_steps), iterator)
+
+    def evaluate_roc_multi_class(self, iterator,
+                                 threshold_steps: int = 100):
+        from deeplearning4j_tpu.eval.roc import ROCMultiClass
+        return self._drive_eval(ROCMultiClass(threshold_steps), iterator)
+
+    def evaluate_regression(self, iterator):
+        from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+        return self._drive_eval(RegressionEvaluation(), iterator)
+
+
 def make_pretrain_step(layer, tx):
     """Jitted single-layer pretraining step for the greedy layerwise walk
     both containers run (ref: MultiLayerNetwork.pretrain /

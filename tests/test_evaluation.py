@@ -96,3 +96,50 @@ def test_stats_renders_per_class_table():
     assert "MCC" in s
     assert "Top 3 Accuracy" in s
     assert "Per-class" in s
+
+
+def test_container_evaluate_roc_and_regression():
+    """evaluate_roc / evaluate_roc_multi_class / evaluate_regression on
+    the containers (ref: MultiLayerNetwork.evaluateROC:2436,
+    evaluateROCMultiClass:2449, evaluateRegression)."""
+    import numpy as np
+
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    labels2 = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater("adam", learning_rate=0.05).weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    it = ListDataSetIterator([DataSet(x, labels2)])
+    for _ in range(30):
+        net.fit(it, use_async=False)
+    roc = net.evaluate_roc(it)
+    assert roc.calculate_auc() > 0.9
+    rmc = net.evaluate_roc_multi_class(it)
+    assert rmc.calculate_auc(1) > 0.9
+    # regression head
+    yreg = (x @ rng.normal(size=(4, 2))).astype(np.float32)
+    conf_r = (NeuralNetConfiguration.builder().seed(1)
+              .updater("adam", learning_rate=0.02).weight_init("xavier")
+              .list()
+              .layer(DenseLayer(n_out=16, activation="tanh"))
+              .layer(OutputLayer(n_out=2, activation="identity",
+                                 loss="mse"))
+              .set_input_type(InputType.feed_forward(4)).build())
+    net_r = MultiLayerNetwork(conf_r).init()
+    it_r = ListDataSetIterator([DataSet(x, yreg)])
+    for _ in range(60):
+        net_r.fit(it_r, use_async=False)
+    reg = net_r.evaluate_regression(it_r)
+    assert reg.correlation_r2(0) > 0.9 and reg.correlation_r2(1) > 0.9
+    assert reg.average_mean_squared_error() < 0.5
