@@ -262,14 +262,36 @@ def _layer_refs(val) -> List[str]:
     return [_layer_ref_name(r) for r in val]
 
 
+def _snake(name: str) -> str:
+    """CamelCase -> snake_case, matching Keras's auto object naming
+    ('Conv2D' -> 'conv2d', 'SimpleRNN' -> 'simple_rnn')."""
+    import re
+    s = re.sub(r"\W+", "", name)
+    s = re.sub(r"(.)([A-Z][a-z]+)", r"\1_\2", s)
+    s = re.sub(r"([a-z])([A-Z])", r"\1_\2", s)
+    return s.lower()
+
+
 class KerasModelImport:
     """Static entry points (ref: KerasModelImport.java:101
-    importKerasSequentialModelAndWeights / importKerasModelAndWeights)."""
+    importKerasSequentialModelAndWeights / importKerasModelAndWeights).
+
+    Accepts legacy HDF5 files (the format the reference supports) AND the
+    modern Keras-3 ``.keras`` zip format (config.json +
+    model.weights.h5) — an extension beyond the reference's importer.
+    """
 
     @staticmethod
     def import_keras_sequential_model_and_weights(path: str,
                                                   enforce_training_config: bool = False
                                                   ) -> MultiLayerNetwork:
+        import zipfile
+        if zipfile.is_zipfile(path):
+            net = KerasModelImport._import_keras_v3(path)
+            if not isinstance(net, MultiLayerNetwork):
+                raise ValueError("Not a Sequential model; use "
+                                 "import_keras_model_and_weights")
+            return net
         with Hdf5Archive(path) as h5:
             cfg_json = h5.read_attribute_as_string("model_config")
             if cfg_json is None:
@@ -296,6 +318,9 @@ class KerasModelImport:
         delegated to the sequential path (ref: KerasModelImport.java:101,
         KerasModel.java getComputationGraphConfiguration/getComputationGraph).
         """
+        import zipfile
+        if zipfile.is_zipfile(path):
+            return KerasModelImport._import_keras_v3(path)
         with Hdf5Archive(path) as h5:
             cfg_json = h5.read_attribute_as_string("model_config")
             if cfg_json is None:
@@ -520,6 +545,100 @@ class KerasModelImport:
                                           inner_out)
         return sub_alias[out_refs[0]]
 
+    # ---------------------------------------------------------- keras-3 zip
+    @staticmethod
+    def _import_keras_v3(path: str):
+        """Import the Keras-3 native ``.keras`` zip: config.json carries
+        the same polymorphic model config; model.weights.h5 stores each
+        layer's variables under ``layers/<class-counter-path>/vars/<i>``
+        (paths use per-class counters in model-build order — 'conv2d',
+        'conv2d_1', ... — NOT the user layer names)."""
+        import io
+        import zipfile
+
+        import h5py
+
+        with zipfile.ZipFile(path) as z:
+            model_cfg = json.loads(z.read("config.json"))
+            wbytes = z.read("model.weights.h5")
+        cls = model_cfg.get("class_name")
+        layer_cfgs = model_cfg["config"]
+        if isinstance(layer_cfgs, dict):
+            inner_layers = layer_cfgs.get("layers", [])
+        else:
+            inner_layers = layer_cfgs
+        if any(lc["class_name"] in ("Sequential", "Functional", "Model")
+               for lc in inner_layers):
+            raise ValueError(
+                ".keras files with nested submodels are unsupported; "
+                "re-save as legacy HDF5 (model.save('m.h5'))")
+        if cls == "Sequential":
+            net = KerasModelImport._build_sequential(inner_layers)
+        elif cls in ("Model", "Functional"):
+            net = KerasModelImport._build_graph(model_cfg["config"])
+        else:
+            raise ValueError(f"Unsupported Keras model class {cls!r}")
+
+        # keras layer name -> class-counter weight path, in config order
+        # (== build order)
+        wpaths: Dict[str, str] = {}
+        counters: Dict[str, int] = {}
+        for lc in inner_layers:
+            snake = _snake(lc["class_name"])
+            idx = counters.get(snake, 0)
+            counters[snake] = idx + 1
+            name = _cfg(lc).get("name", lc.get("name"))
+            wpaths[name] = snake if idx == 0 else f"{snake}_{idx}"
+
+        is_graph = isinstance(net, ComputationGraph)
+        targets = (net._keras_names if is_graph
+                   else list(zip(range(len(net.layers)), net._keras_names)))
+        with h5py.File(io.BytesIO(wbytes), "r") as h:
+            layers_grp = h["layers"]
+            for entry in targets:
+                li, kname = (entry, entry) if is_graph else entry
+                wp = wpaths.get(kname)
+                if wp is None or wp not in layers_grp:
+                    continue
+                grp = layers_grp[wp]
+                for nested in ("cell", "layer"):  # RNNs nest vars in the
+                    # cell; TimeDistributed wraps them under 'layer'
+                    if ("vars" not in grp or not len(grp["vars"])) \
+                            and nested in grp:
+                        grp = grp[nested]
+                if "vars" not in grp or not len(grp["vars"]):
+                    continue
+                arrs = [np.asarray(grp["vars"][str(i)])
+                        for i in range(len(grp["vars"]))]
+                layer = (net.conf.nodes[li].layer if is_graph
+                         else net.layers[li])
+                ds = KerasModelImport._name_v3_vars(layer, arrs)
+                KerasModelImport._set_layer_weights(net, li, layer, ds,
+                                                    tf_kernels=True)
+        return net
+
+    @staticmethod
+    def _name_v3_vars(layer, arrs) -> Dict[str, np.ndarray]:
+        """Assign Keras variable names to the ordered vars list (the v3
+        format stores variables positionally, in layer.weights order)."""
+        if isinstance(layer, BatchNormalization):
+            if len(arrs) != 4:
+                # scale=False / center=False drop gamma/beta from the
+                # positional vars list; assigning by position would
+                # silently write beta into gamma
+                raise ValueError(
+                    ".keras BatchNormalization with scale=False or "
+                    "center=False is unsupported (positional weight "
+                    f"list has {len(arrs)} entries, expected 4)")
+            names = ["gamma", "beta", "moving_mean", "moving_variance"]
+        elif isinstance(layer, (LSTM, GRU, SimpleRnn)):
+            names = ["kernel", "recurrent_kernel", "bias"]
+        elif isinstance(layer, EmbeddingLayer):
+            names = ["embeddings"]
+        else:  # Dense / Conv / TimeDistributed-wrapped Dense
+            names = ["kernel", "bias"]
+        return dict(zip(names, arrs))
+
     @staticmethod
     def _layer_datasets(h5: Hdf5Archive, group: str) -> Dict[str, np.ndarray]:
         """{param name: array} for one layer's weight group, via the
@@ -614,7 +733,12 @@ class KerasModelImport:
             KerasModelImport._set_layer_weights(net, li, layer, datasets)
 
     @staticmethod
-    def _set_layer_weights(net, li: int, layer, ds: Dict[str, np.ndarray]):
+    def _set_layer_weights(net, li: int, layer, ds: Dict[str, np.ndarray],
+                           tf_kernels: bool = False):
+        """``tf_kernels=True`` (the .keras v3 path) asserts kernels are
+        already HWIO, suppressing the legacy Theano-ordering heuristic —
+        which would mis-fire on HWIO kernels whose height happens to
+        equal n_out (e.g. a 3-filter 3x3 conv)."""
         p = dict(net.params[li])
 
         def put(name, arr):
@@ -628,7 +752,8 @@ class KerasModelImport:
 
         if isinstance(layer, ConvolutionLayer):
             kernel = ds.get("kernel", ds.get("W"))
-            if kernel.ndim == 4 and kernel.shape[0] == layer.n_out:
+            if (not tf_kernels and kernel.ndim == 4
+                    and kernel.shape[0] == layer.n_out):
                 # TH ordering [out, in, kh, kw] -> HWIO
                 kernel = kernel.transpose(2, 3, 1, 0)
             put("W", kernel)
@@ -681,7 +806,8 @@ class KerasModelImport:
         elif isinstance(layer, TimeDistributedLayer):
             # Keras nests the wrapped layer's weights directly under the
             # TimeDistributed group; our param dict IS the inner layer's
-            KerasModelImport._set_layer_weights(net, li, layer.inner, ds)
+            KerasModelImport._set_layer_weights(net, li, layer.inner, ds,
+                                                tf_kernels=tf_kernels)
             return
         elif isinstance(layer, EmbeddingLayer):
             put("W", ds.get("embeddings", ds.get("W")))

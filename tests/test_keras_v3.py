@@ -1,0 +1,132 @@
+"""Keras-3 native ``.keras`` (zip) format import — an extension beyond
+the reference's HDF5-only importer (ref: KerasModelImport.java reads .h5;
+modern Keras saves .keras by default).
+
+Fixtures are generated at test time with the environment's real Keras so
+the bytes are always genuine.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.keras.keras_import import KerasModelImport
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+keras = pytest.importorskip("keras")
+
+
+@pytest.fixture(scope="module")
+def layers():
+    return keras.layers
+
+
+def test_v3_sequential_mlp(tmp_path, layers):
+    keras.utils.set_random_seed(1)
+    m = keras.Sequential([
+        layers.Input(shape=(6,)),
+        layers.Dense(8, activation="relu", name="d1"),
+        layers.Dense(3, activation="softmax", name="out"),
+    ])
+    x = np.random.default_rng(0).normal(size=(4, 6)).astype(np.float32)
+    want = m.predict(x, verbose=0)
+    p = str(tmp_path / "m.keras")
+    m.save(p)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    assert isinstance(net, MultiLayerNetwork)
+    np.testing.assert_allclose(np.asarray(net.output(x)), want, atol=1e-5)
+
+
+def test_v3_sequential_cnn_bn(tmp_path, layers):
+    """Class-counter weight paths across mixed conv/BN/dense layers."""
+    keras.utils.set_random_seed(2)
+    m = keras.Sequential([
+        layers.Input(shape=(8, 8, 3)),
+        layers.Conv2D(4, 3, padding="same", activation="relu", name="c1"),
+        layers.BatchNormalization(name="bn"),
+        layers.Conv2D(5, 3, padding="same", name="c2"),
+        layers.Flatten(),
+        layers.Dense(3, activation="softmax", name="out"),
+    ])
+    rng = np.random.default_rng(1)
+    m.compile(optimizer="sgd", loss="categorical_crossentropy")
+    xt = rng.normal(size=(16, 8, 8, 3)).astype(np.float32)
+    m.fit(xt, np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)],
+          epochs=1, verbose=0)  # make BN moving stats non-trivial
+    x = rng.normal(size=(3, 8, 8, 3)).astype(np.float32)
+    want = m.predict(x, verbose=0)
+    p = str(tmp_path / "cnn.keras")
+    m.save(p)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    np.testing.assert_allclose(np.asarray(net.output(x)), want, atol=1e-4)
+
+
+def test_v3_functional_with_merge(tmp_path, layers):
+    keras.utils.set_random_seed(3)
+    ia = layers.Input(shape=(5,), name="in_a")
+    ib = layers.Input(shape=(4,), name="in_b")
+    da = layers.Dense(6, activation="relu", name="da")(ia)
+    db = layers.Dense(6, activation="relu", name="db")(ib)
+    add = layers.Add(name="add")([da, db])
+    out = layers.Dense(2, activation="softmax", name="out")(add)
+    m = keras.Model([ia, ib], out)
+    rng = np.random.default_rng(2)
+    xa = rng.normal(size=(5, 5)).astype(np.float32)
+    xb = rng.normal(size=(5, 4)).astype(np.float32)
+    want = m.predict([xa, xb], verbose=0)
+    p = str(tmp_path / "f.keras")
+    m.save(p)
+    net = KerasModelImport.import_keras_model_and_weights(p)
+    assert isinstance(net, ComputationGraph)
+    np.testing.assert_allclose(np.asarray(net.output([xa, xb])), want,
+                               atol=1e-5)
+
+
+def test_v3_gru_lstm(tmp_path, layers):
+    keras.utils.set_random_seed(4)
+    m = keras.Sequential([
+        layers.Input(shape=(6, 5)),
+        layers.GRU(7, return_sequences=True, name="g"),
+        layers.LSTM(6, name="l", unit_forget_bias=False),
+        layers.Dense(3, activation="softmax", name="out"),
+    ])
+    x = np.random.default_rng(3).normal(size=(4, 6, 5)).astype(np.float32)
+    want = m.predict(x, verbose=0)
+    p = str(tmp_path / "rnn.keras")
+    m.save(p)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    np.testing.assert_allclose(np.asarray(net.output(x)), want, atol=1e-4)
+
+
+def test_v3_nested_raises(tmp_path, layers):
+    keras.utils.set_random_seed(5)
+    inner = keras.Sequential([layers.Input(shape=(4,)),
+                              layers.Dense(3, name="i1")])
+    inp = layers.Input(shape=(4,))
+    m = keras.Model(inp, layers.Dense(2, name="h")(inner(inp)))
+    p = str(tmp_path / "nested.keras")
+    m.save(p)
+    with pytest.raises(ValueError, match="nested"):
+        KerasModelImport.import_keras_model_and_weights(p)
+
+
+def test_v3_time_distributed_and_ambiguous_conv(tmp_path, layers):
+    """TimeDistributed vars nest under 'layer/'; a 3-filter conv on RGB
+    input (HWIO kernel with kh == n_out) must NOT hit the legacy
+    Theano-transpose heuristic."""
+    keras.utils.set_random_seed(6)
+    m = keras.Sequential([
+        layers.Input(shape=(8, 8, 3)),
+        layers.Conv2D(3, 3, padding="same", activation="relu", name="c"),
+        layers.Reshape((64, 3), name="rs"),
+        layers.TimeDistributed(layers.Dense(4, activation="tanh"),
+                               name="td"),
+        layers.GRU(5, name="g"),
+        layers.Dense(2, activation="softmax", name="out"),
+    ])
+    x = np.random.default_rng(5).normal(size=(3, 8, 8, 3)).astype(np.float32)
+    want = m.predict(x, verbose=0)
+    p = str(tmp_path / "td.keras")
+    m.save(p)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    np.testing.assert_allclose(np.asarray(net.output(x)), want, atol=1e-4)
