@@ -7,11 +7,20 @@ from deeplearning4j_tpu.earlystopping.config import (  # noqa: F401
     MaxScoreIterationTerminationCondition,
     MaxTimeIterationTerminationCondition,
     ScoreImprovementEpochTerminationCondition,
+    BestScoreEpochTerminationCondition,
+    InvalidScoreIterationTerminationCondition,
     DataSetLossCalculator,
     InMemoryModelSaver,
     LocalFileModelSaver,
 )
-from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingTrainer  # noqa: F401
+from deeplearning4j_tpu.earlystopping.config import (  # noqa: F401
+    LocalFileModelSaver as LocalFileGraphSaver,
+)
+from deeplearning4j_tpu.earlystopping.trainer import (  # noqa: F401
+    EarlyStoppingGraphTrainer,
+    EarlyStoppingListener,
+    EarlyStoppingTrainer,
+)
 from deeplearning4j_tpu.earlystopping.parallel_trainer import (  # noqa: F401
     EarlyStoppingParallelTrainer,
 )

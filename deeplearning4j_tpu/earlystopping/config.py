@@ -54,6 +54,18 @@ class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
         return self._since >= self.max_epochs_without_improvement
 
 
+@dataclass
+class BestScoreEpochTerminationCondition(EpochTerminationCondition):
+    """Stop once the score reaches a target
+    (ref: termination/BestScoreEpochTerminationCondition.java)."""
+    best_expected_score: float = 0.0
+    lesser_better: bool = True  # minimizing loss
+
+    def terminate(self, epoch, score):
+        return (score <= self.best_expected_score if self.lesser_better
+                else score >= self.best_expected_score)
+
+
 # -------------------------------------------------------- iteration conditions
 class IterationTerminationCondition:
     def initialize(self):
@@ -82,6 +94,15 @@ class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
 
     def terminate(self, score):
         return (time.monotonic() - self._start) > self.max_seconds
+
+
+@dataclass
+class InvalidScoreIterationTerminationCondition(IterationTerminationCondition):
+    """Abort on NaN/Inf scores
+    (ref: termination/InvalidScoreIterationTerminationCondition.java)."""
+
+    def terminate(self, score):
+        return score != score or score in (float("inf"), float("-inf"))
 
 
 # ------------------------------------------------------------ score calculator
@@ -145,7 +166,10 @@ class LocalFileModelSaver:
         from deeplearning4j_tpu.util.serializer import ModelSerializer
         path = self.dir / "bestModel.zip"
         if path.exists():
-            return ModelSerializer.restore_multi_layer_network(path)
+            # container-agnostic restore: the archive may hold either a
+            # MultiLayerNetwork or a ComputationGraph
+            # (EarlyStoppingGraphTrainer / LocalFileGraphSaver)
+            return ModelSerializer.restore_model(path)
         return net
 
 

@@ -18,14 +18,21 @@ from deeplearning4j_tpu.earlystopping.config import (
 
 class EarlyStoppingTrainer:
     def __init__(self, config: EarlyStoppingConfiguration, net,
-                 train_data: DataSetIterator):
+                 train_data: DataSetIterator, listener=None):
         self.config = config
         self.net = net
         self.train_data = train_data
+        self.listener = listener  # EarlyStoppingListener or None
+
+    def set_listener(self, listener) -> None:
+        """(ref: IEarlyStoppingTrainer.setListener)"""
+        self.listener = listener
 
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
         net = self.net
+        if self.listener is not None:
+            self.listener.on_start(cfg, net)
         for c in cfg.epoch_termination_conditions:
             c.initialize()
         for c in cfg.iteration_termination_conditions:
@@ -64,6 +71,10 @@ class EarlyStoppingTrainer:
                     cfg.model_saver.save_best_model(net, score)
                 if cfg.save_last_model:
                     cfg.model_saver.save_latest_model(net, score)
+            if self.listener is not None:
+                self.listener.on_epoch(
+                    epoch, score_vs_epoch.get(epoch, net.score_value),
+                    cfg, net)
             stop = False
             for c in cfg.epoch_termination_conditions:
                 if c.terminate(epoch, score_vs_epoch.get(epoch, net.score_value)):
@@ -74,7 +85,7 @@ class EarlyStoppingTrainer:
             if stop:
                 break
         best_model = cfg.model_saver.get_best_model(net)
-        return EarlyStoppingResult(
+        result = EarlyStoppingResult(
             termination_reason=reason,
             termination_details=details,
             total_epochs=epoch,
@@ -83,3 +94,28 @@ class EarlyStoppingTrainer:
             score_vs_epoch=score_vs_epoch,
             best_model=best_model,
         )
+        if self.listener is not None:
+            self.listener.on_completion(result)
+        return result
+
+
+class EarlyStoppingListener:
+    """Callbacks around the early-stopping loop
+    (ref: listener/EarlyStoppingListener.java — onStart/onEpoch/
+    onCompletion)."""
+
+    def on_start(self, config, net) -> None:
+        pass
+
+    def on_epoch(self, epoch: int, score: float, config, net) -> None:
+        pass
+
+    def on_completion(self, result) -> None:
+        pass
+
+
+class EarlyStoppingGraphTrainer(EarlyStoppingTrainer):
+    """Reference-named trainer for ComputationGraph models
+    (ref: trainer/EarlyStoppingGraphTrainer.java). The base trainer is
+    container-agnostic (fit_batch/score contract), so this is the naming
+    alias the reference API promises."""

@@ -287,10 +287,8 @@ class KerasModelImport:
                                                   ) -> MultiLayerNetwork:
         import zipfile
         if zipfile.is_zipfile(path):
-            net = KerasModelImport._import_keras_v3(path)
-            if not isinstance(net, MultiLayerNetwork):
-                raise ValueError("Not a Sequential model; use "
-                                 "import_keras_model_and_weights")
+            net = KerasModelImport._import_keras_v3(
+                path, require="Sequential")
             return net
         with Hdf5Archive(path) as h5:
             cfg_json = h5.read_attribute_as_string("model_config")
@@ -547,7 +545,7 @@ class KerasModelImport:
 
     # ---------------------------------------------------------- keras-3 zip
     @staticmethod
-    def _import_keras_v3(path: str):
+    def _import_keras_v3(path: str, require: Optional[str] = None):
         """Import the Keras-3 native ``.keras`` zip: config.json carries
         the same polymorphic model config; model.weights.h5 stores each
         layer's variables under ``layers/<class-counter-path>/vars/<i>``
@@ -560,8 +558,13 @@ class KerasModelImport:
 
         with zipfile.ZipFile(path) as z:
             model_cfg = json.loads(z.read("config.json"))
+            cls = model_cfg.get("class_name")
+            if require is not None and cls != require:
+                # fail BEFORE building the graph / copying weights
+                raise ValueError(
+                    f"Not a {require} model; use "
+                    "import_keras_model_and_weights")
             wbytes = z.read("model.weights.h5")
-        cls = model_cfg.get("class_name")
         layer_cfgs = model_cfg["config"]
         if isinstance(layer_cfgs, dict):
             inner_layers = layer_cfgs.get("layers", [])

@@ -140,3 +140,70 @@ def test_early_stopping_nan_abort():
     result = EarlyStoppingTrainer(es, net,
                                   IrisDataSetIterator(batch_size=50)).fit()
     assert result.termination_reason == "IterationTerminationCondition"
+
+
+def test_early_stopping_listener_and_new_conditions():
+    """EarlyStoppingListener hooks fire; BestScore/InvalidScore conditions
+    terminate (ref: listener/EarlyStoppingListener.java,
+    termination/{BestScoreEpochTerminationCondition,
+    InvalidScoreIterationTerminationCondition}.java); the graph trainer
+    alias drives a ComputationGraph."""
+    import numpy as np
+
+    from deeplearning4j_tpu import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.earlystopping import (
+        BestScoreEpochTerminationCondition, EarlyStoppingConfiguration,
+        EarlyStoppingGraphTrainer, EarlyStoppingListener,
+        InMemoryModelSaver, InvalidScoreIterationTerminationCondition,
+        MaxEpochsTerminationCondition,
+    )
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    it = ListDataSetIterator([DataSet(x, y)])
+
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater("adam", learning_rate=0.05).weight_init("xavier")
+            .graph_builder().add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=16, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax"),
+                       "d")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4)).build())
+    net = ComputationGraph(conf).init()
+
+    events = []
+
+    class Rec(EarlyStoppingListener):
+        def on_start(self, config, model):
+            events.append("start")
+
+        def on_epoch(self, epoch, score, config, model):
+            events.append(("epoch", epoch))
+
+        def on_completion(self, result):
+            events.append(("done", result.termination_reason))
+
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[
+            MaxEpochsTerminationCondition(50),
+            BestScoreEpochTerminationCondition(best_expected_score=0.4)],
+        iteration_termination_conditions=[
+            InvalidScoreIterationTerminationCondition()],
+        model_saver=InMemoryModelSaver())
+    trainer = EarlyStoppingGraphTrainer(cfg, net, it, listener=Rec())
+    result = trainer.fit()
+    assert events[0] == "start" and events[-1][0] == "done"
+    assert result.termination_reason == "EpochTerminationCondition"
+    assert "BestScore" in result.termination_details \
+        or "MaxEpochs" in result.termination_details
+    assert result.best_model is not None
+    # invalid-score condition standalone behavior
+    c = InvalidScoreIterationTerminationCondition()
+    assert c.terminate(float("nan")) and c.terminate(float("inf"))
+    assert not c.terminate(1.0)
