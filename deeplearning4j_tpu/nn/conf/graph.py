@@ -12,7 +12,7 @@ methods disappear under autodiff.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple, Type
+from typing import Dict, List, Optional, Tuple, Type, Union
 
 import jax
 import jax.numpy as jnp
@@ -292,16 +292,29 @@ class LastTimeStepVertex(GraphVertex):
 @register_vertex
 @dataclass
 class DuplicateToTimeSeriesVertex(GraphVertex):
-    """[B, F] -> [B, T, F] by duplication; T taken from a reference input
-    (ref: rnn/DuplicateToTimeSeriesVertex.java). The container substitutes
-    ``timesteps`` at build time from the named reference input."""
-    timesteps: int = 1
+    """[B, F] -> [B, T, F] by duplication. ``timesteps`` is either a
+    fixed int T, or the NAME of a reference graph node whose current
+    activation supplies T at runtime (the reference's semantics —
+    ref: rnn/DuplicateToTimeSeriesVertex.java resolves the named input's
+    shape per forward pass, which is what keeps the vertex correct when
+    tBPTT slices the time axis)."""
+    timesteps: Union[int, str] = 1
 
     def n_inputs(self):
         return 1
 
     def infer_output_type(self, in_types):
-        return InputType.recurrent(in_types[0].flat_size(), self.timesteps)
+        t = self.timesteps if isinstance(self.timesteps, int) else None
+        return InputType.recurrent(in_types[0].flat_size(), t)
 
-    def apply(self, inputs):
-        return jnp.repeat(inputs[0][:, None, :], self.timesteps, axis=1)
+    def apply(self, inputs, ref_act=None):
+        if ref_act is not None:
+            t = ref_act.shape[1]
+        elif isinstance(self.timesteps, int):
+            t = self.timesteps
+        else:
+            raise ValueError(
+                f"DuplicateToTimeSeriesVertex references node "
+                f"{self.timesteps!r} but no reference activation was "
+                "supplied")
+        return jnp.repeat(inputs[0][:, None, :], t, axis=1)

@@ -24,7 +24,8 @@ from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.datasets.iterator import (
     AsyncDataSetIterator, DataSetIterator, ListDataSetIterator,
 )
-from deeplearning4j_tpu.nn.conf.graph import LastTimeStepVertex
+from deeplearning4j_tpu.nn.conf.graph import (
+    DuplicateToTimeSeriesVertex, LastTimeStepVertex)
 from deeplearning4j_tpu.nn.conf.graph_builder import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.netcommon import (EvalMixin, LazyScoreMixin,
                                               jit_init)
@@ -40,14 +41,18 @@ def _dtype_of(name: str):
 
 
 def _time_slice(d: Optional[Dict[str, Array]], lo: int, hi: int,
-                min_ndim: int = 3) -> Optional[Dict[str, Array]]:
+                min_ndim: int = 3,
+                only: Optional[set] = None) -> Optional[Dict[str, Array]]:
     """Slice the time axis (dim 1) of every time-distributed array in a
     name->array dict. ``min_ndim=3`` for features/labels ([B, T, ...];
     static [B, F] side inputs pass through unsliced), ``min_ndim=2`` for
-    masks ([B, T])."""
+    masks ([B, T]). ``only`` restricts slicing to the named keys (the
+    recurrent inputs — a CNN input's [B, H, W, C] must NOT be sliced on
+    its height axis)."""
     if d is None:
         return None
-    return {k: (v if v is None or v.ndim < min_ndim else v[:, lo:hi])
+    return {k: (v if v is None or v.ndim < min_ndim
+                or (only is not None and k not in only) else v[:, lo:hi])
             for k, v in d.items()}
 
 
@@ -152,6 +157,12 @@ class ComputationGraph(LazyScoreMixin, EvalMixin):
                 if isinstance(node.vertex, LastTimeStepVertex):
                     acts[name] = node.vertex.apply_masked(in_acts, in_mask)
                     out_masks[name] = None
+                elif isinstance(node.vertex, DuplicateToTimeSeriesVertex) \
+                        and isinstance(node.vertex.timesteps, str):
+                    # runtime T from the named reference node's activation
+                    acts[name] = node.vertex.apply(
+                        in_acts, acts[node.vertex.timesteps])
+                    out_masks[name] = in_mask
                 else:
                     acts[name] = node.vertex.apply(in_acts)
                     out_masks[name] = in_mask
@@ -213,29 +224,39 @@ class ComputationGraph(LazyScoreMixin, EvalMixin):
         MultiLayerNetwork.java:1512); jax.jit re-traces per input shape and
         ``_infer_traces`` counts traces for tests."""
         if self._jit_infer is None:
-            def infer(params, states, in_map):
+            def infer(params, states, in_map, masks):
                 self._infer_traces += 1  # python side effect: runs per TRACE
                 acts, _, _ = self._forward(params, states, in_map,
                                            train=False, rng=None,
+                                           masks=masks,
                                            stop_before_loss=False)
                 return [acts[o] for o in self.conf.network_outputs]
             self._jit_infer = jax.jit(infer)
         return self._jit_infer
 
     def outputs(self, inputs: Union[Array, Sequence[Array], Dict[str, Array]],
-                train: bool = False) -> List[Array]:
+                train: bool = False, mask=None) -> List[Array]:
         """Final activations of all output nodes
-        (ref: ComputationGraph.output(...))."""
+        (ref: ComputationGraph.output(...)). ``mask``: a [B, T] feature
+        mask for the first input, or a name->mask dict."""
         self._check_init()
         in_map = self._to_input_map(inputs)
+        masks = None
+        if mask is not None:
+            masks = (
+                {k: (None if v is None else jnp.asarray(v))
+                 for k, v in mask.items()} if isinstance(mask, dict)
+                else {self.conf.network_inputs[0]: jnp.asarray(mask)})
         if not train:
-            return self._infer_fn()(self.params, self.states, in_map)
+            return self._infer_fn()(self.params, self.states, in_map,
+                                    masks)
         acts, _, _ = self._forward(self.params, self.states, in_map,
-                                   train=train, rng=None, stop_before_loss=False)
+                                   train=train, rng=None, masks=masks,
+                                   stop_before_loss=False)
         return [acts[o] for o in self.conf.network_outputs]
 
-    def output(self, inputs, train: bool = False) -> Array:
-        return self.outputs(inputs, train=train)[0]
+    def output(self, inputs, train: bool = False, mask=None) -> Array:
+        return self.outputs(inputs, train=train, mask=mask)[0]
 
     def _to_input_map(self, inputs) -> Dict[str, Array]:
         names = self.conf.network_inputs
@@ -347,22 +368,34 @@ class ComputationGraph(LazyScoreMixin, EvalMixin):
             from deeplearning4j_tpu.optimize.solvers import solver_fit_batch
             return solver_fit_batch(self, data)
         if self.conf.training.backprop_type == "truncated_bptt":
-            first = (data.features if isinstance(data, DataSet)
-                     else data.features[0])
+            all_feats = ([data.features] if isinstance(data, DataSet)
+                         else list(data.features))
             all_labels = ([data.labels] if isinstance(data, DataSet)
                           else list(data.labels))
-            # EVERY label must be time-distributed: a rank-2 [B, C] label
-            # would pass through _time_slice unsliced and silently train
-            # its head every slice against the full-sequence target (the
-            # reference falls back to standard BPTT with a warning here)
-            if first.ndim == 3 and all(l.ndim == 3 for l in all_labels):
+            has_rnn_input = any(f.ndim == 3 for f in all_feats)
+            # EVERY label must be time-distributed (a rank-2 [B, C] label
+            # would silently train its head per slice against the full-
+            # sequence target), and EVERY rank-3 feature must really be a
+            # time series: a CNN input's [B, H, W, C] would be sliced
+            # along its height axis. The declared InputTypes disambiguate
+            # (the reference falls back to standard BPTT with a warning).
+            rnn_ok = all(
+                (self.conf.input_types.get(n) is None and f.ndim == 3)
+                or (self.conf.input_types.get(n) is not None
+                    and (self.conf.input_types[n].kind == "rnn"
+                         or f.ndim != 3))
+                for n, f in zip(self.conf.network_inputs, all_feats)
+                if f.ndim >= 3)
+            if has_rnn_input and rnn_ok \
+                    and all(l.ndim == 3 for l in all_labels):
                 return self._fit_tbptt(data)
-            if first.ndim == 3:
+            if has_rnn_input:
                 import warnings
                 warnings.warn(
                     "truncated_bptt requires rank-3 (time-distributed) "
-                    "labels on every output; falling back to standard "
-                    "BPTT for this batch")
+                    "labels on every output and recurrent InputTypes for "
+                    "every rank-3 input; falling back to standard BPTT "
+                    "for this batch")
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
         inputs, labels, masks, lmasks = self._split(data)
@@ -405,6 +438,14 @@ class ComputationGraph(LazyScoreMixin, EvalMixin):
                     listener.on_epoch_end(self)
         return self
 
+    def _tbptt_rnn_inputs(self) -> set:
+        """Network inputs whose time axis tBPTT may slice: declared-rnn
+        InputTypes, or untyped inputs (the fit_batch gate only admits
+        untyped inputs when they are rank-3 time series)."""
+        return {n for n in self.conf.network_inputs
+                if self.conf.input_types.get(n) is None
+                or self.conf.input_types[n].kind == "rnn"}
+
     # ------------------------------------------------------------------ tBPTT
     def _build_tbptt_step(self):
         tx = self._tx
@@ -412,6 +453,7 @@ class ComputationGraph(LazyScoreMixin, EvalMixin):
         fwd = training.tbptt_fwd_length
         bwd = training.tbptt_bwd_length or fwd
         data_loss_of = self._data_loss
+        rnn_inputs = self._tbptt_rnn_inputs()
 
         def step(params, opt_state, states, inputs, labels, masks, lmasks,
                  carries, rng):
@@ -420,7 +462,8 @@ class ComputationGraph(LazyScoreMixin, EvalMixin):
             # only — same semantics as MultiLayerNetwork._build_tbptt_step
             # (ref: ComputationGraph.doTruncatedBPTT:2042 shares the MLN
             # backward time-loop truncation via LSTMHelpers.java:333)
-            T = next(v.shape[1] for v in inputs.values() if v.ndim >= 3)
+            T = next(v.shape[1] for n, v in inputs.items()
+                     if n in rnn_inputs)
             split = max(T - bwd, 0) if bwd < fwd else 0
 
             def loss_for_grad(p):
@@ -432,16 +475,21 @@ class ComputationGraph(LazyScoreMixin, EvalMixin):
                 else:
                     rng1, rng2 = (jax.random.split(rng) if rng is not None
                                   else (None, None))
-                    head = lambda d, m=3: _time_slice(d, 0, split, m)
-                    tail = lambda d, m=3: _time_slice(d, split, T, m)
+                    head = lambda d, m=3, o=None: _time_slice(
+                        d, 0, split, m, only=o)
+                    tail = lambda d, m=3, o=None: _time_slice(
+                        d, split, T, m, only=o)
                     acts1, om1, states1, carries1 = self._forward(
-                        p, states, head(inputs), train=True, rng=rng1,
-                        masks=head(masks, 2), carries=carries)
+                        p, states, head(inputs, o=rnn_inputs), train=True,
+                        rng=rng1, masks=head(masks, 2, rnn_inputs),
+                        carries=carries)
                     acts1 = jax.tree.map(jax.lax.stop_gradient, acts1)
                     carries1 = jax.tree.map(jax.lax.stop_gradient, carries1)
                     acts2, om2, new_states, new_carries = self._forward(
-                        p, states1, tail(inputs), train=True, rng=rng2,
-                        masks=tail(masks, 2), carries=carries1)
+                        p, states1, tail(inputs, o=rnn_inputs),
+                        train=True, rng=rng2,
+                        masks=tail(masks, 2, rnn_inputs),
+                        carries=carries1)
                     # per-timestep losses SUM over time: head + tail ==
                     # the single-call slice loss
                     data_loss = (
@@ -474,12 +522,17 @@ class ComputationGraph(LazyScoreMixin, EvalMixin):
         (ref: ComputationGraph.doTruncatedBPTT:2042-2103)."""
         if self._tbptt_step_fn is None:
             self._tbptt_step_fn = self._build_tbptt_step()
+        self.last_grads = None  # tBPTT step doesn't collect gradients
         fwd = self.conf.training.tbptt_fwd_length
         inputs, labels, masks, lmasks = self._split(data)
-        T = next(v.shape[1] for v in inputs.values() if v.ndim >= 3)
+        rnn_inputs = self._tbptt_rnn_inputs()
+        T = next(v.shape[1] for n, v in inputs.items() if n in rnn_inputs)
         B = next(iter(inputs.values())).shape[0]
-        # materialize initial carries so the jit signature is stable
-        carries = {name: self.conf.nodes[name].layer.initial_carry(B)
+        # materialize initial carries so the jit signature is stable —
+        # in the configured training dtype, not initial_carry's f32
+        # default (a bf16 net must not run its recurrence in f32)
+        dt = _dtype_of(self.conf.training.dtype)
+        carries = {name: self.conf.nodes[name].layer.initial_carry(B, dt)
                    for name in self._layer_nodes
                    if getattr(self.conf.nodes[name].layer,
                               "supports_carry", False)}
@@ -490,9 +543,9 @@ class ComputationGraph(LazyScoreMixin, EvalMixin):
             (self.params, self.opt_state, self.states, carries, loss) = \
                 self._tbptt_step_fn(
                     self.params, self.opt_state, self.states,
-                    _time_slice(inputs, start, end),
+                    _time_slice(inputs, start, end, only=rnn_inputs),
                     _time_slice(labels, start, end),
-                    _time_slice(masks, start, end, 2),
+                    _time_slice(masks, start, end, 2, rnn_inputs),
                     _time_slice(lmasks, start, end, 2),
                     carries, step_rng)
             total = total + loss  # device accumulate — no per-slice sync
@@ -526,8 +579,9 @@ class ComputationGraph(LazyScoreMixin, EvalMixin):
             # stable from the first call (empty-dict -> populated-dict
             # would force a second trace/compile)
             B = next(iter(in_map.values())).shape[0]
+            dt = _dtype_of(self.conf.training.dtype)
             self._rnn_carries = {
-                name: self.conf.nodes[name].layer.initial_carry(B)
+                name: self.conf.nodes[name].layer.initial_carry(B, dt)
                 for name in self._layer_nodes
                 if getattr(self.conf.nodes[name].layer,
                            "supports_carry", False)}
@@ -550,7 +604,9 @@ class ComputationGraph(LazyScoreMixin, EvalMixin):
 
     # --------------------------------------------------------------- pretrain
     def _ancestors(self, target: str) -> set:
-        """Ancestor closure of ``target`` (exclusive), for partial walks."""
+        """Ancestor closure of ``target`` (exclusive), for partial walks.
+        Includes runtime reference nodes (DuplicateToTimeSeriesVertex's
+        named T source) so subset walks can resolve them."""
         seen: set = set()
         stack = list(self.conf.nodes[target].inputs)
         while stack:
@@ -558,7 +614,12 @@ class ComputationGraph(LazyScoreMixin, EvalMixin):
             if n in seen:
                 continue
             seen.add(n)
-            stack.extend(self.conf.nodes[n].inputs)
+            node = self.conf.nodes[n]
+            stack.extend(node.inputs)
+            if (node.kind == "vertex"
+                    and isinstance(node.vertex, DuplicateToTimeSeriesVertex)
+                    and isinstance(node.vertex.timesteps, str)):
+                stack.append(node.vertex.timesteps)
         return seen
 
     def _activations_to(self, target: str, in_map: Dict[str, Array],
@@ -571,6 +632,11 @@ class ComputationGraph(LazyScoreMixin, EvalMixin):
         node = self.conf.nodes[target]
         if node.kind != "layer":
             raise ValueError(f"Node {target!r} is not a layer node")
+        if len(node.inputs) != 1:
+            raise ValueError(
+                f"Node {target!r} has {len(node.inputs)} inputs; layerwise "
+                "pretraining needs a single-input node (pretraining on "
+                "inputs[0] alone would silently use the wrong objective)")
         cache = getattr(self, "_act_to_fns", None)
         if cache is None:
             cache = self._act_to_fns = {}

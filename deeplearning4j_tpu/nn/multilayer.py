@@ -410,7 +410,10 @@ class MultiLayerNetwork(LazyScoreMixin, EvalMixin):
                                                  seg(labels, split, T),
                                                  mask=mask2))
                 reg = l1_l2_penalty(p, self.layers)
-                return data_loss + reg, (new_states, new_carries)
+                # aux losses (MoE balancing etc.) — keep parity with the
+                # standard step and the graph container's tBPTT step
+                return (data_loss + reg + _sum_aux_losses(new_states),
+                        (new_states, new_carries))
 
             (loss, (new_states, new_carries)), grads = jax.value_and_grad(
                 loss_for_grad, has_aux=True)(params)
@@ -427,14 +430,16 @@ class MultiLayerNetwork(LazyScoreMixin, EvalMixin):
         (ref: MultiLayerNetwork.doTruncatedBPTT:1119-1183)."""
         if not hasattr(self, "_tbptt_step_fn") or self._tbptt_step_fn is None:
             self._tbptt_step_fn = self._build_tbptt_step()
+        self.last_grads = None  # tBPTT step doesn't collect gradients
         fwd = self.conf.training.tbptt_fwd_length
         T = dataset.features.shape[1]
         carries: list = [None] * len(self.layers)
         # materialize initial carries so the jit signature is stable
         B = dataset.features.shape[0]
+        dt = _dtype_of(self.conf.training.dtype)
         for i, l in enumerate(self.layers):
             if getattr(l, "supports_carry", False):
-                carries[i] = l.initial_carry(B)
+                carries[i] = l.initial_carry(B, dt)  # training dtype
         total, slices = 0.0, 0
         for start in range(0, T, fwd):
             end = min(start + fwd, T)
@@ -545,7 +550,7 @@ class MultiLayerNetwork(LazyScoreMixin, EvalMixin):
             x = x[:, None, :]
         if self._rnn_carries is None:
             self._rnn_carries = [
-                l.initial_carry(x.shape[0])
+                l.initial_carry(x.shape[0], x.dtype)
                 if getattr(l, "supports_carry", False) else None
                 for l in self.layers]
         if getattr(self, "_rnn_step_jit", None) is None:

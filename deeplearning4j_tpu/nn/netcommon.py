@@ -60,8 +60,10 @@ class EvalMixin:
         import numpy as np
         iterator.reset()
         for batch in iterator:
-            evaluator.eval(batch.labels,
-                           np.asarray(self.output(batch.features)),
+            # the feature mask must reach the forward pass: padded steps
+            # would otherwise flow through the recurrence as real data
+            out = self.output(batch.features, mask=batch.features_mask)
+            evaluator.eval(batch.labels, np.asarray(out),
                            mask=batch.labels_mask)
         return evaluator
 

@@ -54,7 +54,10 @@ class DelayedSyncTrainer:
                                   net.params)
         net.states = jax.tree.map(lambda x: jax.device_put(x, rep),
                                   net.states)
-        net.opt_state = net._tx.init(net.params)
+        # preserve accumulated optimizer state (see ParallelTrainer)
+        net.opt_state = jax.tree.map(
+            lambda x: jax.device_put(x, rep) if hasattr(x, "shape") else x,
+            net.opt_state)
         # per-worker gradient accumulator, worker axis sharded over 'data'
         # — accumulation never crosses devices. Each process contributes
         # its local slice of the worker axis (shard_batch assembles the
@@ -162,6 +165,7 @@ class DelayedSyncTrainer:
         if do_sync:
             self._since_sync = 0
         net.last_batch_size = batch.num_examples()
+        net.last_grads = None  # delayed-sync step doesn't collect grads
         net.score_value = loss
         net.iteration_count += 1
         for listener in net.listeners:

@@ -55,7 +55,14 @@ class ParallelTrainer:
         net.params = self.mesh.shard_params(net.params)
         net.states = jax.tree.map(
             lambda x: jax.device_put(x, self.mesh.replicated()), net.states)
-        net.opt_state = net._tx.init(net.params)
+        # PRESERVE accumulated optimizer state (Adam moments etc.) when
+        # wrapping an already-trained net — re-initializing would spike
+        # the loss on resume. Leaves land replicated; the first donated
+        # step re-lays them out to whatever XLA computes.
+        rep = self.mesh.replicated()
+        net.opt_state = jax.tree.map(
+            lambda x: jax.device_put(x, rep) if hasattr(x, "shape") else x,
+            net.opt_state)
 
     # ------------------------------------------------------------- the step
     def _build_step(self):
@@ -147,6 +154,7 @@ class ParallelTrainer:
             net.params, net.opt_state, net.states, feats, labels, fmask,
             lmask, step_rng)
         net.last_batch_size = batch.num_examples()
+        net.last_grads = None  # SPMD step doesn't collect gradients
         # raw device scalar: converting here would sync the SPMD pipeline
         # every step (see MultiLayerNetwork.score_value)
         net.score_value = loss

@@ -153,6 +153,7 @@ class ParallelWrapper:
         if bool(do_avg):
             self._iter_since_avg = 0
         net.iteration_count += 1
+        net.last_grads = None  # vmapped worker step doesn't collect grads
         net.score_value = float(jnp.mean(losses))
         net.last_batch_size = sum(b.num_examples() for b in batches)
         for listener in net.listeners:

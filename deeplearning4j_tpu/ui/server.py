@@ -378,6 +378,7 @@ class _Handler(BaseHTTPRequestHandler):
     flow_data: Optional[dict] = None  # network graph (flow view)
     activation_data: Optional[dict] = None  # layer -> PNG data URL
     _hist_index: dict = {}  # sid -> [n_reports_seen, carrying_reports]
+    _hist_lock = threading.Lock()  # ThreadingHTTPServer: polls race
 
     def log_message(self, *args):  # quiet
         pass
@@ -442,13 +443,16 @@ class _Handler(BaseHTTPRequestHandler):
             out = {"param": {}, "grad": {}, "iteration": None,
                    "iterations": []}
             reports = self.storage.get_reports(sid)
-            cache = type(self)._hist_index.setdefault(sid, [0, []])
-            seen, carrying = cache
-            for r in reports[seen:]:
-                if any(k.startswith(("hist_param:", "hist_grad:"))
-                       for k in r.series):
-                    carrying.append(r)
-            cache[0] = len(reports)
+            with type(self)._hist_lock:  # concurrent polls must not
+                # double-append the same carrying reports
+                cache = type(self)._hist_index.setdefault(sid, [0, []])
+                seen, carrying = cache
+                for r in reports[seen:]:
+                    if any(k.startswith(("hist_param:", "hist_grad:"))
+                           for k in r.series):
+                        carrying.append(r)
+                cache[0] = len(reports)
+                carrying = list(carrying)
             out["iterations"] = [r.iteration for r in carrying]
             if carrying:
                 if want is None:
@@ -527,7 +531,8 @@ class UIServer:
                  storage: Optional[StatsStorage] = None):
         self.storage = storage or InMemoryStatsStorage()
         handler = type("BoundHandler", (_Handler,),
-                       {"storage": self.storage, "_hist_index": {}})
+                       {"storage": self.storage, "_hist_index": {},
+                        "_hist_lock": threading.Lock()})
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(

@@ -143,3 +143,40 @@ def test_container_evaluate_roc_and_regression():
     reg = net_r.evaluate_regression(it_r)
     assert reg.correlation_r2(0) > 0.9 and reg.correlation_r2(1) > 0.9
     assert reg.average_mean_squared_error() < 0.5
+
+
+def test_evaluate_uses_feature_mask():
+    """The evaluation drive must pass features_mask into the forward
+    pass: a masked LSTM last-step classifier evaluated on padded
+    sequences must score the VALID last step, not the padded tail
+    (round-3 review regression)."""
+    import numpy as np
+
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.nn.layers import (LSTM, LastTimeStepLayer,
+                                              OutputLayer)
+
+    rng = np.random.default_rng(0)
+    B, T, F = 8, 6, 4
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater("sgd").learning_rate(0.0).weight_init("xavier")
+            .list()
+            .layer(LSTM(n_out=5, activation="tanh"))
+            .layer(LastTimeStepLayer())
+            .layer(OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.recurrent(F, T)).build())
+    net = MultiLayerNetwork(conf).init()
+
+    x = rng.normal(size=(B, T, F)).astype(np.float32)
+    mask = np.ones((B, T), np.float32)
+    mask[:, 3:] = 0.0  # only 3 valid steps; tail is garbage padding
+    x[:, 3:] *= 100.0  # make the padded tail REALLY garbage
+    # ground truth = prediction on the truncated valid sequence
+    want = np.asarray(net.output(x[:, :3]))
+    labels = np.eye(3, dtype=np.float32)[want.argmax(1)]
+    ds = DataSet(x, labels, features_mask=mask)
+    e = net.evaluate(ListDataSetIterator([ds]))
+    assert e.accuracy() == 1.0, e.accuracy()  # masked eval == truncated

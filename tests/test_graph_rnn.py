@@ -168,3 +168,36 @@ def test_graph_pretrain_autoencoder_layer():
     # the graph still trains end-to-end afterwards
     loss = net.fit_batch(DataSet(x, y))
     assert np.isfinite(float(loss))
+
+
+def test_graph_tbptt_mixed_static_input_not_sliced():
+    """A multi-input graph with an rnn input AND a static feed-forward
+    side input under tBPTT: the static input must pass through unsliced
+    (round-3 review regression — ndim-based slicing corrupted it)."""
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.nn.conf.graph import DuplicateToTimeSeriesVertex, MergeVertex
+
+    b = (NeuralNetConfiguration.builder().seed(3)
+         .updater("sgd").learning_rate(0.05)
+         .graph_builder()
+         .add_inputs("seq", "static")
+         .add_layer("lstm", LSTM(n_out=6, activation="tanh"), "seq")
+         .add_vertex("dup", DuplicateToTimeSeriesVertex("seq"), "static")
+         .add_vertex("cat", MergeVertex(), "lstm", "dup")
+         .add_layer("out", RnnOutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "cat")
+         .set_outputs("out"))
+    b.backprop_type("truncated_bptt", 4, 4)
+    conf = b.set_input_types(InputType.recurrent(4, 8),
+                             InputType.feed_forward(5)).build()
+    net = ComputationGraph(conf).init()
+    B, T = 3, 8
+    seq = RNG.normal(size=(B, T, 4)).astype(np.float32)
+    static = RNG.normal(size=(B, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[RNG.integers(0, 3, (B, T))]
+    mds = MultiDataSet([seq, static], [y])
+    loss = net.fit_batch(mds)
+    assert np.isfinite(float(loss))
+    for _ in range(8):
+        last = net.fit_batch(mds)
+    assert float(last) < float(loss)

@@ -107,3 +107,30 @@ def test_fit_batch_routes_through_solver():
         after = net.fit_batch(ds)
     assert after < before
     assert net.iteration_count == 3
+
+
+@pytest.mark.parametrize("algo", ["conjugate_gradient", "lbfgs"])
+def test_graph_trains_with_solver(algo):
+    """The same Solver serves ComputationGraph (ref: BaseOptimizer.java:
+    295-300) — line-search training must reduce the graph's score."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .optimization_algo(algo)
+            .updater("sgd").learning_rate(0.5).weight_init("xavier")
+            .graph_builder().add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax"),
+                       "d")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4)).build())
+    net = ComputationGraph(conf).init()
+    ds = DataSet(x, y)
+    s0 = net.score(ds)
+    for _ in range(30):
+        net.fit_batch(ds)
+    assert net.score(ds) < s0 * 0.8, (s0, net.score(ds))
