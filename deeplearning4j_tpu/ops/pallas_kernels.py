@@ -76,14 +76,16 @@ def _lstm_fwd_kernel(xz_ref, rw_ref, pw_ref, h0_ref, c0_ref, fb_ref,
     H = h.shape[-1]
     z = xz_ref[0] + jnp.dot(h, rw_ref[:], preferred_element_type=h.dtype)
     zi, zf, zg, zo = z[:, :H], z[:, H:2 * H], z[:, 2 * H:3 * H], z[:, 3 * H:]
-    pw = pw_ref[:]  # [3H]; zeros when the cell has no peepholes
-    zi = zi + c * pw[None, :H]
-    zf = zf + c * pw[None, H:2 * H]
+    # peepholes as [3, H] rows loaded as 2D [1, H] slices: a 1D [3H]
+    # vector sliced with pw[None, :H] lowers to a >2D gather Mosaic
+    # rejects ("Only 2D gather is supported", first seen on real v5e)
+    zi = zi + c * pw_ref[0:1, :]
+    zf = zf + c * pw_ref[1:2, :]
     i = jax.nn.sigmoid(zi)
     f = jax.nn.sigmoid(zf + fb_ref[0])
     g = jnp.tanh(zg)
     c_new = f * c + i * g
-    zo = zo + c_new * pw[None, 2 * H:]
+    zo = zo + c_new * pw_ref[2:3, :]
     o = jax.nn.sigmoid(zo)
     h_new = o * jnp.tanh(c_new)
 
@@ -111,12 +113,12 @@ def _lstm_fwd_infer_kernel(xz_ref, rw_ref, pw_ref, h0_ref, c0_ref, fb_ref,
     H = h.shape[-1]
     z = xz_ref[0] + jnp.dot(h, rw_ref[:], preferred_element_type=h.dtype)
     zi, zf, zg, zo = z[:, :H], z[:, H:2 * H], z[:, 2 * H:3 * H], z[:, 3 * H:]
-    pw = pw_ref[:]
-    i = jax.nn.sigmoid(zi + c * pw[None, :H])
-    f = jax.nn.sigmoid(zf + c * pw[None, H:2 * H] + fb_ref[0])
+    # [1, H] row slices of the [3, H] peephole block (see fwd kernel note)
+    i = jax.nn.sigmoid(zi + c * pw_ref[0:1, :])
+    f = jax.nn.sigmoid(zf + c * pw_ref[1:2, :] + fb_ref[0])
     g = jnp.tanh(zg)
     c_new = f * c + i * g
-    o = jax.nn.sigmoid(zo + c_new * pw[None, 2 * H:])
+    o = jax.nn.sigmoid(zo + c_new * pw_ref[2:3, :])
     h_new = o * jnp.tanh(c_new)
 
     h_scr[:] = h_new
@@ -138,7 +140,7 @@ def _run_lstm_fwd_infer(xz, rw, pw, h0, c0, forget_bias, interpret):
         in_specs=[
             pl.BlockSpec((1, B, 4 * H), step),
             pl.BlockSpec((H, 4 * H), fixed),
-            pl.BlockSpec((3 * H,), lambda t: (0,)),
+            pl.BlockSpec((3, H), fixed),
             pl.BlockSpec((B, H), fixed),
             pl.BlockSpec((B, H), fixed),
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -179,8 +181,8 @@ def _lstm_bwd_kernel(eps_ref, gates_ref, cs_ref, cprev_ref, rwT_ref, pw_ref,
     o = gates[:, 3 * H:]
     c_t = cs_ref[0]
     c_prev = cprev_ref[0]
-    pw = pw_ref[:]
-    pi, pf, po = pw[None, :H], pw[None, H:2 * H], pw[None, 2 * H:]
+    # [1, H] row slices of the [3, H] peephole block (see fwd kernel note)
+    pi, pf, po = pw_ref[0:1, :], pw_ref[1:2, :], pw_ref[2:3, :]
 
     dh = dh_scr[:] + eps_ref[0]
     tc = jnp.tanh(c_t)
@@ -216,7 +218,7 @@ def _run_lstm_fwd(xz, rw, pw, h0, c0, forget_bias, interpret):
         in_specs=[
             pl.BlockSpec((1, B, 4 * H), step),
             pl.BlockSpec((H, 4 * H), lambda t: (0, 0)),
-            pl.BlockSpec((3 * H,), lambda t: (0,)),
+            pl.BlockSpec((3, H), lambda t: (0, 0)),
             pl.BlockSpec((B, H), lambda t: (0, 0)),
             pl.BlockSpec((B, H), lambda t: (0, 0)),
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -251,7 +253,7 @@ def _run_lstm_bwd(eps, gates, cs, c_prev, rw, pw, dhT, dcT, interpret):
             pl.BlockSpec((1, B, H), rev),
             pl.BlockSpec((1, B, H), rev),
             pl.BlockSpec((4 * H, H), fixed),
-            pl.BlockSpec((3 * H,), lambda t: (0,)),
+            pl.BlockSpec((3, H), fixed),
             pl.BlockSpec((B, H), fixed),
             pl.BlockSpec((B, H), fixed),
         ],
@@ -276,9 +278,10 @@ def _run_lstm_bwd(eps, gates, cs, c_prev, rw, pw, dhT, dcT, interpret):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
 def _fused_lstm_core(xz, rw, pw, h0, c0, forget_bias, interpret):
-    """xz: [T,B,4H] (= x@W+b), rw: [H,4H], pw: [3H] (zeros = no peephole).
-    Returns (hs [T,B,H], h_T, c_T). The primal (inference) path uses the
-    cache-free kernel; only the VJP forward pays for residual writes."""
+    """xz: [T,B,4H] (= x@W+b), rw: [H,4H], pw: [3,H] rows (i,f,o) (zeros =
+    no peephole). Returns (hs [T,B,H], h_T, c_T). The primal (inference)
+    path uses the cache-free kernel; only the VJP forward pays for
+    residual writes."""
     hs, cT = _run_lstm_fwd_infer(xz, rw, pw, h0, c0, forget_bias, interpret)
     return hs, hs[-1], cT
 
@@ -298,7 +301,7 @@ def _fused_lstm_bwd(forget_bias, interpret, res, grads):
     dxz = dz
     drw = jnp.einsum("tbh,tbk->hk", h_prev, dz)
     H = hs.shape[-1]
-    dpw = jnp.concatenate([
+    dpw = jnp.stack([
         jnp.einsum("tbh,tbh->h", c_prev, dz[..., :H]),
         jnp.einsum("tbh,tbh->h", c_prev, dz[..., H:2 * H]),
         jnp.einsum("tbh,tbh->h", cs, dz[..., 3 * H:]),
@@ -323,8 +326,10 @@ def fused_lstm(x, w, rw, b, pw, h0, c0, *, forget_bias: float = 0.0,
     H = rw.shape[0]
     xz = (x.reshape(B * T, F) @ w + b).reshape(B, T, 4 * H)
     xz = jnp.swapaxes(xz, 0, 1)  # time-major
-    if pw is None:
-        pw = jnp.zeros((3 * H,), x.dtype)
+    # kernels take peepholes as [3, H] rows (Mosaic-friendly 2D); the
+    # reshape is differentiable so dpw flows back to the caller's [3H]
+    pw = (jnp.zeros((3, H), x.dtype) if pw is None
+          else jnp.reshape(pw, (3, H)))
     hs, hT, cT = _fused_lstm_core(xz, rw, pw, h0, c0, float(forget_bias),
                                   interpret)
     return jnp.swapaxes(hs, 0, 1), hT, cT
