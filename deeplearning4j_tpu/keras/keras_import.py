@@ -38,10 +38,11 @@ from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex, MergeVertex
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.graph import ComputationGraph
 from deeplearning4j_tpu.nn.layers import (
-    GRU, LSTM, ActivationLayer, BatchNormalization, ConvolutionLayer,
-    DenseLayer, DropoutLayer, EmbeddingLayer, GlobalPoolingLayer,
-    OutputLayer, PermuteLayer, RepeatVectorLayer, ReshapeLayer, SimpleRnn,
-    SubsamplingLayer, TimeDistributedLayer, ZeroPaddingLayer,
+    GRU, LSTM, ActivationLayer, BatchNormalization, Convolution1DLayer,
+    ConvolutionLayer, DenseLayer, DropoutLayer, EmbeddingLayer,
+    GlobalPoolingLayer, OutputLayer, PermuteLayer, RepeatVectorLayer,
+    ReshapeLayer, SimpleRnn, Subsampling1DLayer, SubsamplingLayer,
+    TimeDistributedLayer, ZeroPaddingLayer,
 )
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
@@ -78,9 +79,40 @@ class KerasLayerMapper:
             strides = tuple(cfg.get("strides", cfg.get("subsample", (1, 1))))
             pad = cfg.get("padding", cfg.get("border_mode", "valid"))
             mode = "same" if pad == "same" else "truncate"
+            dil = tuple(cfg.get("dilation_rate", (1, 1)))
             return ConvolutionLayer(n_out=int(filters), kernel_size=(kh, kw),
-                                    stride=strides, convolution_mode=mode,
+                                    stride=strides, dilation=dil,
+                                    convolution_mode=mode,
                                     activation=_act(cfg.get("activation")))
+        if class_name in ("Conv1D", "Convolution1D"):
+            # ref: the reference's convolution translator handles 1-D too
+            # (modelimport/.../layers/KerasConvolution.java); Keras 1.x
+            # spells the hyperparams filter_length/subsample_length
+            filters = cfg.get("filters", cfg.get("nb_filter"))
+            k = (cfg["kernel_size"][0] if "kernel_size" in cfg
+                 else cfg.get("filter_length"))
+            strides = cfg.get("strides", cfg.get("subsample_length", 1))
+            s = strides[0] if isinstance(strides, (list, tuple)) else strides
+            pad = cfg.get("padding", cfg.get("border_mode", "valid"))
+            if pad == "causal":
+                raise ValueError("Conv1D padding='causal' is not supported")
+            dil = cfg.get("dilation_rate", 1)
+            dil = dil[0] if isinstance(dil, (list, tuple)) else dil
+            return Convolution1DLayer(
+                n_out=int(filters), kernel_size=(int(k), 1),
+                stride=(int(s), 1), dilation=(int(dil), 1),
+                convolution_mode="same" if pad == "same" else "truncate",
+                activation=_act(cfg.get("activation")))
+        if class_name in ("MaxPooling1D", "AveragePooling1D"):
+            pool = cfg.get("pool_size", cfg.get("pool_length", 2))
+            p0 = pool[0] if isinstance(pool, (list, tuple)) else pool
+            strides = cfg.get("strides", cfg.get("stride")) or p0
+            s = strides[0] if isinstance(strides, (list, tuple)) else strides
+            pad = cfg.get("padding", cfg.get("border_mode", "valid"))
+            return Subsampling1DLayer(
+                pooling_type="max" if class_name.startswith("Max") else "avg",
+                kernel_size=(int(p0), 1), stride=(int(s), 1),
+                convolution_mode="same" if pad == "same" else "truncate")
         if class_name in ("MaxPooling2D", "AveragePooling2D"):
             pool = tuple(cfg.get("pool_size", (2, 2)))
             strides = tuple(cfg.get("strides") or pool)

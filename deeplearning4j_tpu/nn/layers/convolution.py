@@ -70,10 +70,14 @@ class ConvolutionLayer(BaseLayerConf):
 
     def infer_output_type(self, in_type: InputType) -> InputType:
         kh, kw = self.kernel_size
+        dh, dw = self.dilation
         sh, sw = self.stride
         ph, pw = self.padding
-        h = _out_size(in_type.height, kh, sh, ph, self.convolution_mode)
-        w = _out_size(in_type.width, kw, sw, pw, self.convolution_mode)
+        # dilation widens the effective receptive field: k_eff = (k-1)*d+1
+        h = _out_size(in_type.height, (kh - 1) * dh + 1, sh, ph,
+                      self.convolution_mode)
+        w = _out_size(in_type.width, (kw - 1) * dw + 1, sw, pw,
+                      self.convolution_mode)
         return InputType.convolutional(h, w, self.n_out)
 
     def param_order(self) -> List[str]:
@@ -122,6 +126,7 @@ class Convolution1DLayer(ConvolutionLayer):
 
     def infer_output_type(self, in_type: InputType) -> InputType:
         k, s, p = self.kernel_size[0], self.stride[0], self.padding[0]
+        k = (k - 1) * self.dilation[0] + 1  # effective (dilated) kernel
         t = in_type.timesteps
         t_out = None if t is None else _out_size(t, k, s, p, self.convolution_mode)
         return InputType.recurrent(self.n_out, t_out)
@@ -145,6 +150,7 @@ class Convolution1DLayer(ConvolutionLayer):
             x, params["W"],
             window_strides=(self.stride[0],),
             padding=pad,
+            rhs_dilation=(self.dilation[0],),
             dimension_numbers=("NWC", "WIO", "NWC"),
         )
         if self.has_bias:

@@ -1,20 +1,32 @@
 """Pipeline parallelism: GPipe-style microbatch pipeline over a mesh axis.
 
 No counterpart exists in the reference (data parallelism only — SURVEY
-§2.3); this is part of the TPU build's first-class scale-out. Design: the
-S pipeline stages are homogeneous (same activation shapes), their params
-stacked on a leading stage axis sharded over mesh axis ``pp``. Inside
-``shard_map`` every device runs the same program: at tick t it applies its
-stage to the activation it holds, then passes the result to its ring
-neighbor with ``ppermute`` (ICI neighbor hop). Stage 0 injects microbatch
-t; stage S-1 collects finished microbatches. M microbatches drain the
-bubble in S-1 ticks — utilization M/(M+S-1), the GPipe schedule.
+§2.3); this is part of the TPU build's first-class scale-out. Two tiers:
+
+- ``pipeline_apply`` — the homogeneous-stage primitive (same activation
+  shape everywhere): stacked params sharded over mesh axis ``pp``, one
+  ``ppermute`` ring hop per tick (ICI neighbor traffic only).
+- ``PipelineTrainer`` — a real ``MultiLayerNetwork`` partitioned into S
+  contiguous stages balanced by parameter count, with NON-homogeneous
+  activation shapes and heterogeneous per-stage layer programs. Every
+  device runs the same SPMD program (an XLA requirement): stage programs
+  are branches of one ``lax.switch`` selected by the device's position on
+  the ``pp`` axis, and both params and boundary activations travel as
+  flat, right-padded buffers of the maximum stage size, reshaped to their
+  true shapes inside each branch. The GPipe schedule is unchanged: M
+  microbatches drain the bubble in S-1 ticks — utilization M/(M+S-1).
+  Composes with data parallelism: if the mesh also has a ``dp`` axis the
+  microbatch batch dim is sharded over it (dp×pp), and XLA inserts the
+  gradient all-reduce over ``dp`` outside the shard_map.
+
+Reachable through the strategy SPI: ``create_trainer("pipeline", net,
+mesh)`` (ref: TrainingMaster SPI, spark/dl4j-spark/.../api/
+TrainingMaster.java:29 — the strategy seam this plugs into).
 """
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Callable
+from typing import Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -22,10 +34,20 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.updater import compute_updates, l1_l2_penalty
+
+
+def _pvary(x, axis):
+    # jax.lax.pvary was deprecated in favor of pcast(..., to='varying')
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis, to="varying")
+    return jax.lax.pvary(x, (axis,))
+
 
 def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
                    mesh: Mesh, axis: str = "pp"):
-    """Run the pipeline.
+    """Run a homogeneous pipeline.
 
     stage_fn(params_slice, x) -> y with y.shape == x.shape (homogeneous
     stages). ``stacked_params``: pytree with leading stage axis S == mesh
@@ -59,9 +81,9 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
             held_next = jax.lax.ppermute(y, axis, perm)
             return (held_next, outbuf), None
 
-        # pvary: carries must be device-varying to match the scan body
-        held0 = jax.lax.pvary(xs[0] * 0.0, (axis,))
-        outbuf0 = jax.lax.pvary(xs * 0.0, (axis,))
+        # carries must be device-varying to match the scan body
+        held0 = _pvary(xs[0] * 0.0, axis)
+        outbuf0 = _pvary(xs * 0.0, axis)
         (_, outbuf), _ = jax.lax.scan(tick, (held0, outbuf0), jnp.arange(T))
         # every device returns its buffer; only the last stage's is real.
         # psum gathers it to all (cheap: zeros elsewhere).
@@ -76,3 +98,319 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
 def stack_stage_params(param_list):
     """Stack per-stage param pytrees along a new leading axis."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *param_list)
+
+
+# ---------------------------------------------------------------------------
+# heterogeneous pipeline over a real MultiLayerNetwork
+# ---------------------------------------------------------------------------
+
+def partition_stages(layers, params, n_stages: int) -> List[List[int]]:
+    """Split body-layer indices into ``n_stages`` contiguous groups,
+    greedily balanced by parameter count (the reference has no analog —
+    its scale-out clones whole models; stage partitioning is the TPU
+    build's model-parallel axis)."""
+    n = len(layers)
+    if n_stages > n:
+        # more devices on the pp axis than body layers: trailing stages
+        # are identity pass-throughs (the ring hop still runs; they add
+        # bubble ticks but keep the mesh shape unconstrained)
+        return ([[i] for i in range(n)]
+                + [[] for _ in range(n_stages - n)])
+    costs = [sum(int(np.prod(v.shape)) for v in params[i].values()) + 1
+             for i in range(n)]
+    total = sum(costs)
+    stages, cur, acc, remaining = [], [], 0, total
+    for i in range(n):
+        cur.append(i)
+        acc += costs[i]
+        stages_left = n_stages - len(stages)
+        # close the stage once it reaches its fair share of what's left
+        # (or when the remaining layers are only just enough to give each
+        # remaining stage one), but never leave fewer layers than stages
+        if (len(stages) < n_stages - 1
+                and (acc >= remaining / stages_left
+                     or n - i - 1 == stages_left - 1)
+                and n - i - 1 >= stages_left - 1):
+            stages.append(cur)
+            remaining -= acc
+            cur, acc = [], 0
+    stages.append(cur)
+    return stages
+
+
+def _type_shape(t, batch: int):
+    """Concrete activation shape for an InputType at a given batch size."""
+    if t.kind == "ff":
+        return (batch, t.size)
+    if t.kind == "rnn":
+        if t.timesteps is None:
+            raise ValueError("PipelineTrainer needs fixed timesteps in the "
+                             "recurrent InputType (static shapes under jit)")
+        return (batch, t.timesteps, t.size)
+    if t.kind == "cnn":
+        return (batch, t.height, t.width, t.channels)
+    raise ValueError(f"Unsupported InputType kind {t.kind!r}")
+
+
+class PipelineTrainer:
+    """GPipe pipeline-parallel trainer for a ``MultiLayerNetwork``.
+
+    The net's body layers (all but the loss head) are partitioned into S
+    contiguous stages; each pipeline tick every device applies ITS stage
+    (a ``lax.switch`` branch) to the flat activation buffer it holds and
+    ppermutes the result to its ring neighbor. The loss head, gradient
+    normalization, optimizer update, and L1/L2 all reuse the exact
+    single-device code (``compute_updates``), so a pipeline step is
+    loss-parity-identical to ``net.fit_batch`` up to float reassociation.
+
+    v1 scope: stateless feed-forward/conv bodies — layers carrying
+    running state (BatchNormalization) or RNN carries, and active
+    dropout, are rejected at construction (their state/rng threading
+    through the ring schedule is future work).
+    """
+
+    def __init__(self, net, mesh: Optional[Mesh] = None, axis: str = "pp",
+                 n_microbatches: Optional[int] = None,
+                 stages: Optional[Sequence[Sequence[int]]] = None):
+        from deeplearning4j_tpu.parallel.mesh import MeshContext
+        if isinstance(mesh, MeshContext):
+            mesh = mesh.mesh
+        if mesh is None:
+            devs = np.array(jax.devices())
+            mesh = Mesh(devs.reshape(len(devs)), (axis,))
+        if axis not in mesh.axis_names:
+            raise ValueError(f"mesh has no {axis!r} axis: {mesh.axis_names}")
+        net._check_init()
+        if not hasattr(net, "layers"):
+            raise ValueError("PipelineTrainer supports MultiLayerNetwork "
+                             "(graph stage partitioning is future work)")
+        if net.conf.input_type is None:
+            raise ValueError("PipelineTrainer needs set_input_type() on the "
+                             "config (static boundary shapes under jit)")
+        self.net = net
+        self.mesh = mesh
+        self.axis = axis
+        self.dp_axis = "dp" if "dp" in mesh.axis_names else None
+        self.S = mesh.shape[axis]
+        self.M = int(n_microbatches or self.S)
+        body = net.layers[:-1]
+        head = net.layers[-1]
+        if not hasattr(head, "compute_loss"):
+            raise ValueError("Last layer must be an output/loss layer")
+        for i, l in enumerate(body):
+            if net.states[i]:
+                raise ValueError(
+                    f"layer {i} ({type(l).__name__}) carries running state "
+                    "(e.g. BatchNormalization) — unsupported in the "
+                    "pipeline trainer v1")
+            if getattr(l, "supports_carry", False):
+                raise ValueError(f"layer {i} ({type(l).__name__}) is "
+                                 "recurrent — unsupported in the pipeline "
+                                 "trainer v1")
+            d = l.dropout
+            if d is not None and 0.0 < d < 1.0:
+                raise ValueError(f"layer {i} has active dropout — "
+                                 "unsupported in the pipeline trainer v1")
+        self.stages = ([list(s) for s in stages] if stages is not None
+                       else partition_stages(body, net.params, self.S))
+        if len(self.stages) != self.S:
+            raise ValueError(f"{len(self.stages)} stages != pp size {self.S}")
+        flat = [i for st in self.stages for i in st]
+        if flat != list(range(len(body))):
+            raise ValueError(f"stages must cover body layers 0..{len(body)-1}"
+                             f" contiguously, got {self.stages}")
+        if any(not st for st in self.stages[:-1]) and any(
+                st for i, st in enumerate(self.stages) if i
+                and not self.stages[i - 1]):
+            raise ValueError("empty (identity) stages must be trailing, "
+                             f"got {self.stages}")
+        self._step = None
+
+    # ---------------------------------------------------------------- shapes
+    def _boundary_shapes(self, b_mb: int):
+        """Activation shape entering each stage (pre-preprocessor) plus the
+        final body output feeding the loss head."""
+        conf = self.net.conf
+        cur = conf.input_type
+        stage_in = []
+        for st in self.stages:
+            stage_in.append(_type_shape(cur, b_mb))
+            for i in st:
+                t = cur
+                if i in conf.preprocessors:
+                    t = conf.preprocessors[i].infer_output_type(t)
+                cur = self.net.layers[i].infer_output_type(t)
+        return stage_in, _type_shape(cur, b_mb)
+
+    # ------------------------------------------------------------ stage fns
+    def _make_branch(self, stage: List[int], in_shape, amax: int,
+                     seg_shapes):
+        """One lax.switch branch: unpack this stage's flat param segment
+        and activation buffer, run its layers exactly as MLN._forward
+        does (minus state/carry/dropout, rejected at init), repack.
+        The batch dim reshapes with -1: under dp×pp the local batch is
+        the global microbatch divided by the dp axis size."""
+        net = self.net
+        conf = net.conf
+        in_size = int(np.prod(in_shape[1:]))
+        if not stage:
+            return lambda pflat, xbuf: xbuf  # identity (pass-through) stage
+
+        def branch(pflat, xbuf):
+            # unflatten this stage's params from the padded segment
+            p = {}
+            off = 0
+            for i in stage:
+                layer_p = {}
+                for name in net.layers[i].param_order():
+                    shp, dt = seg_shapes[i][name]
+                    n = int(np.prod(shp))
+                    layer_p[name] = pflat[off:off + n].reshape(shp).astype(dt)
+                    off += n
+                p[i] = layer_p
+            h = xbuf[:, :in_size].reshape((-1,) + in_shape[1:])
+            in_types = conf.input_types
+            for i in stage:
+                layer = net.layers[i]
+                if i in conf.preprocessors:
+                    it = in_types[i] if in_types else None
+                    h = conf.preprocessors[i].transform(h, it)
+                h, _ = layer.apply(p[i], h, state={},
+                                   train=not layer.frozen, rng=None,
+                                   mask=None)
+            y = h.reshape(h.shape[0], -1)
+            return jnp.pad(y, ((0, 0), (0, amax - y.shape[1])))
+
+        return branch
+
+    # ------------------------------------------------------------- the step
+    def _build_step(self, b_mb: int):
+        net = self.net
+        S, M, axis = self.S, self.M, self.axis
+        mesh = self.mesh
+        stage_in, head_in_shape = self._boundary_shapes(b_mb)
+        head_in_size = int(np.prod(head_in_shape[1:]))
+        amax = max([int(np.prod(s[1:])) for s in stage_in] + [head_in_size])
+        # per-layer param segment metadata (static shapes for unflatten)
+        seg_shapes = {i: {k: (v.shape, v.dtype)
+                          for k, v in net.params[i].items()}
+                      for st in self.stages for i in st}
+        seg_sizes = [sum(int(np.prod(seg_shapes[i][k][0]))
+                         for i in st for k in seg_shapes[i])
+                     for st in self.stages]
+        pmax = max(seg_sizes)
+        self._amax = amax
+        branches = [self._make_branch(st, stage_in[s], amax, seg_shapes)
+                    for s, st in enumerate(self.stages)]
+
+        def pack_bufs(params):
+            """[S, Pmax] padded flat param buffer (differentiable)."""
+            rows = []
+            for st in self.stages:
+                leaves = [params[i][k].reshape(-1).astype(jnp.float32)
+                          for i in st for k in net.layers[i].param_order()]
+                row = jnp.concatenate(leaves) if leaves else jnp.zeros((0,))
+                rows.append(jnp.pad(row, (0, pmax - row.shape[0])))
+            return jnp.stack(rows)
+
+        def device_fn(bufs, xs):
+            pflat = bufs[0]
+            sid = jax.lax.axis_index(axis)
+            perm = [(j, (j + 1) % S) for j in range(S)]
+
+            def tick(carry, t):
+                held, outbuf = carry
+                inject = jnp.where(t < M, t, 0)
+                x_in = jnp.where(sid == 0, xs[inject], held)
+                y = jax.lax.switch(sid, branches, pflat, x_in)
+                done_idx = t - (S - 1)
+                store = jnp.logical_and(sid == S - 1, done_idx >= 0)
+                idx = jnp.maximum(done_idx, 0)
+                cur = jax.lax.dynamic_index_in_dim(outbuf, idx, 0,
+                                                   keepdims=False)
+                outbuf = jax.lax.dynamic_update_index_in_dim(
+                    outbuf, jnp.where(store, y, cur), idx, 0)
+                return (jax.lax.ppermute(y, axis, perm), outbuf), None
+
+            held0 = _pvary(xs[0] * 0.0, axis)
+            outbuf0 = _pvary(xs * 0.0, axis)
+            (_, outbuf), _ = jax.lax.scan(tick, (held0, outbuf0),
+                                          jnp.arange(M + S - 1))
+            return jax.lax.psum(outbuf, axis)
+
+        dp = self.dp_axis
+        batch_spec = P(None, dp, None)
+        pipe = shard_map(device_fn, mesh=mesh,
+                         in_specs=(P(axis), batch_spec),
+                         out_specs=batch_spec)
+
+        tx = net._tx
+        training = net.conf.training
+        head = net.layers[-1]
+        head_idx = len(net.layers) - 1
+        head_pre = net.conf.preprocessors.get(head_idx)
+        head_pre_type = (net.conf.input_types[head_idx]
+                         if net.conf.input_types else None)
+
+        def loss_of(params, xs, labels):
+            outs = pipe(pack_bufs(params), xs)           # [M, B_mb, amax]
+            h = outs[..., :head_in_size].reshape(
+                (M * b_mb,) + head_in_shape[1:])
+            if head_pre is not None:
+                # e.g. the auto CnnToFeedForward flatten before an
+                # OutputLayer head — exactly as MLN._forward applies it
+                h = head_pre.transform(h, head_pre_type)
+            data_loss = head.compute_loss(params[head_idx], h, labels,
+                                          mask=None)
+            return data_loss + l1_l2_penalty(params, net.layers)
+
+        def step(params, opt_state, xs, labels):
+            loss, grads = jax.value_and_grad(loss_of)(params, xs, labels)
+            new_params, new_opt = compute_updates(
+                tx, grads, opt_state, params, net.layers, training)
+            return new_params, new_opt, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------- fit
+    def fit_batch(self, batch: DataSet) -> float:
+        net = self.net
+        if (batch.features_mask is not None
+                or batch.labels_mask is not None):
+            # loud, like the other unsupported v1 features — a silently
+            # dropped mask would train a whole run subtly wrong
+            raise ValueError("masked DataSets are unsupported in the "
+                             "pipeline trainer v1 (mask threading through "
+                             "the ring schedule is future work)")
+        feats = jnp.asarray(batch.features)
+        labels = jnp.asarray(batch.labels)
+        B = feats.shape[0]
+        if B % self.M != 0:
+            raise ValueError(f"batch size {B} not divisible by "
+                             f"n_microbatches={self.M}")
+        b_mb = B // self.M
+        if self._step is None or getattr(self, "_b_mb", None) != b_mb:
+            self._step = self._build_step(b_mb)
+            self._b_mb = b_mb
+        x = feats.reshape(self.M, b_mb, -1)
+        xs = jnp.pad(x, ((0, 0), (0, 0), (0, self._amax - x.shape[-1])))
+        net.params, net.opt_state, loss = self._step(
+            net.params, net.opt_state, xs, labels)
+        net.last_batch_size = B
+        net.score_value = loss
+        net.iteration_count += 1
+        for listener in net.listeners:
+            listener.iteration_done(net, net.iteration_count,
+                                    net.score_value)
+        return net._score_raw
+
+    def fit(self, data, epochs: int = 1) -> "PipelineTrainer":
+        if isinstance(data, DataSet):
+            for _ in range(epochs):
+                self.fit_batch(data)
+            return self
+        for _ in range(epochs):
+            for batch in data:
+                self.fit_batch(batch)
+            self.net.epoch_count += 1
+        return self

@@ -82,11 +82,14 @@ def ring_attention_sharded(q, k, v, axis_name: str, *, causal: bool = False,
 
 def ring_self_attention(x, params, mesh: Mesh, *, n_heads: int,
                         head_dim: int, seq_axis: str = "data",
+                        batch_axis: Optional[str] = None,
                         causal: bool = False, block_size: int = 512):
     """Full sequence-parallel self attention: x [B, T, F] sharded over
-    ``seq_axis`` on its T dimension; QKV projections are local, attention
-    runs as a ring. Entry point used by SelfAttentionLayer when a mesh
-    context is active, and directly by transformer blocks."""
+    ``seq_axis`` on its T dimension (and over ``batch_axis`` on B when
+    composing with data parallelism — without it every dp device would
+    redundantly attend over the whole batch); QKV projections are local,
+    attention runs as a ring. Entry point used by SelfAttentionLayer when
+    a mesh context is active, and directly by transformer blocks."""
     from jax import shard_map
 
     def local_fn(x_l, Wq, Wk, Wv, Wo):
@@ -101,7 +104,7 @@ def ring_self_attention(x, params, mesh: Mesh, *, n_heads: int,
         out = out.transpose(0, 2, 1, 3).reshape(B, T_l, n_heads * head_dim)
         return out @ Wo
 
-    spec_x = P(None, seq_axis, None)
+    spec_x = P(batch_axis, seq_axis, None)
     spec_w = P()
     fn = shard_map(local_fn, mesh=mesh,
                    in_specs=(spec_x, spec_w, spec_w, spec_w, spec_w),

@@ -75,6 +75,15 @@ def _param_averaging(net, mesh: Optional[MeshContext] = None, **kw):
     return ParallelWrapper(net, mesh=mesh, **kw)
 
 
+@register_strategy("pipeline")
+def _pipeline(net, mesh: Optional[MeshContext] = None, **kw):
+    """GPipe pipeline parallelism: MLN body partitioned into S contiguous
+    stages over the mesh's 'pp' axis, heterogeneous activation shapes via
+    flat padded ring buffers (see parallel/pipeline.PipelineTrainer)."""
+    from deeplearning4j_tpu.parallel.pipeline import PipelineTrainer
+    return PipelineTrainer(net, mesh=mesh, **kw)
+
+
 @register_strategy("delayed_sync")
 def _delayed_sync(net, mesh: Optional[MeshContext] = None, **kw):
     """DP-2 parameter-server analog: local gradient accumulation with a

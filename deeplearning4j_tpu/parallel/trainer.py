@@ -26,7 +26,9 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator, DataSetIterator
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn.updater import compute_updates, l1_l2_penalty
-from deeplearning4j_tpu.parallel.mesh import MeshContext
+from deeplearning4j_tpu.parallel.mesh import (
+    MeshContext, sequence_parallel_scope,
+)
 
 
 class ParallelTrainer:
@@ -150,9 +152,12 @@ class ParallelTrainer:
                 lmask = self.mesh.shard_batch(
                     jnp.asarray(batch.labels_mask))
         net._rng, step_rng = jax.random.split(net._rng)
-        net.params, net.opt_state, net.states, loss = self._step(
-            net.params, net.opt_state, net.states, feats, labels, fmask,
-            lmask, step_rng)
+        # the scope routes SelfAttentionLayer through ring attention over
+        # the mesh's 'sp' axis at trace time (no-op without one)
+        with sequence_parallel_scope(self.mesh):
+            net.params, net.opt_state, net.states, loss = self._step(
+                net.params, net.opt_state, net.states, feats, labels, fmask,
+                lmask, step_rng)
         net.last_batch_size = batch.num_examples()
         net.last_grads = None  # SPMD step doesn't collect gradients
         # raw device scalar: converting here would sync the SPMD pipeline

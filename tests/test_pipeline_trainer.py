@@ -1,0 +1,191 @@
+"""PipelineTrainer: a real MultiLayerNetwork partitioned into GPipe stages
+(VERDICT r3 #4 — pipeline parallelism as a feature, not an exhibit).
+
+Loss parity vs the single-device step is the bar: the pipeline trainer
+reuses the exact loss head and compute_updates path, so one fit step must
+produce the same loss and the same updated parameters up to float
+reassociation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    GravesLSTM, OutputLayer, RnnOutputLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.pipeline import (
+    PipelineTrainer, partition_stages,
+)
+from deeplearning4j_tpu.parallel.strategy import create_trainer
+
+RNG = np.random.default_rng(77)
+
+
+def _mlp_conf(seed=7):
+    """Heterogeneous widths: every stage boundary has a different shape."""
+    return (NeuralNetConfiguration.builder().seed(seed)
+            .updater("sgd", learning_rate=0.1).weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(DenseLayer(n_out=20, activation="tanh"))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(12)).build())
+
+
+def _batch(b=16, f=12, k=10):
+    x = RNG.normal(size=(b, f)).astype(np.float32)
+    y = np.eye(k, dtype=np.float32)[RNG.integers(0, k, b)]
+    return DataSet(x, y)
+
+
+def _pp_mesh(s):
+    return Mesh(np.array(jax.devices()[:s]).reshape(s), axis_names=("pp",))
+
+
+def test_partition_stages_balanced_contiguous():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    stages = partition_stages(net.layers[:-1], net.params, 3)
+    assert [i for st in stages for i in st] == [0, 1, 2]
+    assert all(st for st in stages)
+
+
+def test_pipeline_loss_and_update_parity():
+    """One pipeline step == one single-device step (loss + new params)."""
+    ref = MultiLayerNetwork(_mlp_conf()).init()
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    batch = _batch()
+
+    loss_ref = float(ref.fit_batch(batch))
+    trainer = create_trainer("pipeline", net, mesh=_pp_mesh(4),
+                             n_microbatches=4)
+    loss_pp = float(trainer.fit_batch(batch))
+    assert abs(loss_pp - loss_ref) < 1e-5
+
+    for i in range(len(net.layers)):
+        for k in ref.params[i]:
+            np.testing.assert_allclose(np.asarray(net.params[i][k]),
+                                       np.asarray(ref.params[i][k]),
+                                       atol=1e-5, err_msg=f"layer {i} {k}")
+
+
+def test_pipeline_converges_multi_step():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    trainer = PipelineTrainer(net, mesh=_pp_mesh(4), n_microbatches=4)
+    batch = _batch()
+    first = float(trainer.fit_batch(batch))
+    for _ in range(15):
+        last = float(trainer.fit_batch(batch))
+    assert last < first
+
+
+def test_pipeline_conv_body_nonhomogeneous_shapes():
+    """CNN -> FF boundary inside the pipeline: activation shapes differ
+    per stage (the r3 exhibit required homogeneous shapes)."""
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater("sgd", learning_rate=0.05).weight_init("xavier")
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    convolution_mode="same",
+                                    activation="relu"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=5, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1)).build())
+    ref = MultiLayerNetwork(conf).init()
+    net = MultiLayerNetwork(_clone_conf(conf)).init()
+    x = RNG.normal(size=(8, 8, 8, 1)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[RNG.integers(0, 5, 8)]
+    batch = DataSet(x, y)
+
+    loss_ref = float(ref.fit_batch(batch))
+    trainer = PipelineTrainer(net, mesh=_pp_mesh(2), n_microbatches=4)
+    loss_pp = float(trainer.fit_batch(batch))
+    assert abs(loss_pp - loss_ref) < 1e-5
+    for i in range(len(net.layers)):
+        for k in ref.params[i]:
+            np.testing.assert_allclose(np.asarray(net.params[i][k]),
+                                       np.asarray(ref.params[i][k]),
+                                       atol=2e-5, err_msg=f"layer {i} {k}")
+
+
+def _clone_conf(conf):
+    """Same seed -> same init; rebuild from JSON for independence."""
+    from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+    return MultiLayerConfiguration.from_json(conf.to_json())
+
+
+def test_pipeline_dp_times_pp():
+    """dp=2 x pp=2: microbatch batch dim sharded over dp, stages over pp."""
+    ref = MultiLayerNetwork(_mlp_conf()).init()
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                axis_names=("dp", "pp"))
+    batch = _batch(b=16)
+    loss_ref = float(ref.fit_batch(batch))
+    trainer = PipelineTrainer(net, mesh=mesh, n_microbatches=2)
+    loss_pp = float(trainer.fit_batch(batch))
+    assert abs(loss_pp - loss_ref) < 1e-5
+
+
+def test_pipeline_rejects_stateful_and_recurrent():
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater("sgd", learning_rate=0.05)
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    with pytest.raises(ValueError, match="running state"):
+        PipelineTrainer(net, mesh=_pp_mesh(2))
+
+    rconf = (NeuralNetConfiguration.builder().seed(3)
+             .updater("sgd", learning_rate=0.05)
+             .list()
+             .layer(GravesLSTM(n_out=8, activation="tanh"))
+             .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+             .set_input_type(InputType.recurrent(6, 5)).build())
+    rnet = MultiLayerNetwork(rconf).init()
+    with pytest.raises(ValueError, match="recurrent"):
+        PipelineTrainer(rnet, mesh=_pp_mesh(2))
+
+
+def test_pipeline_conv_directly_before_head():
+    """The head-index auto preprocessor (CnnToFeedForward) must apply
+    before the loss head, exactly as MLN._forward does (review r4)."""
+    conf = (NeuralNetConfiguration.builder().seed(9)
+            .updater("sgd", learning_rate=0.05).weight_init("xavier")
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    convolution_mode="same",
+                                    activation="relu"))
+            .layer(OutputLayer(n_out=5, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(6, 6, 1)).build())
+    ref = MultiLayerNetwork(conf).init()
+    net = MultiLayerNetwork(_clone_conf(conf)).init()
+    x = RNG.normal(size=(8, 6, 6, 1)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[RNG.integers(0, 5, 8)]
+    batch = DataSet(x, y)
+    loss_ref = float(ref.fit_batch(batch))
+    trainer = PipelineTrainer(net, mesh=_pp_mesh(2), n_microbatches=2)
+    loss_pp = float(trainer.fit_batch(batch))
+    assert abs(loss_pp - loss_ref) < 1e-5
+
+
+def test_pipeline_rejects_masked_batches():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    trainer = PipelineTrainer(net, mesh=_pp_mesh(2))
+    b = _batch(b=8)
+    masked = DataSet(b.features, b.labels,
+                     labels_mask=np.ones((8,), np.float32))
+    with pytest.raises(ValueError, match="mask"):
+        trainer.fit_batch(masked)
