@@ -52,10 +52,10 @@ import traceback
 
 import numpy as np
 
-# First recorded value per metric. Update when a round improves it so
-# vs_baseline tracks cumulative speedup over the first measurement.
-# No TPU number has ever been banked (r01 backend failure, r02 timeout),
-# so the first successful run of each rung sets its baseline (vs=1.0).
+# First-EVER recorded value per metric — the fixed vs_baseline
+# denominator. Do NOT update on later improvements (that would hide the
+# cumulative speedup); metrics still None here take their baseline from
+# the first value banked into BENCH_BANKED.json.
 BENCH_HISTORY = {
     # First real-TPU numbers, banked r03 (v5e-1, this harness): LeNet
     # 28811.7, ResNet-50 b64@224 1904.97 samples/s/chip. The small/xl
@@ -140,8 +140,10 @@ def _bank_record(rec: dict, amend: bool = False) -> None:
 
 
 def _banked_baseline(metric: str):
-    """First-ever banked value for ``metric`` (vs_baseline tracks cumulative
-    speedup over the first measurement; falls back to BENCH_HISTORY)."""
+    """vs_baseline denominator for ``metric``: the BENCH_HISTORY literal
+    (the authoritative first-ever measurement — do NOT update it on later
+    improvements) when set, else the first value ever banked into
+    BENCH_BANKED.json's ``baselines``."""
     lit = BENCH_HISTORY.get(metric)
     if lit is not None:
         return lit

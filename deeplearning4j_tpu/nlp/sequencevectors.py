@@ -27,28 +27,32 @@ from deeplearning4j_tpu.nlp.vocab import (VocabCache, VocabConstructor,
                                           huffman_arrays)
 
 
-def _scatter_mean_add(mat, idx, upd):
-    """mat[idx] += sum of upd rows, scaled 1/sqrt(count) per index.
+def _scatter_mean_add(mat, idx, upd, power: float = 0.5):
+    """mat[idx] += sum of upd rows, scaled 1/count**power per index.
 
     The reference's hogwild threads apply each pair's update sequentially
     at the then-current weights, which self-limits as sigmoids saturate.
-    A batched scatter-SUM computes every duplicate-index update at the
-    same stale point, multiplying the effective LR by the duplicate count
-    (divergence for small vocabs); a scatter-MEAN starves progress to one
-    effective update per batch. 1/sqrt(count) is the stable compromise —
-    validated to converge where sum diverges and mean stalls — and equals
-    the plain sum when indices are unique (large vocabs)."""
+    A batched scatter-SUM (power=0) computes every duplicate-index update
+    at the same stale point, multiplying the effective LR by the
+    duplicate count (divergence for small vocabs); a scatter-MEAN
+    (power=1) starves progress to one effective update per batch. The
+    default 1/sqrt(count) is the stable compromise — asserted against
+    both alternatives by tests/test_convergence.py — and approaches the
+    plain sum when indices are unique (large vocabs)."""
     cnt = jnp.zeros(mat.shape[0], mat.dtype).at[idx].add(1.0)
     tot = jnp.zeros_like(mat).at[idx].add(upd)
-    return mat + tot / jnp.sqrt(jnp.maximum(cnt, 1.0))[:, None]
+    return mat + tot / jnp.maximum(cnt, 1.0)[:, None] ** power
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
-def _sgns_step(syn0, syn1neg, centers, contexts, negs, lr):
+@partial(jax.jit, donate_argnums=(0, 1), static_argnames=("dup_power",))
+def _sgns_step(syn0, syn1neg, centers, contexts, negs, lr,
+               dup_power: float = 0.5):
     """One batched skip-gram negative-sampling update.
 
     For each pair (c, o) with K negatives n_k: standard SGNS gradients
     (ref: SkipGram.java iterateSample — per-pair scalar loop there).
+    ``dup_power`` exposes the duplicate-index scaling for the convergence
+    comparison test; production callers use the 0.5 default.
     """
     v = syn0[centers]                                   # [B, D]
     targets = jnp.concatenate([contexts[:, None], negs], axis=1)  # [B,1+K]
@@ -60,9 +64,9 @@ def _sgns_step(syn0, syn1neg, centers, contexts, negs, lr):
     g = (labels - score) * lr                           # [B, 1+K]
     dv = jnp.einsum("bk,bkd->bd", g, u)
     du = g[..., None] * v[:, None, :]                   # [B, 1+K, D]
-    syn0 = _scatter_mean_add(syn0, centers, dv)
+    syn0 = _scatter_mean_add(syn0, centers, dv, dup_power)
     syn1neg = _scatter_mean_add(syn1neg, targets.reshape(-1),
-                                du.reshape(-1, du.shape[-1]))
+                                du.reshape(-1, du.shape[-1]), dup_power)
     return syn0, syn1neg
 
 

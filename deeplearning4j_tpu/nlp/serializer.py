@@ -1,13 +1,23 @@
 """WordVectorSerializer: persist/load word vectors.
 
 Ref: deeplearning4j-nlp models/embeddings/loader/WordVectorSerializer.java
-(2824 LoC: word2vec C text/binary formats + full-model zip). Provided
-here: the word2vec C *text* format (interoperable with the reference's
-writeWordVectors/loadTxtVectors) and a full-model npz+json bundle.
+(2824 LoC: word2vec C text/binary formats, compressed archives, full-model
+zip). Provided here:
+
+- word2vec C **text** format (writeWordVectors / loadTxtVectors parity)
+- word2vec C **binary** format — the Google News ``.bin`` layout the
+  reference's ``loadGoogleModel(file, binary=true)`` reads: ASCII header
+  ``"V D\\n"``, then per word the chars up to ``' '`` followed by D
+  little-endian float32s and an optional ``'\\n'``
+- transparent gzip for both (``.gz`` suffix — loadGoogleModel's
+  GZIPInputStream path)
+- a full-model zip bundle (vocab + syn0/syn1/syn1neg) preserving HS/NS
+  output weights for continued training (lookup-table round-trip)
 """
 
 from __future__ import annotations
 
+import gzip
 import json
 import zipfile
 from pathlib import Path
@@ -19,19 +29,61 @@ from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
 from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord, build_huffman
 
 
+def _is_gz(path) -> bool:
+    return str(path).endswith(".gz")
+
+
+def _infer_binary(path) -> bool:
+    """.bin / .bin.gz → binary; everything else text (override with the
+    explicit ``binary=`` argument, as the reference's loadGoogleModel
+    flag does)."""
+    name = str(path)
+    if name.endswith(".gz"):
+        name = name[:-3]
+    return name.endswith(".bin")
+
+
 class WordVectorSerializer:
     @staticmethod
-    def write_word2vec_format(table: InMemoryLookupTable, path) -> None:
-        """word2vec C text format: header "V D", then "word f f f ..."."""
+    def write_word2vec_format(table: InMemoryLookupTable, path,
+                              binary: Optional[bool] = None) -> None:
+        """word2vec C format, text (default) or binary (.bin); ``.gz``
+        paths are gzip-compressed (ref: writeWordVectors /
+        WordVectorSerializer.writeBinary)."""
+        if binary is None:
+            binary = _infer_binary(path)
+        opener = gzip.open if _is_gz(path) else open
+        if binary:
+            with opener(path, "wb") as f:
+                f.write(f"{len(table.vocab)} {table.vector_length}\n"
+                        .encode("utf-8"))
+                for vw in table.vocab.vocab_words():
+                    f.write(vw.word.encode("utf-8") + b" ")
+                    f.write(np.asarray(table.syn0[vw.index],
+                                       dtype="<f4").tobytes())
+                    f.write(b"\n")
+            return
         lines = [f"{len(table.vocab)} {table.vector_length}"]
         for vw in table.vocab.vocab_words():
             vec = " ".join(f"{v:.6f}" for v in table.syn0[vw.index])
             lines.append(f"{vw.word} {vec}")
-        Path(path).write_text("\n".join(lines) + "\n", encoding="utf-8")
+        with opener(path, "wb") as f:
+            f.write(("\n".join(lines) + "\n").encode("utf-8"))
 
     @staticmethod
-    def read_word2vec_format(path) -> InMemoryLookupTable:
-        text = Path(path).read_text(encoding="utf-8").splitlines()
+    def read_word2vec_format(path, binary: Optional[bool] = None
+                             ) -> InMemoryLookupTable:
+        """Load word2vec C text or binary (= the reference's
+        loadGoogleModel / loadTxtVectors), gzip-transparent."""
+        if binary is None:
+            binary = _infer_binary(path)
+        opener = gzip.open if _is_gz(path) else open
+        if binary:
+            with opener(path, "rb") as f:
+                data = f.read()
+            return WordVectorSerializer._parse_binary(data)
+        with opener(path, "rb") as f:
+            text = f.read().decode("utf-8").splitlines()
         header = text[0].split()
         v, d = int(header[0]), int(header[1])
         cache = VocabCache()
@@ -41,6 +93,31 @@ class WordVectorSerializer:
             word, vals = parts[0], parts[1:]
             cache.add(VocabWord(word, 1.0))
             vecs[i] = np.array([float(x) for x in vals], dtype=np.float32)
+        cache.total_word_count = float(v)
+        build_huffman(cache)
+        table = InMemoryLookupTable(cache, d)
+        table.syn0 = vecs
+        return table
+
+    @staticmethod
+    def _parse_binary(data: bytes) -> InMemoryLookupTable:
+        nl = data.index(b"\n")
+        v, d = (int(x) for x in data[:nl].split())
+        pos = nl + 1
+        cache = VocabCache()
+        vecs = np.zeros((v, d), dtype=np.float32)
+        vec_bytes = 4 * d
+        for i in range(v):
+            # skip any leading newline left by the previous record (the
+            # original C tool writes one; some writers don't)
+            while data[pos:pos + 1] in (b"\n", b"\r"):
+                pos += 1
+            sp = data.index(b" ", pos)
+            word = data[pos:sp].decode("utf-8")
+            pos = sp + 1
+            vecs[i] = np.frombuffer(data, dtype="<f4", count=d, offset=pos)
+            pos += vec_bytes
+            cache.add(VocabWord(word, 1.0))
         cache.total_word_count = float(v)
         build_huffman(cache)
         table = InMemoryLookupTable(cache, d)

@@ -389,6 +389,12 @@ class PipelineTrainer:
             raise ValueError(f"batch size {B} not divisible by "
                              f"n_microbatches={self.M}")
         b_mb = B // self.M
+        if self.dp_axis is not None:
+            dp = self.mesh.shape[self.dp_axis]
+            if b_mb % dp != 0:
+                raise ValueError(
+                    f"microbatch size {b_mb} (batch {B} / {self.M} "
+                    f"microbatches) not divisible by the dp axis ({dp})")
         if self._step is None or getattr(self, "_b_mb", None) != b_mb:
             self._step = self._build_step(b_mb)
             self._b_mb = b_mb

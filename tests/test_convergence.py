@@ -1,0 +1,143 @@
+"""Convergence-parity integration tests (VERDICT r3 #6) — the reference's
+MultiLayerTest bar: train real models on real data in-suite and assert
+outcome quality, not just finiteness.
+
+- LeNet on REAL handwritten digits (tests/fixtures/digits_real.npz — the
+  UCI optdigits images bundled with scikit-learn, committed as a fixture
+  because this image has no network egress for true 28x28 MNIST) to >=98%
+  held-out accuracy.
+- char-LSTM loss-decrease curve on a deterministic text corpus.
+- The SGNS 1/sqrt(count) duplicate-index scaling claim
+  (nlp/sequencevectors.py:_scatter_mean_add) asserted against the sum and
+  mean alternatives instead of living only in a docstring.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _digits():
+    d = np.load(os.path.join(FIXTURES, "digits_real.npz"))
+    x, y = d["x"].astype(np.float32) / 16.0, d["y"]
+    # 2x nearest-neighbor upsample to 16x16: LeNet's two valid-mode 5x5
+    # convs need >= 16px input
+    x = np.kron(x, np.ones((1, 2, 2), np.float32))[..., None]
+    rng = np.random.default_rng(0)
+    idx = rng.permutation(len(x))
+    x, y = x[idx], y[idx]
+    n_test = 300
+    return (x[n_test:], y[n_test:]), (x[:n_test], y[:n_test])
+
+
+def test_lenet_real_digits_accuracy():
+    """LeNet to >=98% held-out accuracy on real digit images in-suite
+    (ref: deeplearning4j-core MultiLayerTest LeNet-MNIST integration)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.models.lenet import lenet_mnist
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    (xtr, ytr), (xte, yte) = _digits()
+    eye = np.eye(10, dtype=np.float32)
+    train_batches = [DataSet(xtr[i:i + 64], eye[ytr[i:i + 64]])
+                     for i in range(0, len(xtr), 64)]
+    net = MultiLayerNetwork(lenet_mnist(height=16, width=16, seed=7,
+                                        learning_rate=1e-3)).init()
+    net.fit(ListDataSetIterator(train_batches), epochs=20)
+    ev = net.evaluate(ListDataSetIterator(
+        [DataSet(xte[i:i + 64], eye[yte[i:i + 64]])
+         for i in range(0, len(xte), 64)]))
+    assert ev.accuracy() >= 0.98, f"accuracy {ev.accuracy():.4f}"
+
+
+def test_charlstm_loss_decreases():
+    """Char-LSTM training curve: average loss over the last steps must
+    fall well below the first steps (BASELINE config #4 in miniature)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.char_rnn import char_rnn_lstm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    text = ("the quick brown fox jumps over the lazy dog. " * 40)
+    chars = sorted(set(text))
+    K = len(chars)
+    to_id = {c: i for i, c in enumerate(chars)}
+    ids = np.array([to_id[c] for c in text], np.int64)
+    T, B = 32, 16
+    rng = np.random.default_rng(3)
+    eye = np.eye(K, dtype=np.float32)
+
+    def batch():
+        starts = rng.integers(0, len(ids) - T - 1, B)
+        xi = np.stack([ids[s:s + T] for s in starts])
+        yi = np.stack([ids[s + 1:s + T + 1] for s in starts])
+        return DataSet(eye[xi], eye[yi])
+
+    net = MultiLayerNetwork(char_rnn_lstm(
+        vocab_size=K, hidden=64, layers=1, tbptt_length=16,
+        learning_rate=3e-3, seed=11)).init()
+    losses = [float(net.fit_batch(batch())) for _ in range(40)]
+    first, last = np.mean(losses[:3]), np.mean(losses[-3:])
+    assert np.isfinite(losses).all()
+    assert last < 0.6 * first, f"loss {first:.3f} -> {last:.3f}"
+
+
+def test_sgns_sqrt_scaling_beats_sum_and_mean():
+    """The 1/sqrt(count) duplicate-index compromise, asserted: from the
+    same init on a heavily duplicated small-vocab batch stream, sqrt
+    scaling must separate the two co-occurrence groups at least as well
+    as scatter-mean, and scatter-sum must blow up embedding norms (the
+    'diverges' half of the claim) or separate worse."""
+    from deeplearning4j_tpu.nlp.sequencevectors import _sgns_step
+
+    V, D, B, K = 8, 16, 512, 4
+    rng = np.random.default_rng(5)
+    init0 = (rng.normal(size=(V, D)) * 0.1).astype(np.float32)
+    init1 = np.zeros((V, D), np.float32)
+    # two topics: words 0-3 co-occur, words 4-7 co-occur
+    groups = [np.arange(0, 4), np.arange(4, 8)]
+
+    def pairs():
+        g = groups[rng.integers(0, 2)]
+        centers = rng.choice(g, B)
+        contexts = rng.choice(g, B)
+        negs = rng.choice(groups[1] if g[0] == 0 else groups[0], (B, K))
+        return (jnp.asarray(centers), jnp.asarray(contexts),
+                jnp.asarray(negs))
+
+    # 6 steps at lr=0.1: few enough batches that scatter-mean's one
+    # effective update per batch visibly stalls, while sum's count-scaled
+    # steps (~128x lr here) visibly blow up
+    batches = [pairs() for _ in range(6)]
+
+    def run(power):
+        s0, s1 = jnp.asarray(init0), jnp.asarray(init1)
+        for c, o, n in batches:
+            s0, s1 = _sgns_step(s0, s1, c, o, n, 0.1, dup_power=power)
+        return np.asarray(s0)
+
+    def separation(emb):
+        e = emb / np.maximum(np.linalg.norm(emb, axis=1, keepdims=True),
+                             1e-9)
+        sims = e @ e.T
+        within = np.mean([sims[i, j] for g in groups
+                          for i in g for j in g if i != j])
+        cross = np.mean([sims[i, j] for i in groups[0] for j in groups[1]])
+        return within - cross
+
+    emb_sqrt, emb_sum, emb_mean = run(0.5), run(0.0), run(1.0)
+    sep_sqrt = separation(emb_sqrt)
+    # sqrt converges (measured 1.80 of a max 2.0 in this regime)
+    assert sep_sqrt > 1.0, f"sqrt scaling failed to separate: {sep_sqrt}"
+    # mean stalls (measured 0.057)
+    assert separation(emb_mean) < 0.5, (
+        f"mean unexpectedly converged: {separation(emb_mean)}")
+    # sum's count-multiplied steps blow up embedding norms (measured ~40x
+    # sqrt's) — the 'diverges' half of the docstring claim
+    norm_ratio = (np.linalg.norm(emb_sum) / np.linalg.norm(emb_sqrt))
+    assert norm_ratio > 10.0, f"sum did not blow up: ratio {norm_ratio}"

@@ -196,3 +196,43 @@ def test_tfidf_downweights_common_terms():
     i_cat, i_dog = v.vocab.index_of("cat"), v.vocab.index_of("dog")
     assert m[0, i_cat] == pytest.approx(0.0)  # idf(log 3/3)=0
     assert m[0, i_dog] > 0.0
+
+
+def test_word2vec_binary_roundtrip(tmp_path):
+    """word2vec C binary format (VERDICT r3 #7): write -> read is exact
+    (f32 bytes), including gzip variants."""
+    sents, _, _ = _corpus(50)
+    w2v = Word2Vec(layer_size=8, epochs=1)
+    w2v.fit(sents)
+    for name in ("vecs.bin", "vecs.bin.gz"):
+        p = tmp_path / name
+        WordVectorSerializer.write_word2vec_format(w2v.lookup_table, p)
+        table = WordVectorSerializer.read_word2vec_format(p)
+        np.testing.assert_array_equal(
+            table.get_word_vector("cat"),
+            np.asarray(w2v.get_word_vector("cat"), np.float32))
+        assert len(table.vocab) == len(w2v.vocab)
+    # text + gzip too (loadGoogleModel's GZIPInputStream path)
+    p = tmp_path / "vecs.txt.gz"
+    WordVectorSerializer.write_word2vec_format(w2v.lookup_table, p)
+    table = WordVectorSerializer.read_word2vec_format(p)
+    np.testing.assert_allclose(
+        table.get_word_vector("cat"), w2v.get_word_vector("cat"), atol=1e-5)
+
+
+def test_load_google_model_bin_fixture():
+    """A committed real .bin file in the Google News layout (header line,
+    'word ' + 5 LE float32 + newline, incl. a UTF-8 multibyte word)."""
+    import os
+    p = os.path.join(os.path.dirname(__file__), "fixtures", "sample_w2v.bin")
+    table = WordVectorSerializer.read_word2vec_format(p)
+    assert len(table.vocab) == 8
+    assert table.vector_length == 5
+    np.testing.assert_allclose(
+        table.get_word_vector("the"),
+        [-1.6038368, 0.06409992, 0.7408913, 0.1526192, 0.8637439],
+        rtol=1e-6)
+    assert table.get_word_vector("日本") is not None
+    # explicit-flag parity with the inferred path
+    t2 = WordVectorSerializer.read_word2vec_format(p, binary=True)
+    np.testing.assert_array_equal(t2.syn0, table.syn0)

@@ -189,3 +189,14 @@ def test_pipeline_rejects_masked_batches():
                      labels_mask=np.ones((8,), np.float32))
     with pytest.raises(ValueError, match="mask"):
         trainer.fit_batch(masked)
+
+
+def test_pipeline_dp_divisibility_validated():
+    """A microbatch that doesn't divide the dp axis must fail with the
+    trainer's ValueError, not a raw shard_map error (review r4)."""
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                axis_names=("dp", "pp"))
+    trainer = PipelineTrainer(net, mesh=mesh, n_microbatches=4)
+    with pytest.raises(ValueError, match="dp axis"):
+        trainer.fit_batch(_batch(b=12))
