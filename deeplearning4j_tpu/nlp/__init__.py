@@ -48,3 +48,16 @@ from deeplearning4j_tpu.nlp.vectorizers import (  # noqa: F401
     BagOfWordsVectorizer,
     TfidfVectorizer,
 )
+from deeplearning4j_tpu.nlp.lattice_tokenizer import (  # noqa: F401
+    JapaneseLatticeTokenizer,
+    JapaneseLatticeTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.annotators import (  # noqa: F401
+    AnnotatorPipeline,
+    AnnotatorSentenceIterator,
+    PosTokenizerFactory,
+    StemmingPreprocessor,
+    default_pipeline,
+    lemmatize,
+    porter_stem,
+)

@@ -1,0 +1,303 @@
+"""Japanese lexicon for the lattice tokenizer: seed entries + a
+conjugation generator.
+
+Ref: deeplearning4j-nlp-japanese bundles full IPADIC (~12MB binary,
+~390k surface forms) inside its Kuromoji fork. This image has no network
+egress, so instead of shipping a large binary this module *generates* the
+inflected surface forms IPADIC lists explicitly: each seed verb carries
+its conjugation class (godan row / ichidan / irregular) and an engine
+expands it to the standard paradigm (dictionary, 連用形, て/た with 音便,
+negative, potential, passive, volitional, conditional, imperative), and
+each い-adjective expands to its five common forms. ~200 seed verbs and
+~80 adjectives plus nouns/loanwords/particles yield several thousand
+surface entries — the coverage that decides segmentation quality for
+everyday text, at a few KB of source.
+
+Entry format matches lattice_tokenizer: surface -> [(pos, cost, base)].
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+Entries = Dict[str, List[Tuple[str, int, Optional[str]]]]
+
+# godan ending -> (irrealis 未然, continuative 連用, euphonic て-stem,
+#                 potential 仮定/可能 stem, volitional stem)
+_GODAN = {
+    "う": ("わ", "い", "っ", "え", "お"),
+    "く": ("か", "き", "い", "け", "こ"),
+    "ぐ": ("が", "ぎ", "い", "げ", "ご"),
+    "す": ("さ", "し", "し", "せ", "そ"),
+    "つ": ("た", "ち", "っ", "て", "と"),
+    "ぬ": ("な", "に", "ん", "ね", "の"),
+    "ぶ": ("ば", "び", "ん", "べ", "ぼ"),
+    "む": ("ま", "み", "ん", "め", "も"),
+    "る": ("ら", "り", "っ", "れ", "ろ"),
+}
+_VOICED_TE = {"ぐ": True, "ぬ": True, "ぶ": True, "む": True}
+
+
+def conjugate_verb(dict_form: str, klass: str) -> List[Tuple[str, str]]:
+    """All (surface, kind) paradigm forms for a verb, kind in
+    {'dict','cont','te','ta','neg','pot','pass','vol','cond','imp'}."""
+    out = [(dict_form, "dict")]
+    if klass == "ichidan":
+        stem = dict_form[:-1]
+        out += [(stem, "cont"), (stem + "て", "te"), (stem + "た", "ta"),
+                (stem + "ない", "neg"), (stem + "なかった", "neg"),
+                (stem + "られる", "pass"), (stem + "よう", "vol"),
+                (stem + "れば", "cond"), (stem + "ろ", "imp")]
+        return out
+    if klass == "suru":  # する-compound: caller passes the する part
+        base = dict_form[:-2]
+        out += [(base + "し", "cont"), (base + "して", "te"),
+                (base + "した", "ta"), (base + "しない", "neg"),
+                (base + "できる", "pot"), (base + "される", "pass"),
+                (base + "しよう", "vol"), (base + "すれば", "cond"),
+                (base + "しろ", "imp")]
+        return out
+    if klass == "kuru":
+        base = dict_form[:-2]
+        out += [(base + "来", "cont"), (base + "来て", "te"),
+                (base + "来た", "ta"), (base + "来ない", "neg"),
+                (base + "来られる", "pass"), (base + "来よう", "vol"),
+                (base + "来れば", "cond"), (base + "来い", "imp")]
+        return out
+    end = dict_form[-1]
+    stem = dict_form[:-1]
+    irr, cont, te, pot, vol = _GODAN[end]
+    te_suf = ("で" if _VOICED_TE.get(end) else "て")
+    ta_suf = ("だ" if _VOICED_TE.get(end) else "た")
+    if dict_form == "行く":  # the classic 音便 exception: 行って
+        te_stem = "行っ"
+    else:
+        te_stem = stem + te
+    out += [(stem + cont, "cont"),
+            (te_stem + te_suf, "te"), (te_stem + ta_suf, "ta"),
+            (stem + irr + "ない", "neg"), (stem + irr + "なかった", "neg"),
+            (stem + pot + "る", "pot"), (stem + irr + "れる", "pass"),
+            (stem + vol + "う", "vol"), (stem + pot + "ば", "cond"),
+            (stem + pot, "imp")]
+    return out
+
+
+def conjugate_i_adjective(dict_form: str) -> List[Tuple[str, str]]:
+    stem = dict_form[:-1]
+    return [(dict_form, "dict"), (stem + "く", "adv"),
+            (stem + "かった", "past"), (stem + "くない", "neg"),
+            (stem + "くなかった", "neg"), (stem + "ければ", "cond"),
+            (stem + "さ", "nominal")]
+
+
+# --------------------------------------------------------------------------
+# seed data
+# --------------------------------------------------------------------------
+
+# (dictionary form, class); classes: godan (by final kana), ichidan,
+# suru (〜する compounds incl. bare する), kuru
+VERBS: List[Tuple[str, str]] = [
+    ("住む", "godan"), ("行く", "godan"), ("見る", "ichidan"),
+    ("食べる", "ichidan"), ("飲む", "godan"), ("する", "suru"),
+    ("やる", "godan"), ("いる", "ichidan"), ("ある", "godan"),
+    ("なる", "godan"), ("思う", "godan"), ("言う", "godan"),
+    ("読む", "godan"), ("書く", "godan"), ("聞く", "godan"),
+    ("話す", "godan"), ("買う", "godan"), ("使う", "godan"),
+    ("作る", "godan"), ("歩く", "godan"), ("走る", "godan"),
+    ("帰る", "godan"), ("働く", "godan"), ("待つ", "godan"),
+    ("分かる", "godan"), ("来る", "kuru"), ("出る", "ichidan"),
+    ("入る", "godan"), ("出す", "godan"), ("持つ", "godan"),
+    ("取る", "godan"), ("置く", "godan"), ("立つ", "godan"),
+    ("座る", "godan"), ("寝る", "ichidan"), ("起きる", "ichidan"),
+    ("開ける", "ichidan"), ("閉める", "ichidan"), ("始める", "ichidan"),
+    ("終わる", "godan"), ("教える", "ichidan"), ("習う", "godan"),
+    ("覚える", "ichidan"), ("忘れる", "ichidan"), ("考える", "ichidan"),
+    ("知る", "godan"), ("会う", "godan"), ("遊ぶ", "godan"),
+    ("泳ぐ", "godan"), ("飛ぶ", "godan"), ("死ぬ", "godan"),
+    ("生きる", "ichidan"), ("売る", "godan"), ("払う", "godan"),
+    ("送る", "godan"), ("届く", "godan"), ("着く", "godan"),
+    ("乗る", "godan"), ("降りる", "ichidan"), ("渡る", "godan"),
+    ("曲がる", "godan"), ("止まる", "godan"), ("動く", "godan"),
+    ("変わる", "godan"), ("選ぶ", "godan"), ("決める", "ichidan"),
+    ("答える", "ichidan"), ("尋ねる", "ichidan"), ("呼ぶ", "godan"),
+    ("歌う", "godan"), ("踊る", "godan"), ("笑う", "godan"),
+    ("泣く", "godan"), ("怒る", "godan"), ("喜ぶ", "godan"),
+    ("困る", "godan"), ("疲れる", "ichidan"), ("休む", "godan"),
+    ("洗う", "godan"), ("切る", "godan"), ("焼く", "godan"),
+    ("煮る", "ichidan"), ("混ぜる", "ichidan"), ("並ぶ", "godan"),
+    ("運ぶ", "godan"), ("押す", "godan"), ("引く", "godan"),
+    ("投げる", "ichidan"), ("受ける", "ichidan"), ("打つ", "godan"),
+    ("勝つ", "godan"), ("負ける", "ichidan"), ("戦う", "godan"),
+    ("守る", "godan"), ("助ける", "ichidan"), ("探す", "godan"),
+    ("見つける", "ichidan"), ("隠す", "godan"), ("捨てる", "ichidan"),
+    ("拾う", "godan"), ("落ちる", "ichidan"), ("落とす", "godan"),
+    ("上がる", "godan"), ("下がる", "godan"), ("登る", "godan"),
+    ("晴れる", "ichidan"), ("曇る", "godan"), ("降る", "godan"),
+    ("吹く", "godan"), ("光る", "godan"), ("消える", "ichidan"),
+    ("消す", "godan"), ("点ける", "ichidan"), ("建てる", "ichidan"),
+    ("壊す", "godan"), ("壊れる", "ichidan"), ("直す", "godan"),
+    ("治る", "godan"), ("増える", "ichidan"), ("減る", "godan"),
+    ("育てる", "ichidan"), ("育つ", "godan"), ("生まれる", "ichidan"),
+    ("勉強する", "suru"), ("仕事する", "suru"), ("電話する", "suru"),
+    ("料理する", "suru"), ("旅行する", "suru"), ("運動する", "suru"),
+    ("練習する", "suru"), ("説明する", "suru"), ("紹介する", "suru"),
+    ("準備する", "suru"), ("利用する", "suru"), ("研究する", "suru"),
+]
+
+I_ADJECTIVES = [
+    "高い", "安い", "大きい", "小さい", "新しい", "古い", "良い",
+    "悪い", "暑い", "寒い", "早い", "遅い", "美しい", "楽しい",
+    "面白い", "難しい", "易しい", "多い", "少ない", "長い", "短い",
+    "広い", "狭い", "重い", "軽い", "強い", "弱い", "明るい", "暗い",
+    "近い", "遠い", "太い", "細い", "厚い", "薄い", "深い", "浅い",
+    "甘い", "辛い", "苦い", "白い", "黒い", "赤い", "青い", "丸い",
+    "若い", "忙しい", "嬉しい", "悲しい", "怖い", "眠い", "痛い",
+    "汚い", "美味しい", "まずい", "うるさい", "正しい",
+    "危ない", "優しい", "厳しい", "賢い", "可愛い", "凄い",
+]
+
+# irregular adjective surfaces the conjugator can't derive:
+# 大きな/小さな are prenominal-only forms, いい/よく suppletive 良い
+IRREGULAR_ADJ_FORMS = [("大きな", "大きい"), ("小さな", "小さい"),
+                       ("いい", "良い"), ("よく", "良い")]
+
+# conjugator outputs that don't exist in the language (the negation of
+# ある is the bare adjective ない, not *あらない)
+BOGUS_FORMS = {"あらない", "あらなかった"}
+
+NA_ADJECTIVES = [
+    "静か", "元気", "綺麗", "便利", "不便", "有名", "大切", "大変",
+    "簡単", "複雑", "自由", "安全", "危険", "特別", "普通", "必要",
+    "十分", "残念", "親切", "丁寧", "真面目", "熱心", "暇", "好き",
+    "嫌い", "上手", "下手", "得意", "苦手",
+]
+
+NOUNS = [
+    # people / society
+    "学生", "先生", "学校", "会社", "社員", "医者", "警察", "店員",
+    "家族", "父", "母", "兄", "弟", "姉", "妹", "息子", "娘", "夫",
+    "妻", "友達", "子供", "大人", "男", "女", "人々", "皆",
+    # places
+    "日本", "東京", "京都", "大阪", "北海道", "沖縄", "アメリカ",
+    "中国", "韓国", "フランス", "ドイツ", "イギリス", "国", "町",
+    "村", "駅", "空港", "病院", "銀行", "図書館", "公園", "店",
+    "レストラン", "ホテル", "大学", "教室", "部屋", "台所", "庭",
+    "道", "橋", "建物", "場所", "世界", "地図",
+    # nature / time
+    "山", "川", "海", "空", "森", "林", "島", "石", "土", "火",
+    "水", "風", "雨", "雪", "雲", "星", "月", "太陽", "天気",
+    "季節", "春", "夏", "秋", "冬", "朝", "昼", "夜", "今日",
+    "明日", "昨日", "今", "時間", "時計", "週末", "去年", "来年",
+    "毎日", "毎週", "午前", "午後",
+    # things
+    "本", "新聞", "雑誌", "手紙", "写真", "絵", "音楽", "映画",
+    "歌", "電話", "電車", "車", "自転車", "飛行機", "船", "荷物",
+    "鞄", "財布", "服", "靴", "帽子", "眼鏡", "傘", "椅子", "机",
+    "窓", "扉", "鍵", "箱", "紙", "鉛筆", "辞書", "言葉", "名前",
+    "声", "音", "色", "形", "大きさ", "値段", "お金", "切符",
+    # food
+    "ご飯", "飯", "パン", "肉", "魚", "野菜", "果物", "卵", "牛乳",
+    "茶", "お茶", "珈琲", "酒", "料理", "朝ご飯", "昼ご飯", "晩ご飯",
+    "すもも", "もも", "林檎", "蜜柑", "葡萄",
+    # body / abstract
+    "体", "頭", "顔", "目", "耳", "口", "鼻", "手", "足", "心",
+    "気持ち", "気分", "夢", "話", "質問", "答え", "問題", "宿題",
+    "試験", "意味", "理由", "方法", "結果", "始め", "終わり",
+    "仕事", "勉強", "旅行", "運動", "練習", "経験", "文化", "歴史",
+    "社会", "政治", "経済", "科学", "技術", "自然", "動物", "犬",
+    "猫", "鳥", "馬", "牛", "花", "木", "草", "うち", "家",
+]
+
+KATAKANA_LOANWORDS = [
+    "コンピュータ", "インターネット", "メール", "テレビ", "ラジオ",
+    "カメラ", "ニュース", "スポーツ", "サッカー", "テニス", "ピアノ",
+    "ギター", "コンサート", "パーティー", "プレゼント", "ケーキ",
+    "コーヒー", "ジュース", "ビール", "ワイン", "バス", "タクシー",
+    "ホテル", "デパート", "スーパー", "コンビニ", "アパート", "ビル",
+    "エレベーター", "トイレ", "シャワー", "ベッド", "テーブル",
+    "ドア", "ページ", "ペン", "ノート", "クラス", "テスト", "レポート",
+    "アルバイト", "サービス", "システム", "データ", "プログラム",
+]
+
+PRONOUNS = ["私", "僕", "君", "彼", "彼女", "これ", "それ", "あれ",
+            "ここ", "そこ", "あそこ", "どこ", "誰", "何", "いつ",
+            "どれ", "こちら", "そちら", "あなた", "我々", "自分"]
+
+ADVERBS = ["とても", "すごく", "もっと", "少し", "たくさん", "いつも",
+           "また", "まだ", "もう", "すぐ", "ゆっくり", "一緒に",
+           "時々", "よく", "たぶん", "きっと", "必ず", "全然",
+           "あまり", "ちょっと", "だいたい", "はっきり", "そろそろ",
+           "やはり", "やっぱり", "実は", "例えば", "特に", "最近",
+           "初めて", "突然", "急に"]
+
+PARTICLES = ["は", "が", "を", "に", "で", "と", "も", "の", "へ",
+             "や", "から", "まで", "より", "ね", "よ", "か", "な",
+             "ば", "ても", "でも", "だけ", "しか", "など", "って",
+             "ながら", "けど", "のに", "ので", "とか", "ずつ", "くらい",
+             "ぐらい", "ほど", "ばかり", "こそ", "さえ", "のみ"]
+
+AUXILIARIES = [
+    ("です", "です"), ("でした", "です"), ("でしょう", "です"),
+    ("だ", "だ"), ("だった", "だ"), ("だろう", "だ"),
+    ("ます", "ます"), ("ました", "ます"), ("ません", "ます"),
+    ("ましょう", "ます"), ("まし", "ます"),
+    ("た", "た"), ("ない", "ない"), ("なかった", "ない"),
+    ("れる", "れる"), ("られる", "られる"), ("せる", "せる"),
+    ("させる", "させる"), ("たい", "たい"), ("たかった", "たい"),
+    ("う", "う"), ("よう", "よう"), ("そう", "そう"),
+    ("らしい", "らしい"), ("みたい", "みたい"), ("はず", "はず"),
+    ("べき", "べき"), ("かもしれない", "かもしれない"),
+]
+
+PREFIXES = ["お", "ご", "真", "小", "大"]
+SUFFIXES = ["さん", "ちゃん", "君", "様", "たち", "都", "府", "県",
+            "市", "区", "町", "村", "語", "人", "屋", "的", "者",
+            "中", "後", "前", "際", "式", "製", "用", "家", "員",
+            "品", "料", "代", "費", "店", "場", "側", "歳", "回",
+            "階", "番", "号", "度", "個", "匹", "冊", "枚", "台",
+            "杯", "本"]
+
+
+def build_entries(pos_names) -> Entries:
+    """Expand the seed data into lattice entries. ``pos_names`` supplies
+    the POS constants (avoids a circular import with lattice_tokenizer)."""
+    P = pos_names
+    lex: Entries = {}
+
+    def add(surface, pos, cost, base=None):
+        lex.setdefault(surface, []).append((pos, cost, base or surface))
+
+    for p in PARTICLES:
+        add(p, P["PARTICLE"], 200)
+    for a, base in AUXILIARIES:
+        add(a, P["AUX"], 300, base)
+    for n in PRONOUNS:
+        add(n, P["PRONOUN"], 700)
+    for n in NOUNS:
+        add(n, P["NOUN"], 800)
+    for n in KATAKANA_LOANWORDS:
+        add(n, P["NOUN"], 750)
+    for n in NA_ADJECTIVES:
+        # na-adjective stems behave like nouns in the lattice (attach
+        # な/に/です); tagged adjective for consumers
+        add(n, P["ADJ"], 850)
+    for a in ADVERBS:
+        add(a, P["ADV"], 900)
+    for v, klass in VERBS:
+        for surface, kind in conjugate_verb(v, klass):
+            if surface in BOGUS_FORMS:
+                continue
+            pos = P["VERB"] if kind == "dict" else P["VERB_INFL"]
+            # dictionary forms slightly preferred; particles must still
+            # beat single-kana inflections (cost ordering as before)
+            add(surface, pos, 900 if kind == "dict" else 950, v)
+    for a in I_ADJECTIVES:
+        for surface, kind in conjugate_i_adjective(a):
+            add(surface, P["ADJ"], 900 if kind == "dict" else 930, a)
+    for surface, base in IRREGULAR_ADJ_FORMS:
+        add(surface, P["ADJ"], 900, base)
+    for p in PREFIXES:
+        add(p, P["PREFIX"], 1200)
+    for s in SUFFIXES:
+        add(s, P["SUFFIX"], 900)
+    return lex

@@ -18,6 +18,7 @@ zip). Provided here:
 from __future__ import annotations
 
 import gzip
+import io
 import json
 import zipfile
 from pathlib import Path
@@ -80,8 +81,9 @@ class WordVectorSerializer:
         opener = gzip.open if _is_gz(path) else open
         if binary:
             with opener(path, "rb") as f:
-                data = f.read()
-            return WordVectorSerializer._parse_binary(data)
+                return WordVectorSerializer._parse_binary_stream(
+                    io.BufferedReader(f) if not isinstance(
+                        f, io.BufferedReader) else f)
         with opener(path, "rb") as f:
             text = f.read().decode("utf-8").splitlines()
         header = text[0].split()
@@ -100,24 +102,38 @@ class WordVectorSerializer:
         return table
 
     @staticmethod
-    def _parse_binary(data: bytes) -> InMemoryLookupTable:
-        nl = data.index(b"\n")
-        v, d = (int(x) for x in data[:nl].split())
-        pos = nl + 1
+    def _parse_binary_stream(f) -> InMemoryLookupTable:
+        """Stream-parse record by record (the reference's loadGoogleModel
+        reads the same way): O(1) extra memory beyond the vector matrix —
+        a Google News-scale .bin must not be duplicated wholesale in RAM,
+        and a .gz input decompresses incrementally."""
+        header = bytearray()
+        while not header.endswith(b"\n"):
+            b = f.read(1)
+            if not b:
+                raise ValueError("truncated word2vec binary header")
+            header += b
+        v, d = (int(x) for x in header.split())
         cache = VocabCache()
-        vecs = np.zeros((v, d), dtype=np.float32)
+        vecs = np.empty((v, d), dtype=np.float32)
         vec_bytes = 4 * d
         for i in range(v):
-            # skip any leading newline left by the previous record (the
-            # original C tool writes one; some writers don't)
-            while data[pos:pos + 1] in (b"\n", b"\r"):
-                pos += 1
-            sp = data.index(b" ", pos)
-            word = data[pos:sp].decode("utf-8")
-            pos = sp + 1
-            vecs[i] = np.frombuffer(data, dtype="<f4", count=d, offset=pos)
-            pos += vec_bytes
-            cache.add(VocabWord(word, 1.0))
+            word = bytearray()
+            ch = f.read(1)
+            # skip the newline the original C tool writes after each
+            # vector (some writers don't)
+            while ch in (b"\n", b"\r"):
+                ch = f.read(1)
+            while ch != b" ":
+                if not ch:
+                    raise ValueError(f"truncated record {i}")
+                word += ch
+                ch = f.read(1)
+            buf = f.read(vec_bytes)
+            if len(buf) != vec_bytes:
+                raise ValueError(f"truncated vector for record {i}")
+            vecs[i] = np.frombuffer(buf, dtype="<f4", count=d)
+            cache.add(VocabWord(word.decode("utf-8"), 1.0))
         cache.total_word_count = float(v)
         build_huffman(cache)
         table = InMemoryLookupTable(cache, d)

@@ -28,7 +28,8 @@ from deeplearning4j_tpu.nn.conf.graph import (
     DuplicateToTimeSeriesVertex, LastTimeStepVertex)
 from deeplearning4j_tpu.nn.conf.graph_builder import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.netcommon import (EvalMixin, LazyScoreMixin,
-                                              jit_init)
+                                              jit_init, ScanFitMixin,
+)
 from deeplearning4j_tpu.nn.updater import build_optimizer, compute_updates
 from deeplearning4j_tpu.optimize.listeners import IterationListener, TrainingListener
 
@@ -56,7 +57,7 @@ def _time_slice(d: Optional[Dict[str, Array]], lo: int, hi: int,
             for k, v in d.items()}
 
 
-class ComputationGraph(LazyScoreMixin, EvalMixin):
+class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin):
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
         self.params: Optional[Dict[str, Dict[str, Array]]] = None

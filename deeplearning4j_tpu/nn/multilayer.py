@@ -36,7 +36,8 @@ from deeplearning4j_tpu.datasets.iterator import (
 from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers.base import BaseLayerConf
 from deeplearning4j_tpu.nn.netcommon import (EvalMixin, LazyScoreMixin,
-                                              jit_init)
+                                              jit_init, ScanFitMixin,
+)
 from deeplearning4j_tpu.nn.updater import (
     build_optimizer, compute_updates, l1_l2_penalty,
 )
@@ -63,7 +64,7 @@ def _sum_aux_losses(states) -> Array:
     return total
 
 
-class MultiLayerNetwork(LazyScoreMixin, EvalMixin):
+class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin):
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
         self.layers: List[BaseLayerConf] = conf.layers

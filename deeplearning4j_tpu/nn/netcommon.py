@@ -106,3 +106,113 @@ def make_pretrain_step(layer, tx):
             updates, opt = tx.update(grads, opt, p)
             return jax.tree.map(lambda a, u: a + u, p, updates), opt, loss
     return jax.jit(step)
+
+
+def make_scan_fit(step_fn):
+    """Multi-step training as ONE jitted program: ``lax.scan`` of the
+    container's train step over a leading batch axis.
+
+    Per-step host dispatch costs a host->device round trip per iteration;
+    over a remote-tunneled TPU that latency can exceed the step's compute
+    (the r03 LeNet rung bottomed out near a fixed ms/step floor). Scanning
+    N steps inside one program pays ONE dispatch for the whole window —
+    the idiomatic XLA shape for a training loop (static trip count,
+    donated carry).
+
+    ``step_fn`` is the (non-jitted semantics of the) per-batch step with
+    signature (params, opt, states, feats, labels, fmask, lmask, rng) ->
+    (params, opt, states, loss, grads); masks are fixed to None in the
+    scanned program. feats/labels may be arrays (MultiLayerNetwork) or
+    name-keyed dicts (ComputationGraph) — lax.scan slices pytrees.
+    """
+
+    def scan_program(params, opt_state, states, feats, labels, rng):
+        def body(carry, xs):
+            p, o, s, r = carry
+            f, l = xs
+            r, sub = jax.random.split(r)
+            p, o, s, loss, _ = step_fn(p, o, s, f, l, None, None, sub)
+            return (p, o, s, r), loss
+
+        (p, o, s, _), losses = jax.lax.scan(
+            body, (params, opt_state, states, rng), (feats, labels))
+        return p, o, s, losses
+
+    return jax.jit(scan_program, donate_argnums=(0, 1, 2))
+
+
+class ScanFitMixin:
+    """``fit_batches_scan(datasets)`` for both containers."""
+
+    def fit_batches_scan(self, datasets):
+        """Run one optimization step per DataSet, all inside ONE jitted
+        scan program (see make_scan_fit). Requirements: SGD-family
+        optimizer, standard backprop, uniform batch shapes, no masks, no
+        gradient-collecting listeners — anything else falls back to the
+        per-batch ``fit_batch`` loop. Returns the per-step losses as a
+        device array (no sync unless converted)."""
+        import jax.numpy as jnp
+        import numpy as _np
+
+        self._check_init()
+        datasets = list(datasets)
+        if not datasets:
+            return _np.zeros((0,), _np.float32)
+        def has_mask(d):
+            # DataSet: singular attrs; MultiDataSet: plural lists
+            for attr in ("features_mask", "labels_mask",
+                         "features_masks", "labels_masks"):
+                m = getattr(d, attr, None)
+                if isinstance(m, (list, tuple)):
+                    if any(x is not None for x in m):
+                        return True
+                elif m is not None:
+                    return True
+            return False
+
+        algo = self.conf.training.optimization_algo
+        scannable = (
+            algo in ("sgd", "stochastic_gradient_descent")
+            and self.conf.training.backprop_type != "truncated_bptt"
+            and not getattr(self, "_collect_grads", False)
+            and not any(has_mask(d) for d in datasets))
+        if not scannable:
+            return _np.asarray([float(self.fit_batch(d))
+                                for d in datasets], _np.float32)
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        cached = getattr(self, "_scan_fit", None)
+        if cached is None or cached[0] is not self._train_step_fn:
+            self._scan_fit = (self._train_step_fn,
+                              make_scan_fit(self._train_step_fn))
+        scan_fn = self._scan_fit[1]
+
+        if hasattr(self, "_split"):  # ComputationGraph: name-keyed dicts
+            splits = [self._split(d) for d in datasets]
+            feats = jax.tree.map(lambda *xs: jnp.stack(
+                [jnp.asarray(x) for x in xs]), *[s[0] for s in splits])
+            labels = jax.tree.map(lambda *xs: jnp.stack(
+                [jnp.asarray(x) for x in xs]), *[s[1] for s in splits])
+        else:
+            feats = jnp.stack([jnp.asarray(d.features) for d in datasets])
+            labels = jnp.stack([jnp.asarray(d.labels) for d in datasets])
+
+        self._rng, r = jax.random.split(self._rng)
+        self.params, self.opt_state, self.states, losses = scan_fn(
+            self.params, self.opt_state, self.states, feats, labels, r)
+        self.last_batch_size = datasets[-1].num_examples()
+        self.last_grads = None
+        self.last_input = getattr(datasets[-1], "features", None)
+        if self.listeners:
+            for i, _ in enumerate(datasets):
+                self.iteration_count += 1
+                # listeners reading model.score_value must see THIS
+                # iteration's loss, not the window's final one
+                self.score_value = float(losses[i])
+                for listener in self.listeners:
+                    listener.iteration_done(self, self.iteration_count,
+                                            self.score_value)
+        else:
+            self.iteration_count += len(datasets)
+        self.score_value = losses[-1]
+        return losses

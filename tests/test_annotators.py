@@ -1,0 +1,113 @@
+"""Annotator-pipeline tests (ref: deeplearning4j-nlp-uima test suite —
+SentenceIteratorTest, PosUimaTokenizerFactoryTest,
+StemmingPreprocessorTest)."""
+
+from deeplearning4j_tpu.nlp.annotators import (
+    AnnotatorPipeline, AnnotatorSentenceIterator, LemmaAnnotator,
+    POSAnnotator, PosTokenizerFactory, SentenceAnnotator,
+    StemmerAnnotator, StemmingPreprocessor, TokenizerAnnotator,
+    default_pipeline, lemmatize, porter_stem,
+)
+
+
+def test_sentence_segmentation_abbreviation_aware():
+    cas = AnnotatorPipeline([SentenceAnnotator()]).process(
+        "Dr. Smith arrived. He met Mrs. Jones at 5 p.m. sharp! Was he "
+        "late? No.")
+    sents = cas.sentences()
+    assert sents[0] == "Dr. Smith arrived."
+    assert sents[1].startswith("He met Mrs. Jones")
+    assert "Was he late?" in sents
+    assert sents[-1] == "No."
+
+
+def test_token_annotations_align_with_text():
+    cas = default_pipeline().process("The cats were running quickly!")
+    toks = cas.select("token")
+    assert [t.covered_text(cas.text) for t in toks] == [
+        "The", "cats", "were", "running", "quickly", "!"]
+    # spans index the original string
+    for t in toks:
+        assert cas.text[t.begin:t.end] == t.covered_text(cas.text)
+
+
+def test_pos_tags():
+    cas = default_pipeline().process(
+        "The happy dogs chased a ball. She went to Washington to vote.")
+    by_word = {t.covered_text(cas.text): t.features["pos"]
+               for t in cas.select("token")}
+    assert by_word["The"] == "DT"
+    assert by_word["dogs"] == "NNS"
+    assert by_word["chased"] == "VBD"
+    assert by_word["went"] == "VBD"          # irregular past
+    assert by_word["Washington"] == "NNP"    # TO + NNP stays a PP object
+    assert by_word["vote"] == "VB"           # TO + common noun -> verb
+    assert by_word["She"] == "PRP"
+
+
+def test_porter_stemmer_canonical_vectors():
+    """Canonical examples from Porter (1980)."""
+    vectors = {
+        "caresses": "caress", "ponies": "poni", "ties": "ti",
+        "caress": "caress", "cats": "cat", "feed": "feed",
+        "agreed": "agre", "plastered": "plaster", "bled": "bled",
+        "motoring": "motor", "sing": "sing", "conflated": "conflat",
+        "troubled": "troubl", "sized": "size", "hopping": "hop",
+        "falling": "fall", "hissing": "hiss", "failing": "fail",
+        "filing": "file", "happy": "happi", "sky": "sky",
+        "relational": "relat", "conditional": "condit",
+        "rational": "ration", "valenci": "valenc", "digitizer": "digit",
+        "triplicate": "triplic", "formative": "form", "formalize": "formal",
+        "electricity": "electr", "hopefulness": "hope",
+        "goodness": "good", "revival": "reviv", "allowance": "allow",
+        "inference": "infer", "airliner": "airlin", "adjustable": "adjust",
+        "defensible": "defens", "replacement": "replac",
+        "adjustment": "adjust", "dependent": "depend", "adoption": "adopt",
+        "homologou": "homolog", "communism": "commun", "activate": "activ",
+        "angularity": "angular", "effective": "effect", "probate": "probat",
+        "rate": "rate", "controlling": "control", "rolling": "roll",
+    }
+    for word, want in vectors.items():
+        assert porter_stem(word) == want, (word, porter_stem(word), want)
+
+
+def test_lemmatizer_irregulars_and_rules():
+    assert lemmatize("went") == "go"
+    assert lemmatize("children") == "child"
+    assert lemmatize("studies", "NNS") == "study"
+    assert lemmatize("stopped", "VBD") == "stop"
+    assert lemmatize("running", "VBG") == "run"
+    assert lemmatize("making", "VBG") == "make"
+    assert lemmatize("boxes", "NNS") == "box"
+    assert lemmatize("cats") == "cat"
+
+
+def test_stem_and_lemma_annotators_fill_features():
+    cas = default_pipeline().process("The ponies were running.")
+    feats = {t.covered_text(cas.text): t.features
+             for t in cas.select("token")}
+    assert feats["ponies"]["stem"] == "poni"
+    assert feats["ponies"]["lemma"] == "pony"
+    assert feats["running"]["lemma"] == "run"
+
+
+def test_pos_tokenizer_factory_filters_and_lemmatizes():
+    tf = PosTokenizerFactory(["NN"], lemmatized=True)
+    toks = tf.create("The cats chased the mice in two gardens.").get_tokens()
+    assert "cat" in toks and "garden" in toks
+    assert "chased" not in toks and "the" not in toks
+    surface = PosTokenizerFactory(["VB"]).create(
+        "The cats chased the mice.").get_tokens()
+    assert surface == ["chased"]
+
+
+def test_annotator_sentence_iterator_and_stemming_preprocessor():
+    it = AnnotatorSentenceIterator(
+        ["First doc. It has two sentences.", "Second doc here!"])
+    assert list(it) == ["First doc.", "It has two sentences.",
+                       "Second doc here!"]
+    assert StemmingPreprocessor().pre_process("Running!") == "run"
+    # composes with SequenceVectors' tokenizer-factory seam
+    from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+    f = DefaultTokenizerFactory(preprocessor=StemmingPreprocessor())
+    assert f.create("Ponies running").get_tokens() == ["poni", "run"]

@@ -57,9 +57,14 @@ def test_unknown_words_are_single_script_runs():
     """OOV katakana/kanji runs come out whole (unk.def analog), not
     char-by-char, and neighbors still resolve from the dictionary."""
     t = JapaneseLatticeTokenizer()
+    # ヘリコプター is OOV; コンピュータ is now a dictionary loanword
+    # (the generated lexicon, ja_lexicon.py)
+    ms = t.tokenize("ヘリコプターを使う")
+    assert _surfaces(ms) == ["ヘリコプター", "を", "使う"]
+    assert ms[0].pos == UNK
     ms = t.tokenize("コンピュータを使う")
     assert _surfaces(ms) == ["コンピュータ", "を", "使う"]
-    assert ms[0].pos == UNK
+    assert ms[0].pos == "noun"
     ms = t.tokenize("私の名前は田中です")
     assert _surfaces(ms) == ["私", "の", "名前", "は", "田中", "です"]
 
@@ -92,3 +97,53 @@ def test_morpheme_positions():
     ms = t.tokenize("猫がいる")
     assert [(m.start, m.surface) for m in ms] == [(0, "猫"), (1, "が"),
                                                   (2, "いる")]
+
+
+def test_generated_lexicon_scale_and_conjugations():
+    """The generated lexicon (ja_lexicon) is dictionary-scale relative to
+    the r3 hand-list (~300): thousands of surfaces, with full verb
+    paradigms resolving to their dictionary base form."""
+    from deeplearning4j_tpu.nlp.lattice_tokenizer import _entries
+    from deeplearning4j_tpu.nlp.ja_lexicon import (
+        conjugate_i_adjective, conjugate_verb)
+
+    lex = _entries()
+    assert len(lex) > 2000
+
+    forms = dict(conjugate_verb("書く", "godan"))
+    assert forms == {"書く": "dict", "書き": "cont", "書いて": "te",
+                     "書いた": "ta", "書かない": "neg",
+                     "書かなかった": "neg", "書ける": "pot",
+                     "書かれる": "pass", "書こう": "vol",
+                     "書けば": "cond", "書け": "imp"}
+    # the classic euphonic exception
+    assert ("行って", "te") in conjugate_verb("行く", "godan")
+    # voiced te-form for む-row
+    assert ("飲んで", "te") in conjugate_verb("飲む", "godan")
+    assert ("食べられる", "pass") in conjugate_verb("食べる", "ichidan")
+    assert ("勉強して", "te") in conjugate_verb("勉強する", "suru")
+    assert ("高かった", "past") in conjugate_i_adjective("高い")
+
+    t = JapaneseLatticeTokenizer()
+    # every paradigm form lattice-resolves back to the dictionary form
+    for surface in ("書いて", "書かなかった", "飲んで", "食べられる"):
+        (m,) = [m for m in t.tokenize(surface)]
+        assert m.base_form in ("書く", "飲む", "食べる"), (surface, m)
+
+
+def test_irregular_adjectives_and_aru_negation():
+    """Review r4: 大きな/小さな/いい must segment as adjectives with the
+    right base form, and *あらない must not exist (ある negates to ない)."""
+    t = JapaneseLatticeTokenizer()
+    ms = t.tokenize("大きな犬がいる")
+    assert [m.surface for m in ms] == ["大きな", "犬", "が", "いる"]
+    assert ms[0].pos == "adjective" and ms[0].base_form == "大きい"
+    ms = t.tokenize("いい天気です")
+    assert [m.surface for m in ms] == ["いい", "天気", "です"]
+    assert ms[0].base_form == "良い"
+    from deeplearning4j_tpu.nlp.lattice_tokenizer import _entries
+    lex = _entries()
+    assert "あらない" not in lex and "静かい" not in lex
+    # ある + ない resolves through the AUX path
+    ms = t.tokenize("問題がない")
+    assert [m.surface for m in ms] == ["問題", "が", "ない"]

@@ -1,0 +1,150 @@
+"""fit_batches_scan: N optimization steps as ONE jitted lax.scan program
+(the dispatch-free training window; see netcommon.make_scan_fit)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+RNG = np.random.default_rng(31)
+
+
+def _conf(seed=4):
+    return (NeuralNetConfiguration.builder().seed(seed)
+            .updater("adam", learning_rate=0.01).weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+
+
+def _batches(n=5, b=8):
+    out = []
+    for _ in range(n):
+        x = RNG.normal(size=(b, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[RNG.integers(0, 3, b)]
+        out.append(DataSet(x, y))
+    return out
+
+
+def test_scan_fit_matches_loop_mln():
+    """Per-step losses and final params identical to the fit_batch loop
+    (no dropout -> the differing rng streams are inert)."""
+    batches = _batches()
+    loop_net = MultiLayerNetwork(_conf()).init()
+    loop_losses = [float(loop_net.fit_batch(d)) for d in batches]
+
+    scan_net = MultiLayerNetwork(_conf()).init()
+    losses = np.asarray(scan_net.fit_batches_scan(batches))
+    np.testing.assert_allclose(losses, loop_losses, rtol=2e-5, atol=1e-6)
+    for i in range(2):
+        for k in loop_net.params[i]:
+            np.testing.assert_allclose(
+                np.asarray(scan_net.params[i][k]),
+                np.asarray(loop_net.params[i][k]), atol=2e-5)
+    assert scan_net.iteration_count == len(batches)
+
+
+def test_scan_fit_matches_loop_graph():
+    """BN-free DAG (merge vertex + two branches): deterministic parity.
+    (A batch-4 ResNet's BN statistics chaotically amplify the legitimate
+    float-reassociation differences between the two compiled programs —
+    covered by the smoke test below instead.)"""
+    from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    def build():
+        b = (NeuralNetConfiguration.builder().seed(2)
+             .updater("sgd", learning_rate=0.05).weight_init("xavier")
+             .graph_builder().add_inputs("in"))
+        b.add_layer("a", DenseLayer(n_out=12, activation="relu"), "in")
+        b.add_layer("b", DenseLayer(n_out=8, activation="tanh"), "in")
+        b.add_vertex("m", MergeVertex(), "a", "b")
+        b.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                       loss="mcxent"), "m")
+        return ComputationGraph(
+            b.set_outputs("out")
+            .set_input_types(InputType.feed_forward(6)).build()).init()
+
+    bs = _batches(4)
+    loop = build()
+    loop_losses = [float(loop.fit_batch(d)) for d in bs]
+    scan = build()
+    losses = np.asarray(scan.fit_batches_scan(bs))
+    np.testing.assert_allclose(losses, loop_losses, rtol=2e-5, atol=1e-6)
+    for name in loop.params:
+        for k in loop.params[name]:
+            np.testing.assert_allclose(np.asarray(scan.params[name][k]),
+                                       np.asarray(loop.params[name][k]),
+                                       atol=2e-5, err_msg=f"{name}/{k}")
+
+
+def test_scan_fit_resnet_graph_smoke():
+    from deeplearning4j_tpu.models.resnet import resnet_tiny
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    bs = []
+    for _ in range(3):
+        x = RNG.normal(size=(4, 32, 32, 3)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[RNG.integers(0, 10, 4)]
+        bs.append(DataSet(x, y))
+    net = ComputationGraph(resnet_tiny(updater="sgd",
+                                       learning_rate=1e-3)).init()
+    losses = np.asarray(net.fit_batches_scan(bs))
+    assert losses.shape == (3,)
+    assert np.isfinite(losses).all()
+
+
+def test_scan_fit_masked_falls_back_to_loop():
+    net = MultiLayerNetwork(_conf()).init()
+    b = _batches(1)[0]
+    masked = DataSet(b.features, b.labels,
+                     labels_mask=np.ones((8,), np.float32))
+    losses = net.fit_batches_scan([masked, masked])
+    assert losses.shape == (2,)
+    assert np.isfinite(losses).all()
+    assert net.iteration_count == 2
+
+
+def test_scan_fit_listeners_and_score():
+    from deeplearning4j_tpu.optimize.listeners import (
+        CollectScoresIterationListener)
+    net = MultiLayerNetwork(_conf()).init()
+    col = CollectScoresIterationListener(frequency=1)
+    net.add_listener(col)
+    losses = net.fit_batches_scan(_batches(4))
+    assert len(col.scores) == 4
+    assert float(net.score_value) == pytest.approx(float(losses[-1]))
+
+
+def test_scan_fit_multidataset_graph():
+    """MultiDataSet batches must scan (or at minimum not crash on the
+    mask guard — review r4)."""
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    b = (NeuralNetConfiguration.builder().seed(2)
+         .updater("sgd", learning_rate=0.05).weight_init("xavier")
+         .graph_builder().add_inputs("x1", "x2"))
+    b.add_layer("d1", DenseLayer(n_out=8, activation="relu"), "x1")
+    b.add_layer("d2", DenseLayer(n_out=8, activation="relu"), "x2")
+    b.add_vertex("m", MergeVertex(), "d1", "d2")
+    b.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"), "m")
+    net = ComputationGraph(
+        b.set_outputs("out")
+        .set_input_types(InputType.feed_forward(4),
+                         InputType.feed_forward(5)).build()).init()
+    mds = []
+    for _ in range(3):
+        x1 = RNG.normal(size=(6, 4)).astype(np.float32)
+        x2 = RNG.normal(size=(6, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[RNG.integers(0, 3, 6)]
+        mds.append(MultiDataSet([x1, x2], [y]))
+    losses = np.asarray(net.fit_batches_scan(mds))
+    assert losses.shape == (3,)
+    assert np.isfinite(losses).all()

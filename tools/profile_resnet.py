@@ -1,0 +1,202 @@
+"""ResNet-50 MFU ablation ladder (VERDICT r3 #1b: find the other 88%).
+
+Runs a sequence of timed ablations on the real chip and prints one JSON
+line per experiment, so a hang can never erase earlier results (the
+bench.py banking lesson). Experiments:
+
+  peak        8192^3 bf16 matmul — the chip's *achievable* peak, the MFU
+              denominator sanity check
+  conv_micro  the three dominant conv shapes fwd+bwd standalone
+  fwd         ResNet-50 b64@224 inference forward
+  train       ResNet-50 b64@224 full train step (bench 'full' rung)
+  train_bnbf16   same with BatchNormalization statistics kept in bf16
+              (ablates the f32-upcast HBM traffic around every conv)
+  train_nobn  same with BN layers removed (upper bound of all BN cost)
+  train_b128 / train_b256   batch scaling (MXU occupancy)
+
+Usage (idempotent, safe to rerun):  python tools/profile_resnet.py
+Env: PROFILE_STEPS=10 PROFILE_SKIP=train_b256,... to trim.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+STEPS = int(os.environ.get("PROFILE_STEPS", "10"))
+SKIP = set(filter(None, os.environ.get("PROFILE_SKIP", "").split(",")))
+
+
+def stamp(msg):
+    print(f"[profile {time.perf_counter() - T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+T0 = time.perf_counter()
+
+
+def emit(rec):
+    print(json.dumps(rec), flush=True)
+
+
+def timed(fn, *args, steps=STEPS, warmup=2):
+    import jax
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    kind = str(getattr(devs[0], "device_kind", devs[0].platform))
+    stamp(f"backend: {len(devs)}x {kind}")
+    peak = 197e12 if "v5" in kind.lower() else None
+
+    # ---------------------------------------------------------------- peak
+    if "peak" not in SKIP:
+        n = 8192
+        a = jnp.ones((n, n), jnp.bfloat16)
+        b = jnp.ones((n, n), jnp.bfloat16)
+        f = jax.jit(lambda x, y: x @ y)
+        dt = timed(f, a, b)
+        tf = 2 * n ** 3 / dt / 1e12
+        emit({"exp": "peak", "tflops": round(tf, 1), "device": kind,
+              "frac_of_spec": round(tf / (peak / 1e12), 3) if peak else None})
+
+    # ---------------------------------------------------------- conv micro
+    if "conv_micro" not in SKIP:
+        from jax import lax
+        shapes = [
+            ("stem7x7", (64, 224, 224, 3), (7, 7, 3, 64), 2),
+            ("s2_3x3", (64, 56, 56, 64), (3, 3, 64, 64), 1),
+            ("s4_3x3", (64, 14, 14, 256), (3, 3, 256, 256), 1),
+        ]
+        for name, xs, ks, stride in shapes:
+            x = jnp.ones(xs, jnp.bfloat16)
+            k = jnp.ones(ks, jnp.bfloat16)
+
+            def conv(x, k, _s=stride):
+                return lax.conv_general_dilated(
+                    x, k, (_s, _s), "SAME",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+            def fwd_bwd(x, k, _c=conv):
+                loss, g = jax.value_and_grad(
+                    lambda kk: (_c(x, kk) ** 2).sum())(k)
+                return g
+
+            dt = timed(jax.jit(fwd_bwd), x, k)
+            out_hw = (xs[1] // stride) * (xs[2] // stride)
+            flops = 3 * 2 * xs[0] * out_hw * ks[0] * ks[1] * ks[2] * ks[3]
+            emit({"exp": f"conv_{name}", "ms": round(dt * 1e3, 3),
+                  "tflops": round(flops / dt / 1e12, 1),
+                  "mfu": round(flops / dt / peak, 3) if peak else None})
+
+    # ------------------------------------------------------------- resnet
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import (
+        DevicePrefetchIterator, ListDataSetIterator)
+    from deeplearning4j_tpu.models.resnet import resnet50
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    from deeplearning4j_tpu.nn.layers import normalization as nm
+    _orig_bn_apply = nm.BatchNormalization.apply
+
+    def _bn_apply_bf16(self, params, x, *, state, train, rng, mask=None):
+        """BN with statistics in the activation dtype (bf16): ablates the
+        f32 upcast traffic of the production impl."""
+        axes = tuple(range(x.ndim - 1))
+        if train and self.is_minibatch:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"]
+                + (1 - self.decay) * mean.astype(jnp.float32),
+                "var": self.decay * state["var"]
+                + (1 - self.decay) * var.astype(jnp.float32),
+            }
+        else:
+            mean = state["mean"].astype(x.dtype)
+            var = state["var"].astype(x.dtype)
+            new_state = state
+        inv = jax.lax.rsqrt(var + jnp.asarray(self.eps, x.dtype))
+        out = (x - mean) * inv
+        if not self.lock_gamma_beta:
+            out = params["gamma"] * out + params["beta"]
+        return out, new_state
+
+    def _bn_apply_identity(self, params, x, *, state, train, rng,
+                           mask=None):
+        return x, state
+
+    def run_train(tag, batch, bn_apply=None):
+        if tag in SKIP:
+            return
+        stamp(f"{tag}: building (batch={batch})")
+        # patch stays active through BOTH init and the fit-time trace
+        if bn_apply is not None:
+            nm.BatchNormalization.apply = bn_apply
+        try:
+            net = ComputationGraph(resnet50(dtype="bfloat16")).init()
+            jax.block_until_ready(net.params)
+            rng = np.random.default_rng(0)
+            xs = [DataSet(
+                rng.normal(size=(batch, 224, 224, 3)).astype(np.float32),
+                np.eye(1000, dtype=np.float32)[
+                    rng.integers(0, 1000, batch)]) for _ in range(3)]
+            staged = list(DevicePrefetchIterator(ListDataSetIterator(xs),
+                                                 dtype="bfloat16"))
+            jax.block_until_ready([d.features for d in staged])
+            for i in range(2):
+                net.fit_batch(staged[i % 3])
+            jax.block_until_ready(net.params)
+            t0 = time.perf_counter()
+            for i in range(STEPS):
+                net.fit_batch(staged[i % 3])
+            jax.block_until_ready(net.params)
+        finally:
+            nm.BatchNormalization.apply = _orig_bn_apply
+        dt = (time.perf_counter() - t0) / STEPS
+        sps = batch / dt
+        mfu = 3 * 4.09e9 * sps / peak if peak else None
+        emit({"exp": tag, "batch": batch, "step_ms": round(dt * 1e3, 2),
+              "samples_per_sec": round(sps, 1),
+              "mfu": round(mfu, 3) if mfu else None})
+
+    if "fwd" not in SKIP:
+        net = ComputationGraph(resnet50(dtype="bfloat16")).init()
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(64, 224, 224, 3)).astype(np.float32)).astype(jnp.bfloat16)
+        jax.block_until_ready(net.params)
+        dt = timed(lambda xx: net.output({"in": xx}), x)
+        sps = 64 / dt
+        emit({"exp": "fwd", "step_ms": round(dt * 1e3, 2),
+              "samples_per_sec": round(sps, 1),
+              "mfu_fwd": round(4.09e9 * sps / peak, 3) if peak else None})
+
+    run_train("train", 64)
+    run_train("train_bnbf16", 64, bn_apply=_bn_apply_bf16)
+    run_train("train_nobn", 64, bn_apply=_bn_apply_identity)
+    run_train("train_b128", 128)
+    run_train("train_b256", 256)
+    stamp("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
