@@ -163,10 +163,17 @@ class PipelineTrainer:
     single-device code (``compute_updates``), so a pipeline step is
     loss-parity-identical to ``net.fit_batch`` up to float reassociation.
 
-    v1 scope: stateless feed-forward/conv bodies — layers carrying
-    running state (BatchNormalization) or RNN carries, and active
-    dropout, are rejected at construction (their state/rng threading
-    through the ring schedule is future work).
+    Layer running state (BatchNormalization's mean/var) threads through
+    the ring schedule: each device carries its stage's flattened state in
+    the tick scan, updating it only on REAL ticks (stage s works on
+    genuine microbatches at ticks s <= t < s+M; fill/drain ticks process
+    ring garbage and must not touch statistics). Note the standard GPipe
+    semantics: BN statistics are per-MICROBATCH (and per-dp-replica, with
+    running averages pmean-synced over 'dp' after the window), so they
+    match the single-device step exactly only when n_microbatches == 1.
+
+    Out of scope: RNN carries and active dropout are rejected at
+    construction (carry/rng threading through the ring is future work).
     """
 
     def __init__(self, net, mesh: Optional[Mesh] = None, axis: str = "pp",
@@ -198,11 +205,16 @@ class PipelineTrainer:
         if not hasattr(head, "compute_loss"):
             raise ValueError("Last layer must be an output/loss layer")
         for i, l in enumerate(body):
-            if net.states[i]:
+            if "aux_loss" in net.states[i]:
+                # MixtureOfExperts-style layers report a differentiable
+                # auxiliary loss through their state; the pipeline's
+                # state buffer is a no-grad aux output, so the balancing
+                # term would silently vanish from the objective
                 raise ValueError(
-                    f"layer {i} ({type(l).__name__}) carries running state "
-                    "(e.g. BatchNormalization) — unsupported in the "
-                    "pipeline trainer v1")
+                    f"layer {i} ({type(l).__name__}) carries an auxiliary "
+                    "loss in its state — unsupported in the pipeline "
+                    "trainer (its gradient cannot thread through the "
+                    "ring's no-grad state buffer)")
             if getattr(l, "supports_carry", False):
                 raise ValueError(f"layer {i} ({type(l).__name__}) is "
                                  "recurrent — unsupported in the pipeline "
@@ -244,42 +256,55 @@ class PipelineTrainer:
 
     # ------------------------------------------------------------ stage fns
     def _make_branch(self, stage: List[int], in_shape, amax: int,
-                     seg_shapes):
-        """One lax.switch branch: unpack this stage's flat param segment
-        and activation buffer, run its layers exactly as MLN._forward
-        does (minus state/carry/dropout, rejected at init), repack.
-        The batch dim reshapes with -1: under dp×pp the local batch is
-        the global microbatch divided by the dp axis size."""
+                     seg_shapes, state_shapes, smax: int):
+        """One lax.switch branch: unpack this stage's flat param segment,
+        flat state segment, and activation buffer, run its layers exactly
+        as MLN._forward does (minus carry/dropout, rejected at init),
+        repack both. The batch dim reshapes with -1: under dp×pp the
+        local batch is the global microbatch divided by the dp size."""
         net = self.net
         conf = net.conf
         in_size = int(np.prod(in_shape[1:]))
         if not stage:
-            return lambda pflat, xbuf: xbuf  # identity (pass-through) stage
+            # identity (pass-through) stage
+            return lambda pflat, sflat, xbuf: (xbuf, sflat)
 
-        def branch(pflat, xbuf):
-            # unflatten this stage's params from the padded segment
-            p = {}
-            off = 0
+        def branch(pflat, sflat, xbuf):
+            # unflatten this stage's params/states from padded segments
+            p, s = {}, {}
+            off = soff = 0
             for i in stage:
-                layer_p = {}
+                layer_p, layer_s = {}, {}
                 for name in net.layers[i].param_order():
                     shp, dt = seg_shapes[i][name]
                     n = int(np.prod(shp))
                     layer_p[name] = pflat[off:off + n].reshape(shp).astype(dt)
                     off += n
-                p[i] = layer_p
+                for name, (shp, dt) in state_shapes[i].items():
+                    n = int(np.prod(shp))
+                    layer_s[name] = (sflat[soff:soff + n]
+                                     .reshape(shp).astype(dt))
+                    soff += n
+                p[i], s[i] = layer_p, layer_s
             h = xbuf[:, :in_size].reshape((-1,) + in_shape[1:])
             in_types = conf.input_types
+            new_s = {}
             for i in stage:
                 layer = net.layers[i]
                 if i in conf.preprocessors:
                     it = in_types[i] if in_types else None
                     h = conf.preprocessors[i].transform(h, it)
-                h, _ = layer.apply(p[i], h, state={},
-                                   train=not layer.frozen, rng=None,
-                                   mask=None)
+                h, s_out = layer.apply(p[i], h, state=s[i],
+                                       train=not layer.frozen, rng=None,
+                                       mask=None)
+                new_s[i] = s[i] if layer.frozen else s_out
             y = h.reshape(h.shape[0], -1)
-            return jnp.pad(y, ((0, 0), (0, amax - y.shape[1])))
+            leaves = [new_s[i][name].reshape(-1).astype(jnp.float32)
+                      for i in stage for name in state_shapes[i]]
+            sflat_new = (jnp.pad(jnp.concatenate(leaves),
+                                 (0, smax - sum(l.shape[0] for l in leaves)))
+                         if leaves else sflat)
+            return jnp.pad(y, ((0, 0), (0, amax - y.shape[1]))), sflat_new
 
         return branch
 
@@ -299,8 +324,17 @@ class PipelineTrainer:
                          for i in st for k in seg_shapes[i])
                      for st in self.stages]
         pmax = max(seg_sizes)
+        # per-layer running-state segment metadata (BN mean/var)
+        state_shapes = {i: {k: (v.shape, v.dtype)
+                            for k, v in net.states[i].items()}
+                        for st in self.stages for i in st}
+        ssizes = [sum(int(np.prod(state_shapes[i][k][0]))
+                      for i in st for k in state_shapes[i])
+                  for st in self.stages]
+        smax = max([1] + ssizes)
         self._amax = amax
-        branches = [self._make_branch(st, stage_in[s], amax, seg_shapes)
+        branches = [self._make_branch(st, stage_in[s], amax, seg_shapes,
+                                      state_shapes, smax)
                     for s, st in enumerate(self.stages)]
 
         def pack_bufs(params):
@@ -313,16 +347,45 @@ class PipelineTrainer:
                 rows.append(jnp.pad(row, (0, pmax - row.shape[0])))
             return jnp.stack(rows)
 
-        def device_fn(bufs, xs):
+        def pack_states(states):
+            rows = []
+            for st in self.stages:
+                leaves = [states[i][k].reshape(-1).astype(jnp.float32)
+                          for i in st for k in state_shapes[i]]
+                row = jnp.concatenate(leaves) if leaves else jnp.zeros((0,))
+                rows.append(jnp.pad(row, (0, smax - row.shape[0])))
+            return jnp.stack(rows)
+
+        def unpack_states(sbuf):
+            out = list(net.states)
+            for s, st in enumerate(self.stages):
+                soff = 0
+                for i in st:
+                    layer_s = {}
+                    for name, (shp, dt) in state_shapes[i].items():
+                        n = int(np.prod(shp))
+                        layer_s[name] = (sbuf[s, soff:soff + n]
+                                         .reshape(shp).astype(dt))
+                        soff += n
+                    out[i] = layer_s
+            return out
+
+        def device_fn(bufs, sbufs, xs):
             pflat = bufs[0]
             sid = jax.lax.axis_index(axis)
             perm = [(j, (j + 1) % S) for j in range(S)]
 
             def tick(carry, t):
-                held, outbuf = carry
+                held, outbuf, sflat = carry
                 inject = jnp.where(t < M, t, 0)
                 x_in = jnp.where(sid == 0, xs[inject], held)
-                y = jax.lax.switch(sid, branches, pflat, x_in)
+                y, sflat2 = jax.lax.switch(sid, branches, pflat, sflat,
+                                           x_in)
+                # stage `sid` works on genuine microbatches only during
+                # ticks sid <= t < sid+M; fill/drain ticks see ring
+                # garbage and must not move the running statistics
+                real = jnp.logical_and(t >= sid, t < sid + M)
+                sflat = jnp.where(real, sflat2, sflat)
                 done_idx = t - (S - 1)
                 store = jnp.logical_and(sid == S - 1, done_idx >= 0)
                 idx = jnp.maximum(done_idx, 0)
@@ -330,19 +393,32 @@ class PipelineTrainer:
                                                    keepdims=False)
                 outbuf = jax.lax.dynamic_update_index_in_dim(
                     outbuf, jnp.where(store, y, cur), idx, 0)
-                return (jax.lax.ppermute(y, axis, perm), outbuf), None
+                return (jax.lax.ppermute(y, axis, perm), outbuf,
+                        sflat), None
 
             held0 = _pvary(xs[0] * 0.0, axis)
             outbuf0 = _pvary(xs * 0.0, axis)
-            (_, outbuf), _ = jax.lax.scan(tick, (held0, outbuf0),
-                                          jnp.arange(M + S - 1))
-            return jax.lax.psum(outbuf, axis)
+            # the state carry must enter the switch varying over EVERY
+            # mesh axis: stateful branches derive their output from the
+            # (dp-varying) batch shard while stateless ones return the
+            # carry itself — mismatched varying sets are a type error
+            sflat0 = sbufs[0]
+            if self.dp_axis is not None:
+                sflat0 = _pvary(sflat0, self.dp_axis)
+            (_, outbuf, sflat), _ = jax.lax.scan(
+                tick, (held0, outbuf0, sflat0), jnp.arange(M + S - 1))
+            if self.dp_axis is not None:
+                # dp replicas saw different microbatch shards: sync the
+                # running averages (the normalization itself stays
+                # per-replica, standard unsynced-BN semantics)
+                sflat = jax.lax.pmean(sflat, self.dp_axis)
+            return jax.lax.psum(outbuf, axis), sflat[None]
 
         dp = self.dp_axis
         batch_spec = P(None, dp, None)
         pipe = shard_map(device_fn, mesh=mesh,
-                         in_specs=(P(axis), batch_spec),
-                         out_specs=batch_spec)
+                        in_specs=(P(axis), P(axis), batch_spec),
+                        out_specs=(batch_spec, P(axis)))
 
         tx = net._tx
         training = net.conf.training
@@ -352,8 +428,8 @@ class PipelineTrainer:
         head_pre_type = (net.conf.input_types[head_idx]
                          if net.conf.input_types else None)
 
-        def loss_of(params, xs, labels):
-            outs = pipe(pack_bufs(params), xs)           # [M, B_mb, amax]
+        def loss_of(params, sbuf, xs, labels):
+            outs, new_sbuf = pipe(pack_bufs(params), sbuf, xs)
             h = outs[..., :head_in_size].reshape(
                 (M * b_mb,) + head_in_shape[1:])
             if head_pre is not None:
@@ -362,15 +438,17 @@ class PipelineTrainer:
                 h = head_pre.transform(h, head_pre_type)
             data_loss = head.compute_loss(params[head_idx], h, labels,
                                           mask=None)
-            return data_loss + l1_l2_penalty(params, net.layers)
+            return data_loss + l1_l2_penalty(params, net.layers), new_sbuf
 
-        def step(params, opt_state, xs, labels):
-            loss, grads = jax.value_and_grad(loss_of)(params, xs, labels)
+        def step(params, opt_state, states, xs, labels):
+            sbuf = pack_states(states)
+            (loss, new_sbuf), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params, sbuf, xs, labels)
             new_params, new_opt = compute_updates(
                 tx, grads, opt_state, params, net.layers, training)
-            return new_params, new_opt, loss
+            return new_params, new_opt, unpack_states(new_sbuf), loss
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return jax.jit(step, donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------------------- fit
     def fit_batch(self, batch: DataSet) -> float:
@@ -400,8 +478,8 @@ class PipelineTrainer:
             self._b_mb = b_mb
         x = feats.reshape(self.M, b_mb, -1)
         xs = jnp.pad(x, ((0, 0), (0, 0), (0, self._amax - x.shape[-1])))
-        net.params, net.opt_state, loss = self._step(
-            net.params, net.opt_state, xs, labels)
+        net.params, net.opt_state, net.states, loss = self._step(
+            net.params, net.opt_state, net.states, xs, labels)
         net.last_batch_size = B
         net.score_value = loss
         net.iteration_count += 1
