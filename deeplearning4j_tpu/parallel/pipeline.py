@@ -26,7 +26,7 @@ TrainingMaster.java:29 — the strategy seam this plugs into).
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -100,6 +100,145 @@ def stack_stage_params(param_list):
     return jax.tree.map(lambda *xs: jnp.stack(xs), *param_list)
 
 
+def _make_ring(mesh: Mesh, axis: str, dp_axis: Optional[str], S: int,
+               M: int, branches):
+    """The GPipe ring schedule as a shard_map callable shared by the MLN
+    and graph pipeline trainers:
+    pipe(param_bufs [S, Pmax], state_bufs [S, Smax], xs [M, B_mb, Amax])
+    -> (outputs [M, B_mb, Amax], new_state_bufs [S, Smax]).
+
+    Each branch is branch(pflat, sflat, xbuf) -> (ybuf, sflat_new).
+    State updates apply only on REAL ticks (stage s works on genuine
+    microbatches at ticks s <= t < s+M; fill/drain ticks process ring
+    garbage). Running-state rows pmean-sync over ``dp_axis`` after the
+    window."""
+
+    def device_fn(bufs, sbufs, xs):
+        pflat = bufs[0]
+        sid = jax.lax.axis_index(axis)
+        perm = [(j, (j + 1) % S) for j in range(S)]
+
+        def tick(carry, t):
+            held, outbuf, sflat = carry
+            inject = jnp.where(t < M, t, 0)
+            x_in = jnp.where(sid == 0, xs[inject], held)
+            y, sflat2 = jax.lax.switch(sid, branches, pflat, sflat, x_in)
+            real = jnp.logical_and(t >= sid, t < sid + M)
+            sflat = jnp.where(real, sflat2, sflat)
+            done_idx = t - (S - 1)
+            store = jnp.logical_and(sid == S - 1, done_idx >= 0)
+            idx = jnp.maximum(done_idx, 0)
+            cur = jax.lax.dynamic_index_in_dim(outbuf, idx, 0,
+                                               keepdims=False)
+            outbuf = jax.lax.dynamic_update_index_in_dim(
+                outbuf, jnp.where(store, y, cur), idx, 0)
+            return (jax.lax.ppermute(y, axis, perm), outbuf, sflat), None
+
+        held0 = _pvary(xs[0] * 0.0, axis)
+        outbuf0 = _pvary(xs * 0.0, axis)
+        # the state carry must enter the switch varying over EVERY mesh
+        # axis: stateful branches derive their output from the
+        # (dp-varying) batch shard while stateless ones return the carry
+        # itself — mismatched varying sets are a type error
+        sflat0 = sbufs[0]
+        if dp_axis is not None:
+            sflat0 = _pvary(sflat0, dp_axis)
+        (_, outbuf, sflat), _ = jax.lax.scan(
+            tick, (held0, outbuf0, sflat0), jnp.arange(M + S - 1))
+        if dp_axis is not None:
+            # dp replicas saw different microbatch shards: sync the
+            # running averages (normalization itself stays per-replica,
+            # standard unsynced-BN semantics)
+            sflat = jax.lax.pmean(sflat, dp_axis)
+        return jax.lax.psum(outbuf, axis), sflat[None]
+
+    batch_spec = P(None, dp_axis, None)
+    return shard_map(device_fn, mesh=mesh,
+                     in_specs=(P(axis), P(axis), batch_spec),
+                     out_specs=(batch_spec, P(axis)))
+
+
+class _RingFitMixin:
+    """fit_batch/fit shared by the MLN and graph pipeline trainers (the
+    jitted step signature and all bookkeeping are identical; only stage
+    construction differs). Subclasses provide ``_build_step(b_mb)``
+    setting ``self._amax``, and the attrs net/M/mesh/dp_axis."""
+
+    def fit_batch(self, batch: DataSet) -> float:
+        net = self.net
+        if not isinstance(batch, DataSet):
+            # MultiDataSet's features is a LIST — jnp.asarray would stack
+            # it into (n_inputs, B, ...) and fail bafflingly downstream
+            raise ValueError(
+                "pipeline trainers take a single-input DataSet; got "
+                f"{type(batch).__name__}")
+        if (batch.features_mask is not None
+                or batch.labels_mask is not None):
+            # loud, like the other unsupported features — a silently
+            # dropped mask would train a whole run subtly wrong
+            raise ValueError("masked DataSets are unsupported in the "
+                             "pipeline trainers (mask threading through "
+                             "the ring schedule is future work)")
+        feats = jnp.asarray(batch.features)
+        labels = jnp.asarray(batch.labels)
+        B = feats.shape[0]
+        if B % self.M != 0:
+            raise ValueError(f"batch size {B} not divisible by "
+                             f"n_microbatches={self.M}")
+        b_mb = B // self.M
+        if self.dp_axis is not None:
+            dp = self.mesh.shape[self.dp_axis]
+            if b_mb % dp != 0:
+                raise ValueError(
+                    f"microbatch size {b_mb} (batch {B} / {self.M} "
+                    f"microbatches) not divisible by the dp axis ({dp})")
+        if self._step is None or getattr(self, "_b_mb", None) != b_mb:
+            self._step = self._build_step(b_mb)
+            self._b_mb = b_mb
+        x = feats.reshape(self.M, b_mb, -1)
+        xs = jnp.pad(x, ((0, 0), (0, 0), (0, self._amax - x.shape[-1])))
+        net.params, net.opt_state, net.states, loss = self._step(
+            net.params, net.opt_state, net.states, xs, labels)
+        net.last_batch_size = B
+        net.score_value = loss
+        net.iteration_count += 1
+        for listener in net.listeners:
+            listener.iteration_done(net, net.iteration_count,
+                                    net.score_value)
+        return net._score_raw
+
+    def fit(self, data, epochs: int = 1):
+        from deeplearning4j_tpu.optimize.listeners import TrainingListener
+        net = self.net
+        if isinstance(data, DataSet):
+            for _ in range(epochs):
+                self.fit_batch(data)
+            return self
+        for _ in range(epochs):
+            for listener in net.listeners:
+                if isinstance(listener, TrainingListener):
+                    listener.on_epoch_start(net)
+            for batch in data:
+                self.fit_batch(batch)
+            net.epoch_count += 1
+            for listener in net.listeners:
+                if isinstance(listener, TrainingListener):
+                    listener.on_epoch_end(net)
+        return self
+
+
+def _reject_remat(conf):
+    """The pipeline branches run layer.apply without jax.checkpoint: a
+    remat'd config would silently lose its gradient checkpointing (and
+    its memory headroom) — fail loudly like the other unsupported
+    features."""
+    if getattr(conf.training, "remat", False):
+        raise ValueError(
+            "gradient_checkpointing (remat) is unsupported in the "
+            "pipeline trainers — stage branches store activations for "
+            "backward; disable remat or train without the pipeline")
+
+
 # ---------------------------------------------------------------------------
 # heterogeneous pipeline over a real MultiLayerNetwork
 # ---------------------------------------------------------------------------
@@ -152,7 +291,7 @@ def _type_shape(t, batch: int):
     raise ValueError(f"Unsupported InputType kind {t.kind!r}")
 
 
-class PipelineTrainer:
+class PipelineTrainer(_RingFitMixin):
     """GPipe pipeline-parallel trainer for a ``MultiLayerNetwork``.
 
     The net's body layers (all but the loss head) are partitioned into S
@@ -188,6 +327,7 @@ class PipelineTrainer:
         if axis not in mesh.axis_names:
             raise ValueError(f"mesh has no {axis!r} axis: {mesh.axis_names}")
         net._check_init()
+        _reject_remat(net.conf)
         if not hasattr(net, "layers"):
             raise ValueError("PipelineTrainer supports MultiLayerNetwork "
                              "(graph stage partitioning is future work)")
@@ -370,55 +510,7 @@ class PipelineTrainer:
                     out[i] = layer_s
             return out
 
-        def device_fn(bufs, sbufs, xs):
-            pflat = bufs[0]
-            sid = jax.lax.axis_index(axis)
-            perm = [(j, (j + 1) % S) for j in range(S)]
-
-            def tick(carry, t):
-                held, outbuf, sflat = carry
-                inject = jnp.where(t < M, t, 0)
-                x_in = jnp.where(sid == 0, xs[inject], held)
-                y, sflat2 = jax.lax.switch(sid, branches, pflat, sflat,
-                                           x_in)
-                # stage `sid` works on genuine microbatches only during
-                # ticks sid <= t < sid+M; fill/drain ticks see ring
-                # garbage and must not move the running statistics
-                real = jnp.logical_and(t >= sid, t < sid + M)
-                sflat = jnp.where(real, sflat2, sflat)
-                done_idx = t - (S - 1)
-                store = jnp.logical_and(sid == S - 1, done_idx >= 0)
-                idx = jnp.maximum(done_idx, 0)
-                cur = jax.lax.dynamic_index_in_dim(outbuf, idx, 0,
-                                                   keepdims=False)
-                outbuf = jax.lax.dynamic_update_index_in_dim(
-                    outbuf, jnp.where(store, y, cur), idx, 0)
-                return (jax.lax.ppermute(y, axis, perm), outbuf,
-                        sflat), None
-
-            held0 = _pvary(xs[0] * 0.0, axis)
-            outbuf0 = _pvary(xs * 0.0, axis)
-            # the state carry must enter the switch varying over EVERY
-            # mesh axis: stateful branches derive their output from the
-            # (dp-varying) batch shard while stateless ones return the
-            # carry itself — mismatched varying sets are a type error
-            sflat0 = sbufs[0]
-            if self.dp_axis is not None:
-                sflat0 = _pvary(sflat0, self.dp_axis)
-            (_, outbuf, sflat), _ = jax.lax.scan(
-                tick, (held0, outbuf0, sflat0), jnp.arange(M + S - 1))
-            if self.dp_axis is not None:
-                # dp replicas saw different microbatch shards: sync the
-                # running averages (the normalization itself stays
-                # per-replica, standard unsynced-BN semantics)
-                sflat = jax.lax.pmean(sflat, self.dp_axis)
-            return jax.lax.psum(outbuf, axis), sflat[None]
-
-        dp = self.dp_axis
-        batch_spec = P(None, dp, None)
-        pipe = shard_map(device_fn, mesh=mesh,
-                        in_specs=(P(axis), P(axis), batch_spec),
-                        out_specs=(batch_spec, P(axis)))
+        pipe = _make_ring(mesh, axis, self.dp_axis, S, M, branches)
 
         tx = net._tx
         training = net.conf.training
@@ -450,51 +542,321 @@ class PipelineTrainer:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
-    # ------------------------------------------------------------------- fit
-    def fit_batch(self, batch: DataSet) -> float:
-        net = self.net
-        if (batch.features_mask is not None
-                or batch.labels_mask is not None):
-            # loud, like the other unsupported v1 features — a silently
-            # dropped mask would train a whole run subtly wrong
-            raise ValueError("masked DataSets are unsupported in the "
-                             "pipeline trainer v1 (mask threading through "
-                             "the ring schedule is future work)")
-        feats = jnp.asarray(batch.features)
-        labels = jnp.asarray(batch.labels)
-        B = feats.shape[0]
-        if B % self.M != 0:
-            raise ValueError(f"batch size {B} not divisible by "
-                             f"n_microbatches={self.M}")
-        b_mb = B // self.M
-        if self.dp_axis is not None:
-            dp = self.mesh.shape[self.dp_axis]
-            if b_mb % dp != 0:
-                raise ValueError(
-                    f"microbatch size {b_mb} (batch {B} / {self.M} "
-                    f"microbatches) not divisible by the dp axis ({dp})")
-        if self._step is None or getattr(self, "_b_mb", None) != b_mb:
-            self._step = self._build_step(b_mb)
-            self._b_mb = b_mb
-        x = feats.reshape(self.M, b_mb, -1)
-        xs = jnp.pad(x, ((0, 0), (0, 0), (0, self._amax - x.shape[-1])))
-        net.params, net.opt_state, net.states, loss = self._step(
-            net.params, net.opt_state, net.states, xs, labels)
-        net.last_batch_size = B
-        net.score_value = loss
-        net.iteration_count += 1
-        for listener in net.listeners:
-            listener.iteration_done(net, net.iteration_count,
-                                    net.score_value)
-        return net._score_raw
 
-    def fit(self, data, epochs: int = 1) -> "PipelineTrainer":
-        if isinstance(data, DataSet):
-            for _ in range(epochs):
-                self.fit_batch(data)
-            return self
-        for _ in range(epochs):
-            for batch in data:
-                self.fit_batch(batch)
-            self.net.epoch_count += 1
-        return self
+# ---------------------------------------------------------------------------
+# pipeline over a ComputationGraph (DAG stage partitioning)
+# ---------------------------------------------------------------------------
+
+def find_graph_cut_points(conf) -> List[Tuple[int, str]]:
+    """Valid stage boundaries of a DAG: positions ``p`` in the topological
+    order where exactly ONE node's activation crosses from the prefix
+    ``topo[:p]`` to the suffix — the single tensor the ring can carry.
+    Returns [(p, crossing_node_name)]. ResNet-style block chains cut at
+    every block output; a skip connection spanning a candidate boundary
+    disqualifies it (two tensors would cross)."""
+    topo = list(conf.topological_order)
+    consumers = {n: [] for n in topo}
+    for n in topo:
+        for i in conf.nodes[n].inputs:
+            consumers[i].append(n)
+    out_set = set(conf.network_outputs)
+    cuts = []
+    prefix = set()
+    crossing = set()
+    for p, n in enumerate(topo):
+        prefix.add(n)
+        crossing.add(n)
+        crossing = {m for m in crossing
+                    if m in out_set
+                    or any(c not in prefix for c in consumers[m])}
+        if len(crossing) == 1:
+            cuts.append((p + 1, next(iter(crossing))))
+    return cuts
+
+
+class GraphPipelineTrainer(_RingFitMixin):
+    """GPipe pipeline-parallel trainer for a ``ComputationGraph`` — the
+    DAG analog of PipelineTrainer (ResNet-50, the flagship BASELINE
+    model, is a graph here). The topological order is split at single-
+    tensor cut points (find_graph_cut_points) into S contiguous stages
+    balanced by parameter count; skip connections live entirely inside
+    stages, so the ring still carries one activation buffer. Running
+    state (BN) threads exactly as in PipelineTrainer; the output node's
+    loss head and compute_updates reuse the graph's single-device code.
+
+    v1 scope: one network input, one output (loss head), no masks, no
+    RNN/carry vertices (LastTimeStep / DuplicateToTimeSeries), no active
+    dropout, no aux-loss layers.
+    """
+
+    def __init__(self, net, mesh: Optional[Mesh] = None, axis: str = "pp",
+                 n_microbatches: Optional[int] = None):
+        from deeplearning4j_tpu.nn.conf.graph import (
+            DuplicateToTimeSeriesVertex, LastTimeStepVertex)
+        from deeplearning4j_tpu.parallel.mesh import MeshContext
+        if isinstance(mesh, MeshContext):
+            mesh = mesh.mesh
+        if mesh is None:
+            devs = np.array(jax.devices())
+            mesh = Mesh(devs.reshape(len(devs)), (axis,))
+        if axis not in mesh.axis_names:
+            raise ValueError(f"mesh has no {axis!r} axis: {mesh.axis_names}")
+        net._check_init()
+        _reject_remat(net.conf)
+        conf = net.conf
+        if len(conf.network_inputs) != 1 or len(conf.network_outputs) != 1:
+            raise ValueError("GraphPipelineTrainer v1 supports exactly one "
+                             "network input and one output")
+        if not conf.resolved_types:
+            raise ValueError("GraphPipelineTrainer needs set_input_types() "
+                             "on the config (static boundary shapes)")
+        self.net = net
+        self.mesh = mesh
+        self.axis = axis
+        self.dp_axis = "dp" if "dp" in mesh.axis_names else None
+        self.S = mesh.shape[axis]
+        self.M = int(n_microbatches or self.S)
+        self.in_name = conf.network_inputs[0]
+        self.out_name = conf.network_outputs[0]
+        out_node = conf.nodes[self.out_name]
+        if out_node.kind != "layer" \
+                or not hasattr(out_node.layer, "compute_loss"):
+            raise ValueError("the output node must be a loss head")
+        for name in conf.topological_order:
+            node = conf.nodes[name]
+            if node.kind == "vertex" and isinstance(
+                    node.vertex, (LastTimeStepVertex,
+                                  DuplicateToTimeSeriesVertex)):
+                raise ValueError(f"vertex {name!r} "
+                                 f"({type(node.vertex).__name__}) is "
+                                 "unsupported in the graph pipeline v1")
+            if node.kind != "layer":
+                continue
+            l = node.layer
+            if "aux_loss" in net.states.get(name, {}):
+                raise ValueError(f"layer node {name!r} carries an "
+                                 "auxiliary loss — unsupported (see "
+                                 "PipelineTrainer)")
+            if getattr(l, "supports_carry", False):
+                raise ValueError(f"layer node {name!r} is recurrent — "
+                                 "unsupported in the graph pipeline v1")
+            d = l.dropout
+            if d is not None and 0.0 < d < 1.0:
+                raise ValueError(f"layer node {name!r} has active "
+                                 "dropout — unsupported")
+        self.stages, self.boundaries = self._partition()
+        self._step = None
+
+    # ------------------------------------------------------------ partition
+    def _partition(self):
+        """Split topo[input+1 : out) into S node groups at balanced cut
+        points. Returns (stages: list of node-name lists, boundaries:
+        crossing-node name entering each stage)."""
+        conf = self.net.conf
+        topo = list(conf.topological_order)
+        out_pos = topo.index(self.out_name)
+        cuts = [(p, n) for p, n in find_graph_cut_points(conf)
+                if 0 < p < out_pos]
+        body = [n for n in topo[:out_pos]
+                if conf.nodes[n].kind != "input"]
+        if not body:
+            raise ValueError("no body nodes to pipeline")
+
+        def cost(name):
+            node = conf.nodes[name]
+            if node.kind != "layer":
+                return 1
+            return 1 + sum(int(np.prod(v.shape))
+                           for v in self.net.params[name].values())
+
+        total = sum(cost(n) for n in body)
+        # walk topo, close a stage at the first available cut once the
+        # stage has its fair share of the remaining cost
+        stages, bounds = [], [self.in_name]
+        cur, acc, remaining = [], 0, total
+        cuts_iter = {p: n for p, n in cuts}
+        for p, name in enumerate(topo[:out_pos]):
+            if conf.nodes[name].kind == "input":
+                continue
+            cur.append(name)
+            acc += cost(name)
+            stages_left = self.S - len(stages)
+            if (len(stages) < self.S - 1 and (p + 1) in cuts_iter
+                    and acc >= remaining / stages_left):
+                stages.append(cur)
+                bounds.append(cuts_iter[p + 1])
+                remaining -= acc
+                cur, acc = [], 0
+        stages.append(cur)
+        # fewer cut points than stages: trailing identity stages
+        while len(stages) < self.S:
+            stages.append([])
+            bounds.append(bounds[-1])
+        return stages, bounds
+
+    # ---------------------------------------------------------------- shapes
+    def _boundary_shapes(self, b_mb: int):
+        """Activation shape entering each stage + the head input."""
+        rt = self.net.conf.resolved_types
+        stage_in = [_type_shape(rt[b], b_mb) for b in self.boundaries]
+        # the head consumes the final crossing node's activation
+        final = self.net.conf.nodes[self.out_name].inputs[0]
+        return stage_in, _type_shape(rt[final], b_mb)
+
+    # ------------------------------------------------------------ stage fns
+    def _make_branch(self, stage: List[str], b_in: str, amax: int,
+                     seg_shapes, state_shapes, smax: int):
+        net = self.net
+        conf = net.conf
+        in_shape_t = conf.resolved_types[b_in]
+
+        if not stage:
+            return lambda pflat, sflat, xbuf: (xbuf, sflat)
+
+        def branch(pflat, sflat, xbuf):
+            p, s = {}, {}
+            off = soff = 0
+            for name in stage:
+                if conf.nodes[name].kind != "layer":
+                    continue
+                layer_p, layer_s = {}, {}
+                for pname in conf.nodes[name].layer.param_order():
+                    shp, dt = seg_shapes[name][pname]
+                    n = int(np.prod(shp))
+                    layer_p[pname] = (pflat[off:off + n]
+                                      .reshape(shp).astype(dt))
+                    off += n
+                for sname, (shp, dt) in state_shapes[name].items():
+                    n = int(np.prod(shp))
+                    layer_s[sname] = (sflat[soff:soff + n]
+                                      .reshape(shp).astype(dt))
+                    soff += n
+                p[name], s[name] = layer_p, layer_s
+            in_size = int(np.prod(_type_shape(in_shape_t, 1)[1:]))
+            acts = {b_in: xbuf[:, :in_size].reshape(
+                (-1,) + _type_shape(in_shape_t, 1)[1:])}
+            new_s = {}
+            last = b_in
+            for name in stage:
+                node = conf.nodes[name]
+                in_acts = [acts[i] for i in node.inputs]
+                if node.kind == "vertex":
+                    acts[name] = node.vertex.apply(in_acts)
+                else:
+                    h = in_acts[0]
+                    if node.preprocessor is not None:
+                        h = node.preprocessor.transform(h, None)
+                    layer = node.layer
+                    h, s_out = layer.apply(p[name], h, state=s[name],
+                                           train=not layer.frozen,
+                                           rng=None, mask=None)
+                    new_s[name] = s[name] if layer.frozen else s_out
+                    acts[name] = h
+                last = name
+            y = acts[last].reshape(acts[last].shape[0], -1)
+            leaves = [new_s[nm][k].reshape(-1).astype(jnp.float32)
+                      for nm in stage if nm in new_s
+                      for k in state_shapes[nm]]
+            sflat_new = (jnp.pad(
+                jnp.concatenate(leaves),
+                (0, smax - sum(l.shape[0] for l in leaves)))
+                if leaves else sflat)
+            return jnp.pad(y, ((0, 0), (0, amax - y.shape[1]))), sflat_new
+
+        return branch
+
+    # ------------------------------------------------------------- the step
+    def _build_step(self, b_mb: int):
+        net = self.net
+        conf = net.conf
+        S, M, axis = self.S, self.M, self.axis
+        stage_in, head_in_shape = self._boundary_shapes(b_mb)
+        head_in_size = int(np.prod(head_in_shape[1:]))
+        amax = max([int(np.prod(s[1:])) for s in stage_in]
+                   + [head_in_size])
+        layer_stage_nodes = [[n for n in st
+                              if conf.nodes[n].kind == "layer"]
+                             for st in self.stages]
+        seg_shapes = {n: {k: (v.shape, v.dtype)
+                          for k, v in net.params[n].items()}
+                      for st in layer_stage_nodes for n in st}
+        state_shapes = {n: {k: (v.shape, v.dtype)
+                            for k, v in net.states[n].items()}
+                        for st in layer_stage_nodes for n in st}
+        pmax = max(1, max(sum(int(np.prod(seg_shapes[n][k][0]))
+                              for n in st for k in seg_shapes[n])
+                          for st in layer_stage_nodes))
+        smax = max([1] + [sum(int(np.prod(state_shapes[n][k][0]))
+                             for n in st for k in state_shapes[n])
+                          for st in layer_stage_nodes])
+        self._amax = amax
+        branches = [self._make_branch(st, self.boundaries[s], amax,
+                                      seg_shapes, state_shapes, smax)
+                    for s, st in enumerate(self.stages)]
+
+        def pack_bufs(params):
+            rows = []
+            for st in layer_stage_nodes:
+                leaves = [params[n][k].reshape(-1).astype(jnp.float32)
+                          for n in st
+                          for k in conf.nodes[n].layer.param_order()]
+                row = jnp.concatenate(leaves) if leaves else jnp.zeros((0,))
+                rows.append(jnp.pad(row, (0, pmax - row.shape[0])))
+            return jnp.stack(rows)
+
+        def pack_states(states):
+            rows = []
+            for st in layer_stage_nodes:
+                leaves = [states[n][k].reshape(-1).astype(jnp.float32)
+                          for n in st for k in state_shapes[n]]
+                row = jnp.concatenate(leaves) if leaves else jnp.zeros((0,))
+                rows.append(jnp.pad(row, (0, smax - row.shape[0])))
+            return jnp.stack(rows)
+
+        def unpack_states(sbuf):
+            out = dict(net.states)
+            for s, st in enumerate(layer_stage_nodes):
+                soff = 0
+                for n in st:
+                    layer_s = {}
+                    for name, (shp, dt) in state_shapes[n].items():
+                        k = int(np.prod(shp))
+                        layer_s[name] = (sbuf[s, soff:soff + k]
+                                         .reshape(shp).astype(dt))
+                        soff += k
+                    out[n] = layer_s
+            return out
+
+        pipe = _make_ring(self.mesh, axis, self.dp_axis, S, M, branches)
+
+        tx = net._tx
+        training = conf.training
+        head_node = conf.nodes[self.out_name]
+        head = head_node.layer
+        layer_list = [conf.nodes[n].layer for n in net._layer_nodes]
+
+        def loss_of(params, sbuf, xs, labels):
+            outs, new_sbuf = pipe(pack_bufs(params), sbuf, xs)
+            h = outs[..., :head_in_size].reshape(
+                (M * b_mb,) + head_in_shape[1:])
+            if head_node.preprocessor is not None:
+                h = head_node.preprocessor.transform(h, None)
+            data_loss = head.compute_loss(params[self.out_name], h,
+                                          labels, mask=None)
+            # l1_l2_penalty wants a LIST aligned with layer_list (the
+            # graph loss path does the same, nn/graph.py:296-299)
+            reg = l1_l2_penalty([params[n] for n in net._layer_nodes],
+                                layer_list)
+            return data_loss + reg, new_sbuf
+
+        def step(params, opt_state, states, xs, labels):
+            sbuf = pack_states(states)
+            (loss, new_sbuf), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params, sbuf, xs, labels)
+            new_params, new_opt = compute_updates(
+                tx, grads, opt_state, params, layer_list, training)
+            return new_params, new_opt, unpack_states(new_sbuf), loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+

@@ -80,8 +80,11 @@ def _pipeline(net, mesh: Optional[MeshContext] = None, **kw):
     """GPipe pipeline parallelism: MLN body partitioned into S contiguous
     stages over the mesh's 'pp' axis, heterogeneous activation shapes via
     flat padded ring buffers (see parallel/pipeline.PipelineTrainer)."""
-    from deeplearning4j_tpu.parallel.pipeline import PipelineTrainer
-    return PipelineTrainer(net, mesh=mesh, **kw)
+    from deeplearning4j_tpu.parallel.pipeline import (
+        GraphPipelineTrainer, PipelineTrainer)
+    if hasattr(net, "layers"):
+        return PipelineTrainer(net, mesh=mesh, **kw)
+    return GraphPipelineTrainer(net, mesh=mesh, **kw)
 
 
 @register_strategy("delayed_sync")
