@@ -415,8 +415,11 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin):
             listener.iteration_done(self, self.iteration_count, self.score_value)
         return self._score_raw
 
-    def fit(self, data, epochs: int = 1, use_async: bool = True) -> "ComputationGraph":
-        """(ref: ComputationGraph.fit(DataSetIterator):701-771)"""
+    def fit(self, data, epochs: int = 1, use_async: bool = True,
+            scan_window: int = 1) -> "ComputationGraph":
+        """(ref: ComputationGraph.fit(DataSetIterator):701-771).
+        ``scan_window``: see MultiLayerNetwork.fit — batches grouped into
+        one jitted multi-step scan program per window."""
         self._check_init()
         if isinstance(data, (DataSet, MultiDataSet)):
             batches = [data]
@@ -432,8 +435,11 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin):
             for listener in self.listeners:
                 if isinstance(listener, TrainingListener):
                     listener.on_epoch_start(self)
-            for batch in it:
-                self.fit_batch(batch)
+            if scan_window > 1:
+                self._fit_epoch_scan(it, scan_window)
+            else:
+                for batch in it:
+                    self.fit_batch(batch)
             self.epoch_count += 1
             for listener in self.listeners:
                 if isinstance(listener, TrainingListener):

@@ -465,9 +465,17 @@ class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin):
         return total / max(slices, 1)
 
     # -------------------------------------------------------------------- fit
-    def fit(self, data, labels=None, epochs: int = 1, use_async: bool = True) -> "MultiLayerNetwork":
+    def fit(self, data, labels=None, epochs: int = 1,
+            use_async: bool = True,
+            scan_window: int = 1) -> "MultiLayerNetwork":
         """Train (ref: MultiLayerNetwork.fit(DataSetIterator):947-1016).
-        Accepts a DataSetIterator, a DataSet, or (features, labels) arrays."""
+        Accepts a DataSetIterator, a DataSet, or (features, labels) arrays.
+
+        ``scan_window > 1`` groups that many consecutive batches into ONE
+        jitted multi-step program (``fit_batches_scan``) — dispatch-free
+        training windows, the idiomatic TPU loop shape; short tail
+        windows fall back to per-batch steps (a different window length
+        would recompile)."""
         self._check_init()
         if labels is not None:
             data = DataSet(np.asarray(data), np.asarray(labels))
@@ -480,8 +488,11 @@ class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin):
             for listener in self.listeners:
                 if isinstance(listener, TrainingListener):
                     listener.on_epoch_start(self)
-            for batch in it:  # __iter__ resets the (async) iterator
-                self.fit_batch(batch)
+            if scan_window > 1:
+                self._fit_epoch_scan(it, scan_window)
+            else:
+                for batch in it:  # __iter__ resets the (async) iterator
+                    self.fit_batch(batch)
             self.epoch_count += 1
             for listener in self.listeners:
                 if isinstance(listener, TrainingListener):

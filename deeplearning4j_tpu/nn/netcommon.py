@@ -144,6 +144,19 @@ def make_scan_fit(step_fn):
 class ScanFitMixin:
     """``fit_batches_scan(datasets)`` for both containers."""
 
+    def _fit_epoch_scan(self, it, scan_window: int) -> None:
+        """One epoch's batches grouped into scan windows; the short tail
+        (and any unscannable window, via fit_batches_scan's fallback)
+        still trains per batch."""
+        window: list = []
+        for batch in it:
+            window.append(batch)
+            if len(window) == scan_window:
+                self.fit_batches_scan(window)
+                window = []
+        for batch in window:
+            self.fit_batch(batch)
+
     def fit_batches_scan(self, datasets):
         """Run one optimization step per DataSet, all inside ONE jitted
         scan program (see make_scan_fit). Requirements: SGD-family
@@ -170,12 +183,21 @@ class ScanFitMixin:
                     return True
             return False
 
+        def shape_sig(d):
+            f, l = d.features, d.labels
+            if isinstance(f, (list, tuple)):  # MultiDataSet
+                return (tuple(_np.shape(x) for x in f),
+                        tuple(_np.shape(y) for y in l))
+            return (_np.shape(f), _np.shape(l))
+
         algo = self.conf.training.optimization_algo
         scannable = (
             algo in ("sgd", "stochastic_gradient_descent")
             and self.conf.training.backprop_type != "truncated_bptt"
             and not getattr(self, "_collect_grads", False)
-            and not any(has_mask(d) for d in datasets))
+            and not any(has_mask(d) for d in datasets)
+            # a ragged batch (short dataset tail) cannot stack — loop it
+            and len({shape_sig(d) for d in datasets}) == 1)
         if not scannable:
             return _np.asarray([float(self.fit_batch(d))
                                 for d in datasets], _np.float32)

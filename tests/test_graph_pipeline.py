@@ -60,15 +60,49 @@ def test_graph_pipeline_resnet_first_step_parity_and_converges():
     assert float(np.abs(np.asarray(bn["mean"])).max()) > 0
 
 
+def _small_dag(seed=5):
+    """Merge-vertex DAG with BN — fast to compile (keeps suite time sane;
+    ResNet compiles are reserved for the parity test)."""
+    from deeplearning4j_tpu import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+    from deeplearning4j_tpu.nn.layers import (BatchNormalization,
+                                              DenseLayer, OutputLayer)
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater("sgd", learning_rate=0.05).weight_init("xavier")
+         .graph_builder().add_inputs("in"))
+    b.add_layer("d1", DenseLayer(n_out=12, activation="relu"), "in")
+    b.add_layer("bn", BatchNormalization(), "d1")
+    b.add_layer("d2a", DenseLayer(n_out=8, activation="tanh"), "bn")
+    b.add_layer("d2b", DenseLayer(n_out=6, activation="relu"), "bn")
+    b.add_vertex("m", MergeVertex(), "d2a", "d2b")
+    b.add_layer("out", OutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent"), "m")
+    return (b.set_outputs("out")
+            .set_input_types(InputType.feed_forward(6)).build())
+
+
+def _small_batch(b=8):
+    x = RNG.normal(size=(b, 6)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[RNG.integers(0, 4, b)]
+    return DataSet(x, y)
+
+
 def test_graph_pipeline_microbatched_dp():
-    """dp x pp mesh with M=2 microbatches on the DAG pipeline."""
-    net = ComputationGraph(resnet_tiny(updater="sgd",
-                                       learning_rate=1e-3)).init()
+    """dp x pp mesh with M=2 microbatches on a DAG with a merge vertex
+    and BN state (small model: compile time, not coverage, is the
+    constraint here — ResNet is covered by the parity test). Trains
+    repeatedly on ONE batch so the loss decrease is by construction,
+    not seed luck."""
+    net = ComputationGraph(_small_dag()).init()
     mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
                 axis_names=("dp", "pp"))
     trainer = GraphPipelineTrainer(net, mesh=mesh, n_microbatches=2)
-    losses = [float(trainer.fit_batch(_batch(b=8))) for _ in range(3)]
-    assert np.isfinite(losses).all()
+    batch = _small_batch()
+    first = float(trainer.fit_batch(batch))
+    for _ in range(10):
+        last = float(trainer.fit_batch(batch))
+    assert np.isfinite(last) and last < first
+    assert float(np.abs(np.asarray(net.states["bn"]["mean"])).max()) > 0
 
 
 def test_graph_pipeline_validations():
@@ -88,7 +122,7 @@ def test_graph_pipeline_rejects_remat_and_multidataset():
     with pytest.raises(ValueError, match="remat"):
         GraphPipelineTrainer(net, mesh=_pp_mesh(2))
 
-    net2 = ComputationGraph(resnet_tiny()).init()
+    net2 = ComputationGraph(_small_dag()).init()
     trainer = GraphPipelineTrainer(net2, mesh=_pp_mesh(2),
                                    n_microbatches=1)
     b = _batch(b=4)
@@ -114,11 +148,10 @@ def test_graph_pipeline_epoch_hooks_fire():
         def iteration_done(self, model, iteration, score):
             events.append("iter")
 
-    net = ComputationGraph(resnet_tiny(updater="sgd",
-                                       learning_rate=1e-3)).init()
+    net = ComputationGraph(_small_dag()).init()
     net.set_listeners(Hook())
     trainer = GraphPipelineTrainer(net, mesh=_pp_mesh(2),
                                    n_microbatches=1)
-    trainer.fit(ListDataSetIterator([_batch(b=4)]), epochs=2)
+    trainer.fit(ListDataSetIterator([_small_batch(b=4)]), epochs=2)
     assert events == ["start", "iter", "end", "start", "iter", "end"]
     assert net.epoch_count == 2
