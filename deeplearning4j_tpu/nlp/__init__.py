@@ -61,3 +61,12 @@ from deeplearning4j_tpu.nlp.annotators import (  # noqa: F401
     lemmatize,
     porter_stem,
 )
+from deeplearning4j_tpu.nlp.treeparser import (  # noqa: F401
+    BinarizeTreeTransformer,
+    CollapseUnaries,
+    HeadWordFinder,
+    Tree,
+    TreeIterator,
+    TreeParser,
+    TreeVectorizer,
+)

@@ -1,0 +1,110 @@
+"""Tree parser / transformers / vectorizer tests (ref:
+deeplearning4j-nlp-uima treeparser tests — TreeParserTest,
+TreeTransformerTests)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.treeparser import (
+    BinarizeTreeTransformer, CollapseUnaries, HeadWordFinder, Tree,
+    TreeIterator, TreeParser, TreeVectorizer,
+)
+
+
+def test_parse_chunks_np_vp_pp():
+    parser = TreeParser()
+    (tree,) = parser.trees_for("The big dog chased the cat in the garden.")
+    assert tree.label == "S"
+    labels = [c.label for c in tree.children]
+    assert labels[0] == "NP"          # the big dog
+    assert "VP" in labels             # chased
+    assert "PP" in labels             # in the garden
+    assert tree.tokens() == ["The", "big", "dog", "chased", "the",
+                             "cat", "in", "the", "garden"]
+
+
+def test_penn_round_trip():
+    parser = TreeParser()
+    (tree,) = parser.trees_for("She quickly read two books.")
+    penn = tree.to_penn()
+    back = Tree.from_penn(penn)
+    assert back.to_penn() == penn
+    assert back.tokens() == tree.tokens()
+
+
+def test_binarize_preserves_leaves_and_arity():
+    parser = TreeParser()
+    (tree,) = parser.trees_for(
+        "The quick brown fox jumps over the lazy dog.")
+    btree = BinarizeTreeTransformer().transform(tree)
+    assert btree.tokens() == tree.tokens()
+    for node in btree.preorder():
+        assert len(node.children) <= 2, node.to_penn()
+
+
+def test_collapse_unaries():
+    t = Tree.from_penn("(S (NP (NP (NN dog))) (VP (VBD ran)))")
+    c = CollapseUnaries().transform(t)
+    # the NP->NP unary chain collapsed; the leaf-preterminal survives
+    np_node = c.children[0]
+    assert np_node.label == "NP"
+    assert np_node.children[0].is_leaf()
+    assert c.tokens() == ["dog", "ran"]
+
+
+def test_head_word_finder():
+    parser = TreeParser()
+    (tree,) = parser.trees_for("The big dog chased the cat.")
+    HeadWordFinder().annotate(tree)
+    np_node = tree.children[0]
+    assert np_node.label == "NP" and np_node.head_word == "dog"
+    vp = [c for c in tree.children if c.label == "VP"][0]
+    assert vp.head_word == "chased"
+    assert tree.head_word is not None
+
+
+def test_tree_iterator_binarizes():
+    trees = list(TreeIterator(["One dog ran. Two cats sat."]))
+    assert len(trees) == 2
+    for t in trees:
+        for node in t.preorder():
+            assert len(node.children) <= 2
+
+
+def test_vectorizer_composes_bottom_up():
+    rng = np.random.default_rng(3)
+    vocab = {}
+
+    def lookup(tok):
+        key = tok.lower()
+        if key not in vocab:
+            vocab[key] = rng.normal(size=8).astype(np.float32)
+        return vocab[key]
+
+    tv = TreeVectorizer(lookup, dim=8, seed=1)
+    (tree,) = tv.vectorize("The dog chased the cat.")
+    for node in tree.preorder():
+        assert node.vector is not None and node.vector.shape == (8,)
+        assert np.isfinite(node.vector).all()
+    # root vector composed (not any single leaf's)
+    leaf_vecs = [l.vector for l in tree.leaves()]
+    assert not any(np.allclose(tree.vector, v) for v in leaf_vecs)
+    # internal node values bounded by tanh
+    assert np.abs(tree.vector).max() <= 1.0
+    assert tree.head_word is not None  # heads annotated en route
+
+
+def test_review_fixes_value_preserved_and_arity_guard():
+    """Review r4: unary collapse keeps a chain node's token value, and
+    the vectorizer refuses non-binarized arity instead of silently
+    composing only two children."""
+    import pytest
+
+    t = Tree.from_penn("(X foo (Y (A a) (B b)))")
+    wrapped = Tree("S", children=[Tree("X", children=[t])])
+    c = CollapseUnaries().transform(wrapped)
+    assert "foo" in [n.value for n in c.preorder() if n.value]
+
+    tv = TreeVectorizer(lambda tok: np.ones(4, np.float32), dim=4)
+    wide = Tree("S", children=[Tree("NN", value=w) for w in "a b c".split()])
+    with pytest.raises(ValueError, match="binarize"):
+        tv.vectorize_tree(wide)
