@@ -174,6 +174,19 @@ class KerasLayerMapper:
             return PermuteLayer(dims=tuple(cfg["dims"]))
         if class_name == "RepeatVector":
             return RepeatVectorLayer(n=int(cfg["n"]))
+        if class_name == "ZeroPadding1D":
+            p = cfg.get("padding", 1)
+            if isinstance(p, (list, tuple)):
+                l, r = (p[0], p[1]) if len(p) == 2 else (p[0], p[0])
+            else:
+                l = r = int(p)
+            from deeplearning4j_tpu.nn.layers import ZeroPadding1DLayer
+            return ZeroPadding1DLayer(padding=(int(l), int(r)))
+        if class_name == "TimeDistributedDense":
+            # Keras 1.x spelling of TimeDistributed(Dense); reuse the
+            # Dense mapping so future Dense fixes cover this path too
+            return TimeDistributedLayer(
+                inner=KerasLayerMapper.map("Dense", cfg))
         if class_name == "TimeDistributed":
             inner_cfg = cfg["layer"]
             inner = KerasLayerMapper.map(inner_cfg["class_name"],

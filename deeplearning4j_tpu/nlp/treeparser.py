@@ -64,7 +64,10 @@ class Tree:
     def to_penn(self) -> str:
         if self.is_leaf():
             return f"({self.label} {self.value})"
-        return (f"({self.label} "
+        # a mixed node (value + children, e.g. after CollapseUnaries)
+        # keeps its token inline so the round-trip is lossless
+        val = f" {self.value}" if self.value is not None else ""
+        return (f"({self.label}{val} "
                 + " ".join(c.to_penn() for c in self.children) + ")")
 
     @staticmethod
@@ -205,7 +208,10 @@ class HeadWordFinder:
         "@VP": (["VB", "VBZ", "VBD", "VBG", "MD"], "first"),
         "PP": (["IN", "TO"], "first"),
         "ADJP": (["JJ"], "last"),
-        "S": (["VP", "NP"], "first"),
+        # '@S' before 'NP': a binarization intermediate hides the VP, so
+        # the verb head must flow up through it, not lose to a left NP
+        "S": (["VP", "@S", "NP"], "first"),
+        "@S": (["VP", "@S", "NP"], "first"),
     }
 
     def annotate(self, tree: Tree) -> Tree:
@@ -305,6 +311,11 @@ class TreeVectorizer:
             tree.vector = np.asarray(self._compose(
                 tree.children[0].vector, tree.children[1].vector,
                 self.W, self.b))
+        if tree.value is not None:
+            # mixed node (token + children, e.g. post-CollapseUnaries):
+            # the token's embedding must enter the composition too
+            tree.vector = np.asarray(self._compose(
+                self._leaf_vec(tree.value), tree.vector, self.W, self.b))
         return tree
 
     def vectorize(self, text: str) -> List[Tree]:

@@ -46,7 +46,8 @@ _CNN_LAYERS = {"ConvolutionLayer", "SubsamplingLayer", "ZeroPaddingLayer",
 _RNN_LAYERS = {"LSTM", "GravesLSTM", "GravesBidirectionalLSTM", "SimpleRnn",
                "GRU", "RnnOutputLayer", "Convolution1DLayer",
                "Subsampling1DLayer", "SelfAttentionLayer",
-               "LastTimeStepLayer", "TimeDistributedLayer"}
+               "LastTimeStepLayer", "TimeDistributedLayer",
+               "ZeroPadding1DLayer"}
 _ANY_LAYERS = {"BatchNormalization", "GlobalPoolingLayer", "ActivationLayer",
                "DropoutLayer", "LossLayer", "ReshapeLayer", "PermuteLayer"}
 

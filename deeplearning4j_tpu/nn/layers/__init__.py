@@ -52,6 +52,7 @@ from deeplearning4j_tpu.nn.layers.shape import (  # noqa: F401
     RepeatVectorLayer,
     ReshapeLayer,
     TimeDistributedLayer,
+    ZeroPadding1DLayer,
 )
 from deeplearning4j_tpu.nn.layers.variational import VariationalAutoencoder  # noqa: F401
 from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer  # noqa: F401

@@ -179,3 +179,37 @@ class TimeDistributedLayer(BaseLayerConf):
         d = super().to_dict()
         d["inner"] = self.inner.to_dict()
         return d
+
+
+@register_layer
+@dataclass
+class ZeroPadding1DLayer(BaseLayerConf):
+    """Zero-pad the time axis of [B, T, F] (ref: the reference importer's
+    ZeroPadding1D mapping, KerasLayer.java LAYER_CLASS_NAME_ZERO_PADDING_1D).
+    ``padding`` = (left, right)."""
+    padding: Tuple[int, int] = (1, 1)
+
+    def set_n_in(self, in_type: InputType) -> None:
+        if in_type.kind != "rnn":
+            raise ValueError(
+                f"ZeroPadding1D expects RNN input, got {in_type}")
+        self.n_in = in_type.size
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        l, r = self.padding
+        t = in_type.timesteps
+        return InputType.recurrent(in_type.size,
+                                   None if t is None else t + l + r)
+
+    def param_order(self) -> List[str]:
+        return []
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        l, r = self.padding
+        return jnp.pad(x, ((0, 0), (l, r), (0, 0))), state
+
+    def propagate_mask(self, mask):
+        if mask is None:
+            return None
+        l, r = self.padding
+        return jnp.pad(mask, ((0, 0), (l, r)))

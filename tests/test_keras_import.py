@@ -258,3 +258,31 @@ def test_conv1d_dilation_mapped_and_shapes():
     ref = sum(np.asarray(x)[:, 2 * i:2 * i + 16] @ W[i] for i in range(3))
     np.testing.assert_allclose(np.asarray(y), ref + np.asarray(p["b"]),
                                atol=1e-5)
+
+
+def test_zero_padding1d_and_time_distributed_dense():
+    """Reference KerasLayer.java maps ZeroPadding1D and the Keras-1.x
+    TimeDistributedDense; golden forward on the padded time axis."""
+    from deeplearning4j_tpu.keras.keras_import import KerasLayerMapper
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    import jax
+    import jax.numpy as jnp
+
+    zp = KerasLayerMapper.map("ZeroPadding1D", {"padding": 2})
+    zp.set_n_in(InputType.recurrent(3, 5))
+    out_t = zp.infer_output_type(InputType.recurrent(3, 5))
+    assert out_t.timesteps == 9
+    x = jnp.asarray(RNG.normal(size=(2, 5, 3)), jnp.float32)
+    y, _ = zp.apply({}, x, state={}, train=False, rng=None)
+    assert y.shape == (2, 9, 3)
+    np.testing.assert_array_equal(np.asarray(y[:, :2]), 0.0)
+    np.testing.assert_allclose(np.asarray(y[:, 2:7]), np.asarray(x))
+
+    tdd = KerasLayerMapper.map("TimeDistributedDense",
+                               {"output_dim": 4, "activation": "tanh"})
+    tdd.set_n_in(InputType.recurrent(3, 5))
+    assert tdd.infer_output_type(InputType.recurrent(3, 5)).size == 4
+    p = tdd.init_params(jax.random.PRNGKey(0))
+    y, _ = tdd.apply(p, x, state={}, train=False, rng=None)
+    assert y.shape == (2, 5, 4)
+    assert float(jnp.abs(y).max()) <= 1.0

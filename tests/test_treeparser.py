@@ -108,3 +108,26 @@ def test_review_fixes_value_preserved_and_arity_guard():
     wide = Tree("S", children=[Tree("NN", value=w) for w in "a b c".split()])
     with pytest.raises(ValueError, match="binarize"):
         tv.vectorize_tree(wide)
+
+
+def test_mixed_node_round_trip_and_head_through_binarization():
+    """Review r4 round 2: mixed (value+children) nodes serialize
+    losslessly; the sentence head survives binarization; mixed-node
+    tokens enter the vector composition."""
+    t = Tree.from_penn("(X foo (Y (A a) (B b)))")
+    assert Tree.from_penn(t.to_penn()).to_penn() == t.to_penn()
+    assert "foo" in t.to_penn()
+
+    parser = TreeParser()
+    (tree,) = parser.trees_for("The big dog chased the cat.")
+    btree = BinarizeTreeTransformer().transform(tree)
+    HeadWordFinder().annotate(btree)
+    assert btree.head_word == "chased"
+
+    tv = TreeVectorizer(lambda tok: np.full(4, 1.0 if tok == "foo"
+                                            else 0.25, np.float32), dim=4)
+    mixed = Tree.from_penn("(X foo (Y y))")
+    plain = Tree.from_penn("(X (Y y))")
+    tv.vectorize_tree(mixed)
+    tv.vectorize_tree(plain)
+    assert not np.allclose(mixed.vector, plain.vector)
