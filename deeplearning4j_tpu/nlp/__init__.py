@@ -70,3 +70,4 @@ from deeplearning4j_tpu.nlp.treeparser import (  # noqa: F401
     TreeParser,
     TreeVectorizer,
 )
+from deeplearning4j_tpu.nlp.sentiment import SentimentAnalyzer  # noqa: F401

@@ -1,0 +1,52 @@
+"""Sentiment analyzer tests (ref: deeplearning4j-nlp-uima SWN3.java)."""
+
+from deeplearning4j_tpu.nlp import SentimentAnalyzer
+
+
+def test_word_scores_and_stem_fallback():
+    sa = SentimentAnalyzer()
+    assert sa.word_score("excellent") > 0
+    assert sa.word_score("terrible") < 0
+    assert sa.word_score("table") == 0.0
+    # inflected form resolves through the Porter stem
+    assert sa.word_score("enjoying") > 0
+    assert sa.word_score("crashing") < 0
+
+
+def test_classify_documents():
+    sa = SentimentAnalyzer()
+    assert sa.classify("This movie was wonderful and the cast was "
+                       "brilliant.") == "positive"
+    assert sa.classify("An awful, boring film with terrible acting."
+                       ) == "negative"
+    assert sa.classify("The train departs at noon.") == "neutral"
+
+
+def test_negation_flips_and_intensity_weights():
+    sa = SentimentAnalyzer()
+    pos = sa.score("the food was good".split())
+    neg = sa.score("the food was not good".split())
+    assert pos > 0 > neg
+    strong = sa.score("the food was very good".split())
+    weak = sa.score("the food was slightly good".split())
+    assert strong > pos > weak > 0
+    # double negation cancels
+    dd = sa.score("it is not without charming moments".split())
+    assert dd > 0
+
+
+def test_extra_lexicon_override():
+    sa = SentimentAnalyzer(extra_lexicon={"sick": 1.0})  # slang flip
+    assert sa.word_score("sick") > 0
+
+
+def test_contractions_negate():
+    """Review r4: the tokenizer keeps contractions whole, so wasn't/don't
+    must negate directly."""
+    sa = SentimentAnalyzer()
+    assert sa.classify("The movie wasn't good.") == "negative"
+    assert sa.classify("I don't like this film.") == "negative"
+    # 'barely' diminishes OR negates, not both: weakly positive stays >= 0
+    assert sa.score("the food was barely good".split()) <= 0  # negator
+    assert "barely" not in __import__(
+        "deeplearning4j_tpu.nlp.sentiment", fromlist=["x"])._DIMINISHERS
