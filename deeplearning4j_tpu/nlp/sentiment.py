@@ -104,8 +104,13 @@ class SentimentAnalyzer:
                 continue
             weight = 1.0
             flip = 1.0
-            for j in range(max(0, i - self._window), i):
+            # walk back to the window edge, stopping at a sentence/clause
+            # boundary — a negator in the previous sentence must not flip
+            # this one's words
+            for j in range(i - 1, max(0, i - self._window) - 1, -1):
                 prev = toks[j]
+                if prev in {".", "!", "?", ";"}:
+                    break
                 if prev in _NEGATORS:
                     flip = -flip
                 weight *= _INTENSIFIERS.get(prev,

@@ -169,7 +169,9 @@ class ParallelTrainer:
         return net._score_raw
 
     def fit(self, data: Union[DataSet, DataSetIterator], epochs: int = 1,
-            use_async: bool = True) -> "ParallelTrainer":
+            use_async: bool = True,
+            scan_window: int = 1) -> "ParallelTrainer":
+        """``scan_window > 1``: see fit_batches_scan."""
         if isinstance(data, DataSet):
             for _ in range(epochs):
                 self.fit_batch(data)
@@ -177,7 +179,95 @@ class ParallelTrainer:
         it = (AsyncDataSetIterator(data)
               if use_async and data.async_supported() else data)
         for _ in range(epochs):
-            for batch in it:
-                self.fit_batch(batch)
+            if scan_window > 1:
+                window: list = []
+                for batch in it:
+                    window.append(batch)
+                    if len(window) == scan_window:
+                        self.fit_batches_scan(window)
+                        window = []
+                for batch in window:
+                    self.fit_batch(batch)
+            else:
+                for batch in it:
+                    self.fit_batch(batch)
             self.net.epoch_count += 1
         return self
+
+    # ---------------------------------------------------------- scan windows
+    def fit_batches_scan(self, batches):
+        """N SPMD optimization steps as ONE jitted lax.scan program over
+        the mesh (the single-device fit_batches_scan, sharded): stacked
+        batches are placed with the leading window axis replicated and
+        the batch axis sharded over 'data', so the scan body runs the
+        same NamedSharding step the per-batch path compiles. Falls back
+        to the fit_batch loop for masked/ragged/MultiDataSet windows."""
+        net = self.net
+        batches = list(batches)
+        if not batches:
+            return np.zeros((0,), np.float32)
+        scannable = (
+            not self._is_graph
+            and all(isinstance(b, DataSet)
+                    and b.features_mask is None and b.labels_mask is None
+                    for b in batches)
+            and len({(np.shape(b.features), np.shape(b.labels))
+                     for b in batches}) == 1)
+        if not scannable:
+            return np.asarray([float(self.fit_batch(b))
+                               for b in batches], np.float32)
+        if self._step is None:
+            self._step = self._build_step()
+        cached = getattr(self, "_scan_step", None)
+        if cached is None or cached[0] is not self._step:
+            step_fn = self._step
+
+            def scan_program(params, opt_state, states, feats, labels,
+                             rng):
+                def body(carry, xs):
+                    p, o, s, r = carry
+                    f, l = xs
+                    r, sub = jax.random.split(r)
+                    p, o, s, loss = step_fn(p, o, s, f, l, None, None,
+                                            sub)
+                    return (p, o, s, r), loss
+
+                (p, o, s, _), losses = jax.lax.scan(
+                    body, (params, opt_state, states, rng),
+                    (feats, labels))
+                return p, o, s, losses
+
+            self._scan_step = (step_fn,
+                               jax.jit(scan_program,
+                                       donate_argnums=(0, 1, 2)
+                                       if self._donate else ()))
+        scan_fn = self._scan_step[1]
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self.mesh.mesh
+        data_axis = self.mesh.data_axis
+
+        def place(arrs):
+            stacked = np.stack([np.asarray(a) for a in arrs])
+            spec = P(None, data_axis, *([None] * (stacked.ndim - 2)))
+            return jax.device_put(stacked, NamedSharding(mesh, spec))
+
+        feats = place([b.features for b in batches])
+        labels = place([b.labels for b in batches])
+        net._rng, r = jax.random.split(net._rng)
+        with sequence_parallel_scope(self.mesh):
+            net.params, net.opt_state, net.states, losses = scan_fn(
+                net.params, net.opt_state, net.states, feats, labels, r)
+        net.last_batch_size = batches[-1].num_examples()
+        net.last_grads = None
+        if net.listeners:
+            for i, _ in enumerate(batches):
+                net.iteration_count += 1
+                net.score_value = float(losses[i])
+                for listener in net.listeners:
+                    listener.iteration_done(net, net.iteration_count,
+                                            net.score_value)
+        else:
+            net.iteration_count += len(batches)
+        net.score_value = losses[-1]
+        return losses

@@ -50,3 +50,10 @@ def test_contractions_negate():
     assert sa.score("the food was barely good".split()) <= 0  # negator
     assert "barely" not in __import__(
         "deeplearning4j_tpu.nlp.sentiment", fromlist=["x"])._DIMINISHERS
+
+
+def test_negation_does_not_cross_sentence_boundary():
+    """Review r4: a negator in the previous sentence must not flip the
+    next sentence's words."""
+    sa = SentimentAnalyzer()
+    assert sa.classify("The movie was not bad. Amazing!") == "positive"
