@@ -155,9 +155,10 @@ class SelfAttentionLayer(BaseLayerConf):
     def _ring_context(self, x, mask):
         """The active MeshContext when this apply should run as ring
         attention: inside a sequence_parallel_scope, allowed by config,
-        unmasked (the ring kernel has no KV-mask path), T divides the sp
-        axis, and B divides the data axis (the shard_map shards both)."""
-        if not self.sequence_parallel or mask is not None:
+        T divides the sp axis, and B divides the data axis (the
+        shard_map shards both). Sequence-padding masks ride the ring
+        (their KV shard rotates with the KVs)."""
+        if not self.sequence_parallel:
             return None
         from deeplearning4j_tpu.parallel.mesh import active_sequence_context
         ctx = active_sequence_context()
@@ -180,7 +181,7 @@ class SelfAttentionLayer(BaseLayerConf):
                 x, params, ring.mesh, n_heads=self.n_heads,
                 head_dim=self.head_dim, seq_axis=ring.seq_axis,
                 batch_axis=ring.data_axis, causal=self.causal,
-                block_size=self.block_size)
+                block_size=self.block_size, mask=mask)
             return out, state
         q = self._split_heads(x @ params["Wq"])
         k = self._split_heads(x @ params["Wk"])

@@ -108,7 +108,7 @@ def make_pretrain_step(layer, tx):
     return jax.jit(step)
 
 
-def make_scan_fit(step_fn):
+def make_scan_fit(step_fn, donate_argnums=(0, 1, 2)):
     """Multi-step training as ONE jitted program: ``lax.scan`` of the
     container's train step over a leading batch axis.
 
@@ -121,9 +121,11 @@ def make_scan_fit(step_fn):
 
     ``step_fn`` is the (non-jitted semantics of the) per-batch step with
     signature (params, opt, states, feats, labels, fmask, lmask, rng) ->
-    (params, opt, states, loss, grads); masks are fixed to None in the
-    scanned program. feats/labels may be arrays (MultiLayerNetwork) or
-    name-keyed dicts (ComputationGraph) — lax.scan slices pytrees.
+    (params, opt, states, loss[, grads]) — ``returns_grads`` names which
+    arity (the containers' steps emit grads; ParallelTrainer's doesn't).
+    Masks are fixed to None in the scanned program. feats/labels may be
+    arrays (MultiLayerNetwork) or name-keyed dicts (ComputationGraph) —
+    lax.scan slices pytrees.
     """
 
     def scan_program(params, opt_state, states, feats, labels, rng):
@@ -131,14 +133,15 @@ def make_scan_fit(step_fn):
             p, o, s, r = carry
             f, l = xs
             r, sub = jax.random.split(r)
-            p, o, s, loss, _ = step_fn(p, o, s, f, l, None, None, sub)
+            out = step_fn(p, o, s, f, l, None, None, sub)
+            p, o, s, loss = out[:4]
             return (p, o, s, r), loss
 
         (p, o, s, _), losses = jax.lax.scan(
             body, (params, opt_state, states, rng), (feats, labels))
         return p, o, s, losses
 
-    return jax.jit(scan_program, donate_argnums=(0, 1, 2))
+    return jax.jit(scan_program, donate_argnums=donate_argnums)
 
 
 class ScanFitMixin:

@@ -25,6 +25,7 @@ import numpy as np
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator, DataSetIterator
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.netcommon import ScanFitMixin, make_scan_fit
 from deeplearning4j_tpu.nn.updater import compute_updates, l1_l2_penalty
 from deeplearning4j_tpu.parallel.mesh import (
     MeshContext, sequence_parallel_scope,
@@ -180,14 +181,9 @@ class ParallelTrainer:
               if use_async and data.async_supported() else data)
         for _ in range(epochs):
             if scan_window > 1:
-                window: list = []
-                for batch in it:
-                    window.append(batch)
-                    if len(window) == scan_window:
-                        self.fit_batches_scan(window)
-                        window = []
-                for batch in window:
-                    self.fit_batch(batch)
+                # reuse the containers' windowing loop (only needs
+                # fit_batches_scan / fit_batch from self)
+                ScanFitMixin._fit_epoch_scan(self, it, scan_window)
             else:
                 for batch in it:
                     self.fit_batch(batch)
@@ -220,36 +216,27 @@ class ParallelTrainer:
             self._step = self._build_step()
         cached = getattr(self, "_scan_step", None)
         if cached is None or cached[0] is not self._step:
-            step_fn = self._step
-
-            def scan_program(params, opt_state, states, feats, labels,
-                             rng):
-                def body(carry, xs):
-                    p, o, s, r = carry
-                    f, l = xs
-                    r, sub = jax.random.split(r)
-                    p, o, s, loss = step_fn(p, o, s, f, l, None, None,
-                                            sub)
-                    return (p, o, s, r), loss
-
-                (p, o, s, _), losses = jax.lax.scan(
-                    body, (params, opt_state, states, rng),
-                    (feats, labels))
-                return p, o, s, losses
-
-            self._scan_step = (step_fn,
-                               jax.jit(scan_program,
-                                       donate_argnums=(0, 1, 2)
-                                       if self._donate else ()))
+            self._scan_step = (self._step, make_scan_fit(
+                self._step,
+                donate_argnums=(0, 1, 2) if self._donate else ()))
         scan_fn = self._scan_step[1]
 
         from jax.sharding import NamedSharding, PartitionSpec as P
         mesh = self.mesh.mesh
         data_axis = self.mesh.data_axis
 
+        seq_axis = self.mesh.seq_axis
+
         def place(arrs):
             stacked = np.stack([np.asarray(a) for a in arrs])
-            spec = P(None, data_axis, *([None] * (stacked.ndim - 2)))
+            trailing = [None] * (stacked.ndim - 2)
+            # rank-3 batches ([B, T, F]) shard T over 'sp' exactly like
+            # the per-batch path (mesh.batch_sharding) — leaving it
+            # unsharded would cost a full resharding before the ring
+            if (seq_axis is not None and stacked.ndim == 4
+                    and stacked.shape[2] % mesh.shape[seq_axis] == 0):
+                trailing[0] = seq_axis
+            spec = P(None, data_axis, *trailing)
             return jax.device_put(stacked, NamedSharding(mesh, spec))
 
         feats = place([b.features for b in batches])

@@ -162,8 +162,9 @@ def test_sequence_parallel_opt_out_flag():
         assert layer._ring_context(x, None) is None
         layer.sequence_parallel = True
         assert layer._ring_context(x, None) is not None
-        # masked input declines the ring path (no KV-mask support)
-        assert layer._ring_context(x, jnp.ones((2, 16))) is None
+        # masked input now rides the ring too (kv shards rotate with
+        # their validity mask)
+        assert layer._ring_context(x, jnp.ones((2, 16))) is not None
         # T not divisible by sp size declines
         assert layer._ring_context(jnp.zeros((2, 15, 8)), None) is None
     assert layer._ring_context(x, None) is None  # scope exited
@@ -181,3 +182,96 @@ def test_shard_batch_nondivisible_T_falls_back():
     assert out.sharding.spec[1] is None  # T not sharded
     ok = ctx.shard_batch(np.zeros((4, 16, 8), np.float32))
     assert ok.sharding.spec[1] == "sp"
+
+
+def test_masked_ring_attention_matches_local():
+    """Sequence-padding masks ride the ring: ring output == the local
+    blockwise layer path with the same mask."""
+    from jax.sharding import Mesh
+    from deeplearning4j_tpu.parallel.sequence import ring_self_attention
+
+    rng = np.random.default_rng(8)
+    B, T, F, H = 4, 16, 8, 2
+    layer = SelfAttentionLayer(n_heads=H, block_size=4)
+    layer.set_n_in(__import__(
+        "deeplearning4j_tpu").InputType.recurrent(F, T))
+    params = layer.init_params(jax.random.PRNGKey(0))
+    x = jnp.asarray(rng.normal(size=(B, T, F)), jnp.float32)
+    lengths = rng.integers(5, T + 1, B)
+    mask = jnp.asarray((np.arange(T)[None] < lengths[:, None])
+                       .astype(np.float32))
+
+    local, _ = layer.apply(params, x, state={}, train=False, rng=None,
+                           mask=mask)
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4),
+                axis_names=("data", "sp"))
+    ring = ring_self_attention(x, params, mesh, n_heads=H,
+                               head_dim=layer.head_dim, seq_axis="sp",
+                               batch_axis="data", block_size=4, mask=mask)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(local),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_masked_container_sequence_parallel_parity():
+    """Masked time-series training through ParallelTrainer with an sp
+    axis matches the single-device step."""
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.layers import RnnOutputLayer
+    from deeplearning4j_tpu.parallel.mesh import MeshContext
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+    def build():
+        return MultiLayerNetwork(
+            NeuralNetConfiguration.builder().seed(5)
+            .updater("sgd", learning_rate=0.05).weight_init("xavier")
+            .list()
+            .layer(SelfAttentionLayer(n_heads=2, block_size=4))
+            .layer(RnnOutputLayer(n_out=5, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(8, 16)).build()).init()
+
+    rng = np.random.default_rng(23)
+    B, T, F, K = 4, 16, 8, 5
+    x = rng.normal(size=(B, T, F)).astype(np.float32)
+    y = np.eye(K, dtype=np.float32)[rng.integers(0, K, (B, T))]
+    lengths = rng.integers(6, T + 1, B)
+    m = (np.arange(T)[None] < lengths[:, None]).astype(np.float32)
+    batch = DataSet(x, y, features_mask=m, labels_mask=m)
+
+    ref = build()
+    loss_ref = float(ref.fit_batch(batch))
+    net = build()
+    trainer = ParallelTrainer(net, MeshContext.create(n_data=2, n_model=1,
+                                                      n_seq=4))
+    loss_sp = float(trainer.fit_batch(batch))
+    assert abs(loss_sp - loss_ref) < 2e-5, (loss_sp, loss_ref)
+
+
+def test_masked_causal_ring_attention_matches_local():
+    """Causal + padding mask together on the ring (the diagonal-block
+    recompute must see the rotated kv mask) — review r4."""
+    from jax.sharding import Mesh
+    from deeplearning4j_tpu.parallel.sequence import ring_self_attention
+
+    rng = np.random.default_rng(17)
+    B, T, F, H = 4, 16, 8, 2
+    layer = SelfAttentionLayer(n_heads=H, causal=True, block_size=4)
+    layer.set_n_in(__import__(
+        "deeplearning4j_tpu").InputType.recurrent(F, T))
+    params = layer.init_params(jax.random.PRNGKey(1))
+    x = jnp.asarray(rng.normal(size=(B, T, F)), jnp.float32)
+    lengths = rng.integers(5, T + 1, B)
+    mask = jnp.asarray((np.arange(T)[None] < lengths[:, None])
+                       .astype(np.float32))
+    local, _ = layer.apply(params, x, state={}, train=False, rng=None,
+                           mask=mask)
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4),
+                axis_names=("data", "sp"))
+    ring = ring_self_attention(x, params, mesh, n_heads=H,
+                               head_dim=layer.head_dim, seq_axis="sp",
+                               batch_axis="data", causal=True,
+                               block_size=4, mask=mask)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(local),
+                               rtol=2e-5, atol=2e-5)
