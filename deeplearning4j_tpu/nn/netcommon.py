@@ -121,8 +121,9 @@ def make_scan_fit(step_fn, donate_argnums=(0, 1, 2)):
 
     ``step_fn`` is the (non-jitted semantics of the) per-batch step with
     signature (params, opt, states, feats, labels, fmask, lmask, rng) ->
-    (params, opt, states, loss[, grads]) — ``returns_grads`` names which
-    arity (the containers' steps emit grads; ParallelTrainer's doesn't).
+    (params, opt, states, loss[, grads]) — both arities are accepted
+    (the containers' steps emit grads, ParallelTrainer's doesn't; the
+    body reads only the first four outputs).
     Masks are fixed to None in the scanned program. feats/labels may be
     arrays (MultiLayerNetwork) or name-keyed dicts (ComputationGraph) —
     lax.scan slices pytrees.

@@ -39,15 +39,21 @@ def ring_attention_sharded(q, k, v, axis_name: str, *, causal: bool = False,
     q_offset = my_idx * T_local
     has_mask = kv_mask is not None
     if not has_mask:
-        kv_mask = q[:, 0, :, 0] * 0.0 + 1.0  # all-valid, q-varying
+        # keep the 5-element carry: an all-ones mask would still be
+        # ppermuted every ring step (a dead ICI collective per layer)
+        kv_mask = None
 
     def step(carry, i):
-        out, m, lse, k_cur, v_cur, mask_cur = carry
+        if has_mask:
+            out, m, lse, k_cur, v_cur, mask_cur = carry
+        else:
+            out, m, lse, k_cur, v_cur = carry
+            mask_cur = None
         # which device's KV shard are we holding at ring step i?
         src = (my_idx - i) % axis_size
         o_blk, m_blk, lse_blk = blockwise_attention(
             q, k_cur, v_cur, block_size=block_size, causal=False,
-            kv_mask=mask_cur)
+            kv_mask=mask_cur)  # None when unmasked
         if causal:
             # causal across shards: KV shard `src` is fully visible if
             # src < my_idx, invisible if src > my_idx, diagonal if equal.
@@ -75,15 +81,19 @@ def ring_attention_sharded(q, k, v, axis_name: str, *, causal: bool = False,
         perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        mask_nxt = jax.lax.ppermute(mask_cur, axis_name, perm)
-        return (out, m_new, lse, k_nxt, v_nxt, mask_nxt), None
+        if has_mask:
+            mask_nxt = jax.lax.ppermute(mask_cur, axis_name, perm)
+            return (out, m_new, lse, k_nxt, v_nxt, mask_nxt), None
+        return (out, m_new, lse, k_nxt, v_nxt), None
 
     # q-derived initial carries: correct varying-manual-axes under shard_map
     out0 = q * 0.0
     m0 = q[..., 0] * 0.0 + NEG_INF
     lse0 = q[..., 0] * 0.0
-    (out, m, lse, _, _, _), _ = jax.lax.scan(
-        step, (out0, m0, lse0, k, v, kv_mask), jnp.arange(axis_size))
+    carry0 = ((out0, m0, lse0, k, v, kv_mask) if has_mask
+              else (out0, m0, lse0, k, v))
+    final_carry, _ = jax.lax.scan(step, carry0, jnp.arange(axis_size))
+    out, m, lse = final_carry[:3]
     return finalize_attention(out, lse)
 
 
@@ -103,7 +113,8 @@ def ring_self_attention(x, params, mesh: Mesh, *, n_heads: int,
     directly by transformer blocks."""
     from jax import shard_map
 
-    def local_fn(x_l, Wq, Wk, Wv, Wo, mask_l):
+    def local_fn(x_l, Wq, Wk, Wv, Wo, *mask_rest):
+        mask_l = mask_rest[0] if mask_rest else None
         B, T_l, F = x_l.shape
 
         def split(h):
@@ -120,12 +131,12 @@ def ring_self_attention(x, params, mesh: Mesh, *, n_heads: int,
         return out
 
     spec_x = P(batch_axis, seq_axis, None)
-    spec_m = P(batch_axis, seq_axis)
     spec_w = P()
-    fn = shard_map(local_fn, mesh=mesh,
-                   in_specs=(spec_x, spec_w, spec_w, spec_w, spec_w,
-                             spec_m),
+    in_specs = [spec_x, spec_w, spec_w, spec_w, spec_w]
+    args = [x, params["Wq"], params["Wk"], params["Wv"], params["Wo"]]
+    if mask is not None:
+        in_specs.append(P(batch_axis, seq_axis))
+        args.append(jnp.asarray(mask, x.dtype))
+    fn = shard_map(local_fn, mesh=mesh, in_specs=tuple(in_specs),
                    out_specs=spec_x)
-    m = (jnp.ones(x.shape[:2], x.dtype) if mask is None
-         else jnp.asarray(mask, x.dtype))
-    return fn(x, params["Wq"], params["Wk"], params["Wv"], params["Wo"], m)
+    return fn(*args)

@@ -225,18 +225,14 @@ class ParallelTrainer:
         mesh = self.mesh.mesh
         data_axis = self.mesh.data_axis
 
-        seq_axis = self.mesh.seq_axis
-
         def place(arrs):
             stacked = np.stack([np.asarray(a) for a in arrs])
-            trailing = [None] * (stacked.ndim - 2)
-            # rank-3 batches ([B, T, F]) shard T over 'sp' exactly like
-            # the per-batch path (mesh.batch_sharding) — leaving it
-            # unsharded would cost a full resharding before the ring
-            if (seq_axis is not None and stacked.ndim == 4
-                    and stacked.shape[2] % mesh.shape[seq_axis] == 0):
-                trailing[0] = seq_axis
-            spec = P(None, data_axis, *trailing)
+            # reuse the per-batch sharding policy (incl. its sp-axis
+            # rule) with the window axis prepended — reimplementing the
+            # divisibility decision here would let the two paths drift
+            batch_spec = self.mesh.batch_sharding(
+                stacked.ndim - 1, stacked.shape[1:]).spec
+            spec = P(None, *batch_spec)
             return jax.device_put(stacked, NamedSharding(mesh, spec))
 
         feats = place([b.features for b in batches])
