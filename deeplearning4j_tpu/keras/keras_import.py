@@ -40,9 +40,9 @@ from deeplearning4j_tpu.nn.graph import ComputationGraph
 from deeplearning4j_tpu.nn.layers import (
     GRU, LSTM, ActivationLayer, BatchNormalization, Convolution1DLayer,
     ConvolutionLayer, DenseLayer, DropoutLayer, EmbeddingLayer,
-    GlobalPoolingLayer, OutputLayer, PermuteLayer, RepeatVectorLayer,
-    ReshapeLayer, SimpleRnn, Subsampling1DLayer, SubsamplingLayer,
-    TimeDistributedLayer, ZeroPaddingLayer,
+    GlobalPoolingLayer, LayerNormalization, OutputLayer, PermuteLayer,
+    RepeatVectorLayer, ReshapeLayer, SimpleRnn, Subsampling1DLayer,
+    SubsamplingLayer, TimeDistributedLayer, ZeroPaddingLayer,
 )
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
@@ -133,6 +133,18 @@ class KerasLayerMapper:
             return DropoutLayer(dropout=1.0 - float(rate))
         if class_name == "Activation":
             return ActivationLayer(activation=_act(cfg.get("activation")))
+        if class_name == "LayerNormalization":
+            axis = cfg.get("axis", -1)
+            if isinstance(axis, (list, tuple)):
+                axis = axis[0] if len(axis) == 1 else axis
+            if axis not in (-1,):
+                raise ValueError(
+                    f"LayerNormalization axis={axis} unsupported (only the "
+                    "last/feature axis)")
+            if not cfg.get("scale", True) or not cfg.get("center", True):
+                raise ValueError("LayerNormalization with scale=False or "
+                                 "center=False is unsupported")
+            return LayerNormalization(eps=float(cfg.get("epsilon", 1e-5)))
         if class_name == "BatchNormalization":
             return BatchNormalization(eps=float(cfg.get("epsilon", 1e-5)),
                                       decay=float(cfg.get("momentum", 0.99)))
@@ -679,6 +691,8 @@ class KerasModelImport:
                     "center=False is unsupported (positional weight "
                     f"list has {len(arrs)} entries, expected 4)")
             names = ["gamma", "beta", "moving_mean", "moving_variance"]
+        elif isinstance(layer, LayerNormalization):
+            names = ["gamma", "beta"]
         elif isinstance(layer, (LSTM, GRU, SimpleRnn)):
             names = ["kernel", "recurrent_kernel", "bias"]
         elif isinstance(layer, EmbeddingLayer):
@@ -807,6 +821,9 @@ class KerasModelImport:
             put("W", kernel)
             if "bias" in ds or "b" in ds:
                 put("b", ds.get("bias", ds.get("b")))
+        elif isinstance(layer, LayerNormalization):
+            put("gamma", ds.get("gamma"))
+            put("beta", ds.get("beta"))
         elif isinstance(layer, BatchNormalization):
             put("gamma", ds.get("gamma"))
             put("beta", ds.get("beta"))

@@ -34,6 +34,7 @@ from deeplearning4j_tpu.nn.layers.convolution import (  # noqa: F401
     ZeroPaddingLayer,
 )
 from deeplearning4j_tpu.nn.layers.normalization import (  # noqa: F401
+    LayerNormalization,
     BatchNormalization,
     LocalResponseNormalization,
 )

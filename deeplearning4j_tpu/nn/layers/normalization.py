@@ -114,3 +114,42 @@ class LocalResponseNormalization(BaseLayerConf):
             padding=[(0, 0), (0, 0), (0, 0), (half, half)],
         )
         return x / jnp.power(self.k + self.alpha * summed, self.beta), state
+
+
+@register_layer
+@dataclass
+class LayerNormalization(BaseLayerConf):
+    """Layer normalization over the feature (last) axis — per example,
+    batch-independent. The reference snapshot predates LayerNorm (its
+    normalization is BatchNormalization.java); this is the modern
+    companion of SelfAttentionLayer (pre/post-norm transformer blocks)
+    and, being stateless, it composes with every trainer including the
+    GPipe pipelines. Statistics compute in >= f32 like BN."""
+    eps: float = 1e-5
+    # filled by builder:
+    n_features: int = 0
+
+    def set_n_in(self, in_type: InputType) -> None:
+        # same per-kind feature-axis rule as BatchNormalization above
+        self.n_in = in_type.flat_size()
+        self.n_features = (in_type.channels if in_type.kind == "cnn"
+                           else in_type.flat_size())
+
+    def infer_output_type(self, in_type: InputType) -> InputType:
+        return in_type
+
+    def param_order(self) -> List[str]:
+        return ["gamma", "beta"]
+
+    def init_params(self, rng, dtype=jnp.float32) -> Params:
+        return {"gamma": jnp.ones((self.n_features,), dtype),
+                "beta": jnp.zeros((self.n_features,), dtype)}
+
+    def apply(self, params, x, *, state, train, rng, mask=None):
+        in_dtype = x.dtype
+        xs = x.astype(jnp.promote_types(in_dtype, jnp.float32))
+        mean = jnp.mean(xs, axis=-1, keepdims=True)
+        var = jnp.var(xs, axis=-1, keepdims=True)
+        xhat = (xs - mean) * jax.lax.rsqrt(var + self.eps)
+        out = params["gamma"] * xhat + params["beta"]
+        return out.astype(in_dtype), state

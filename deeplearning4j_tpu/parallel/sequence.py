@@ -37,11 +37,9 @@ def ring_attention_sharded(q, k, v, axis_name: str, *, causal: bool = False,
     my_idx = jax.lax.axis_index(axis_name)
     T_local = q.shape[2]
     q_offset = my_idx * T_local
+    # when unmasked, keep the 5-element carry: an all-ones mask would
+    # still be ppermuted every ring step (a dead ICI collective per layer)
     has_mask = kv_mask is not None
-    if not has_mask:
-        # keep the 5-element carry: an all-ones mask would still be
-        # ppermuted every ring step (a dead ICI collective per layer)
-        kv_mask = None
 
     def step(carry, i):
         if has_mask:

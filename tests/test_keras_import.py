@@ -286,3 +286,58 @@ def test_zero_padding1d_and_time_distributed_dense():
     y, _ = tdd.apply(p, x, state={}, train=False, rng=None)
     assert y.shape == (2, 5, 4)
     assert float(jnp.abs(y).max()) <= 1.0
+
+
+def test_layernorm_import_with_weights():
+    """LayerNormalization imports with its trained gamma/beta (review
+    r4: the weight branch must exist, not silently fall through)."""
+    path_dir = __import__("tempfile").mkdtemp()
+    path = f"{path_dir}/ln.h5"
+    F = 5
+    gamma = RNG.normal(size=(F,)).astype(np.float32) + 1.0
+    beta = RNG.normal(size=(F,)).astype(np.float32)
+    model_config = {
+        "class_name": "Sequential",
+        "config": {"layers": [
+            {"class_name": "LayerNormalization",
+             "config": {"name": "ln", "epsilon": 1e-5, "axis": -1,
+                        "batch_input_shape": [None, F]}},
+            {"class_name": "Dense",
+             "config": {"name": "fc", "units": 2,
+                        "activation": "softmax"}},
+        ]},
+    }
+    W = RNG.normal(size=(F, 2)).astype(np.float32)
+    b = np.zeros(2, np.float32)
+    with Hdf5Writer(path) as w:
+        w.write_attr_str("/", "model_config", json.dumps(model_config))
+        w.create_group("/model_weights")
+        for name, arrays in (("ln", {"gamma:0": gamma, "beta:0": beta}),
+                             ("fc", {"kernel:0": W, "bias:0": b})):
+            g = f"/model_weights/{name}"
+            w.create_group(g)
+            w.create_group(f"{g}/{name}")
+            for an, av in arrays.items():
+                w.write_dataset(f"{g}/{name}/{an}", av)
+            w.write_attr_strlist(g, "weight_names",
+                                 [f"{name}/{k}" for k in arrays])
+
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    np.testing.assert_allclose(np.asarray(net.params[0]["gamma"]), gamma,
+                               rtol=1e-6)
+    x = RNG.normal(size=(3, F)).astype(np.float32)
+    mu = x.mean(axis=-1, keepdims=True)
+    sd = np.sqrt(x.var(axis=-1, keepdims=True) + 1e-5)
+    h = gamma * (x - mu) / sd + beta
+    logits = h @ W + b
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               e / e.sum(axis=1, keepdims=True),
+                               rtol=1e-4, atol=1e-5)
+    # unsupported configs fail loudly
+    import pytest
+    from deeplearning4j_tpu.keras.keras_import import KerasLayerMapper
+    with pytest.raises(ValueError, match="axis"):
+        KerasLayerMapper.map("LayerNormalization", {"axis": 1})
+    with pytest.raises(ValueError, match="scale"):
+        KerasLayerMapper.map("LayerNormalization", {"scale": False})
