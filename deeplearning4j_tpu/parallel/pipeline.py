@@ -107,22 +107,29 @@ def _make_ring(mesh: Mesh, axis: str, dp_axis: Optional[str], S: int,
     pipe(param_bufs [S, Pmax], state_bufs [S, Smax], xs [M, B_mb, Amax])
     -> (outputs [M, B_mb, Amax], new_state_bufs [S, Smax]).
 
-    Each branch is branch(pflat, sflat, xbuf) -> (ybuf, sflat_new).
-    State updates apply only on REAL ticks (stage s works on genuine
-    microbatches at ticks s <= t < s+M; fill/drain ticks process ring
-    garbage). Running-state rows pmean-sync over ``dp_axis`` after the
-    window."""
+    Each branch is branch(pflat, sflat, xbuf, key) -> (ybuf, sflat_new);
+    ``key`` is a per-(tick, stage[, dp shard]) PRNG key folded from the
+    step's base rng — the dropout stream. State updates apply only on
+    REAL ticks (stage s works on genuine microbatches at ticks
+    s <= t < s+M; fill/drain ticks process ring garbage). Running-state
+    rows pmean-sync over ``dp_axis`` after the window."""
 
-    def device_fn(bufs, sbufs, xs):
+    def device_fn(bufs, sbufs, xs, rng):
         pflat = bufs[0]
         sid = jax.lax.axis_index(axis)
         perm = [(j, (j + 1) % S) for j in range(S)]
+        key_base = jax.random.fold_in(rng, sid)
+        if dp_axis is not None:
+            # decorrelate dropout masks across dp shards
+            key_base = jax.random.fold_in(
+                key_base, jax.lax.axis_index(dp_axis))
 
         def tick(carry, t):
             held, outbuf, sflat = carry
             inject = jnp.where(t < M, t, 0)
             x_in = jnp.where(sid == 0, xs[inject], held)
-            y, sflat2 = jax.lax.switch(sid, branches, pflat, sflat, x_in)
+            y, sflat2 = jax.lax.switch(sid, branches, pflat, sflat, x_in,
+                                       jax.random.fold_in(key_base, t))
             real = jnp.logical_and(t >= sid, t < sid + M)
             sflat = jnp.where(real, sflat2, sflat)
             done_idx = t - (S - 1)
@@ -154,7 +161,7 @@ def _make_ring(mesh: Mesh, axis: str, dp_axis: Optional[str], S: int,
 
     batch_spec = P(None, dp_axis, None)
     return shard_map(device_fn, mesh=mesh,
-                     in_specs=(P(axis), P(axis), batch_spec),
+                     in_specs=(P(axis), P(axis), batch_spec, P()),
                      out_specs=(batch_spec, P(axis)))
 
 
@@ -197,8 +204,9 @@ class _RingFitMixin:
             self._b_mb = b_mb
         x = feats.reshape(self.M, b_mb, -1)
         xs = jnp.pad(x, ((0, 0), (0, 0), (0, self._amax - x.shape[-1])))
+        net._rng, step_rng = jax.random.split(net._rng)
         net.params, net.opt_state, net.states, loss = self._step(
-            net.params, net.opt_state, net.states, xs, labels)
+            net.params, net.opt_state, net.states, xs, labels, step_rng)
         net.last_batch_size = B
         net.score_value = loss
         net.iteration_count += 1
@@ -311,8 +319,11 @@ class PipelineTrainer(_RingFitMixin):
     running averages pmean-synced over 'dp' after the window), so they
     match the single-device step exactly only when n_microbatches == 1.
 
-    Out of scope: RNN carries and active dropout are rejected at
-    construction (carry/rng threading through the ring is future work).
+    Dropout runs inside the ring: each tick's switch branch receives a
+    PRNG key folded from the step rng by (stage, tick[, dp shard]), so
+    masks differ per microbatch/stage/shard and a fixed seed reproduces.
+    Out of scope: RNN carries are rejected at construction (carry
+    threading through the ring is future work).
     """
 
     def __init__(self, net, mesh: Optional[Mesh] = None, axis: str = "pp",
@@ -359,10 +370,6 @@ class PipelineTrainer(_RingFitMixin):
                 raise ValueError(f"layer {i} ({type(l).__name__}) is "
                                  "recurrent — unsupported in the pipeline "
                                  "trainer v1")
-            d = l.dropout
-            if d is not None and 0.0 < d < 1.0:
-                raise ValueError(f"layer {i} has active dropout — "
-                                 "unsupported in the pipeline trainer v1")
         self.stages = ([list(s) for s in stages] if stages is not None
                        else partition_stages(body, net.params, self.S))
         if len(self.stages) != self.S:
@@ -407,9 +414,9 @@ class PipelineTrainer(_RingFitMixin):
         in_size = int(np.prod(in_shape[1:]))
         if not stage:
             # identity (pass-through) stage
-            return lambda pflat, sflat, xbuf: (xbuf, sflat)
+            return lambda pflat, sflat, xbuf, key: (xbuf, sflat)
 
-        def branch(pflat, sflat, xbuf):
+        def branch(pflat, sflat, xbuf, key):
             # unflatten this stage's params/states from padded segments
             p, s = {}, {}
             off = soff = 0
@@ -435,7 +442,8 @@ class PipelineTrainer(_RingFitMixin):
                     it = in_types[i] if in_types else None
                     h = conf.preprocessors[i].transform(h, it)
                 h, s_out = layer.apply(p[i], h, state=s[i],
-                                       train=not layer.frozen, rng=None,
+                                       train=not layer.frozen,
+                                       rng=jax.random.fold_in(key, i),
                                        mask=None)
                 new_s[i] = s[i] if layer.frozen else s_out
             y = h.reshape(h.shape[0], -1)
@@ -520,8 +528,8 @@ class PipelineTrainer(_RingFitMixin):
         head_pre_type = (net.conf.input_types[head_idx]
                          if net.conf.input_types else None)
 
-        def loss_of(params, sbuf, xs, labels):
-            outs, new_sbuf = pipe(pack_bufs(params), sbuf, xs)
+        def loss_of(params, sbuf, xs, labels, rng):
+            outs, new_sbuf = pipe(pack_bufs(params), sbuf, xs, rng)
             h = outs[..., :head_in_size].reshape(
                 (M * b_mb,) + head_in_shape[1:])
             if head_pre is not None:
@@ -532,10 +540,10 @@ class PipelineTrainer(_RingFitMixin):
                                           mask=None)
             return data_loss + l1_l2_penalty(params, net.layers), new_sbuf
 
-        def step(params, opt_state, states, xs, labels):
+        def step(params, opt_state, states, xs, labels, rng):
             sbuf = pack_states(states)
             (loss, new_sbuf), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(params, sbuf, xs, labels)
+                loss_of, has_aux=True)(params, sbuf, xs, labels, rng)
             new_params, new_opt = compute_updates(
                 tx, grads, opt_state, params, net.layers, training)
             return new_params, new_opt, unpack_states(new_sbuf), loss
@@ -640,10 +648,6 @@ class GraphPipelineTrainer(_RingFitMixin):
             if getattr(l, "supports_carry", False):
                 raise ValueError(f"layer node {name!r} is recurrent — "
                                  "unsupported in the graph pipeline v1")
-            d = l.dropout
-            if d is not None and 0.0 < d < 1.0:
-                raise ValueError(f"layer node {name!r} has active "
-                                 "dropout — unsupported")
         self.stages, self.boundaries = self._partition()
         self._step = None
 
@@ -709,11 +713,15 @@ class GraphPipelineTrainer(_RingFitMixin):
         net = self.net
         conf = net.conf
         in_shape_t = conf.resolved_types[b_in]
+        # deterministic per-node dropout-stream ids (Python's hash() is
+        # salted per process — it would break seed reproducibility and
+        # desync masks across multihost trace constants)
+        node_ix = {n: i for i, n in enumerate(net._layer_nodes)}
 
         if not stage:
-            return lambda pflat, sflat, xbuf: (xbuf, sflat)
+            return lambda pflat, sflat, xbuf, key: (xbuf, sflat)
 
-        def branch(pflat, sflat, xbuf):
+        def branch(pflat, sflat, xbuf, key):
             p, s = {}, {}
             off = soff = 0
             for name in stage:
@@ -747,9 +755,11 @@ class GraphPipelineTrainer(_RingFitMixin):
                     if node.preprocessor is not None:
                         h = node.preprocessor.transform(h, None)
                     layer = node.layer
-                    h, s_out = layer.apply(p[name], h, state=s[name],
-                                           train=not layer.frozen,
-                                           rng=None, mask=None)
+                    h, s_out = layer.apply(
+                        p[name], h, state=s[name],
+                        train=not layer.frozen,
+                        rng=jax.random.fold_in(key, node_ix[name]),
+                        mask=None)
                     new_s[name] = s[name] if layer.frozen else s_out
                     acts[name] = h
                 last = name
@@ -835,8 +845,8 @@ class GraphPipelineTrainer(_RingFitMixin):
         head = head_node.layer
         layer_list = [conf.nodes[n].layer for n in net._layer_nodes]
 
-        def loss_of(params, sbuf, xs, labels):
-            outs, new_sbuf = pipe(pack_bufs(params), sbuf, xs)
+        def loss_of(params, sbuf, xs, labels, rng):
+            outs, new_sbuf = pipe(pack_bufs(params), sbuf, xs, rng)
             h = outs[..., :head_in_size].reshape(
                 (M * b_mb,) + head_in_shape[1:])
             if head_node.preprocessor is not None:
@@ -849,10 +859,10 @@ class GraphPipelineTrainer(_RingFitMixin):
                                 layer_list)
             return data_loss + reg, new_sbuf
 
-        def step(params, opt_state, states, xs, labels):
+        def step(params, opt_state, states, xs, labels, rng):
             sbuf = pack_states(states)
             (loss, new_sbuf), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(params, sbuf, xs, labels)
+                loss_of, has_aux=True)(params, sbuf, xs, labels, rng)
             new_params, new_opt = compute_updates(
                 tx, grads, opt_state, params, layer_list, training)
             return new_params, new_opt, unpack_states(new_sbuf), loss

@@ -155,3 +155,58 @@ def test_graph_pipeline_epoch_hooks_fire():
     trainer.fit(ListDataSetIterator([_small_batch(b=4)]), epochs=2)
     assert events == ["start", "iter", "end", "start", "iter", "end"]
     assert net.epoch_count == 2
+
+
+def test_graph_pipeline_dropout_cross_process_deterministic():
+    """Dropout keys must fold deterministic node indices, not salted
+    hash(name): the same seed reproduces the same loss in a DIFFERENT
+    python process with a different PYTHONHASHSEED (review r4)."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    prog = textwrap.dedent("""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+        import numpy as np
+        from jax.sharding import Mesh
+        from deeplearning4j_tpu import InputType, NeuralNetConfiguration
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.layers import (DenseLayer, DropoutLayer,
+                                                  OutputLayer)
+        from deeplearning4j_tpu.parallel.pipeline import GraphPipelineTrainer
+        b = (NeuralNetConfiguration.builder().seed(9)
+             .updater("sgd", learning_rate=0.05).weight_init("xavier")
+             .graph_builder().add_inputs("in"))
+        b.add_layer("d1", DenseLayer(n_out=12, activation="relu",
+                                     dropout=0.7), "in")
+        b.add_layer("drop", DropoutLayer(dropout=0.5), "d1")
+        b.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                       loss="mcxent"), "drop")
+        net = ComputationGraph(
+            b.set_outputs("out")
+            .set_input_types(InputType.feed_forward(6)).build()).init()
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2),
+                    axis_names=("pp",))
+        t = GraphPipelineTrainer(net, mesh=mesh, n_microbatches=2)
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(8, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        losses = [float(t.fit_batch(DataSet(x, y))) for _ in range(3)]
+        print("LOSSES", ",".join(f"{l:.8f}" for l in losses))
+    """)
+
+    def run(hash_seed):
+        env = dict(os.environ, PYTHONHASHSEED=hash_seed,
+                   JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.path.dirname(os.path.dirname(__file__)))
+        out = subprocess.run([sys.executable, "-c", prog], env=env,
+                             capture_output=True, text=True, timeout=420)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return [l for l in out.stdout.splitlines()
+                if l.startswith("LOSSES")][0]
+
+    assert run("1") == run("2")
