@@ -24,7 +24,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Sequence
 
 from deeplearning4j_tpu.nlp.tokenization import (
-    CollectionSentenceIterator, DefaultTokenizerFactory, _Tokenizer,
+    CollectionSentenceIterator, _Tokenizer,
 )
 
 # ---------------------------------------------------------------------------

@@ -11,7 +11,7 @@ for inflected forms.
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 from deeplearning4j_tpu.nlp.annotators import porter_stem
 

@@ -13,13 +13,11 @@ exactly composable.
 
 from __future__ import annotations
 
-import functools
-import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from deeplearning4j_tpu.nn.layers.attention import (
     NEG_INF, blockwise_attention, finalize_attention,

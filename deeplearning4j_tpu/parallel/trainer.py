@@ -15,8 +15,7 @@ updater-state averaging becomes a no-op (state is replicated & consistent)
 
 from __future__ import annotations
 
-import time
-from typing import Any, List, Optional, Union
+from typing import Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -24,9 +23,8 @@ import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator, DataSetIterator
-from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn.netcommon import ScanFitMixin, make_scan_fit
-from deeplearning4j_tpu.nn.updater import compute_updates, l1_l2_penalty
+from deeplearning4j_tpu.nn.updater import compute_updates
 from deeplearning4j_tpu.parallel.mesh import (
     MeshContext, sequence_parallel_scope,
 )
