@@ -133,8 +133,21 @@ def _bank_record(rec: dict, amend: bool = False) -> None:
     if rec.get("value"):
         data.setdefault("baselines", {}).setdefault(rec["metric"],
                                                     rec["value"])
+    # records[] keeps the BEST value per metric. Direction comes from the
+    # record itself (rec["direction"]: "max"|"min"); default "max" because
+    # every current banked metric is a throughput. A lower-is-better metric
+    # (step_ms, latency) MUST set direction="min" or it would bank
+    # regressions as best.
     cur = data.setdefault("records", {}).get(rec["metric"])
-    if cur is None or rec.get("value", 0) >= cur.get("value", 0):
+    direction = rec.get("direction") or (cur or {}).get("direction", "max")
+    if cur is None:
+        better = True
+    elif direction == "min":
+        better = rec.get("value", float("inf")) <= cur.get("value",
+                                                           float("inf"))
+    else:
+        better = rec.get("value", 0) >= cur.get("value", 0)
+    if better:
         data["records"][rec["metric"]] = rec
     tmp = _BANK_PATH + ".tmp"
     with open(tmp, "w") as f:

@@ -219,9 +219,7 @@ class _RingFitMixin:
         from deeplearning4j_tpu.optimize.listeners import TrainingListener
         net = self.net
         if isinstance(data, DataSet):
-            for _ in range(epochs):
-                self.fit_batch(data)
-            return self
+            data = [data]
         for _ in range(epochs):
             for listener in net.listeners:
                 if isinstance(listener, TrainingListener):
@@ -406,7 +404,8 @@ class PipelineTrainer(_RingFitMixin):
                      seg_shapes, state_shapes, smax: int):
         """One lax.switch branch: unpack this stage's flat param segment,
         flat state segment, and activation buffer, run its layers exactly
-        as MLN._forward does (minus carry/dropout, rejected at init),
+        as MLN._forward does (carry layers are rejected at init; dropout
+        runs in-ring with per-stage/tick/dp-shard folded RNG keys),
         repack both. The batch dim reshapes with -1: under dp×pp the
         local batch is the global microbatch divided by the dp size."""
         net = self.net
@@ -593,8 +592,9 @@ class GraphPipelineTrainer(_RingFitMixin):
     loss head and compute_updates reuse the graph's single-device code.
 
     v1 scope: one network input, one output (loss head), no masks, no
-    RNN/carry vertices (LastTimeStep / DuplicateToTimeSeries), no active
-    dropout, no aux-loss layers.
+    RNN/carry vertices (LastTimeStep / DuplicateToTimeSeries), no
+    aux-loss layers. Dropout runs in-ring (per-stage/tick/dp-shard
+    folded RNG keys), as in PipelineTrainer.
     """
 
     def __init__(self, net, mesh: Optional[Mesh] = None, axis: str = "pp",
