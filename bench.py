@@ -148,7 +148,9 @@ def _bank_record(rec: dict, amend: bool = False) -> None:
     else:
         better = rec.get("value", 0) >= cur.get("value", 0)
     if better:
-        data["records"][rec["metric"]] = rec
+        # persist the resolved direction so a later direction-less call
+        # can't flip a min-metric back to max-is-better
+        data["records"][rec["metric"]] = dict(rec, direction=direction)
     tmp = _BANK_PATH + ".tmp"
     with open(tmp, "w") as f:
         json.dump(data, f, indent=1, sort_keys=True)

@@ -178,6 +178,20 @@ class MultiLayerConfiguration:
     def from_json(s: str) -> "MultiLayerConfiguration":
         return MultiLayerConfiguration.from_dict(json.loads(s))
 
+    def to_yaml(self) -> str:
+        """YAML twin of ``to_json`` (the reference serializes configs to
+        both JSON and YAML — ref: nn/conf/MultiLayerConfiguration.java
+        toYaml/fromYaml alongside toJson). The dict is normalized through
+        JSON first so the YAML document is the exact same data JSON
+        carries (tuples → lists, keys → strings)."""
+        import yaml
+        return yaml.safe_dump(json.loads(self.to_json()), sort_keys=False)
+
+    @staticmethod
+    def from_yaml(s: str) -> "MultiLayerConfiguration":
+        import yaml
+        return MultiLayerConfiguration.from_dict(yaml.safe_load(s))
+
 
 def validate_layer_options(layers) -> None:
     """Fail at config-build time (not first forward) on unknown

@@ -98,6 +98,19 @@ class ComputationGraphConfiguration:
     def from_json(s: str) -> "ComputationGraphConfiguration":
         return ComputationGraphConfiguration.from_dict(json.loads(s))
 
+    def to_yaml(self) -> str:
+        """YAML twin of ``to_json`` (ref: ComputationGraphConfiguration
+        toYaml/fromYaml mirror NeuralNetConfiguration.java:283-360). The
+        dict is normalized through JSON first so both formats carry the
+        exact same data."""
+        import yaml
+        return yaml.safe_dump(json.loads(self.to_json()), sort_keys=False)
+
+    @staticmethod
+    def from_yaml(s: str) -> "ComputationGraphConfiguration":
+        import yaml
+        return ComputationGraphConfiguration.from_dict(yaml.safe_load(s))
+
     # ------------------------------------------------------------ shape pass
     def _topo_sort(self) -> List[str]:
         """Kahn's algorithm (ref: ComputationGraph.topologicalSortOrder:888)."""

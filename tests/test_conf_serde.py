@@ -182,3 +182,59 @@ def test_gradient_checkpointing_same_result():
     # round-trips through JSON too
     conf2 = MultiLayerConfiguration.from_json(b.conf.to_json())
     assert conf2.training.remat is True
+
+
+# ---------------------------------------------------------------------------
+# YAML round-trip (the reference serializes configs to BOTH JSON and YAML:
+# NeuralNetConfiguration.java:283-360 toYaml/fromYaml)
+# ---------------------------------------------------------------------------
+
+def test_yaml_round_trip_mlp():
+    conf = _mlp_conf()
+    y = conf.to_yaml()
+    conf2 = MultiLayerConfiguration.from_yaml(y)
+    # YAML and JSON must carry the exact same data
+    assert conf2.to_json() == conf.to_json()
+    assert conf2.to_yaml() == y
+    assert conf2.training.updater.name == "adam"
+    assert conf2.training.updater.learning_rate == 1e-3
+
+
+def test_yaml_round_trip_cnn_with_preprocessor():
+    conf = (NeuralNetConfiguration.builder()
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3)))
+            .layer(DenseLayer(n_out=10))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build())
+    conf2 = MultiLayerConfiguration.from_yaml(conf.to_yaml())
+    assert conf2.to_json() == conf.to_json()
+    # int-keyed preprocessor dict survives the YAML trip
+    assert 1 in conf2.preprocessors
+    assert type(conf2.preprocessors[1]).__name__ == "CnnToFeedForwardPreProcessor"
+
+
+def test_yaml_restored_conf_builds_working_net():
+    conf = _mlp_conf()
+    net = MultiLayerNetwork(MultiLayerConfiguration.from_yaml(conf.to_yaml())).init()
+    out = net.output(np.random.default_rng(0).normal(size=(5, 8)).astype(np.float32))
+    assert out.shape == (5, 3)
+
+
+def test_yaml_round_trip_graph():
+    from deeplearning4j_tpu.nn.conf.graph_builder import (
+        ComputationGraphConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer as D
+    conf = (NeuralNetConfiguration.builder()
+            .seed(9).updater("adam", learning_rate=0.05)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", D(n_out=16, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax"), "d1")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    conf2 = ComputationGraphConfiguration.from_yaml(conf.to_yaml())
+    assert conf2.to_json() == conf.to_json()
+    assert conf2.topological_order == conf.topological_order
