@@ -45,8 +45,10 @@ class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
     def __init__(self, config: EarlyStoppingConfiguration, net,
                  train_data: DataSetIterator,
                  mesh: Optional[MeshContext] = None,
-                 gradient_accumulation: int = 1):
-        trainer = ParallelTrainer(net, mesh,
-                                  gradient_accumulation=gradient_accumulation)
+                 gradient_accumulation: int = 1,
+                 collect_training_stats: bool = False):
+        trainer = ParallelTrainer(
+            net, mesh, gradient_accumulation=gradient_accumulation,
+            collect_training_stats=collect_training_stats)
         super().__init__(config, _ParallelNetAdapter(trainer), train_data)
         self.trainer = trainer

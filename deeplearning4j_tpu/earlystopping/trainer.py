@@ -65,12 +65,20 @@ class EarlyStoppingTrainer:
                 else:
                     score = net.score_value
                 score_vs_epoch[epoch] = score
+                # per-phase telemetry when driven by a stats-collecting
+                # ParallelTrainer (checkpoint = saver/serializer time)
+                from deeplearning4j_tpu.optimize.training_stats import (
+                    maybe_phase)
+                stats = getattr(getattr(net, "_trainer", None),
+                                "training_stats", None)
                 if best_score is None or score < best_score:
                     best_score = score
                     best_epoch = epoch
-                    cfg.model_saver.save_best_model(net, score)
+                    with maybe_phase(stats, "checkpoint"):
+                        cfg.model_saver.save_best_model(net, score)
                 if cfg.save_last_model:
-                    cfg.model_saver.save_latest_model(net, score)
+                    with maybe_phase(stats, "checkpoint"):
+                        cfg.model_saver.save_latest_model(net, score)
             if self.listener is not None:
                 self.listener.on_epoch(
                     epoch, score_vs_epoch.get(epoch, net.score_value),

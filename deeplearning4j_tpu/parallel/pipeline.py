@@ -26,6 +26,7 @@ TrainingMaster.java:29 — the strategy seam this plugs into).
 
 from __future__ import annotations
 
+import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
@@ -169,7 +170,10 @@ class _RingFitMixin:
     """fit_batch/fit shared by the MLN and graph pipeline trainers (the
     jitted step signature and all bookkeeping are identical; only stage
     construction differs). Subclasses provide ``_build_step(b_mb)``
-    setting ``self._amax``, and the attrs net/M/mesh/dp_axis."""
+    setting ``self._amax``, and the attrs net/M/mesh/dp_axis; they may
+    set ``training_stats`` (a TrainingStats) for per-phase telemetry."""
+
+    training_stats = None
 
     def fit_batch(self, batch: DataSet) -> float:
         net = self.net
@@ -202,17 +206,29 @@ class _RingFitMixin:
         if self._step is None or getattr(self, "_b_mb", None) != b_mb:
             self._step = self._build_step(b_mb)
             self._b_mb = b_mb
+        stats = self.training_stats
+        t_shard = time.perf_counter() if stats else 0.0
         x = feats.reshape(self.M, b_mb, -1)
         xs = jnp.pad(x, ((0, 0), (0, 0), (0, self._amax - x.shape[-1])))
+        if stats:
+            jax.block_until_ready(xs)
+            stats.record("shard", time.perf_counter() - t_shard)
+            t_step = time.perf_counter()
         net._rng, step_rng = jax.random.split(net._rng)
         net.params, net.opt_state, net.states, loss = self._step(
             net.params, net.opt_state, net.states, xs, labels, step_rng)
+        if stats:
+            jax.block_until_ready(loss)
+            stats.record("step", time.perf_counter() - t_step)
         net.last_batch_size = B
         net.score_value = loss
         net.iteration_count += 1
+        t_l = time.perf_counter() if stats else 0.0
         for listener in net.listeners:
             listener.iteration_done(net, net.iteration_count,
                                     net.score_value)
+        if stats:
+            stats.record("listener", time.perf_counter() - t_l)
         return net._score_raw
 
     def fit(self, data, epochs: int = 1):
@@ -220,11 +236,13 @@ class _RingFitMixin:
         net = self.net
         if isinstance(data, DataSet):
             data = [data]
+        stats = self.training_stats
         for _ in range(epochs):
             for listener in net.listeners:
                 if isinstance(listener, TrainingListener):
                     listener.on_epoch_start(net)
-            for batch in data:
+            src = stats.timed_iter(data) if stats else data
+            for batch in src:
                 self.fit_batch(batch)
             net.epoch_count += 1
             for listener in net.listeners:
@@ -326,8 +344,12 @@ class PipelineTrainer(_RingFitMixin):
 
     def __init__(self, net, mesh: Optional[Mesh] = None, axis: str = "pp",
                  n_microbatches: Optional[int] = None,
-                 stages: Optional[Sequence[Sequence[int]]] = None):
+                 stages: Optional[Sequence[Sequence[int]]] = None,
+                 collect_training_stats: bool = False):
+        from deeplearning4j_tpu.optimize.training_stats import TrainingStats
         from deeplearning4j_tpu.parallel.mesh import MeshContext
+        if collect_training_stats:
+            self.training_stats = TrainingStats()
         if isinstance(mesh, MeshContext):
             mesh = mesh.mesh
         if mesh is None:
@@ -598,10 +620,14 @@ class GraphPipelineTrainer(_RingFitMixin):
     """
 
     def __init__(self, net, mesh: Optional[Mesh] = None, axis: str = "pp",
-                 n_microbatches: Optional[int] = None):
+                 n_microbatches: Optional[int] = None,
+                 collect_training_stats: bool = False):
         from deeplearning4j_tpu.nn.conf.graph import (
             DuplicateToTimeSeriesVertex, LastTimeStepVertex)
+        from deeplearning4j_tpu.optimize.training_stats import TrainingStats
         from deeplearning4j_tpu.parallel.mesh import MeshContext
+        if collect_training_stats:
+            self.training_stats = TrainingStats()
         if isinstance(mesh, MeshContext):
             mesh = mesh.mesh
         if mesh is None:

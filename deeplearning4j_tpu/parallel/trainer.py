@@ -15,6 +15,7 @@ updater-state averaging becomes a no-op (state is replicated & consistent)
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Union
 
 import jax
@@ -25,6 +26,9 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator, DataSetIterator
 from deeplearning4j_tpu.nn.netcommon import ScanFitMixin, make_scan_fit
 from deeplearning4j_tpu.nn.updater import compute_updates
+from deeplearning4j_tpu.optimize.training_stats import (
+    TrainingStats, maybe_phase,
+)
 from deeplearning4j_tpu.parallel.mesh import (
     MeshContext, sequence_parallel_scope,
 )
@@ -41,12 +45,18 @@ class ParallelTrainer:
 
     def __init__(self, net, mesh: Optional[MeshContext] = None,
                  gradient_accumulation: int = 1,
-                 donate_params: bool = True):
+                 donate_params: bool = True,
+                 collect_training_stats: bool = False):
         self.net = net
         self.mesh = mesh or MeshContext.create()
         self.gradient_accumulation = max(1, gradient_accumulation)
         self._step = None
         self._donate = donate_params
+        # per-phase telemetry, ref ParameterAveragingTrainingMasterStats
+        # (Spark tier's collectTrainingStats flag). Syncs the device every
+        # step when on — accurate step timing is not free.
+        self.training_stats = (TrainingStats()
+                               if collect_training_stats else None)
         net._check_init()
         self._is_graph = not hasattr(net, "layers")
         self._layers = (
@@ -132,6 +142,8 @@ class ParallelTrainer:
         if self._step is None:
             self._step = self._build_step()
         net = self.net
+        stats = self.training_stats
+        t_shard = time.perf_counter() if stats else 0.0
         if self._is_graph:
             # name-keyed dicts (DataSet or MultiDataSet), every leaf
             # sharded over the data axis
@@ -150,6 +162,13 @@ class ParallelTrainer:
             if batch.labels_mask is not None:
                 lmask = self.mesh.shard_batch(
                     jnp.asarray(batch.labels_mask))
+        if stats:
+            # sync the async device_put so transfer time lands in 'shard',
+            # not 'step' — over a remote tunnel that distinction is the
+            # whole point of the phase
+            jax.block_until_ready((feats, labels))
+            stats.record("shard", time.perf_counter() - t_shard)
+            t_step = time.perf_counter()
         net._rng, step_rng = jax.random.split(net._rng)
         # the scope routes SelfAttentionLayer through ring attention over
         # the mesh's 'sp' axis at trace time (no-op without one)
@@ -157,14 +176,19 @@ class ParallelTrainer:
             net.params, net.opt_state, net.states, loss = self._step(
                 net.params, net.opt_state, net.states, feats, labels, fmask,
                 lmask, step_rng)
+        if stats:
+            jax.block_until_ready(loss)
+            stats.record("step", time.perf_counter() - t_step)
         net.last_batch_size = batch.num_examples()
         net.last_grads = None  # SPMD step doesn't collect gradients
         # raw device scalar: converting here would sync the SPMD pipeline
         # every step (see MultiLayerNetwork.score_value)
         net.score_value = loss
         net.iteration_count += 1
-        for listener in net.listeners:
-            listener.iteration_done(net, net.iteration_count, net.score_value)
+        with maybe_phase(stats, "listener"):
+            for listener in net.listeners:
+                listener.iteration_done(net, net.iteration_count,
+                                        net.score_value)
         return net._score_raw
 
     def fit(self, data: Union[DataSet, DataSetIterator], epochs: int = 1,
@@ -177,13 +201,15 @@ class ParallelTrainer:
             return self
         it = (AsyncDataSetIterator(data)
               if use_async and data.async_supported() else data)
+        stats = self.training_stats
         for _ in range(epochs):
+            src = stats.timed_iter(it) if stats else it
             if scan_window > 1:
                 # reuse the containers' windowing loop (only needs
                 # fit_batches_scan / fit_batch from self)
-                ScanFitMixin._fit_epoch_scan(self, it, scan_window)
+                ScanFitMixin._fit_epoch_scan(self, src, scan_window)
             else:
-                for batch in it:
+                for batch in src:
                     self.fit_batch(batch)
             self.net.epoch_count += 1
         return self
@@ -233,21 +259,33 @@ class ParallelTrainer:
             spec = P(None, *batch_spec)
             return jax.device_put(stacked, NamedSharding(mesh, spec))
 
+        stats = self.training_stats
+        t_shard = time.perf_counter() if stats else 0.0
         feats = place([b.features for b in batches])
         labels = place([b.labels for b in batches])
+        if stats:
+            jax.block_until_ready((feats, labels))
+            stats.record("shard", time.perf_counter() - t_shard)
+            t_step = time.perf_counter()
         net._rng, r = jax.random.split(net._rng)
         with sequence_parallel_scope(self.mesh):
             net.params, net.opt_state, net.states, losses = scan_fn(
                 net.params, net.opt_state, net.states, feats, labels, r)
+        if stats:
+            jax.block_until_ready(losses)
+            stats.record("step", time.perf_counter() - t_step)
         net.last_batch_size = batches[-1].num_examples()
         net.last_grads = None
         if net.listeners:
+            t_l = time.perf_counter() if stats else 0.0
             for i, _ in enumerate(batches):
                 net.iteration_count += 1
                 net.score_value = float(losses[i])
                 for listener in net.listeners:
                     listener.iteration_done(net, net.iteration_count,
                                             net.score_value)
+            if stats:
+                stats.record("listener", time.perf_counter() - t_l)
         else:
             net.iteration_count += len(batches)
         net.score_value = losses[-1]
