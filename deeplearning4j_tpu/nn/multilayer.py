@@ -475,7 +475,15 @@ class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin):
         jitted multi-step program (``fit_batches_scan``) — dispatch-free
         training windows, the idiomatic TPU loop shape; short tail
         windows fall back to per-batch steps (a different window length
-        would recompile)."""
+        would recompile).
+
+        Listener cadence under scan windows: iteration events fire in a
+        post-window burst, one per scanned step with that step's loss;
+        ``model.last_scan_window`` carries {n, wall_s} during the burst
+        so time-based listeners (PerformanceListener) amortize the
+        window wall time per step. Gradient-collecting listeners force
+        the per-batch fallback (per-step gradients never materialize on
+        the host inside a scanned window)."""
         self._check_init()
         if labels is not None:
             data = DataSet(np.asarray(data), np.asarray(labels))

@@ -108,6 +108,33 @@ def make_pretrain_step(layer, tx):
     return jax.jit(step)
 
 
+def emit_scan_burst(net, losses, n, t0, stats=None):
+    """Post-window listener burst shared by the containers and
+    ParallelTrainer: one iteration event per scanned step with that
+    step's loss. ``net.last_scan_window`` carries {n, wall_s} for the
+    duration of the burst so time-based listeners (PerformanceListener)
+    amortize the window wall time per step instead of misreading the
+    burst cadence; try/finally guarantees a raising listener can't leave
+    the stale window dict behind."""
+    import time as _time
+    jax.block_until_ready(losses)
+    net.last_scan_window = {"n": n, "wall_s": _time.perf_counter() - t0}
+    t_l = _time.perf_counter()
+    try:
+        for i in range(n):
+            net.iteration_count += 1
+            # listeners reading model.score_value must see THIS
+            # iteration's loss, not the window's final one
+            net.score_value = float(losses[i])
+            for listener in net.listeners:
+                listener.iteration_done(net, net.iteration_count,
+                                        net.score_value)
+    finally:
+        net.last_scan_window = None
+    if stats:
+        stats.record("listener", _time.perf_counter() - t_l)
+
+
 def make_scan_fit(step_fn, donate_argnums=(0, 1, 2)):
     """Multi-step training as ONE jitted program: ``lax.scan`` of the
     container's train step over a leading batch axis.
@@ -223,6 +250,8 @@ class ScanFitMixin:
             feats = jnp.stack([jnp.asarray(d.features) for d in datasets])
             labels = jnp.stack([jnp.asarray(d.labels) for d in datasets])
 
+        import time as _time
+        t0 = _time.perf_counter()
         self._rng, r = jax.random.split(self._rng)
         self.params, self.opt_state, self.states, losses = scan_fn(
             self.params, self.opt_state, self.states, feats, labels, r)
@@ -230,14 +259,7 @@ class ScanFitMixin:
         self.last_grads = None
         self.last_input = getattr(datasets[-1], "features", None)
         if self.listeners:
-            for i, _ in enumerate(datasets):
-                self.iteration_count += 1
-                # listeners reading model.score_value must see THIS
-                # iteration's loss, not the window's final one
-                self.score_value = float(losses[i])
-                for listener in self.listeners:
-                    listener.iteration_done(self, self.iteration_count,
-                                            self.score_value)
+            emit_scan_burst(self, losses, len(datasets), t0)
         else:
             self.iteration_count += len(datasets)
         self.score_value = losses[-1]

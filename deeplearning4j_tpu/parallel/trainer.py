@@ -24,7 +24,9 @@ import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator, DataSetIterator
-from deeplearning4j_tpu.nn.netcommon import ScanFitMixin, make_scan_fit
+from deeplearning4j_tpu.nn.netcommon import (
+    ScanFitMixin, emit_scan_burst, make_scan_fit,
+)
 from deeplearning4j_tpu.nn.updater import compute_updates
 from deeplearning4j_tpu.optimize.training_stats import (
     TrainingStats, maybe_phase,
@@ -267,6 +269,7 @@ class ParallelTrainer:
             jax.block_until_ready((feats, labels))
             stats.record("shard", time.perf_counter() - t_shard)
             t_step = time.perf_counter()
+        t0 = time.perf_counter()
         net._rng, r = jax.random.split(net._rng)
         with sequence_parallel_scope(self.mesh):
             net.params, net.opt_state, net.states, losses = scan_fn(
@@ -277,15 +280,7 @@ class ParallelTrainer:
         net.last_batch_size = batches[-1].num_examples()
         net.last_grads = None
         if net.listeners:
-            t_l = time.perf_counter() if stats else 0.0
-            for i, _ in enumerate(batches):
-                net.iteration_count += 1
-                net.score_value = float(losses[i])
-                for listener in net.listeners:
-                    listener.iteration_done(net, net.iteration_count,
-                                            net.score_value)
-            if stats:
-                stats.record("listener", time.perf_counter() - t_l)
+            emit_scan_burst(net, losses, len(batches), t0, stats=stats)
         else:
             net.iteration_count += len(batches)
         net.score_value = losses[-1]

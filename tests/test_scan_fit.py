@@ -176,3 +176,45 @@ def test_fit_scan_window_ragged_tail_batch():
     batches = _batches(3) + [_batches(1, b=3)[0]]  # 8,8,8,3 examples
     net.fit(ListDataSetIterator(batches), epochs=1, scan_window=2)
     assert net.iteration_count == 4
+
+
+def test_performance_listener_amortizes_scan_window():
+    """Scan windows fire listener events in a post-window burst; the
+    PerformanceListener must report per-step throughput amortized over
+    the window wall time, not the burst cadence (which would read as one
+    slow step then near-infinite ones)."""
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.optimize.listeners import PerformanceListener
+    net = MultiLayerNetwork(_conf()).init()
+    pl = PerformanceListener(frequency=1)
+    net.set_listeners(pl)
+    net.fit(ListDataSetIterator(_batches(8)), scan_window=4,
+            use_async=False)
+    assert len(pl.history) == 8
+    sps = [h[1] for h in pl.history]
+    assert all(np.isfinite(s) and s > 0 for s in sps), sps
+    # all events of one window amortize to the same per-step rate
+    first_window = sps[:4]
+    assert max(first_window) / min(first_window) < 1.001, sps
+    assert net.last_scan_window is None
+
+
+def test_performance_listener_frequency_not_inflated():
+    """frequency>1 must not inflate throughput: _last_time advances on
+    every event, so the measured span is one iteration regardless of the
+    reporting cadence (reproduced 5x inflation before the fix)."""
+    import time as _time
+    from deeplearning4j_tpu.optimize.listeners import PerformanceListener
+
+    class _Model:
+        last_batch_size = 10
+        last_scan_window = None
+
+    pl = PerformanceListener(frequency=5)
+    for it in range(1, 11):
+        _time.sleep(0.01)
+        pl.iteration_done(_Model(), it, 0.0)
+    assert len(pl.history) == 2
+    for _, sps, bps in pl.history:
+        assert 500 <= sps <= 1100, sps   # true rate ~1000/s, never ~5000
+        assert 50 <= bps <= 110, bps
