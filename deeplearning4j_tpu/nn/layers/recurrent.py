@@ -61,6 +61,19 @@ def _lstm_cell(params: Params, x_t: Array, h: Array, c: Array,
     return h_new, c_new
 
 
+def _carry_like(carry, x):
+    """Make the initial carry inherit ``x``'s varying mesh axes. Inside
+    ``shard_map`` (the pipeline trainers) a plain-zeros init is unvaried
+    while the scan body's outputs derive from the sharded batch, and
+    ``lax.scan`` rejects the type mismatch; adding a zero-weighted slice
+    of x is a numerical no-op that fixes the types, and folds away
+    entirely outside shard_map."""
+    z = (x[:, 0, :1] * 0)
+    return jax.tree.map(lambda c: c + z.astype(c.dtype)
+                        if getattr(c, "ndim", 0) == 2
+                        and c.shape[0] == x.shape[0] else c, carry)
+
+
 @register_layer
 @dataclass
 class LSTM(BaseLayerConf):
@@ -142,6 +155,7 @@ class LSTM(BaseLayerConf):
     def scan(self, params: Params, x: Array, carry, mask: Optional[Array],
              reverse: bool = False):
         """Run the full sequence [B, T, F] -> ([B, T, H], final_carry)."""
+        carry = _carry_like(carry, x)
         if self._fused_kernel_ok(mask, batch=x.shape[0]):
             from deeplearning4j_tpu.ops.pallas_kernels import (
                 fused_lstm, lstm_mode)
@@ -261,6 +275,7 @@ class SimpleRnn(BaseLayerConf):
     def scan(self, params, x, carry, mask: Optional[Array] = None,
              reverse: bool = False):
         act = get_activation(self.activation or "tanh")
+        carry = _carry_like(carry, x)
 
         def body(h, inp):
             if mask is None:
@@ -352,6 +367,8 @@ class GRU(BaseLayerConf):
 
     def scan(self, params, x, carry, mask: Optional[Array] = None,
              reverse: bool = False):
+        carry = _carry_like(carry, x)
+
         def body(h, inp):
             if mask is None:
                 h2 = self._cell(params, inp, h)

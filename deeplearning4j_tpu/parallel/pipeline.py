@@ -105,17 +105,22 @@ def _make_ring(mesh: Mesh, axis: str, dp_axis: Optional[str], S: int,
                M: int, branches):
     """The GPipe ring schedule as a shard_map callable shared by the MLN
     and graph pipeline trainers:
-    pipe(param_bufs [S, Pmax], state_bufs [S, Smax], xs [M, B_mb, Amax])
-    -> (outputs [M, B_mb, Amax], new_state_bufs [S, Smax]).
+    pipe(param_bufs [S, Pmax], state_bufs [S, Smax], carry_bufs [S, Cmax],
+    xs [M, B_mb, Amax]) -> (outputs [M, B_mb, Amax],
+    new_state_bufs [S, Smax], new_carry_bufs [S, Cmax]).
 
-    Each branch is branch(pflat, sflat, xbuf, key) -> (ybuf, sflat_new);
-    ``key`` is a per-(tick, stage[, dp shard]) PRNG key folded from the
-    step's base rng — the dropout stream. State updates apply only on
+    Each branch is branch(pflat, sflat, cflat, xbuf, key, m) ->
+    (ybuf, sflat_new, cflat_new); ``key`` is a per-(tick, stage[, dp
+    shard]) PRNG key folded from the step's base rng — the dropout
+    stream — and ``m`` the microbatch index the tick processes (carry
+    segments are per-microbatch slices). State updates apply only on
     REAL ticks (stage s works on genuine microbatches at ticks
     s <= t < s+M; fill/drain ticks process ring garbage). Running-state
-    rows pmean-sync over ``dp_axis`` after the window."""
+    rows pmean-sync over ``dp_axis`` after the window; carry rows do NOT
+    (tBPTT carries are per-batch-row, never averaged — the trainers
+    reject dp meshes when carries are live)."""
 
-    def device_fn(bufs, sbufs, xs, rng):
+    def device_fn(bufs, sbufs, cbufs, xs, rng):
         pflat = bufs[0]
         sid = jax.lax.axis_index(axis)
         perm = [(j, (j + 1) % S) for j in range(S)]
@@ -126,13 +131,16 @@ def _make_ring(mesh: Mesh, axis: str, dp_axis: Optional[str], S: int,
                 key_base, jax.lax.axis_index(dp_axis))
 
         def tick(carry, t):
-            held, outbuf, sflat = carry
+            held, outbuf, sflat, cflat = carry
             inject = jnp.where(t < M, t, 0)
             x_in = jnp.where(sid == 0, xs[inject], held)
-            y, sflat2 = jax.lax.switch(sid, branches, pflat, sflat, x_in,
-                                       jax.random.fold_in(key_base, t))
+            m = jnp.clip(t - sid, 0, M - 1)
+            y, sflat2, cflat2 = jax.lax.switch(
+                sid, branches, pflat, sflat, cflat, x_in,
+                jax.random.fold_in(key_base, t), m)
             real = jnp.logical_and(t >= sid, t < sid + M)
             sflat = jnp.where(real, sflat2, sflat)
+            cflat = jnp.where(real, cflat2, cflat)
             done_idx = t - (S - 1)
             store = jnp.logical_and(sid == S - 1, done_idx >= 0)
             idx = jnp.maximum(done_idx, 0)
@@ -140,7 +148,8 @@ def _make_ring(mesh: Mesh, axis: str, dp_axis: Optional[str], S: int,
                                                keepdims=False)
             outbuf = jax.lax.dynamic_update_index_in_dim(
                 outbuf, jnp.where(store, y, cur), idx, 0)
-            return (jax.lax.ppermute(y, axis, perm), outbuf, sflat), None
+            return (jax.lax.ppermute(y, axis, perm), outbuf, sflat,
+                    cflat), None
 
         held0 = _pvary(xs[0] * 0.0, axis)
         outbuf0 = _pvary(xs * 0.0, axis)
@@ -149,21 +158,24 @@ def _make_ring(mesh: Mesh, axis: str, dp_axis: Optional[str], S: int,
         # (dp-varying) batch shard while stateless ones return the carry
         # itself — mismatched varying sets are a type error
         sflat0 = sbufs[0]
+        cflat0 = cbufs[0]
         if dp_axis is not None:
             sflat0 = _pvary(sflat0, dp_axis)
-        (_, outbuf, sflat), _ = jax.lax.scan(
-            tick, (held0, outbuf0, sflat0), jnp.arange(M + S - 1))
+            cflat0 = _pvary(cflat0, dp_axis)
+        (_, outbuf, sflat, cflat), _ = jax.lax.scan(
+            tick, (held0, outbuf0, sflat0, cflat0), jnp.arange(M + S - 1))
         if dp_axis is not None:
             # dp replicas saw different microbatch shards: sync the
             # running averages (normalization itself stays per-replica,
             # standard unsynced-BN semantics)
             sflat = jax.lax.pmean(sflat, dp_axis)
-        return jax.lax.psum(outbuf, axis), sflat[None]
+            cflat = jax.lax.pmean(cflat, dp_axis)  # dummy rows when dp on
+        return jax.lax.psum(outbuf, axis), sflat[None], cflat[None]
 
     batch_spec = P(None, dp_axis, None)
     return shard_map(device_fn, mesh=mesh,
-                     in_specs=(P(axis), P(axis), batch_spec, P()),
-                     out_specs=(batch_spec, P(axis)))
+                     in_specs=(P(axis), P(axis), P(axis), batch_spec, P()),
+                     out_specs=(batch_spec, P(axis), P(axis)))
 
 
 class _RingFitMixin:
@@ -174,6 +186,7 @@ class _RingFitMixin:
     set ``training_stats`` (a TrainingStats) for per-phase telemetry."""
 
     training_stats = None
+    _tbptt = False
 
     def fit_batch(self, batch: DataSet) -> float:
         net = self.net
@@ -203,6 +216,18 @@ class _RingFitMixin:
                 raise ValueError(
                     f"microbatch size {b_mb} (batch {B} / {self.M} "
                     f"microbatches) not divisible by the dp axis ({dp})")
+        if self._tbptt and feats.ndim == 3:
+            # rank-3 features + truncated_bptt => window the updates,
+            # exactly MLN.fit_batch's routing (multilayer.py:327) —
+            # including its loud rank-3-labels requirement: slicing a
+            # rank-2 label tensor along time would shear off classes
+            if labels.ndim != 3:
+                raise ValueError(
+                    "truncated_bptt requires rank-3 (time-distributed) "
+                    "labels [B, T, K]; got rank-"
+                    f"{labels.ndim} {tuple(labels.shape)} — use "
+                    "standard backprop for sequence-to-one training")
+            return self._fit_batch_tbptt(feats, labels, b_mb, B)
         if self._step is None or getattr(self, "_b_mb", None) != b_mb:
             self._step = self._build_step(b_mb)
             self._b_mb = b_mb
@@ -215,8 +240,10 @@ class _RingFitMixin:
             stats.record("shard", time.perf_counter() - t_shard)
             t_step = time.perf_counter()
         net._rng, step_rng = jax.random.split(net._rng)
-        net.params, net.opt_state, net.states, loss = self._step(
-            net.params, net.opt_state, net.states, xs, labels, step_rng)
+        cbuf = jnp.zeros((self.S, getattr(self, "_cmax", 1)), jnp.float32)
+        net.params, net.opt_state, net.states, _, loss = self._step(
+            net.params, net.opt_state, net.states, cbuf, xs, labels,
+            step_rng)
         if stats:
             jax.block_until_ready(loss)
             stats.record("step", time.perf_counter() - t_step)
@@ -230,6 +257,59 @@ class _RingFitMixin:
         if stats:
             stats.record("listener", time.perf_counter() - t_l)
         return net._score_raw
+
+    def _fit_batch_tbptt(self, feats, labels, b_mb: int, B: int) -> float:
+        """Truncated BPTT through the ring: time windows run one pipeline
+        step each; recurrent layers' final carries ride the (no-grad)
+        carry buffer between windows, so gradients stop at window edges
+        exactly like MLN._fit_tbptt (ref:
+        MultiLayerNetwork.doTruncatedBPTT:1119-1183). Carries reset to
+        zeros at batch start."""
+        net = self.net
+        fwd = net.conf.training.tbptt_fwd_length
+        T = feats.shape[1]
+        cbuf = None
+        total, slices = 0.0, 0
+        for start in range(0, T, fwd):
+            end = min(start + fwd, T)
+            w = end - start
+            key = (b_mb, w)
+            if key not in self._tbptt_cache:
+                step = self._build_step(b_mb, timesteps=w)
+                self._tbptt_cache[key] = (step, self._amax, self._cmax)
+            step, amax, cmax = self._tbptt_cache[key]
+            if cbuf is None:
+                cbuf = jnp.zeros((self.S, cmax), jnp.float32)
+            stats = self.training_stats
+            t_shard = time.perf_counter() if stats else 0.0
+            x = jnp.asarray(feats[:, start:end]).reshape(self.M, b_mb, -1)
+            xs = jnp.pad(x, ((0, 0), (0, 0), (0, amax - x.shape[-1])))
+            lw = jnp.asarray(labels[:, start:end])
+            if stats:
+                jax.block_until_ready((xs, lw))
+                stats.record("shard", time.perf_counter() - t_shard)
+                t_step = time.perf_counter()
+            net._rng, step_rng = jax.random.split(net._rng)
+            net.params, net.opt_state, net.states, cbuf, loss = step(
+                net.params, net.opt_state, net.states, cbuf, xs, lw,
+                step_rng)
+            if stats:
+                jax.block_until_ready(loss)
+                stats.record("step", time.perf_counter() - t_step)
+            total = total + loss
+            slices += 1
+            net.score_value = loss
+            net.iteration_count += 1
+            t_l = time.perf_counter() if stats else 0.0
+            for listener in net.listeners:
+                listener.iteration_done(net, net.iteration_count,
+                                        net.score_value)
+            if stats:
+                stats.record("listener", time.perf_counter() - t_l)
+        net.last_batch_size = B
+        # device scalar, like MLN._fit_tbptt: converting here would sync
+        # the dispatch pipeline every batch (multilayer.py:459-465)
+        return total / max(slices, 1)
 
     def fit(self, data, epochs: int = 1):
         from deeplearning4j_tpu.optimize.listeners import TrainingListener
@@ -386,17 +466,54 @@ def _type_elems(t) -> int:
     return int(np.prod(_type_shape(t, 1)))
 
 
+def _true_layer_shapes(conf, layers, b: int,
+                       timesteps: Optional[int] = None) -> List[tuple]:
+    """[input_shape, out_of_layer_0, ..., out_of_last] — the TRUE tensor
+    shapes flowing between layers. This differs from the InputType walk
+    in one place: RnnToFeedForward/FeedForwardToRnn preprocessors are
+    no-ops here (the broadcast form keeps [B, T, F] through FF layers,
+    see nn/conf/preprocessors.py:84-104), so an ff-typed tensor inside
+    such a region still carries the time axis. ``timesteps`` overrides
+    the recurrent input length (tBPTT windows)."""
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.preprocessors import (
+        FeedForwardToRnnPreProcessor, RnnToFeedForwardPreProcessor)
+    cur = conf.input_type
+    if timesteps is not None and cur.kind == "rnn":
+        cur = InputType.recurrent(cur.size, timesteps)
+    broadcast_t: Optional[int] = None  # live time axis on an ff type
+
+    def true_shape(t, bt):
+        if t.kind == "ff" and bt:
+            return (b, bt, t.size)
+        return _type_shape(t, b)
+
+    shapes = [true_shape(cur, broadcast_t)]
+    for i, layer in enumerate(layers):
+        if i in conf.preprocessors:
+            pre = conf.preprocessors[i]
+            if isinstance(pre, RnnToFeedForwardPreProcessor):
+                broadcast_t = cur.timesteps
+            cur = pre.infer_output_type(cur)
+            if (isinstance(pre, FeedForwardToRnnPreProcessor)
+                    and cur.timesteps is None and broadcast_t):
+                cur = InputType.recurrent(cur.size, broadcast_t)
+            if cur.kind != "ff":
+                broadcast_t = None
+        cur = layer.infer_output_type(cur)
+        if cur.kind == "rnn":
+            if cur.timesteps is None and broadcast_t:
+                cur = InputType.recurrent(cur.size, broadcast_t)
+            broadcast_t = None
+        shapes.append(true_shape(cur, broadcast_t))
+    return shapes
+
+
 def _mln_boundary_elems(conf, layers) -> List[int]:
     """Per-sample activation elements leaving each body layer (the ring
     payload if the stage cut lands after that layer)."""
-    cur = conf.input_type
-    out = []
-    for i, layer in enumerate(layers):
-        if i in conf.preprocessors:
-            cur = conf.preprocessors[i].infer_output_type(cur)
-        cur = layer.infer_output_type(cur)
-        out.append(_type_elems(cur))
-    return out
+    shapes = _true_layer_shapes(conf, layers, 1)
+    return [int(np.prod(s[1:])) for s in shapes[1:]]
 
 
 def _type_shape(t, batch: int):
@@ -436,8 +553,13 @@ class PipelineTrainer(_RingFitMixin):
     Dropout runs inside the ring: each tick's switch branch receives a
     PRNG key folded from the step rng by (stage, tick[, dp shard]), so
     masks differ per microbatch/stage/shard and a fixed seed reproduces.
-    Out of scope: RNN carries are rejected at construction (carry
-    threading through the ring is future work).
+
+    Recurrent layers pipeline too: a stage runs its layer's full
+    sequence scan in-stage (plain BPTT, zero carry per batch), and under
+    truncated BPTT the final carries ride the ring's no-grad carry
+    buffer between time windows — per-microbatch slices, gradients
+    stopped at window edges by construction (pp-only meshes; see
+    __init__).
     """
 
     def __init__(self, net, mesh: Optional[Mesh] = None, axis: str = "pp",
@@ -484,10 +606,36 @@ class PipelineTrainer(_RingFitMixin):
                     "loss in its state — unsupported in the pipeline "
                     "trainer (its gradient cannot thread through the "
                     "ring's no-grad state buffer)")
-            if getattr(l, "supports_carry", False):
-                raise ValueError(f"layer {i} ({type(l).__name__}) is "
-                                 "recurrent — unsupported in the pipeline "
-                                 "trainer v1")
+        # recurrent layers run their full sequence INSIDE their stage
+        # (zero initial carry per batch, exactly layer.apply); under
+        # tBPTT the final carries additionally thread through the ring's
+        # no-grad carry buffer across time windows — which gives the
+        # stop-gradient-at-window-edges semantics for free (ref:
+        # MultiLayerNetwork.doTruncatedBPTT:1119-1183 / LSTMHelpers.java)
+        self._carry_layers = [i for i, l in enumerate(body)
+                              if getattr(l, "supports_carry", False)]
+        # gate on backprop_type alone: a truncated_bptt net with NO
+        # carry layers (e.g. bidirectional-only) still windows its
+        # updates on a single device, and must window here too — gating
+        # on carries would silently train full-sequence BPTT instead
+        self._tbptt = (net.conf.training.backprop_type == "truncated_bptt")
+        if self._tbptt and self._carry_layers and self.dp_axis is not None:
+            raise ValueError(
+                "tBPTT under the pipeline needs a pp-only mesh: carries "
+                "are per-batch-row and cannot ride the dp-averaged state "
+                "buffer — drop the dp axis or train without tBPTT")
+        if self._tbptt:
+            tr = net.conf.training
+            bwd = tr.tbptt_bwd_length or tr.tbptt_fwd_length
+            if bwd < tr.tbptt_fwd_length:
+                # MLN's split-window trick (forward-only head, backprop
+                # tail — multilayer.py:368-378) doesn't fit the ring: a
+                # silently full-window backprop would train differently
+                raise ValueError(
+                    "tbptt_bwd_length < tbptt_fwd_length is unsupported "
+                    "under the pipeline (windows backprop whole); set "
+                    "bwd == fwd or train without the pipeline")
+        self._tbptt_cache = {}
         self.stages = ([list(s) for s in stages] if stages is not None
                        else partition_stages(
                            body, net.params, self.S,
@@ -506,38 +654,43 @@ class PipelineTrainer(_RingFitMixin):
         self._step = None
 
     # ---------------------------------------------------------------- shapes
-    def _boundary_shapes(self, b_mb: int):
-        """Activation shape entering each stage (pre-preprocessor) plus the
-        final body output feeding the loss head."""
-        conf = self.net.conf
-        cur = conf.input_type
-        stage_in = []
+    def _boundary_shapes(self, b_mb: int, timesteps: Optional[int] = None):
+        """TRUE activation shape entering each stage plus the final body
+        output feeding the loss head (via _true_layer_shapes — an ff-typed
+        tensor between Rnn<->FF preprocessors still carries its time
+        axis). ``timesteps`` overrides the recurrent input length (tBPTT
+        windows are shorter than the configured sequence)."""
+        body = [self.net.layers[i] for st in self.stages for i in st]
+        shapes = _true_layer_shapes(self.net.conf, body, b_mb, timesteps)
+        stage_in, pos = [], 0
         for st in self.stages:
-            stage_in.append(_type_shape(cur, b_mb))
-            for i in st:
-                t = cur
-                if i in conf.preprocessors:
-                    t = conf.preprocessors[i].infer_output_type(t)
-                cur = self.net.layers[i].infer_output_type(t)
-        return stage_in, _type_shape(cur, b_mb)
+            stage_in.append(shapes[pos])
+            pos += len(st)
+        return stage_in, shapes[-1]
 
     # ------------------------------------------------------------ stage fns
     def _make_branch(self, stage: List[int], in_shape, amax: int,
-                     seg_shapes, state_shapes, smax: int):
+                     seg_shapes, state_shapes, smax: int,
+                     carry_meta=None):
         """One lax.switch branch: unpack this stage's flat param segment,
         flat state segment, and activation buffer, run its layers exactly
-        as MLN._forward does (carry layers are rejected at init; dropout
-        runs in-ring with per-stage/tick/dp-shard folded RNG keys),
-        repack both. The batch dim reshapes with -1: under dp×pp the
-        local batch is the global microbatch divided by the dp size."""
+        as MLN._forward does (dropout runs in-ring with per-stage/tick/
+        dp-shard folded RNG keys), repack both. Under tBPTT
+        (``carry_meta``), recurrent layers read their microbatch-``m``
+        carry slice from the no-grad carry buffer, scan the window, and
+        write the final carry back — MLN._forward's carries branch, in
+        ring form. The batch dim reshapes with -1: under dp×pp the local
+        batch is the global microbatch divided by the dp size."""
         net = self.net
         conf = net.conf
         in_size = int(np.prod(in_shape[1:]))
+        carry_meta = carry_meta or {}
         if not stage:
             # identity (pass-through) stage
-            return lambda pflat, sflat, xbuf, key: (xbuf, sflat)
+            return lambda pflat, sflat, cflat, xbuf, key, m: (
+                xbuf, sflat, cflat)
 
-        def branch(pflat, sflat, xbuf, key):
+        def branch(pflat, sflat, cflat, xbuf, key, m):
             # unflatten this stage's params/states from padded segments
             p, s = {}, {}
             off = soff = 0
@@ -562,27 +715,54 @@ class PipelineTrainer(_RingFitMixin):
                 if i in conf.preprocessors:
                     it = in_types[i] if in_types else None
                     h = conf.preprocessors[i].transform(h, it)
-                h, s_out = layer.apply(p[i], h, state=s[i],
-                                       train=not layer.frozen,
-                                       rng=jax.random.fold_in(key, i),
-                                       mask=None)
-                new_s[i] = s[i] if layer.frozen else s_out
+                sub = jax.random.fold_in(key, i)
+                if i in carry_meta:
+                    coff, per_mb, leaf_meta, treedef = carry_meta[i]
+                    seg = jax.lax.dynamic_slice(
+                        cflat, (coff + m * per_mb,), (per_mb,))
+                    leaves, o = [], 0
+                    for shp, dt in leaf_meta:
+                        n = int(np.prod(shp))
+                        leaves.append(seg[o:o + n].reshape(shp).astype(dt))
+                        o += n
+                    c_in = jax.tree_util.tree_unflatten(treedef, leaves)
+                    # scan() bypasses apply(): input dropout must still
+                    # fire (exactly MLN._forward's carries branch)
+                    h = layer._dropout_input(h, not layer.frozen, sub)
+                    h, c_out = layer.scan(p[i], h, c_in, None)
+                    flat_out = jnp.concatenate(
+                        [jnp.reshape(x, (-1,)).astype(jnp.float32)
+                         for x in jax.tree_util.tree_leaves(c_out)])
+                    cflat = jax.lax.dynamic_update_slice(
+                        cflat, flat_out, (coff + m * per_mb,))
+                    new_s[i] = s[i]
+                else:
+                    # recurrent layers included: apply() scans the full
+                    # window from a zero carry, which _carry_like (in
+                    # nn/layers/recurrent.py) marks varying over the mesh
+                    # axes so the in-stage lax.scan type-checks under
+                    # shard_map
+                    h, s_out = layer.apply(p[i], h, state=s[i],
+                                           train=not layer.frozen,
+                                           rng=sub, mask=None)
+                    new_s[i] = s[i] if layer.frozen else s_out
             y = h.reshape(h.shape[0], -1)
             leaves = [new_s[i][name].reshape(-1).astype(jnp.float32)
                       for i in stage for name in state_shapes[i]]
             sflat_new = (jnp.pad(jnp.concatenate(leaves),
                                  (0, smax - sum(l.shape[0] for l in leaves)))
                          if leaves else sflat)
-            return jnp.pad(y, ((0, 0), (0, amax - y.shape[1]))), sflat_new
+            return (jnp.pad(y, ((0, 0), (0, amax - y.shape[1]))),
+                    sflat_new, cflat)
 
         return branch
 
     # ------------------------------------------------------------- the step
-    def _build_step(self, b_mb: int):
+    def _build_step(self, b_mb: int, timesteps: Optional[int] = None):
         net = self.net
         S, M, axis = self.S, self.M, self.axis
         mesh = self.mesh
-        stage_in, head_in_shape = self._boundary_shapes(b_mb)
+        stage_in, head_in_shape = self._boundary_shapes(b_mb, timesteps)
         head_in_size = int(np.prod(head_in_shape[1:]))
         amax = max([int(np.prod(s[1:])) for s in stage_in] + [head_in_size])
         # per-layer param segment metadata (static shapes for unflatten)
@@ -602,8 +782,32 @@ class PipelineTrainer(_RingFitMixin):
                   for st in self.stages]
         smax = max([1] + ssizes)
         self._amax = amax
+        # per-stage carry segment layout (tBPTT only): for each recurrent
+        # layer, M per-microbatch slices of its flattened (h, c) carry
+        carry_metas: List[dict] = []
+        csizes = []
+        if self._tbptt and self._carry_layers:
+            dt_tr = net.params[self._carry_layers[0]][
+                net.layers[self._carry_layers[0]].param_order()[0]].dtype
+            for st in self.stages:
+                meta, coff = {}, 0
+                for i in st:
+                    if i not in self._carry_layers:
+                        continue
+                    c0 = net.layers[i].initial_carry(b_mb, dt_tr)
+                    leaves, treedef = jax.tree_util.tree_flatten(c0)
+                    leaf_meta = [(x.shape, x.dtype) for x in leaves]
+                    per_mb = sum(int(np.prod(x.shape)) for x in leaves)
+                    meta[i] = (coff, per_mb, leaf_meta, treedef)
+                    coff += per_mb * M
+                carry_metas.append(meta)
+                csizes.append(coff)
+        else:
+            carry_metas = [{} for _ in self.stages]
+        cmax = max([1] + csizes)
+        self._cmax = cmax
         branches = [self._make_branch(st, stage_in[s], amax, seg_shapes,
-                                      state_shapes, smax)
+                                      state_shapes, smax, carry_metas[s])
                     for s, st in enumerate(self.stages)]
 
         def pack_bufs(params):
@@ -649,8 +853,9 @@ class PipelineTrainer(_RingFitMixin):
         head_pre_type = (net.conf.input_types[head_idx]
                          if net.conf.input_types else None)
 
-        def loss_of(params, sbuf, xs, labels, rng):
-            outs, new_sbuf = pipe(pack_bufs(params), sbuf, xs, rng)
+        def loss_of(params, sbuf, cbuf, xs, labels, rng):
+            outs, new_sbuf, new_cbuf = pipe(pack_bufs(params), sbuf, cbuf,
+                                            xs, rng)
             h = outs[..., :head_in_size].reshape(
                 (M * b_mb,) + head_in_shape[1:])
             if head_pre is not None:
@@ -659,17 +864,19 @@ class PipelineTrainer(_RingFitMixin):
                 h = head_pre.transform(h, head_pre_type)
             data_loss = head.compute_loss(params[head_idx], h, labels,
                                           mask=None)
-            return data_loss + l1_l2_penalty(params, net.layers), new_sbuf
+            return (data_loss + l1_l2_penalty(params, net.layers),
+                    (new_sbuf, new_cbuf))
 
-        def step(params, opt_state, states, xs, labels, rng):
+        def step(params, opt_state, states, cbuf, xs, labels, rng):
             sbuf = pack_states(states)
-            (loss, new_sbuf), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(params, sbuf, xs, labels, rng)
+            (loss, (new_sbuf, new_cbuf)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params, sbuf, cbuf, xs, labels, rng)
             new_params, new_opt = compute_updates(
                 tx, grads, opt_state, params, net.layers, training)
-            return new_params, new_opt, unpack_states(new_sbuf), loss
+            return (new_params, new_opt, unpack_states(new_sbuf), new_cbuf,
+                    loss)
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
 
 # ---------------------------------------------------------------------------
@@ -774,6 +981,15 @@ class GraphPipelineTrainer(_RingFitMixin):
             if getattr(l, "supports_carry", False):
                 raise ValueError(f"layer node {name!r} is recurrent — "
                                  "unsupported in the graph pipeline v1")
+        if conf.training.backprop_type == "truncated_bptt":
+            # the single-device graph windows updates via _fit_tbptt;
+            # running full-sequence BPTT here instead would silently
+            # train differently (PipelineTrainer implements windowing,
+            # the graph trainer does not yet)
+            raise ValueError(
+                "truncated_bptt is unsupported in the graph pipeline v1 "
+                "— use PipelineTrainer (MLN) for windowed tBPTT or "
+                "standard backprop for the graph")
         self.stages, self.boundaries = self._partition()
         self._step = None
 
@@ -855,9 +1071,10 @@ class GraphPipelineTrainer(_RingFitMixin):
         node_ix = {n: i for i, n in enumerate(net._layer_nodes)}
 
         if not stage:
-            return lambda pflat, sflat, xbuf, key: (xbuf, sflat)
+            return lambda pflat, sflat, cflat, xbuf, key, m: (
+                xbuf, sflat, cflat)
 
-        def branch(pflat, sflat, xbuf, key):
+        def branch(pflat, sflat, cflat, xbuf, key, m):
             p, s = {}, {}
             off = soff = 0
             for name in stage:
@@ -907,7 +1124,8 @@ class GraphPipelineTrainer(_RingFitMixin):
                 jnp.concatenate(leaves),
                 (0, smax - sum(l.shape[0] for l in leaves)))
                 if leaves else sflat)
-            return jnp.pad(y, ((0, 0), (0, amax - y.shape[1]))), sflat_new
+            return (jnp.pad(y, ((0, 0), (0, amax - y.shape[1]))),
+                    sflat_new, cflat)
 
         return branch
 
@@ -981,8 +1199,9 @@ class GraphPipelineTrainer(_RingFitMixin):
         head = head_node.layer
         layer_list = [conf.nodes[n].layer for n in net._layer_nodes]
 
-        def loss_of(params, sbuf, xs, labels, rng):
-            outs, new_sbuf = pipe(pack_bufs(params), sbuf, xs, rng)
+        def loss_of(params, sbuf, cbuf, xs, labels, rng):
+            outs, new_sbuf, new_cbuf = pipe(pack_bufs(params), sbuf, cbuf,
+                                            xs, rng)
             h = outs[..., :head_in_size].reshape(
                 (M * b_mb,) + head_in_shape[1:])
             if head_node.preprocessor is not None:
@@ -993,16 +1212,17 @@ class GraphPipelineTrainer(_RingFitMixin):
             # graph loss path does the same, nn/graph.py:296-299)
             reg = l1_l2_penalty([params[n] for n in net._layer_nodes],
                                 layer_list)
-            return data_loss + reg, new_sbuf
+            return data_loss + reg, (new_sbuf, new_cbuf)
 
-        def step(params, opt_state, states, xs, labels, rng):
+        def step(params, opt_state, states, cbuf, xs, labels, rng):
             sbuf = pack_states(states)
-            (loss, new_sbuf), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(params, sbuf, xs, labels, rng)
+            (loss, (new_sbuf, new_cbuf)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params, sbuf, cbuf, xs, labels, rng)
             new_params, new_opt = compute_updates(
                 tx, grads, opt_state, params, layer_list, training)
-            return new_params, new_opt, unpack_states(new_sbuf), loss
+            return (new_params, new_opt, unpack_states(new_sbuf), new_cbuf,
+                    loss)
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
 
