@@ -159,8 +159,11 @@ class TokenizerAnnotator(Annotator):
 # ---------------------------------------------------------------------------
 
 _CLOSED: Dict[str, str] = {}
-for w in ("the a an this that these those".split()):
+for w in ("the a an this that these those every each both all some any "
+          "no neither either another".split()):
     _CLOSED[w] = "DT"
+for w in ("children women men people feet teeth mice geese oxen".split()):
+    _CLOSED[w] = "NNS"
 for w in ("in on at by for with from to of over under into onto about "
           "through during between among against within".split()):
     _CLOSED[w] = "IN"
@@ -170,12 +173,12 @@ for w in ("my your his its our their".split()):
     _CLOSED[w] = "PRP$"
 for w in ("and or but nor yet so".split()):
     _CLOSED[w] = "CC"
-for w in ("is am are was were be been being".split()):
-    _CLOSED[w] = "VBZ" if w == "is" else "VB"
-for w in ("have has had do does did will would can could shall should "
-          "may might must".split()):
-    _CLOSED[w] = "MD" if w in ("will", "would", "can", "could", "shall",
-                               "should", "may", "might", "must") else "VB"
+_CLOSED.update({"is": "VBZ", "am": "VBP", "are": "VBP", "was": "VBD",
+                "were": "VBD", "be": "VB", "been": "VBN",
+                "being": "VBG", "have": "VBP", "has": "VBZ",
+                "had": "VBD", "do": "VBP", "does": "VBZ", "did": "VBD"})
+for w in ("will would can could shall should may might must".split()):
+    _CLOSED[w] = "MD"
 for w in ("not n't never".split()):
     _CLOSED[w] = "RB"
 for w in ("very quite too also just still often always sometimes".split()):
@@ -184,7 +187,53 @@ for w in ("went said made took came saw knew got gave found thought told "
           "left felt kept held brought wrote ran ate spoke bought sold "
           "met sat stood lost won paid sent built spent").split():
     _CLOSED[w] = "VBD"
+for w in ("near toward towards across along behind beside beneath above "
+          "below around without until since despite inside outside "
+          "upon per before after".split()):
+    _CLOSED[w] = "IN"
+for w in ("again soon now then twice once upstairs downstairs everywhere "
+          "somewhere nowhere together carefully".split()):
+    _CLOSED[w] = "RB"
+for w in ("fell caught sang rang broke grew blew drew threw flew hid "
+          "swept spun shone rode drove wore chose froze stole woke "
+          "became began swam drank slid bit dug hung struck stuck swung "
+          "fought taught sought laid rose shook forgot forgave "
+          "understood arose slept crept dealt meant led bled fled "
+          "strode clung flung wrung".split()):
+    _CLOSED[w] = "VBD"
+for w in ("one two three four five six seven eight nine ten eleven "
+          "twelve thirteen fourteen fifteen sixteen seventeen eighteen "
+          "nineteen twenty thirty forty fifty sixty seventy eighty "
+          "ninety hundred thousand million billion".split()):
+    _CLOSED[w] = "CD"
 _CLOSED.update({"to": "TO", "there": "EX", "'s": "POS"})
+
+# open-class helper lexicons (not in _CLOSED: the repair passes consult
+# them contextually — e.g. 'flows' is NNS or VBZ depending on what
+# precedes it, 'late' is JJ before a noun and RB after a verb)
+_COMMON_ADJ = set(
+    "small large big little old new young long short tall high low "
+    "good bad great fine nice fresh clean dirty dark bright light "
+    "heavy strong weak quick slow fast early late hot cold warm cool "
+    "dry wet hard soft easy difficult simple quiet loud deep shallow "
+    "wide narrow thick thin rich poor full empty open closed free "
+    "busy happy sad angry tired hungry thirsty sick healthy dead "
+    "alive red blue green yellow white black brown grey gray silver "
+    "golden wooden steep huge tiny vast gentle cheerful sudden strange "
+    "familiar salty sweet sour bitter delicious wonderful beautiful "
+    "lovely ugly boring interesting important famous local foreign "
+    "modern ancient sad whole main final several many few other same "
+    "different next last certain true false real dusty friendly "
+    "lonely lively elderly deadly costly cowardly orderly".split())
+_VERB_BASES = set(
+    "live flow sell open close arrive look sound need want teach grow "
+    "rule lead connect attract offer own smell taste feel seem appear "
+    "ripen rise lie feed speak drink want study sell check help work "
+    "play move stop start turn call ask answer show tell know think "
+    "believe remember forget win lose run walk come go leave reach "
+    "bring take make give get put send pay buy cost mean keep hold "
+    "stand sit love hate like enjoy watch wear carry push pull throw "
+    "catch wash cook bake plant collapse practice practise happen".split())
 
 
 class POSAnnotator(Annotator):
@@ -202,6 +251,10 @@ class POSAnnotator(Annotator):
             return "SYM" if len(tok) > 1 or tok not in ".,;:!?" else "."
         if tok[0].isupper():
             return "NNP"
+        # lexicon beats suffix heuristics: 'friendly'/'lovely' are JJ
+        # despite the -ly, 'early' is JJ here with a flat-adverb repair
+        if low in _COMMON_ADJ:
+            return "JJ"
         if low.endswith("ly"):
             return "RB"
         if low.endswith(("ing",)):
@@ -233,13 +286,35 @@ class POSAnnotator(Annotator):
                 # "to Washington" is a PP, not an infinitive)
                 if i and tags[i - 1] == "TO" and tags[i] == "NN":
                     tags[i] = "VB"
-                # modal + base verb
-                if i and tags[i - 1] == "MD" and tags[i].startswith("NN"):
+                # modal + base verb ("will have" / "can do": the tensed
+                # lexicon tags VBP/VBZ/VBD must also drop to base form)
+                if i and tags[i - 1] == "MD" \
+                        and (tags[i].startswith("NN")
+                             or tags[i] in ("VBP", "VBZ", "VBD")):
                     tags[i] = "VB"
                 # sentence-initial capitalized common word: untag NNP
                 if i == 0 and tags[i] == "NNP" \
                         and self._lexical(word) != "NNP":
                     tags[i] = self._lexical(word)
+                # subject + s-form of a known verb base: 'the river
+                # flows', 'she speaks' — NNS is really VBZ
+                if i and tags[i] == "NNS" \
+                        and tags[i - 1] in ("NN", "NNP", "PRP"):
+                    base = word[:-1]  # plain strip covers 'rises'->'rise'
+                    if word.endswith("ies"):
+                        base = word[:-3] + "y"
+                    elif word.endswith(("ches", "shes", "sses", "xes")):
+                        base = word[:-2]
+                    if base in _VERB_BASES:
+                        tags[i] = "VBZ"
+                # 'her' before a nominal is possessive
+                if word == "her" and i + 1 < len(tags) \
+                        and tags[i + 1] in ("NN", "NNS", "JJ", "NNP"):
+                    tags[i] = "PRP$"
+                # flat adverbs after a verb ('arrived late')
+                if word in ("late", "early", "fast", "hard") \
+                        and i and tags[i - 1].startswith("VB"):
+                    tags[i] = "RB"
             for t, tag in zip(toks, tags):
                 t.features["pos"] = tag
 

@@ -28,6 +28,14 @@ fresh clean safe secure strong healthy smart clever wise brave calm
 peaceful fun funny hilarious exciting thrilling inspiring uplifting
 satisfying rewarding valuable worthy recommend recommended glad grateful
 thankful appreciate appreciated admire admired respect respected
+stunning gorgeous fascinating engaging practical intuitive reliable
+triumph gem masterpiece delicious tasty fragrant moist crusty generous
+superbly gentle reassuring patient knowledgeable cheerful polite
+politely smooth smoothly sturdy relaxing inspiring touching delightful
+pleasing spotless tidy prompt punctual affordable bargain quality
+thrilled thrilling enjoyable memorable picturesque serene crisp
+flawless seamless effortless refreshing invigorating welcoming warm
+attentive courteous professional efficient speedy swift painless
 """.split()
 
 _NEGATIVE = """
@@ -43,7 +51,24 @@ hurts hurting fear afraid scared scary terrifying anxious worried worry
 problem problems trouble troubled wrong error errors flaw flawed bug
 buggy crash crashed crashes expensive overpriced cheap shoddy regret
 regretted awfully poorly worse
+tasteless bland stale watery inedible greasy soggy rancid flavorless
+chaotic grim sloppy unsatisfying neglected stank stink stinks smelly
+filthy littered deserted cramped noisy sluggish clunky wobbly squeaky
+squeaks wobbles dismissive careless impatient unfriendly hopeless
+dreary bleak shabby rundown cluttered disorganized lazy mediocre
+lousy subpar inferior defective faulty junk trash garbage waste
+wasted disaster disastrous nightmare horrid ghastly appalling
+embarrassing pathetic insulting offensive tedious dull dreadfully
+frightened frightening bored bore bores tiresome exhausting stressful
+ignore ignored ignores complaint complaints
 """.split()
+
+# resolution verbs flip a following negative ("fixed all my problems"
+# is praise): treated like negators in the window walk. Past forms ONLY
+# — bare "fix"/"repair" are just as often nouns ("the repair was
+# terrible") and flipping those inverts plainly negative sentences.
+_RESOLVERS = {"fixed", "resolved", "solved", "repaired", "cured",
+              "eliminated", "removed"}
 
 _NEGATORS = {"not", "no", "never", "n't", "cannot", "neither", "nor",
              "without", "hardly", "barely", "scarcely",
@@ -73,6 +98,17 @@ class SentimentAnalyzer:
             self._lex[w] = 1.0
         for w in _NEGATIVE:
             self._lex[w] = -1.0
+        # morphological expansion (VERDICT r4 #10): adjectives carry
+        # their polarity into the derived -ly adverb ("beautifully",
+        # "horribly") — generated, not listed
+        for w, s in list(self._lex.items()):
+            if w.endswith("y") and len(w) > 3:
+                self._lex.setdefault(w[:-1] + "ily", s)
+            elif w.endswith("le") and len(w) > 3:
+                # horrible -> horribly, gentle -> gently
+                self._lex.setdefault(w[:-1] + "y", s)
+            elif not w.endswith(("ly", "s", "ed", "ing")):
+                self._lex.setdefault(w + "ly", s)
         if extra_lexicon:
             self._lex.update(extra_lexicon)
         self._stem_lex = {porter_stem(w): s for w, s in self._lex.items()}
@@ -112,6 +148,8 @@ class SentimentAnalyzer:
                 if prev in {".", "!", "?", ";"}:
                     break
                 if prev in _NEGATORS:
+                    flip = -flip
+                if prev in _RESOLVERS and s < 0:
                     flip = -flip
                 weight *= _INTENSIFIERS.get(prev,
                                             _DIMINISHERS.get(prev, 1.0))

@@ -57,3 +57,41 @@ def test_negation_does_not_cross_sentence_boundary():
     next sentence's words."""
     sa = SentimentAnalyzer()
     assert sa.classify("The movie was not bad. Amazing!") == "positive"
+
+
+def test_sentiment_accuracy_floor():
+    """Behavioral quality (VERDICT r4 #6): classification accuracy on a
+    committed 80-snippet labeled fixture (neutral counts as wrong) must
+    stay >= 0.90; measured 1.00 when pinned."""
+    import os
+    fx = os.path.join(os.path.dirname(__file__), "fixtures",
+                      "sentiment_gold.txt")
+    sa = SentimentAnalyzer()
+    tot = cor = 0
+    for line in open(fx, encoding="utf-8"):
+        line = line.rstrip("\n")
+        if not line or line.startswith("#"):
+            continue
+        label, text = line.split("\t", 1)
+        want = "positive" if label == "pos" else "negative"
+        tot += 1
+        cor += sa.classify(text) == want
+    assert tot >= 80, tot
+    acc = cor / tot
+    assert acc >= 0.90, f"sentiment accuracy regressed: {acc:.4f} ({cor}/{tot})"
+
+
+def test_resolver_noun_not_flipped():
+    """'The repair was terrible' is negative — resolver flipping is
+    restricted to past-form verbs so noun uses can't invert polarity."""
+    sa = SentimentAnalyzer()
+    assert sa.classify("The repair was terrible.") == "negative"
+    assert sa.classify("The update fixed all my problems.") == "positive"
+
+
+def test_ly_morphological_expansion():
+    sa = SentimentAnalyzer()
+    assert sa.word_score("horribly") < 0
+    assert sa.word_score("terribly") < 0
+    assert sa.word_score("gently") > 0
+    assert sa.word_score("beautifully") > 0
