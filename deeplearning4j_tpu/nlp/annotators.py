@@ -283,8 +283,10 @@ class POSAnnotator(Annotator):
                         and tags[i] in ("VBD", "VBG", "VB"):
                     tags[i] = "NN"
                 # TO + base verb ("to run"; proper nouns stay NNP —
-                # "to Washington" is a PP, not an infinitive)
-                if i and tags[i - 1] == "TO" and tags[i] == "NN":
+                # "to Washington" is a PP, not an infinitive). Tensed
+                # lexicon tags (have->VBP etc.) drop to base form too.
+                if i and tags[i - 1] == "TO" \
+                        and tags[i] in ("NN", "VBP", "VBZ", "VBD"):
                     tags[i] = "VB"
                 # modal + base verb ("will have" / "can do": the tensed
                 # lexicon tags VBP/VBZ/VBD must also drop to base form)

@@ -373,7 +373,7 @@ SURU_NOUNS = [
     "在庫", "裁判", "試食", "持続", "失望", "受信", "瞬間移動", "上演",
     "伸張", "推進", "寸断", "先行", "全滅", "蘇生", "妥当化", "宅配",
     "探索", "追跡", "沈下", "痛感", "展望", "徒歩", "搭載", "内蔵",
-    "燃焼", "波及", "買い物", "発酵", "versus無効", "比例", "浮上",
+    "燃焼", "波及", "買い物", "発酵", "無効", "比例", "浮上",
     "分布", "平行", "崩壊", "膨張", "密集", "黙認", "油断", "濾過",
 ]
 # defensively drop anything that isn't pure CJK/kana (typo guard)

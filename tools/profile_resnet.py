@@ -32,6 +32,10 @@ sys.path.insert(0, REPO)
 
 STEPS = int(os.environ.get("PROFILE_STEPS", "10"))
 SKIP = set(filter(None, os.environ.get("PROFILE_SKIP", "").split(",")))
+# PROFILE_SMOKE=1: tiny shapes so the whole ladder runs in ~a minute on
+# CPU — validates the harness (patching, timing, emission) before the
+# chip run spends its window on it
+SMOKE = os.environ.get("PROFILE_SMOKE") == "1"
 
 
 def stamp(msg):
@@ -62,6 +66,13 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # sitecustomize pins the axon tunnel; the env var alone doesn't
+        # stick — needed for the CPU smoke validation of this harness
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
     devs = jax.devices()
     kind = str(getattr(devs[0], "device_kind", devs[0].platform))
     stamp(f"backend: {len(devs)}x {kind}")
@@ -69,7 +80,7 @@ def main():
 
     # ---------------------------------------------------------------- peak
     if "peak" not in SKIP:
-        n = 8192
+        n = 512 if SMOKE else 8192
         a = jnp.ones((n, n), jnp.bfloat16)
         b = jnp.ones((n, n), jnp.bfloat16)
         f = jax.jit(lambda x, y: x @ y)
@@ -85,6 +96,9 @@ def main():
             ("stem7x7", (64, 224, 224, 3), (7, 7, 3, 64), 2),
             ("s2_3x3", (64, 56, 56, 64), (3, 3, 64, 64), 1),
             ("s4_3x3", (64, 14, 14, 256), (3, 3, 256, 256), 1),
+        ] if not SMOKE else [
+            ("stem7x7", (4, 32, 32, 3), (7, 7, 3, 8), 2),
+            ("s2_3x3", (4, 8, 8, 8), (3, 3, 8, 8), 1),
         ]
         for name, xs, ks, stride in shapes:
             x = jnp.ones(xs, jnp.bfloat16)
@@ -151,12 +165,14 @@ def main():
         # patch stays active through BOTH init and the fit-time trace
         if bn_apply is not None:
             nm.BatchNormalization.apply = bn_apply
+        hw = 32 if SMOKE else 224
         try:
-            net = ComputationGraph(resnet50(dtype="bfloat16")).init()
+            net = ComputationGraph(
+                resnet50(dtype="bfloat16", height=hw, width=hw)).init()
             jax.block_until_ready(net.params)
             rng = np.random.default_rng(0)
             xs = [DataSet(
-                rng.normal(size=(batch, 224, 224, 3)).astype(np.float32),
+                rng.normal(size=(batch, hw, hw, 3)).astype(np.float32),
                 np.eye(1000, dtype=np.float32)[
                     rng.integers(0, 1000, batch)]) for _ in range(3)]
             staged = list(DevicePrefetchIterator(ListDataSetIterator(xs),
@@ -173,27 +189,33 @@ def main():
             nm.BatchNormalization.apply = _orig_bn_apply
         dt = (time.perf_counter() - t0) / STEPS
         sps = batch / dt
-        mfu = 3 * 4.09e9 * sps / peak if peak else None
+        fwd_flops = 4.09e9 * (hw * hw) / (224 * 224)
+        mfu = 3 * fwd_flops * sps / peak if peak else None
         emit({"exp": tag, "batch": batch, "step_ms": round(dt * 1e3, 2),
               "samples_per_sec": round(sps, 1),
               "mfu": round(mfu, 3) if mfu else None})
 
     if "fwd" not in SKIP:
-        net = ComputationGraph(resnet50(dtype="bfloat16")).init()
+        hw = 32 if SMOKE else 224
+        fb = 8 if SMOKE else 64
+        net = ComputationGraph(
+            resnet50(dtype="bfloat16", height=hw, width=hw)).init()
         x = jnp.asarray(np.random.default_rng(0).normal(
-            size=(64, 224, 224, 3)).astype(np.float32)).astype(jnp.bfloat16)
+            size=(fb, hw, hw, 3)).astype(np.float32)).astype(jnp.bfloat16)
         jax.block_until_ready(net.params)
         dt = timed(lambda xx: net.output({"in": xx}), x)
-        sps = 64 / dt
+        sps = fb / dt
+        ffl = 4.09e9 * (hw * hw) / (224 * 224)
         emit({"exp": "fwd", "step_ms": round(dt * 1e3, 2),
               "samples_per_sec": round(sps, 1),
-              "mfu_fwd": round(4.09e9 * sps / peak, 3) if peak else None})
+              "mfu_fwd": round(ffl * sps / peak, 3) if peak else None})
 
-    run_train("train", 64)
-    run_train("train_bnbf16", 64, bn_apply=_bn_apply_bf16)
-    run_train("train_nobn", 64, bn_apply=_bn_apply_identity)
-    run_train("train_b128", 128)
-    run_train("train_b256", 256)
+    B = 8 if SMOKE else 64
+    run_train("train", B)
+    run_train("train_bnbf16", B, bn_apply=_bn_apply_bf16)
+    run_train("train_nobn", B, bn_apply=_bn_apply_identity)
+    run_train("train_b128", 2 * B)
+    run_train("train_b256", 4 * B)
     stamp("done")
     return 0
 
