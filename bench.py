@@ -43,6 +43,7 @@ remote-TPU link.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import subprocess
@@ -317,6 +318,40 @@ def _pallas_parity_check(jax, B=8, T=16, F=128, H=128) -> str:
     tol = max(1e-3, 2.5e-4 * T)
     return ("ok" if err < tol
             else f"fail: max_abs_err={err:.3e} (tol {tol:.1e})")
+
+
+def _pallas_attention_parity_check(jax) -> str:
+    """Compiled Pallas flash attention (fwd + FA2 bwd) vs the XLA
+    reference on a NON-aligned shape (T=40, D=24 — the pad path). Like
+    the LSTM check: recorded, never fatal."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.layers.attention import attention_reference
+    from deeplearning4j_tpu.ops.pallas_attention import flash_attention
+
+    rng = np.random.default_rng(11)
+    B, H, T, D = 2, 2, 40, 24
+    q, k, v = (jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+               for _ in range(3))
+    cot = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) * cot)
+
+    # HIGHEST precision for the XLA reference: TPU f32 einsums default
+    # to bf16 MXU passes whose ~1e-2 logit drift would drown the
+    # kernel's true error (same rationale as the LSTM check above)
+    with jax.default_matmul_precision("highest"):
+        ref_fn = functools.partial(attention_reference, causal=True)
+        fl_fn = functools.partial(flash_attention, causal=True,
+                                  interpret=False)
+        out_err = float(jnp.max(jnp.abs(fl_fn(q, k, v) - ref_fn(q, k, v))))
+        g_ref = jax.grad(loss(ref_fn), argnums=(0, 1, 2))(q, k, v)
+        g_fl = jax.grad(loss(fl_fn), argnums=(0, 1, 2))(q, k, v)
+        g_err = max(float(jnp.max(jnp.abs(a - b)))
+                    for a, b in zip(g_fl, g_ref))
+    err = max(out_err, g_err)
+    return "ok" if err < 5e-4 else f"fail: max_abs_err={err:.3e}"
 
 
 def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
@@ -596,9 +631,15 @@ def _run_child() -> int:
         parity = (aligned if aligned == unaligned
                   else f"aligned: {aligned}; unaligned[H=200,B=6]: "
                        f"{unaligned}")
-        _stamp(f"pallas parity: {parity} ({time.perf_counter() - t:.1f}s)")
+        try:
+            attn = _pallas_attention_parity_check(jax)
+        except Exception as e:  # noqa: BLE001
+            attn = f"error: {type(e).__name__}: {e}"[:200]
+        _stamp(f"pallas parity: lstm={parity} attention={attn} "
+               f"({time.perf_counter() - t:.1f}s)")
         for rec in banked:  # verdict applies to every rung of this run
             rec["pallas_lstm_parity"] = parity
+            rec["pallas_attention_parity"] = attn
         print(json.dumps(banked[-1]), flush=True)
         if not smoke:
             for rec in banked:  # durable parity verdict (VERDICT #3)

@@ -186,7 +186,16 @@ class SelfAttentionLayer(BaseLayerConf):
         q = self._split_heads(x @ params["Wq"])
         k = self._split_heads(x @ params["Wk"])
         v = self._split_heads(x @ params["Wv"])
-        if self.use_blockwise:
+        # helper seam (the cuDNN-discovery analog, like the fused LSTM):
+        # MXU-native flash attention when the Pallas kernel applies
+        from deeplearning4j_tpu.ops.pallas_attention import (
+            attention_mode, flash_attention, flash_ok)
+        amode = attention_mode()
+        if amode != "off" and flash_ok(x.shape[1], self.head_dim):
+            out = flash_attention(q, k, v, causal=self.causal,
+                                  kv_mask=mask,
+                                  interpret=amode == "interpret")
+        elif self.use_blockwise:
             out, _, lse = blockwise_attention(q, k, v, block_size=self.block_size,
                                               causal=self.causal, kv_mask=mask)
             out = finalize_attention(out, lse)

@@ -410,14 +410,27 @@ class _Handler(BaseHTTPRequestHandler):
         authorized."""
         if not self.auth_token:
             return True
-        if self.headers.get("Authorization") == f"Bearer {self.auth_token}":
-            return True
-        cookie = self.headers.get("Cookie", "")
-        if f"ui_token={self.auth_token}" in cookie.replace(" ", ""):
-            return True
+        import hmac
+        from http.cookies import SimpleCookie
         from urllib.parse import parse_qs, urlparse
+
+        def ok(candidate):  # constant-time: no byte-by-byte timing leak
+            return candidate is not None and hmac.compare_digest(
+                candidate, self.auth_token)
+
+        header = self.headers.get("Authorization", "")
+        if header.startswith("Bearer ") and ok(header[len("Bearer "):]):
+            return True
+        jar = SimpleCookie()
+        try:
+            jar.load(self.headers.get("Cookie", ""))
+        except Exception:  # malformed cookie header = unauthenticated
+            jar = {}
+        morsel = jar.get("ui_token") if hasattr(jar, "get") else None
+        if morsel is not None and ok(morsel.value):
+            return True
         q = parse_qs(urlparse(self.path).query)
-        if q.get("token", [None])[0] == self.auth_token:
+        if ok(q.get("token", [None])[0]):
             self._set_auth_cookie = True
             return True
         return False
