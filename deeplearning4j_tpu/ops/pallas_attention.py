@@ -20,6 +20,12 @@ CPU CI, off to force the XLA paths. Parity between the kernel and
 Shapes: q, k, v are [B, H, T, D] (self-attention: same T). The kernel
 pads T to the 128-lane block and D to 128 internally; padded KV columns
 are masked with the same additive bias that carries ``kv_mask``.
+
+Future work: the ring-attention path (parallel/sequence.py) still uses
+the lax.scan blockwise kernel for its per-shard step — composing ring
+steps needs the (unnormalized acc, running max, lse) carry, so routing
+it through this kernel means exposing a partial-softmax variant and
+threading the FA2 residuals through the ppermute schedule.
 """
 
 from __future__ import annotations

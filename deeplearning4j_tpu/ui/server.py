@@ -415,8 +415,11 @@ class _Handler(BaseHTTPRequestHandler):
         from urllib.parse import parse_qs, urlparse
 
         def ok(candidate):  # constant-time: no byte-by-byte timing leak
+            # bytes, not str: compare_digest raises on non-ASCII str and
+            # that TypeError would 500 instead of 401
             return candidate is not None and hmac.compare_digest(
-                candidate, self.auth_token)
+                candidate.encode("utf-8", "surrogateescape"),
+                self.auth_token.encode("utf-8", "surrogateescape"))
 
         header = self.headers.get("Authorization", "")
         if header.startswith("Bearer ") and ok(header[len("Bearer "):]):
@@ -426,7 +429,7 @@ class _Handler(BaseHTTPRequestHandler):
             jar.load(self.headers.get("Cookie", ""))
         except Exception:  # malformed cookie header = unauthenticated
             jar = {}
-        morsel = jar.get("ui_token") if hasattr(jar, "get") else None
+        morsel = jar.get("ui_token")
         if morsel is not None and ok(morsel.value):
             return True
         q = parse_qs(urlparse(self.path).query)

@@ -121,3 +121,31 @@ def test_selfattention_layer_uses_flash_kernel(monkeypatch):
     monkeypatch.setenv("DL4J_TPU_PALLAS", "interpret")
     got = np.asarray(net.output(x))
     np.testing.assert_allclose(got, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_multi_block_causal_masked():
+    """T=300 spans three KV blocks: the cross-block online-softmax
+    carry, causal block skipping (hi=qi+1 / lo=ki) and masked-block
+    rescale all genuinely fire — fwd AND grads."""
+    q, k, v = _qkv(B=1, H=1, T=300, D=8)
+    mask = jnp.ones((1, 300)).at[0, 130:170].set(0.0)  # hole in block 2
+    cot = jnp.asarray(RNG.normal(size=q.shape).astype(np.float32))
+
+    ref = attention_reference(q, k, v, causal=True, mask=mask)
+    got = flash_attention(q, k, v, causal=True, kv_mask=mask,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) * cot)
+
+    g_ref = jax.grad(loss(lambda q, k, v: attention_reference(
+        q, k, v, causal=True, mask=mask)), argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, kv_mask=mask, interpret=True)),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_fl, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4,
+                                   err_msg=f"d{name}")

@@ -342,6 +342,12 @@ def test_ui_server_auth_token():
         assert r.status == 200
         r = urlopen(url + "?token=sekrit", timeout=5)
         assert r.status == 200
+        # non-ASCII token guess is a clean 401, not a compare_digest 500
+        try:
+            urlopen(url + "?token=%C3%A9", timeout=5)
+            raise AssertionError("expected 401")
+        except HTTPError as e:
+            assert e.code == 401, e.code
     finally:
         srv.stop()
 

@@ -121,6 +121,39 @@ def main():
                   "tflops": round(flops / dt / 1e12, 1),
                   "mfu": round(flops / dt / peak, 3) if peak else None})
 
+    # ------------------------------------------------- flash attention
+    if "attn" not in SKIP:
+        from deeplearning4j_tpu.nn.layers.attention import (
+            attention_reference)
+        from deeplearning4j_tpu.ops.pallas_attention import (
+            attention_mode, flash_attention)
+        B, H, T, D = (2, 2, 256, 64) if SMOKE else (8, 8, 2048, 64)
+        r = np.random.default_rng(1)
+        q, k, v = (jnp.asarray(r.normal(size=(B, H, T, D))
+                               .astype(np.float32)).astype(jnp.bfloat16)
+                   for _ in range(3))
+        interp = attention_mode() == "interpret"
+
+        def train_like(fn):
+            def f(q, k, v):
+                return jnp.sum(fn(q, k, v) ** 2)
+            return jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+
+        flops = 4 * 2 * B * H * T * T * D  # fwd QK^T+PV, ~2x again bwd
+        for name, fn in (
+                ("attn_xla", lambda q, k, v: attention_reference(
+                    q, k, v, causal=True)),
+                ("attn_flash", lambda q, k, v: flash_attention(
+                    q, k, v, causal=True, interpret=interp))):
+            try:
+                dt = timed(train_like(fn), q, k, v)
+                emit({"exp": name, "B": B, "T": T, "ms": round(dt * 1e3, 2),
+                      "tflops": round(flops / dt / 1e12, 1),
+                      "mfu": (round(flops / dt / peak, 3)
+                              if peak else None)})
+            except Exception as e:  # noqa: BLE001 — never cost the ladder
+                emit({"exp": name, "error": f"{type(e).__name__}: {e}"[:160]})
+
     # ------------------------------------------------------------- resnet
     from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.datasets.iterator import (
