@@ -595,17 +595,14 @@ class PipelineTrainer(_RingFitMixin):
         head = net.layers[-1]
         if not hasattr(head, "compute_loss"):
             raise ValueError("Last layer must be an output/loss layer")
-        for i, l in enumerate(body):
-            if "aux_loss" in net.states[i]:
-                # MixtureOfExperts-style layers report a differentiable
-                # auxiliary loss through their state; the pipeline's
-                # state buffer is a no-grad aux output, so the balancing
-                # term would silently vanish from the objective
-                raise ValueError(
-                    f"layer {i} ({type(l).__name__}) carries an auxiliary "
-                    "loss in its state — unsupported in the pipeline "
-                    "trainer (its gradient cannot thread through the "
-                    "ring's no-grad state buffer)")
+        # MixtureOfExperts-style aux losses ride a dedicated
+        # DIFFERENTIABLE column of the ring activation buffer (the state
+        # buffer is no-grad; the activation buffer is not) — see
+        # _make_branch. Under dp each shard accumulates its local aux
+        # and the loss takes the row mean, the same approximation the
+        # dp gradient all-reduce already makes.
+        self._aux_layers = [i for i, l in enumerate(body)
+                            if "aux_loss" in net.states[i]]
         # recurrent layers run their full sequence INSIDE their stage
         # (zero initial carry per batch, exactly layer.apply); under
         # tBPTT the final carries additionally thread through the ring's
@@ -746,14 +743,25 @@ class PipelineTrainer(_RingFitMixin):
                                            train=not layer.frozen,
                                            rng=sub, mask=None)
                     new_s[i] = s[i] if layer.frozen else s_out
-            y = h.reshape(h.shape[0], -1)
+            y = h.reshape(xbuf.shape[0], -1)
             leaves = [new_s[i][name].reshape(-1).astype(jnp.float32)
                       for i in stage for name in state_shapes[i]]
             sflat_new = (jnp.pad(jnp.concatenate(leaves),
                                  (0, smax - sum(l.shape[0] for l in leaves)))
                          if leaves else sflat)
-            return (jnp.pad(y, ((0, 0), (0, amax - y.shape[1]))),
-                    sflat_new, cflat)
+            y_pad = jnp.pad(y, ((0, 0), (0, amax - y.shape[1])))
+            # running aux-loss accumulator: read the incoming sum from
+            # the (differentiable) last column, add this stage's aux
+            # scalars, write it back for the next hop
+            aux = xbuf[0, amax - 1]
+            for i in stage:
+                # same predicate as loss_of's gate (self._aux_layers,
+                # init_state-declared) — a split predicate could silently
+                # drop a layer's balancing term from the objective
+                if i in self._aux_layers and "aux_loss" in new_s[i]:
+                    aux = aux + new_s[i]["aux_loss"].astype(jnp.float32)
+            y_pad = y_pad.at[:, amax - 1].set(aux.astype(y_pad.dtype))
+            return y_pad, sflat_new, cflat
 
         return branch
 
@@ -764,7 +772,10 @@ class PipelineTrainer(_RingFitMixin):
         mesh = self.mesh
         stage_in, head_in_shape = self._boundary_shapes(b_mb, timesteps)
         head_in_size = int(np.prod(head_in_shape[1:]))
-        amax = max([int(np.prod(s[1:])) for s in stage_in] + [head_in_size])
+        # +1: the last buffer column is the differentiable running
+        # aux-loss accumulator (zero-cost when no aux layers exist)
+        amax = max([int(np.prod(s[1:])) for s in stage_in]
+                   + [head_in_size]) + 1
         # per-layer param segment metadata (static shapes for unflatten)
         seg_shapes = {i: {k: (v.shape, v.dtype)
                           for k, v in net.params[i].items()}
@@ -864,7 +875,12 @@ class PipelineTrainer(_RingFitMixin):
                 h = head_pre.transform(h, head_pre_type)
             data_loss = head.compute_loss(params[head_idx], h, labels,
                                           mask=None)
-            return (data_loss + l1_l2_penalty(params, net.layers),
+            # per-microbatch aux sums arrive in the buffer's last column
+            # (rows within a shard are identical; the mean also averages
+            # over dp shards and microbatches — exact at M=1, pp-only)
+            aux = (outs[..., amax - 1].mean().astype(data_loss.dtype)
+                   if self._aux_layers else 0.0)
+            return (data_loss + l1_l2_penalty(params, net.layers) + aux,
                     (new_sbuf, new_cbuf))
 
         def step(params, opt_state, states, cbuf, xs, labels, rng):
