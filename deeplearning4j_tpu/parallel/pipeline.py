@@ -190,21 +190,52 @@ class _RingFitMixin:
 
     def fit_batch(self, batch: DataSet) -> float:
         net = self.net
+        multi_io = getattr(self, "in_names", None)
         if not isinstance(batch, DataSet):
-            # MultiDataSet's features is a LIST — jnp.asarray would stack
-            # it into (n_inputs, B, ...) and fail bafflingly downstream
-            raise ValueError(
-                "pipeline trainers take a single-input DataSet; got "
-                f"{type(batch).__name__}")
-        if (batch.features_mask is not None
-                or batch.labels_mask is not None):
-            # loud, like the other unsupported features — a silently
-            # dropped mask would train a whole run subtly wrong
-            raise ValueError("masked DataSets are unsupported in the "
-                             "pipeline trainers (mask threading through "
-                             "the ring schedule is future work)")
-        feats = jnp.asarray(batch.features)
-        labels = jnp.asarray(batch.labels)
+            from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+            if not (multi_io and isinstance(batch, MultiDataSet)):
+                raise ValueError(
+                    "this pipeline trainer takes a single-input DataSet; "
+                    f"got {type(batch).__name__}")
+            if any(m is not None for m in (batch.features_masks or []))\
+                    or any(m is not None
+                           for m in (batch.labels_masks or [])):
+                raise ValueError("masked MultiDataSets are unsupported "
+                                 "in the pipeline trainers")
+            if len(batch.features) != len(self.in_names) \
+                    or len(batch.labels) != len(self.out_names):
+                raise ValueError(
+                    f"MultiDataSet arity {len(batch.features)}in/"
+                    f"{len(batch.labels)}out != network "
+                    f"{len(self.in_names)}in/{len(self.out_names)}out")
+            B = batch.features[0].shape[0]
+            rt = net.conf.resolved_types
+            for name, f in zip(self.in_names, batch.features):
+                want = _type_elems(rt[name])
+                got = int(np.prod(f.shape[1:]))
+                if got != want:
+                    raise ValueError(
+                        f"input {name!r}: got {got} elements/sample "
+                        f"{tuple(f.shape)}, network expects {want} "
+                        f"({rt[name]})")
+            # stage 0 unpacks the inputs from one concatenated flat
+            # buffer, in network_inputs order (matches _make_branch)
+            feats = jnp.concatenate(
+                [jnp.asarray(f).reshape(B, -1) for f in batch.features],
+                axis=1)
+            labels = {o: jnp.asarray(l)
+                      for o, l in zip(self.out_names, batch.labels)}
+        else:
+            if (batch.features_mask is not None
+                    or batch.labels_mask is not None):
+                # loud, like the other unsupported features — a silently
+                # dropped mask would train a whole run subtly wrong
+                raise ValueError("masked DataSets are unsupported in the "
+                                 "pipeline trainers (mask threading "
+                                 "through the ring schedule is future "
+                                 "work)")
+            feats = jnp.asarray(batch.features)
+            labels = jnp.asarray(batch.labels)
         B = feats.shape[0]
         if B % self.M != 0:
             raise ValueError(f"batch size {B} not divisible by "
@@ -936,10 +967,16 @@ class GraphPipelineTrainer(_RingFitMixin):
     state (BN) threads exactly as in PipelineTrainer; the output node's
     loss head and compute_updates reuse the graph's single-device code.
 
-    v1 scope: one network input, one output (loss head), no masks, no
-    RNN/carry vertices (LastTimeStep / DuplicateToTimeSeries), no
-    aux-loss layers. Dropout runs in-ring (per-stage/tick/dp-shard
-    folded RNG keys), as in PipelineTrainer.
+    Multi-input graphs inject every network input into stage 0 as one
+    concatenated flat buffer; multi-output graphs put every loss head's
+    input on the final boundary (find_graph_cut_points counts heads as
+    consumers, so no cut can strand a head input in an earlier stage)
+    and the loss sums the heads, exactly like the single-device graph.
+
+    Out of scope: masks, RNN/carry vertices (LastTimeStep /
+    DuplicateToTimeSeries), aux-loss layers, truncated BPTT. Dropout
+    runs in-ring (per-stage/tick/dp-shard folded RNG keys), as in
+    PipelineTrainer.
     """
 
     def __init__(self, net, mesh: Optional[Mesh] = None, axis: str = "pp",
@@ -961,9 +998,6 @@ class GraphPipelineTrainer(_RingFitMixin):
         net._check_init()
         _reject_remat(net.conf)
         conf = net.conf
-        if len(conf.network_inputs) != 1 or len(conf.network_outputs) != 1:
-            raise ValueError("GraphPipelineTrainer v1 supports exactly one "
-                             "network input and one output")
         if not conf.resolved_types:
             raise ValueError("GraphPipelineTrainer needs set_input_types() "
                              "on the config (static boundary shapes)")
@@ -973,12 +1007,31 @@ class GraphPipelineTrainer(_RingFitMixin):
         self.dp_axis = "dp" if "dp" in mesh.axis_names else None
         self.S = mesh.shape[axis]
         self.M = int(n_microbatches or self.S)
-        self.in_name = conf.network_inputs[0]
-        self.out_name = conf.network_outputs[0]
-        out_node = conf.nodes[self.out_name]
-        if out_node.kind != "layer" \
-                or not hasattr(out_node.layer, "compute_loss"):
-            raise ValueError("the output node must be a loss head")
+        # multi-input: every network input is injected into stage 0 as a
+        # concatenated flat buffer. Multi-output: heads count as
+        # consumers in find_graph_cut_points (they sit in out_set), so
+        # no cut can separate a head input from its head — all head
+        # inputs are provably computed in the final stage, whose
+        # boundary carries their concatenation.
+        self.in_names = list(conf.network_inputs)
+        self.out_names = list(conf.network_outputs)
+        consumers_of = {n: 0 for n in conf.topological_order}
+        for n in conf.topological_order:
+            for i in conf.nodes[n].inputs:
+                consumers_of[i] += 1
+        for o in self.out_names:
+            out_node = conf.nodes[o]
+            if out_node.kind != "layer" \
+                    or not hasattr(out_node.layer, "compute_loss"):
+                raise ValueError(f"output node {o!r} must be a loss head")
+            if consumers_of[o]:
+                raise ValueError(f"output node {o!r} feeds other nodes — "
+                                 "unsupported in the graph pipeline")
+        self.head_in_names = []
+        for o in self.out_names:
+            for i in conf.nodes[o].inputs:
+                if i not in self.head_in_names:
+                    self.head_in_names.append(i)
         for name in conf.topological_order:
             node = conf.nodes[name]
             if node.kind == "vertex" and isinstance(
@@ -1011,18 +1064,21 @@ class GraphPipelineTrainer(_RingFitMixin):
 
     # ------------------------------------------------------------ partition
     def _partition(self):
-        """Split topo[input+1 : out) into S node groups at balanced cut
-        points. Returns (stages: list of node-name lists, boundaries:
-        crossing-node name entering each stage)."""
+        """Split the non-input, non-head topo nodes into S contiguous
+        groups at balanced cut points. Returns (stages: list of
+        node-name lists, boundaries: LIST of tensor names entering each
+        stage — all network inputs for stage 0, the single crossing
+        node after)."""
         conf = self.net.conf
         topo = list(conf.topological_order)
-        out_pos = topo.index(self.out_name)
-        cuts = [(p, n) for p, n in find_graph_cut_points(conf)
-                if 0 < p < out_pos]
-        body = [n for n in topo[:out_pos]
-                if conf.nodes[n].kind != "input"]
+        heads = set(self.out_names)
+        body = [n for n in topo
+                if conf.nodes[n].kind != "input" and n not in heads]
         if not body:
             raise ValueError("no body nodes to pipeline")
+        body_set = set(body)
+        cuts = [(p, n) for p, n in find_graph_cut_points(conf)
+                if 0 < p < len(topo) and n in body_set]
 
         def cost(name):
             node = conf.nodes[name]
@@ -1036,10 +1092,9 @@ class GraphPipelineTrainer(_RingFitMixin):
         # (same DP + cost model as partition_stages: max stage params +
         # max ring payload — a fat skip-free boundary early in a ResNet
         # would otherwise set every tick's ppermute size)
-        body_set = set(body)
         topo_to_bidx = {}
         b = 0
-        for p, name in enumerate(topo[:out_pos]):
+        for p, name in enumerate(topo):
             topo_to_bidx[p + 1] = b + (1 if name in body_set else 0)
             if name in body_set:
                 b += 1
@@ -1054,12 +1109,12 @@ class GraphPipelineTrainer(_RingFitMixin):
         n_cuts_usable = min(self.S - 1, len(boundaries))
         cut_idx = (_optimal_cuts(costs, boundaries, n_cuts_usable + 1)
                    if n_cuts_usable else None) or []
-        stages, bounds = [], [self.in_name]
+        stages, bounds = [], [list(self.in_names)]
         edges = [0] + list(cut_idx) + [len(body)]
         for i in range(len(edges) - 1):
             stages.append(body[edges[i]:edges[i + 1]])
             if i + 1 < len(edges) - 1:
-                bounds.append(bound_name[edges[i + 1]])
+                bounds.append([bound_name[edges[i + 1]]])
         # fewer cut points than stages: trailing identity stages
         while len(stages) < self.S:
             stages.append([])
@@ -1068,19 +1123,25 @@ class GraphPipelineTrainer(_RingFitMixin):
 
     # ---------------------------------------------------------------- shapes
     def _boundary_shapes(self, b_mb: int):
-        """Activation shape entering each stage + the head input."""
+        """Per-stage lists of (name, shape) entering each stage + the
+        final boundary (the concatenated head inputs)."""
         rt = self.net.conf.resolved_types
-        stage_in = [_type_shape(rt[b], b_mb) for b in self.boundaries]
-        # the head consumes the final crossing node's activation
-        final = self.net.conf.nodes[self.out_name].inputs[0]
-        return stage_in, _type_shape(rt[final], b_mb)
+        stage_in = [[(n, _type_shape(rt[n], b_mb)) for n in names]
+                    for names in self.boundaries]
+        head_in = [(n, _type_shape(rt[n], b_mb))
+                   for n in self.head_in_names]
+        return stage_in, head_in
 
     # ------------------------------------------------------------ stage fns
-    def _make_branch(self, stage: List[str], b_in: str, amax: int,
+    def _make_branch(self, stage: List[str], b_in: List[str],
+                     b_out: Optional[List[str]], amax: int,
                      seg_shapes, state_shapes, smax: int):
+        """``b_in``/``b_out``: the named tensors entering/leaving this
+        stage, packed as one concatenated flat buffer (stage 0 unpacks
+        every network input; the last real stage emits every head
+        input)."""
         net = self.net
         conf = net.conf
-        in_shape_t = conf.resolved_types[b_in]
         # deterministic per-node dropout-stream ids (Python's hash() is
         # salted per process — it would break seed reproducibility and
         # desync masks across multihost trace constants)
@@ -1089,6 +1150,9 @@ class GraphPipelineTrainer(_RingFitMixin):
         if not stage:
             return lambda pflat, sflat, cflat, xbuf, key, m: (
                 xbuf, sflat, cflat)
+
+        rt = conf.resolved_types
+        in_shapes = [(n, _type_shape(rt[n], 1)[1:]) for n in b_in]
 
         def branch(pflat, sflat, cflat, xbuf, key, m):
             p, s = {}, {}
@@ -1109,11 +1173,13 @@ class GraphPipelineTrainer(_RingFitMixin):
                                       .reshape(shp).astype(dt))
                     soff += n
                 p[name], s[name] = layer_p, layer_s
-            in_size = int(np.prod(_type_shape(in_shape_t, 1)[1:]))
-            acts = {b_in: xbuf[:, :in_size].reshape(
-                (-1,) + _type_shape(in_shape_t, 1)[1:])}
+            acts = {}
+            xoff = 0
+            for name, shp in in_shapes:
+                n = int(np.prod(shp))
+                acts[name] = xbuf[:, xoff:xoff + n].reshape((-1,) + shp)
+                xoff += n
             new_s = {}
-            last = b_in
             for name in stage:
                 node = conf.nodes[name]
                 in_acts = [acts[i] for i in node.inputs]
@@ -1131,8 +1197,9 @@ class GraphPipelineTrainer(_RingFitMixin):
                         mask=None)
                     new_s[name] = s[name] if layer.frozen else s_out
                     acts[name] = h
-                last = name
-            y = acts[last].reshape(acts[last].shape[0], -1)
+            rows = xbuf.shape[0]
+            y = jnp.concatenate([acts[n].reshape(rows, -1) for n in b_out],
+                                axis=1)
             leaves = [new_s[nm][k].reshape(-1).astype(jnp.float32)
                       for nm in stage if nm in new_s
                       for k in state_shapes[nm]]
@@ -1150,10 +1217,22 @@ class GraphPipelineTrainer(_RingFitMixin):
         net = self.net
         conf = net.conf
         S, M, axis = self.S, self.M, self.axis
-        stage_in, head_in_shape = self._boundary_shapes(b_mb)
-        head_in_size = int(np.prod(head_in_shape[1:]))
-        amax = max([int(np.prod(s[1:])) for s in stage_in]
-                   + [head_in_size])
+        stage_in, head_in = self._boundary_shapes(b_mb)
+
+        def width(named_shapes):
+            return sum(int(np.prod(shp[1:])) for _, shp in named_shapes)
+
+        head_in_size = width(head_in)
+        amax = max([width(si) for si in stage_in] + [head_in_size])
+        last_real = max(i for i, st in enumerate(self.stages) if st)
+        out_lists = []
+        for s in range(S):
+            if s == last_real:
+                out_lists.append(self.head_in_names)
+            elif s < last_real:
+                out_lists.append(self.boundaries[s + 1])
+            else:
+                out_lists.append(None)  # identity pass-through
         layer_stage_nodes = [[n for n in st
                               if conf.nodes[n].kind == "layer"]
                              for st in self.stages]
@@ -1170,8 +1249,8 @@ class GraphPipelineTrainer(_RingFitMixin):
                              for n in st for k in state_shapes[n])
                           for st in layer_stage_nodes])
         self._amax = amax
-        branches = [self._make_branch(st, self.boundaries[s], amax,
-                                      seg_shapes, state_shapes, smax)
+        branches = [self._make_branch(st, self.boundaries[s], out_lists[s],
+                                      amax, seg_shapes, state_shapes, smax)
                     for s, st in enumerate(self.stages)]
 
         def pack_bufs(params):
@@ -1211,19 +1290,30 @@ class GraphPipelineTrainer(_RingFitMixin):
 
         tx = net._tx
         training = conf.training
-        head_node = conf.nodes[self.out_name]
-        head = head_node.layer
         layer_list = [conf.nodes[n].layer for n in net._layer_nodes]
+        # static slicing metadata: where each head input lives in the
+        # final boundary buffer
+        head_slices = {}
+        hoff = 0
+        for n, shp in head_in:
+            sz = int(np.prod(shp[1:]))
+            head_slices[n] = (hoff, sz, shp[1:])
+            hoff += sz
 
         def loss_of(params, sbuf, cbuf, xs, labels, rng):
             outs, new_sbuf, new_cbuf = pipe(pack_bufs(params), sbuf, cbuf,
                                             xs, rng)
-            h = outs[..., :head_in_size].reshape(
-                (M * b_mb,) + head_in_shape[1:])
-            if head_node.preprocessor is not None:
-                h = head_node.preprocessor.transform(h, None)
-            data_loss = head.compute_loss(params[self.out_name], h,
-                                          labels, mask=None)
+            flat = outs[..., :head_in_size].reshape(M * b_mb, head_in_size)
+            data_loss = 0.0
+            for o in self.out_names:
+                node = conf.nodes[o]
+                off, sz, shp = head_slices[node.inputs[0]]
+                h = flat[:, off:off + sz].reshape((M * b_mb,) + shp)
+                if node.preprocessor is not None:
+                    h = node.preprocessor.transform(h, None)
+                lab = labels[o] if isinstance(labels, dict) else labels
+                data_loss = data_loss + node.layer.compute_loss(
+                    params[o], h, lab, mask=None)
             # l1_l2_penalty wants a LIST aligned with layer_list (the
             # graph loss path does the same, nn/graph.py:296-299)
             reg = l1_l2_penalty([params[n] for n in net._layer_nodes],

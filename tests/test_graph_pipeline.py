@@ -125,9 +125,12 @@ def test_graph_pipeline_rejects_remat_and_multidataset():
     net2 = ComputationGraph(_small_dag()).init()
     trainer = GraphPipelineTrainer(net2, mesh=_pp_mesh(2),
                                    n_microbatches=1)
-    b = _batch(b=4)
-    with pytest.raises(ValueError, match="DataSet"):
+    b = _batch(b=4)  # conv-sized features against the 6-wide dense DAG
+    with pytest.raises(ValueError, match="elements/sample"):
         trainer.fit_batch(MultiDataSet([b.features], [b.labels]))
+    with pytest.raises(ValueError, match="arity"):
+        trainer.fit_batch(MultiDataSet([b.features, b.features],
+                                       [b.labels]))
 
 
 def test_graph_pipeline_epoch_hooks_fire():
@@ -210,3 +213,47 @@ def test_graph_pipeline_dropout_cross_process_deterministic():
                 if l.startswith("LOSSES")][0]
 
     assert run("1") == run("2")
+
+
+def _two_in_two_out_dag(seed=8):
+    """Two inputs merge into a shared trunk; two loss heads read the
+    trunk (multi-io graphs, r5)."""
+    from deeplearning4j_tpu import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater("sgd", learning_rate=0.05).weight_init("xavier")
+         .graph_builder().add_inputs("a", "b"))
+    b.add_vertex("cat", MergeVertex(), "a", "b")
+    b.add_layer("t1", DenseLayer(n_out=16, activation="relu"), "cat")
+    b.add_layer("t2", DenseLayer(n_out=10, activation="tanh"), "t1")
+    b.add_layer("out1", OutputLayer(n_out=3, activation="softmax",
+                                    loss="mcxent"), "t2")
+    b.add_layer("out2", OutputLayer(n_out=2, activation="softmax",
+                                    loss="mcxent"), "t2")
+    return (b.set_outputs("out1", "out2")
+            .set_input_types(InputType.feed_forward(5),
+                             InputType.feed_forward(4)).build())
+
+
+def test_graph_pipeline_multi_io_parity():
+    """Two-input/two-head graph under pp=2: loss and updated params
+    match the single-device ComputationGraph step (summed head losses)."""
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    xa = RNG.normal(size=(8, 5)).astype(np.float32)
+    xb = RNG.normal(size=(8, 4)).astype(np.float32)
+    y1 = np.eye(3, dtype=np.float32)[RNG.integers(0, 3, 8)]
+    y2 = np.eye(2, dtype=np.float32)[RNG.integers(0, 2, 8)]
+    md = MultiDataSet([xa, xb], [y1, y2])
+
+    ref = ComputationGraph(_two_in_two_out_dag()).init()
+    loss_ref = float(ref.fit_batch(md))
+    net = ComputationGraph(_two_in_two_out_dag()).init()
+    tr = GraphPipelineTrainer(net, mesh=_pp_mesh(2), n_microbatches=2)
+    loss_pp = float(tr.fit_batch(md))
+    assert abs(loss_pp - loss_ref) < 1e-5, (loss_pp, loss_ref)
+    for n in ref.params:
+        for k in ref.params[n]:
+            np.testing.assert_allclose(np.asarray(net.params[n][k]),
+                                       np.asarray(ref.params[n][k]),
+                                       atol=1e-5, err_msg=f"{n} {k}")

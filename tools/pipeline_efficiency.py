@@ -182,7 +182,8 @@ def main():
     gmesh = Mesh(np.array(devs[:4]), axis_names=("pp",))
     gtr = GraphPipelineTrainer(rnet, mesh=gmesh, n_microbatches=4)
     rt = rnet.conf.resolved_types
-    payloads = [int(_type_elems(rt[b])) for b in gtr.boundaries[1:]]
+    payloads = [sum(int(_type_elems(rt[n])) for n in b)
+                for b in gtr.boundaries[1:]]
     lines += [
         "",
         "## ResNet-50 (32x32) DAG partition, S=4 (activation-aware DP)",
