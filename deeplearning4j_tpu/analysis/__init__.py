@@ -1,0 +1,33 @@
+"""Static analysis: pre-execution model validation + JAX anti-pattern lint.
+
+Two tools, both CPU-only and array-free, meant to run in milliseconds
+before any TPU time is spent (the pre-execution planning tradition of
+cuDNN-style primitive selection and the sharding-legality checks of
+automatic cross-replica sharding — PAPERS.md):
+
+- ``graphcheck``: walks a ``MultiLayerConfiguration`` /
+  ``ComputationGraphConfiguration`` without building arrays — per-layer
+  shape+dtype inference, cycle / dangling / dead-vertex / duplicate-name
+  detection, parameter-count + HBM/VMEM footprint estimation
+  (``MemoryReport``), and mesh-legality checks (dp divisibility, pp stage
+  balance, MoE expert counts).
+- ``jaxlint``: an AST linter over the source tree flagging JAX
+  anti-patterns inside jitted/scanned/vmapped code (tracer leaks, traced
+  branches, host syncs, Python-loop compute, impure calls in jit, jitted
+  train steps missing ``donate_argnums``).
+
+CLIs: ``tools/graphcheck.py`` and ``tools/jaxlint.py``; both are wired
+into ``tools/run_checks.sh``.
+"""
+
+from deeplearning4j_tpu.analysis.findings import Finding, Severity, max_severity
+from deeplearning4j_tpu.analysis.graphcheck import (
+    check_graph, check_multilayer, validate_config,
+)
+from deeplearning4j_tpu.analysis.memory import MemoryReport, memory_report
+
+__all__ = [
+    "Finding", "Severity", "max_severity",
+    "check_multilayer", "check_graph", "validate_config",
+    "MemoryReport", "memory_report",
+]

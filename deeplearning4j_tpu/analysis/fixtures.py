@@ -1,0 +1,190 @@
+"""Known-bad and known-good configs for graphcheck's self-check.
+
+Shared by ``tools/graphcheck.py --self-check`` (the CI gate) and
+``tests/test_graphcheck.py``. Each known-bad entry names the rule id its
+defect must produce; the known-good entries are the seed model families
+(MLP, CNN, RNN, ComputationGraph merge) and must validate clean.
+
+The broken configs are constructed directly (dataclass constructors, no
+``build()``): the builders throw on several of these defects by design,
+and graphcheck exists precisely for configs that arrive from JSON/YAML
+without ever passing through a builder.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.nn.conf.builder import (
+    MultiLayerConfiguration, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+from deeplearning4j_tpu.nn.conf.graph_builder import (
+    ComputationGraphConfiguration, NodeConf,
+)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.layers.convolution import (
+    ConvolutionLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.layers.recurrent import LSTM, RnnOutputLayer
+
+
+# ---------------------------------------------------------------------------
+# known-bad: (name, expected_rule, build() -> (conf, validate_kwargs))
+# ---------------------------------------------------------------------------
+
+def bad_shape_mismatch():
+    """Stacked Dense layers whose declared widths disagree: 784 -> 256
+    feeding a layer that claims n_in=128."""
+    conf = MultiLayerConfiguration(layers=[
+        DenseLayer(n_in=784, n_out=256, activation="relu"),
+        DenseLayer(n_in=128, n_out=64, activation="relu"),
+        OutputLayer(n_in=64, n_out=10, activation="softmax", loss="mcxent"),
+    ])
+    return conf, {}
+
+
+def bad_graph_cycle():
+    """a -> b -> c -> a: a DAG with a loop."""
+    mk = lambda name, inputs: NodeConf(
+        name=name, kind="layer", inputs=inputs,
+        layer=DenseLayer(n_in=8, n_out=8, activation="relu"))
+    nodes = {
+        "in": NodeConf(name="in", kind="input"),
+        "a": mk("a", ["c"]),
+        "b": mk("b", ["a"]),
+        "c": mk("c", ["b"]),
+        "out": NodeConf(name="out", kind="layer", inputs=["c"],
+                        layer=OutputLayer(n_in=8, n_out=2,
+                                          activation="softmax")),
+    }
+    conf = ComputationGraphConfiguration(
+        nodes=nodes, network_inputs=["in"], network_outputs=["out"],
+        input_types={"in": InputType.feed_forward(8)})
+    return conf, {}
+
+
+def bad_dangling_vertex():
+    """A node referencing an input that does not exist."""
+    nodes = {
+        "in": NodeConf(name="in", kind="input"),
+        "h": NodeConf(name="h", kind="layer", inputs=["ghost"],
+                      layer=DenseLayer(n_in=8, n_out=8, activation="relu")),
+        "out": NodeConf(name="out", kind="layer", inputs=["h"],
+                        layer=OutputLayer(n_in=8, n_out=2,
+                                          activation="softmax")),
+    }
+    conf = ComputationGraphConfiguration(
+        nodes=nodes, network_inputs=["in"], network_outputs=["out"],
+        input_types={"in": InputType.feed_forward(8)})
+    return conf, {}
+
+
+def bad_dp_indivisible():
+    """Fine model, but batch 33 cannot shard over dp=8."""
+    conf, _ = good_mlp()
+    return conf, {"mesh": {"dp": 8}, "batch_size": 33}
+
+
+def bad_pp_unbalanced():
+    """One layer holds ~99% of the params: no contiguous 4-stage split
+    can balance, three pipeline stages idle every tick."""
+    conf = (NeuralNetConfiguration.builder()
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=4096, activation="relu"))
+            .layer(DenseLayer(n_out=4096, activation="relu"))
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.feed_forward(4096))
+            .build())
+    return conf, {"mesh": {"pp": 4}, "batch_size": 32}
+
+
+KNOWN_BAD: List[Tuple[str, str, Callable]] = [
+    ("shape-mismatch", "GC005", bad_shape_mismatch),
+    ("graph-cycle", "GC002", bad_graph_cycle),
+    ("dangling-vertex", "GC003", bad_dangling_vertex),
+    ("dp-indivisible-batch", "GC008", bad_dp_indivisible),
+    ("unbalanced-pp-split", "GC009", bad_pp_unbalanced),
+]
+
+
+# ---------------------------------------------------------------------------
+# known-good: the seed model families
+# ---------------------------------------------------------------------------
+
+def good_mlp():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12345)
+            .updater("adam", learning_rate=1e-3)
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=256, activation="relu"))
+            .layer(DenseLayer(n_out=64, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(784))
+            .build())
+    return conf, {"mesh": {"dp": 8}, "batch_size": 64}
+
+
+def good_cnn():
+    """LeNet-style stack (the seed's models/lenet.py family)."""
+    conf = (NeuralNetConfiguration.builder()
+            .updater("adam", learning_rate=1e-3)
+            .weight_init("xavier")
+            .list()
+            .layer(ConvolutionLayer(n_out=8, kernel_size=(5, 5),
+                                    stride=(1, 1), activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=16, kernel_size=(5, 5),
+                                    stride=(1, 1), activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=64, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(28, 28, 1))
+            .build())
+    return conf, {"mesh": {"dp": 2}, "batch_size": 32}
+
+
+def good_rnn():
+    conf = (NeuralNetConfiguration.builder()
+            .updater("adam", learning_rate=1e-3)
+            .weight_init("xavier")
+            .list()
+            .layer(LSTM(n_out=32, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=5, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(16, 20))
+            .build())
+    return conf, {"batch_size": 16}
+
+
+def good_graph_merge():
+    """Two-branch merge graph (the ComputationGraph seed family)."""
+    conf = (NeuralNetConfiguration.builder()
+            .updater("adam", learning_rate=1e-3)
+            .weight_init("xavier")
+            .graph_builder()
+            .add_inputs("in_a", "in_b")
+            .set_input_types(InputType.feed_forward(12),
+                             InputType.feed_forward(8))
+            .add_layer("da", DenseLayer(n_out=16, activation="relu"), "in_a")
+            .add_layer("db", DenseLayer(n_out=16, activation="relu"), "in_b")
+            .add_vertex("merge", MergeVertex(), "da", "db")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "merge")
+            .set_outputs("out")
+            .build())
+    return conf, {"mesh": {"dp": 4}, "batch_size": 32}
+
+
+KNOWN_GOOD: List[Tuple[str, Callable]] = [
+    ("mlp", good_mlp),
+    ("cnn", good_cnn),
+    ("rnn", good_rnn),
+    ("graph-merge", good_graph_merge),
+]

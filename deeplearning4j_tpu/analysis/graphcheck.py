@@ -1,0 +1,658 @@
+"""graphcheck: config-level static validator.
+
+Walks a ``MultiLayerConfiguration`` / ``ComputationGraphConfiguration``
+WITHOUT building any arrays and returns a list of ``Finding``s instead of
+throwing on the first defect — the collectable form of the reference's
+config-time checks (``InputType.getOutputType``, preprocessor insertion,
+``MemoryReport``), extended with the mesh-legality rules the TPU
+parallel layer needs (dp divisibility, pp stage balance, MoE expert
+counts per ``parallel/pipeline.py`` and ``parallel/expert.py``).
+
+Rules (stable ids; severities in parentheses):
+
+- GC001 duplicate-name    (error)   two layers/vertices share a name
+- GC002 graph-cycle       (error)   the DAG contains a cycle
+- GC003 dangling-ref      (error)   a node references an unknown input
+- GC004 dead-vertex       (warning) a node feeds no network output
+- GC005 shape-mismatch    (error)   declared n_in contradicts the
+                                    inferred input size, or per-layer
+                                    shape/dtype inference fails
+- GC006 missing-loss-head (warning) final layer / output node has no loss
+- GC007 hbm-overflow      (warning) estimated training HBM exceeds the
+                                    per-chip budget
+- GC008 dp-indivisible    (error)   batch size not divisible by the data-
+                                    parallel mesh axis
+- GC009 pp-imbalance      (warning) best contiguous stage partition is
+                                    skewed, or more pp stages than layers
+- GC010 ep-mismatch       (error)   MoE expert count not divisible by the
+                                    expert-parallel mesh axis
+- GC012 vertex-arity      (error)   vertex input count != n_inputs()
+
+Entry points: ``check_multilayer`` / ``check_graph`` /
+``validate_config`` (dispatch), plus ``.validate()`` hooks installed on
+both configuration classes and builders (nn/conf). The CLI lives in
+``tools/graphcheck.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from deeplearning4j_tpu.analysis.findings import Finding, Severity
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+# pp stage partitions whose heaviest stage exceeds the mean by this factor
+# waste the slice (the bubble amortizes, the skew does not)
+PP_IMBALANCE_RATIO = 1.5
+
+
+# ---------------------------------------------------------------------------
+# mesh normalization
+# ---------------------------------------------------------------------------
+
+def _mesh_axes(mesh) -> Dict[str, int]:
+    """Normalize a mesh spec to {axis_name: size}. Accepts a dict, a
+    jax.sharding.Mesh, or a parallel.mesh.MeshContext."""
+    if mesh is None:
+        return {}
+    if isinstance(mesh, dict):
+        return {str(k): int(v) for k, v in mesh.items()}
+    inner = getattr(mesh, "mesh", None)  # MeshContext
+    if inner is not None and hasattr(inner, "shape"):
+        mesh = inner
+    if hasattr(mesh, "shape") and hasattr(mesh, "axis_names"):
+        return {a: int(mesh.shape[a]) for a in mesh.axis_names}
+    raise TypeError(f"Unsupported mesh spec {type(mesh).__name__}")
+
+
+def _dp_size(axes: Dict[str, int]) -> Optional[int]:
+    for name in ("dp", "data"):
+        if name in axes:
+            return axes[name]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+def _layer_label(i: int, layer) -> str:
+    if getattr(layer, "name", None):
+        return str(layer.name)
+    return f"layer[{i}]({type(layer).__name__})"
+
+
+def _safe_param_count(layer) -> int:
+    """Param count via abstract eval; 0 when inference is impossible
+    (a GC005 finding covers that case)."""
+    from deeplearning4j_tpu.analysis.memory import param_count
+    try:
+        return param_count(layer)
+    except Exception:
+        return 0
+
+
+def _declared_n_ins(layer, prefix: str = "n_in") -> Dict[str, int]:
+    """Every declared input width on a layer, including widths nested in
+    wrapper layers (TimeDistributedLayer.inner)."""
+    out: Dict[str, int] = {}
+    if getattr(layer, "n_in", None) is not None:
+        out[prefix] = int(layer.n_in)
+    inner = getattr(layer, "inner", None)
+    if inner is not None and hasattr(inner, "n_in"):
+        out.update(_declared_n_ins(inner, prefix="inner." + prefix))
+    return out
+
+
+def _n_in_conflicts(layer, in_type: InputType):
+    """[(path, declared, inferred)] for every declared n_in (nested
+    wrappers included) that shape inference would overwrite with a
+    different value — some layers (MoE, recurrent) record the feature
+    size of an rnn input, not the flat size, so the comparison runs
+    set_n_in on a DEEP copy (wrapper layers forward it to a nested layer
+    object a shallow copy would share; the validator must never mutate
+    the user's config)."""
+    import copy
+    declared = _declared_n_ins(layer)
+    if not declared or not layer.has_params():
+        return []
+    probe = copy.deepcopy(layer)
+    probe.set_n_in(in_type)
+    inferred = _declared_n_ins(probe)
+    return [(path, declared[path], inferred[path]) for path in declared
+            if path in inferred and inferred[path] != declared[path]]
+
+
+def _walk_multilayer_shapes(conf, findings: List[Finding]
+                            ) -> List[Optional[InputType]]:
+    """Infer each layer's OUTPUT type, collecting findings instead of
+    raising. Returns one entry per layer (None once inference is lost)."""
+    from deeplearning4j_tpu.nn.conf.builder import expected_input_kind
+    from deeplearning4j_tpu.nn.conf.preprocessors import auto_preprocessor
+
+    out_types: List[Optional[InputType]] = []
+    cur: Optional[InputType] = conf.input_type
+    for i, layer in enumerate(conf.layers):
+        label = _layer_label(i, layer)
+        if cur is None and layer.has_params():
+            if layer.n_in is None:
+                findings.append(Finding(
+                    "GC005", Severity.ERROR, label,
+                    "n_in is not set and the configuration has no "
+                    "input_type to infer it from",
+                    "call set_input_type(...) on the builder or set n_in "
+                    "explicitly"))
+                out_types.append(None)
+                continue
+            # resume inference from the declared width
+            cur = InputType.feed_forward(layer.n_in)
+        if cur is not None:
+            pre = conf.preprocessors.get(i)
+            if pre is None:
+                try:
+                    pre = auto_preprocessor(cur, expected_input_kind(layer))
+                except ValueError as e:
+                    findings.append(Finding(
+                        "GC005", Severity.ERROR, label, str(e),
+                        "insert an explicit InputPreProcessor for this "
+                        "layer"))
+                    cur = None
+            if pre is not None and cur is not None:
+                cur = pre.infer_output_type(cur)
+        if cur is not None:
+            try:
+                conflicts = _n_in_conflicts(layer, cur)
+            except Exception:
+                conflicts = []  # inference failure reported just below
+            for path, declared, want in conflicts:
+                findings.append(Finding(
+                    "GC005", Severity.ERROR, label,
+                    f"declared {path}={declared} but the previous layer "
+                    f"produces {want} features ({cur})",
+                    f"set {path}={want} or fix the upstream layer's "
+                    "n_out"))
+        if cur is None:
+            out_types.append(None)
+            continue
+        try:
+            import copy  # deep probe: never mutate the user's conf
+            probe = copy.deepcopy(layer)
+            probe.set_n_in(cur)
+            cur = probe.infer_output_type(cur)
+            out_types.append(cur)
+        except Exception as e:
+            findings.append(Finding(
+                "GC005", Severity.ERROR, label,
+                f"shape inference failed: {e}",
+                "check kernel/stride/padding against the incoming "
+                "activation shape"))
+            cur = None
+            out_types.append(None)
+    return out_types
+
+
+# ---------------------------------------------------------------------------
+# mesh-legality checks (shared by both config kinds)
+# ---------------------------------------------------------------------------
+
+def _check_mesh(findings: List[Finding], body_layers: List[Tuple[str, object]],
+                mesh, batch_size: Optional[int],
+                counts: Optional[List[int]] = None) -> None:
+    """dp divisibility, pp stage balance, MoE expert counts.
+    ``body_layers``: (label, layer) for every non-head layer, in order;
+    ``counts``: their param counts when the caller already has them (one
+    MemoryReport pass), else abstract-evaluated here."""
+    axes = _mesh_axes(mesh)
+    dp = _dp_size(axes)
+    if dp and batch_size is not None and batch_size % dp != 0:
+        findings.append(Finding(
+            "GC008", Severity.ERROR, f"batch={batch_size}",
+            f"batch size {batch_size} is not divisible by the "
+            f"data-parallel axis (dp={dp}) — shard_map would reject the "
+            "batch spec at trace time",
+            f"use a batch size that is a multiple of {dp}"))
+    pp = axes.get("pp")
+    if pp and pp > 1 and body_layers:
+        if counts is None:
+            counts = [_safe_param_count(l) for _, l in body_layers]
+        if pp > len(body_layers):
+            findings.append(Finding(
+                "GC009", Severity.WARNING, f"pp={pp}",
+                f"{pp} pipeline stages over {len(body_layers)} body "
+                "layers — trailing stages are identity pass-throughs "
+                "that only add bubble ticks",
+                "shrink the pp axis or deepen the model"))
+        else:
+            total = sum(counts)
+            heaviest = _optimal_max_stage(counts, pp)
+            mean = total / pp
+            if mean > 0 and heaviest / mean > PP_IMBALANCE_RATIO:
+                findings.append(Finding(
+                    "GC009", Severity.WARNING, f"pp={pp}",
+                    f"best contiguous stage partition is unbalanced: the "
+                    f"heaviest stage holds {heaviest:,} of {total:,} "
+                    f"params ({heaviest / max(total, 1):.0%}, vs "
+                    f"{1 / pp:.0%} ideal); the other stages idle behind "
+                    "it every tick",
+                    "split the dominant layer, move width into other "
+                    "layers, or reduce the pp axis"))
+    ep = axes.get("ep")
+    if ep and ep > 1:
+        for label, layer in body_layers:
+            n_experts = getattr(layer, "n_experts", None)
+            if n_experts is not None and n_experts % ep != 0:
+                findings.append(Finding(
+                    "GC010", Severity.ERROR, label,
+                    f"n_experts={n_experts} is not divisible by the "
+                    f"expert-parallel axis (ep={ep}) — the stacked expert "
+                    "weights cannot shard evenly",
+                    f"use a multiple of {ep} experts or resize the ep "
+                    "axis"))
+
+
+def _optimal_max_stage(costs: List[int], n_stages: int) -> int:
+    """Heaviest stage of the OPTIMAL contiguous partition — the same
+    minimize-the-max objective as parallel/pipeline.partition_stages with
+    no activation term, re-implemented locally so the validator never
+    imports the (jax-heavy) parallel layer. If even the best split is
+    skewed, the skew is inherent to the model, which is exactly what
+    GC009 reports. O(S * n^2) DP over prefix sums; n = layer count."""
+    n = len(costs)
+    ps = [0]
+    for c in costs:
+        ps.append(ps[-1] + c)
+    INF = float("inf")
+    # best[i] = minimal max-stage-sum splitting items[0:i] into k stages,
+    # for the current k (rolled)
+    best = [0.0] + [INF] * n
+    for _ in range(n_stages - 1):
+        nxt = [INF] * (n + 1)
+        for i in range(n):
+            if best[i] == INF:
+                continue
+            for j in range(i + 1, n + 1):
+                v = max(best[i], ps[j] - ps[i])
+                if v < nxt[j]:
+                    nxt[j] = v
+        best = nxt
+    return int(min(max(best[i], ps[n] - ps[i]) for i in range(n)
+                   if best[i] != INF))
+
+
+def _build_report(conf, batch_size: Optional[int], walk=None):
+    """One MemoryReport per validation pass — _check_mesh reuses its
+    param counts and _check_hbm its totals. ``walk`` hands over the
+    (name, layer, out_type) triples the checker already inferred so the
+    report never re-runs the shape walk."""
+    from deeplearning4j_tpu.analysis.memory import memory_report
+    try:
+        return memory_report(conf, batch_size=batch_size or 32, layers=walk)
+    except Exception:
+        return None  # inference failures already reported as GC005
+
+
+def _check_hbm(findings: List[Finding], rep, batch_size: Optional[int],
+               hbm_bytes: int) -> None:
+    if rep is None or batch_size is None:
+        return
+    if rep.total_hbm_bytes > hbm_bytes:
+        findings.append(Finding(
+            "GC007", Severity.WARNING, f"batch={batch_size}",
+            f"estimated training footprint "
+            f"{rep.total_hbm_bytes / 1024 ** 3:.1f} GiB exceeds the "
+            f"{hbm_bytes / 1024 ** 3:.0f} GiB per-chip HBM budget",
+            "shard params over more chips, shrink the batch, or enable "
+            "gradient_checkpointing()"))
+
+
+# ---------------------------------------------------------------------------
+# MultiLayerConfiguration
+# ---------------------------------------------------------------------------
+
+def check_multilayer(conf, *, mesh=None, batch_size: Optional[int] = None,
+                     hbm_bytes: Optional[int] = None) -> List[Finding]:
+    """Validate a MultiLayerConfiguration. Pure CPU metadata walk — no
+    arrays are built."""
+    from deeplearning4j_tpu.analysis.memory import DEFAULT_HBM_BYTES
+    findings: List[Finding] = []
+    if not conf.layers:
+        findings.append(Finding(
+            "GC005", Severity.ERROR, "<config>", "configuration has no "
+            "layers", "add at least one layer before build()"))
+        return findings
+    seen: Dict[str, int] = {}
+    for i, layer in enumerate(conf.layers):
+        n = getattr(layer, "name", None)
+        if n:
+            if n in seen:
+                findings.append(Finding(
+                    "GC001", Severity.ERROR, n,
+                    f"duplicate layer name (layers {seen[n]} and {i})",
+                    "give each layer a unique name"))
+            else:
+                seen[n] = i
+    out_types = _walk_multilayer_shapes(conf, findings)
+    head = conf.layers[-1]
+    if not hasattr(head, "compute_loss"):
+        findings.append(Finding(
+            "GC006", Severity.WARNING, _layer_label(len(conf.layers) - 1, head),
+            f"final layer {type(head).__name__} has no loss — fit() will "
+            "be rejected (inference-only configs are fine)",
+            "end the stack with OutputLayer / RnnOutputLayer / LossLayer"))
+    if (conf.training.backprop_type == "truncated_bptt"
+            and out_types and out_types[-1] is not None
+            and out_types[-1].kind != "rnn"):
+        findings.append(Finding(
+            "GC005", Severity.ERROR, _layer_label(len(conf.layers) - 1, head),
+            "truncated_bptt requires a time-distributed (rnn) output; the "
+            f"final layer produces {out_types[-1].kind!r}",
+            "use RnnOutputLayer or switch to standard backprop"))
+    body = [(_layer_label(i, l), l) for i, l in enumerate(conf.layers[:-1])]
+    walk = [(_layer_label(i, l), l, out_types[i])
+            for i, l in enumerate(conf.layers)]
+    rep = (_build_report(conf, batch_size, walk)
+           if mesh is not None or batch_size is not None else None)
+    counts = ([e.n_params for e in rep.entries[:-1]]
+              if rep is not None and len(rep.entries) == len(conf.layers)
+              else None)
+    _check_mesh(findings, body, mesh, batch_size, counts=counts)
+    _check_hbm(findings, rep, batch_size, hbm_bytes or DEFAULT_HBM_BYTES)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# ComputationGraphConfiguration
+# ---------------------------------------------------------------------------
+
+def _lenient_topo(conf, findings: List[Finding]) -> List[str]:
+    """Kahn's algorithm that REPORTS cycles/dangling refs instead of
+    raising (graph_builder._topo_sort throws; graphcheck must keep
+    walking to collect every defect)."""
+    nodes = conf.nodes
+    dangling = set()
+    for name, node in nodes.items():
+        for inp in node.inputs:
+            if inp not in nodes:
+                findings.append(Finding(
+                    "GC003", Severity.ERROR, name,
+                    f"references unknown input {inp!r}",
+                    "add the missing node or fix the input name"))
+                dangling.add((name, inp))
+    indeg = {n: sum(1 for i in c.inputs if i in nodes)
+             for n, c in nodes.items()}
+    children: Dict[str, List[str]] = {n: [] for n in nodes}
+    for n, c in nodes.items():
+        for inp in c.inputs:
+            if inp in nodes:
+                children[inp].append(n)
+    queue = [n for n, d in indeg.items() if d == 0]
+    order: List[str] = []
+    while queue:
+        n = queue.pop(0)
+        order.append(n)
+        for ch in children[n]:
+            indeg[ch] -= 1
+            if indeg[ch] == 0:
+                queue.append(ch)
+    if len(order) != len(nodes):
+        cyc = sorted(n for n, d in indeg.items() if d > 0)
+        findings.append(Finding(
+            "GC002", Severity.ERROR, ",".join(cyc),
+            f"graph contains a cycle through {cyc}",
+            "break the cycle (a recurrent loop must live inside a "
+            "recurrent layer, not the DAG)"))
+    return order
+
+
+def _walk_graph_shapes(conf, order: List[str],
+                       findings: List[Finding]) -> Dict[str, InputType]:
+    """Shape/dtype inference over the resolvable part of the DAG — the
+    lenient counterpart of ``_resolve_shapes``, shared by check_graph
+    and the memory walk so types are inferred exactly once per pass."""
+    from deeplearning4j_tpu.nn.conf.builder import expected_input_kind
+    from deeplearning4j_tpu.nn.conf.preprocessors import auto_preprocessor
+
+    nodes = conf.nodes
+    types: Dict[str, InputType] = {}
+    for name in order:
+        node = nodes[name]
+        if node.kind == "input":
+            t = conf.input_types.get(name)
+            if t is not None:
+                types[name] = t
+            continue
+        if any(i not in types for i in node.inputs):
+            continue  # upstream unresolved (missing input_types or errors)
+        in_ts = [types[i] for i in node.inputs]
+        if node.kind == "layer":
+            if len(node.inputs) != 1:
+                findings.append(Finding(
+                    "GC012", Severity.ERROR, name,
+                    f"layer node takes exactly 1 input, got "
+                    f"{len(node.inputs)}",
+                    "merge multiple inputs with a MergeVertex first"))
+                continue
+            cur = in_ts[0]
+            try:
+                pre = node.preprocessor
+                if pre is None:
+                    pre = auto_preprocessor(cur,
+                                            expected_input_kind(node.layer))
+                if pre is not None:
+                    cur = pre.infer_output_type(cur)
+                for path, declared, want in _n_in_conflicts(node.layer, cur):
+                    findings.append(Finding(
+                        "GC005", Severity.ERROR, name,
+                        f"declared {path}={declared} but input "
+                        f"{node.inputs[0]!r} produces {want} features "
+                        f"({cur})",
+                        f"set {path}={want} or fix the upstream node"))
+                import copy
+                probe = copy.deepcopy(node.layer)
+                probe.set_n_in(cur)
+                types[name] = probe.infer_output_type(cur)
+            except Exception as e:
+                findings.append(Finding(
+                    "GC005", Severity.ERROR, name,
+                    f"shape inference failed: {e}",
+                    "check the layer's geometry against its input"))
+        else:
+            want = node.vertex.n_inputs()
+            if want is not None and len(node.inputs) != want:
+                findings.append(Finding(
+                    "GC012", Severity.ERROR, name,
+                    f"vertex {type(node.vertex).__name__} expects {want} "
+                    f"input(s), got {len(node.inputs)}",
+                    "fix the vertex wiring"))
+                continue
+            try:
+                types[name] = node.vertex.infer_output_type(in_ts)
+            except Exception as e:
+                findings.append(Finding(
+                    "GC005", Severity.ERROR, name,
+                    f"vertex shape inference failed: {e}",
+                    "check that all vertex inputs have compatible shapes"))
+    return types
+
+
+def check_graph(conf, *, mesh=None, batch_size: Optional[int] = None,
+                hbm_bytes: Optional[int] = None) -> List[Finding]:
+    """Validate a ComputationGraphConfiguration — including configs the
+    builder itself would refuse to construct (cycles, dangling refs),
+    which is why this walk never calls ``_resolve_shapes``."""
+    from deeplearning4j_tpu.analysis.memory import DEFAULT_HBM_BYTES
+
+    findings: List[Finding] = []
+    nodes = conf.nodes
+    for name, count in getattr(conf, "duplicate_nodes", ()):
+        findings.append(Finding(
+            "GC001", Severity.ERROR, name,
+            f"node name appears {count} times in the serialized graph "
+            "(only the last definition survives loading)",
+            "give each node a unique name"))
+    if not conf.network_inputs:
+        findings.append(Finding(
+            "GC003", Severity.ERROR, "<config>",
+            "no network inputs declared", "call add_inputs(...)"))
+    if not conf.network_outputs:
+        findings.append(Finding(
+            "GC003", Severity.ERROR, "<config>",
+            "no network outputs declared", "call set_outputs(...)"))
+    for out in conf.network_outputs:
+        if out not in nodes:
+            findings.append(Finding(
+                "GC003", Severity.ERROR, out,
+                "declared network output does not exist",
+                "fix set_outputs(...) or add the node"))
+    order = _lenient_topo(conf, findings)
+
+    # dead vertices: reverse reachability from the outputs
+    parents = {n: [i for i in c.inputs if i in nodes]
+               for n, c in nodes.items()}
+    live = set()
+    stack = [o for o in conf.network_outputs if o in nodes]
+    while stack:
+        n = stack.pop()
+        if n in live:
+            continue
+        live.add(n)
+        stack.extend(parents[n])
+    for name in order:
+        if name not in live:
+            kind = nodes[name].kind
+            findings.append(Finding(
+                "GC004", Severity.WARNING, name,
+                f"{kind} node feeds no network output (dead vertex) — its "
+                "params would train on no gradient signal",
+                "connect it to an output or remove it"))
+
+    types = _walk_graph_shapes(conf, order, findings)
+
+    # merge-vertex height/width agreement (concat along channels needs
+    # matching spatial dims — infer_output_type alone doesn't check)
+    from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+    for name in order:
+        node = nodes[name]
+        if node.kind != "vertex" or not isinstance(node.vertex, MergeVertex):
+            continue
+        in_ts = [types.get(i) for i in node.inputs]
+        cnn = [t for t in in_ts if t is not None and t.kind == "cnn"]
+        if len(cnn) > 1 and len({(t.height, t.width) for t in cnn}) > 1:
+            findings.append(Finding(
+                "GC005", Severity.ERROR, name,
+                "MergeVertex inputs have mismatched spatial dims: "
+                + ", ".join(f"{t.height}x{t.width}" for t in cnn),
+                "pad or pool the branches to a common height/width before "
+                "merging"))
+
+    for out in conf.network_outputs:
+        node = nodes.get(out)
+        if node is None:
+            continue
+        if node.kind != "layer" or not hasattr(node.layer, "compute_loss"):
+            findings.append(Finding(
+                "GC006", Severity.WARNING, out,
+                "output node has no loss head — fit() will be rejected "
+                "(inference-only graphs are fine)",
+                "make the output an OutputLayer/RnnOutputLayer/LossLayer "
+                "node"))
+
+    heads = set(conf.network_outputs)
+    body = [(n, nodes[n].layer) for n in order
+            if nodes[n].kind == "layer" and n not in heads]
+    walk = [(n, nodes[n].layer, types.get(n)) for n in order
+            if nodes[n].kind == "layer"]
+    rep = (_build_report(conf, batch_size, walk)
+           if mesh is not None or batch_size is not None else None)
+    counts = None
+    if rep is not None:
+        by_name = {e.name: e.n_params for e in rep.entries}
+        if all(n in by_name for n, _ in body):
+            counts = [by_name[n] for n, _ in body]
+    _check_mesh(findings, body, mesh, batch_size, counts=counts)
+    if not any(f.severity == Severity.ERROR for f in findings):
+        _check_hbm(findings, rep, batch_size,
+                   hbm_bytes or DEFAULT_HBM_BYTES)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# dispatch + iteration helpers
+# ---------------------------------------------------------------------------
+
+def validate_config(conf, *, mesh=None, batch_size: Optional[int] = None,
+                    hbm_bytes: Optional[int] = None) -> List[Finding]:
+    """Dispatch on configuration type."""
+    if hasattr(conf, "nodes"):
+        return check_graph(conf, mesh=mesh, batch_size=batch_size,
+                           hbm_bytes=hbm_bytes)
+    return check_multilayer(conf, mesh=mesh, batch_size=batch_size,
+                            hbm_bytes=hbm_bytes)
+
+
+def iter_config_layers(conf) -> Iterator[Tuple[str, object,
+                                               Optional[InputType]]]:
+    """Yield (name, layer_conf, output InputType or None) for every layer
+    of either config kind, in execution order — the walk MemoryReport
+    aggregates over."""
+    if hasattr(conf, "nodes"):
+        rt = dict(conf.resolved_types or {})
+        scratch: List[Finding] = []
+        if rt:
+            order = conf.topological_order or list(conf.nodes)
+        else:
+            # leniently-loaded graph (CLI / builder validate): infer the
+            # types here so activation memory is not silently dropped
+            order = _lenient_topo(conf, scratch)
+            rt = _walk_graph_shapes(conf, order, scratch)
+        for name in order:
+            node = conf.nodes[name]
+            if node.kind == "layer":
+                yield name, node.layer, rt.get(name)
+        return
+    scratch = []
+    out_types = _walk_multilayer_shapes(conf, scratch)
+    for i, layer in enumerate(conf.layers):
+        yield _layer_label(i, layer), layer, out_types[i]
+
+
+def load_config_dict(d: dict):
+    """Deserialize a config dict LENIENTLY: the standard ``from_dict``
+    paths resolve shapes and throw on broken graphs; this loader
+    constructs the object without resolution so graphcheck can report
+    every defect. Dispatches on the ``format`` tag."""
+    fmt = d.get("format", "")
+    if "ComputationGraph" in fmt:
+        from deeplearning4j_tpu.nn.conf.builder import TrainingConfig
+        from deeplearning4j_tpu.nn.conf.graph import GraphVertex
+        from deeplearning4j_tpu.nn.conf.graph_builder import (
+            ComputationGraphConfiguration, NodeConf,
+        )
+        from deeplearning4j_tpu.nn.conf.preprocessors import InputPreProcessor
+        from deeplearning4j_tpu.nn.layers.base import layer_from_dict
+        nodes: Dict[str, NodeConf] = {}
+        name_counts: Dict[str, int] = {}
+        for nd in d["nodes"]:
+            name_counts[nd["name"]] = name_counts.get(nd["name"], 0) + 1
+            nodes[nd["name"]] = NodeConf(
+                name=nd["name"], kind=nd["kind"], inputs=list(nd["inputs"]),
+                layer=layer_from_dict(nd["layer"]) if "layer" in nd else None,
+                vertex=(GraphVertex.from_dict(nd["vertex"])
+                        if "vertex" in nd else None),
+                preprocessor=(InputPreProcessor.from_dict(nd["preprocessor"])
+                              if "preprocessor" in nd else None))
+        conf = ComputationGraphConfiguration(
+            nodes=nodes,
+            network_inputs=list(d["network_inputs"]),
+            network_outputs=list(d["network_outputs"]),
+            input_types={k: InputType.from_dict(v)
+                         for k, v in d.get("input_types", {}).items()},
+            training=TrainingConfig.from_dict(d["training"]))
+        # the dict form can carry name collisions the node map cannot —
+        # record them so check_graph reports GC001 instead of silently
+        # validating the collapsed graph
+        conf.duplicate_nodes = [(n, c) for n, c in name_counts.items()
+                                if c > 1]
+        return conf
+    from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+    return MultiLayerConfiguration.from_dict(d)

@@ -1,0 +1,499 @@
+"""jaxlint: AST-based linter for JAX anti-patterns in traced code.
+
+Finds the mistakes that burn TPU time silently: tracer leaks, host-device
+syncs, Python-loop compute, impure calls inside jit, and jitted training
+steps that forget buffer donation. Pure ``ast`` + ``tokenize`` — no jax
+import, no code execution; runs in milliseconds over the whole tree.
+
+Rules (stable ids):
+
+- JL001 tracer-cast    (error)   ``float()``/``int()``/``bool()`` or
+        ``.item()``/``.tolist()`` applied to a traced value inside a
+        traced function — forces a host sync (and under jit, a concretization
+        error at trace time)
+- JL002 traced-branch  (error)   ``if``/``while``/ternary whose condition
+        calls into jnp/jax.lax inside a traced function — Python control
+        flow cannot branch on a tracer; use ``lax.cond``/``jnp.where``
+- JL003 host-sync      (warning) ``jax.device_get`` / ``np.asarray`` /
+        ``.block_until_ready()`` / ``print`` on traced values in a traced
+        function — a device round-trip in the hot path
+- JL004 loop-compute   (warning) a Python ``for``/``while`` loop inside a
+        traced function whose body calls jnp/jax.lax — unrolls into the
+        program; usually wants ``lax.scan``/``fori_loop``/``vmap``
+- JL005 impure-jit     (error)   ``time.time()``/``time.perf_counter()``/
+        ``np.random.*``/``random.*``/``datetime.now()`` inside a traced
+        function — baked in as a trace-time constant
+- JL006 missing-donate (warning) ``jax.jit`` applied to a function whose
+        name marks it as a training step without ``donate_argnums`` —
+        doubles peak HBM by keeping dead input buffers alive
+
+Traced-context detection is lexical: a function counts as traced when it
+is (a) decorated with ``jax.jit``/``pmap``/``vmap``/``shard_map`` (bare
+or via ``partial``), (b) passed by name to a tracing entry point
+(``jax.jit(f)``, ``lax.scan(f, ...)``, ``jax.grad(f)``, ...), or (c)
+lexically nested inside a traced function. This catches the hot paths
+without whole-program call-graph analysis; helper closures invoked from a
+traced caller but defined outside one are out of scope by design.
+
+Suppression: append ``# jaxlint: disable=JL004`` to the offending line
+(comma-separate multiple ids, ``disable=all`` for everything). Add the
+reason after the ids: ``# jaxlint: disable=JL004 -- static unroll over
+config``. Every suppression in this repo must carry a reason; the CLI
+(tools/jaxlint.py) flags reasonless suppressions with JL000 (warning).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple, Union
+
+from deeplearning4j_tpu.analysis.findings import Finding, Severity
+
+RULES: Dict[str, Tuple[str, str]] = {
+    "JL000": ("reasonless-suppression",
+              "suppression comment without a '-- reason'"),
+    "JL001": ("tracer-cast",
+              "float()/int()/bool()/.item()/.tolist() on a traced value "
+              "inside a traced function"),
+    "JL002": ("traced-branch",
+              "Python control flow on a traced condition; use lax.cond / "
+              "jnp.where"),
+    "JL003": ("host-sync",
+              "host-device sync (device_get/np.asarray/block_until_ready/"
+              "print) inside a traced function"),
+    "JL004": ("loop-compute",
+              "jnp/lax compute inside a Python loop in a traced function; "
+              "use lax.scan / fori_loop / vmap"),
+    "JL005": ("impure-jit",
+              "time/np.random/random/datetime call inside a traced "
+              "function is baked in at trace time"),
+    "JL006": ("missing-donate",
+              "jitted train step without donate_argnums keeps dead input "
+              "buffers alive (2x peak HBM)"),
+}
+
+RULE_SEVERITY = {
+    "JL000": Severity.WARNING,
+    "JL001": Severity.ERROR,
+    "JL002": Severity.ERROR,
+    "JL003": Severity.WARNING,
+    "JL004": Severity.WARNING,
+    "JL005": Severity.ERROR,
+    "JL006": Severity.WARNING,
+}
+
+# decorators / callables whose function argument is traced
+_TRACING_DECORATORS = {
+    "jax.jit", "jit", "jax.pmap", "pmap", "jax.vmap", "vmap",
+    "shard_map", "jax.experimental.shard_map.shard_map", "jax.checkpoint",
+    "jax.remat", "partial", "functools.partial",
+}
+# call targets whose positional function-valued args are traced:
+# name -> indices of function args (None = all positional args)
+_TRACING_CALLS: Dict[str, Optional[Tuple[int, ...]]] = {
+    "jax.jit": (0,), "jit": (0,),
+    "jax.pmap": (0,), "pmap": (0,),
+    "jax.vmap": (0,), "vmap": (0,),
+    "shard_map": (0,), "jax.experimental.shard_map.shard_map": (0,),
+    "jax.lax.scan": (0,), "lax.scan": (0,),
+    "jax.lax.map": (0,), "lax.map": (0,),
+    "jax.lax.fori_loop": (2,), "lax.fori_loop": (2,),
+    "jax.lax.while_loop": (0, 1), "lax.while_loop": (0, 1),
+    "jax.lax.cond": (1, 2), "lax.cond": (1, 2),
+    "jax.lax.switch": None, "lax.switch": None,
+    "jax.grad": (0,), "jax.value_and_grad": (0,),
+    "jax.jacfwd": (0,), "jax.jacrev": (0,),
+    "jax.checkpoint": (0,), "jax.remat": (0,),
+    "jax.eval_shape": (0,),
+}
+
+# jnp/jax functions that return static Python values even on tracers —
+# never evidence of traced compute
+_STATIC_FNS = {
+    "issubdtype", "result_type", "dtype", "iscomplexobj", "isdtype",
+    "ndim", "shape", "size", "can_cast", "promote_types",
+}
+
+# module roots whose calls produce/act on traced values
+_TRACED_ROOTS = ("jnp.", "jax.lax.", "jax.nn.", "jax.numpy.", "jax.random.",
+                 "lax.")
+
+_STEP_NAME = re.compile(r"(^|_)(train_)?(step|update)$")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,\s]+?)(?:\s*--\s*(.*))?$")
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.lax.scan' for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_traced_call(node: ast.Call) -> bool:
+    """Call whose target is rooted in jnp/jax.lax/jax.nn/... and is not a
+    static metadata helper."""
+    name = _dotted(node.func)
+    if not name:
+        return False
+    if name.rsplit(".", 1)[-1] in _STATIC_FNS:
+        return False
+    return name.startswith(_TRACED_ROOTS) or name in ("jnp", "lax")
+
+
+def _contains_traced_call(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call) and _is_traced_call(n)
+               for n in ast.walk(node))
+
+
+# calls that reduce anything (tracers included, via __len__/shape) to a
+# host-side Python value — their subtrees are not tracer evidence
+_STATICIZING_FNS = {
+    "len", "np.prod", "np.size", "np.ndim", "np.shape",
+    "numpy.prod", "numpy.size", "numpy.ndim", "numpy.shape",
+    "isinstance", "hasattr", "getattr", "type", "range",
+}
+
+
+def _references_any(node: ast.AST, names: Set[str]) -> bool:
+    """Param reference check, skipping subtrees inside static-izing calls
+    (``int(np.prod(shp))`` is static shape math, not a tracer cast)."""
+    if isinstance(node, ast.Call) and _dotted(node.func) in _STATICIZING_FNS:
+        return False
+    if isinstance(node, ast.Attribute) and node.attr in (
+            "shape", "ndim", "dtype"):  # static metadata even on tracers
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in names
+    return any(_references_any(c, names) for c in ast.iter_child_nodes(node))
+
+
+def _collect_suppressions(source: str,
+                          findings: List[Finding], path: str
+                          ) -> Dict[int, Set[str]]:
+    """line -> suppressed rule ids ({'all'} suppresses everything).
+    Reasonless suppressions produce JL000 findings."""
+    out: Dict[int, Set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            ids = {s.strip().upper() if s.strip().lower() != "all" else "all"
+                   for s in m.group(1).split(",") if s.strip()}
+            out.setdefault(tok.start[0], set()).update(ids)
+            if not (m.group(2) or "").strip():
+                findings.append(Finding(
+                    "JL000", RULE_SEVERITY["JL000"],
+                    f"{path}:{tok.start[0]}",
+                    "suppression without a reason",
+                    "append '-- <why this is safe>' to the comment"))
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+# ---------------------------------------------------------------------------
+# traced-context discovery
+# ---------------------------------------------------------------------------
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+
+def _decorator_traces(dec: ast.AST) -> bool:
+    name = _dotted(dec if not isinstance(dec, ast.Call) else dec.func)
+    if name is None:
+        return False
+    if name in ("partial", "functools.partial") and isinstance(dec, ast.Call):
+        # @partial(jax.jit, ...) — the traced target is the first arg
+        if dec.args:
+            inner = _dotted(dec.args[0])
+            return inner in _TRACING_DECORATORS and inner not in (
+                "partial", "functools.partial")
+        return False
+    return name in _TRACING_DECORATORS and name not in (
+        "partial", "functools.partial")
+
+
+def _collect_traced_names(tree: ast.AST) -> Tuple[Set[str], Set[int]]:
+    """Names of functions passed to tracing entry points anywhere in the
+    module, plus ids of Lambda nodes passed directly."""
+    names: Set[str] = set()
+    lambda_ids: Set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target = _dotted(node.func)
+        if target not in _TRACING_CALLS:
+            continue
+        idxs = _TRACING_CALLS[target]
+        args = (node.args if idxs is None
+                else [node.args[i] for i in idxs if i < len(node.args)])
+        for a in args:
+            if isinstance(a, ast.Name):
+                names.add(a.id)
+            elif isinstance(a, ast.Lambda):
+                lambda_ids.add(id(a))
+            elif isinstance(a, (ast.List, ast.Tuple)):  # lax.switch branches
+                for el in a.elts:
+                    if isinstance(el, ast.Name):
+                        names.add(el.id)
+                    elif isinstance(el, ast.Lambda):
+                        lambda_ids.add(id(el))
+    return names, lambda_ids
+
+
+# ---------------------------------------------------------------------------
+# per-file lint
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _Ctx:
+    path: str
+    suppressed: Dict[int, Set[str]]
+    findings: List[Finding] = field(default_factory=list)
+
+    def emit(self, rule: str, node: ast.AST, message: str, hint: str = ""):
+        line = getattr(node, "lineno", 0)
+        dis = self.suppressed.get(line, set())
+        if "all" in dis or rule in dis:
+            return
+        self.findings.append(Finding(
+            rule, RULE_SEVERITY[rule], f"{self.path}:{line}", message, hint))
+
+
+def _lint_traced_function(fn: FunctionNode, ctx: _Ctx) -> None:
+    """Apply JL001-JL005 inside one traced function (not descending into
+    nested defs — they are linted as their own traced contexts)."""
+    params: Set[str] = set()
+    if not isinstance(fn, ast.Lambda):
+        a = fn.args
+        params = {p.arg for p in
+                  a.posonlyargs + a.args + a.kwonlyargs
+                  + ([a.vararg] if a.vararg else [])
+                  + ([a.kwarg] if a.kwarg else [])}
+    else:
+        a = fn.args
+        params = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+
+    def tainted(expr: ast.AST) -> bool:
+        """Plausibly traced: references a function parameter or calls
+        into jnp/lax. Static shape math (np.prod over metadata, len())
+        stays clean."""
+        return _references_any(expr, params) or _contains_traced_call(expr)
+
+    body = fn.body if isinstance(fn.body, list) else [ast.Expr(fn.body)]
+    nested: Set[int] = set()
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and id(node) != id(fn):
+                nested.update(id(x) for x in ast.walk(node)
+                              if id(x) != id(node))
+                nested.add(id(node))
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if id(node) in nested:
+                continue
+            # JL001: scalar casts / .item() on traced values
+            if isinstance(node, ast.Call):
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id in ("float", "int", "bool")
+                        and len(node.args) == 1
+                        and tainted(node.args[0])):
+                    ctx.emit("JL001", node,
+                             f"{node.func.id}() on a traced value forces "
+                             "concretization",
+                             "keep it as an array; cast with .astype() or "
+                             "move the cast outside jit")
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("item", "tolist")
+                        and not node.args
+                        and tainted(node.func.value)):
+                    ctx.emit("JL001", node,
+                             f".{node.func.attr}() syncs the device and "
+                             "leaks the tracer",
+                             "return the array and convert outside the "
+                             "traced function")
+                # JL003: explicit host syncs
+                name = _dotted(node.func)
+                if name in ("jax.device_get", "jax.block_until_ready"):
+                    ctx.emit("JL003", node,
+                             f"{name}() inside a traced function is a "
+                             "host-device sync in the hot path",
+                             "move it outside jit")
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr == "block_until_ready"
+                      and tainted(node.func.value)):
+                    ctx.emit("JL003", node,
+                             ".block_until_ready() inside a traced "
+                             "function is a host sync",
+                             "move it outside jit")
+                elif (name in ("np.asarray", "np.array", "numpy.asarray",
+                               "numpy.array", "onp.asarray", "onp.array")
+                      and node.args and tainted(node.args[0])):
+                    ctx.emit("JL003", node,
+                             f"{name}() on a traced value pulls it to "
+                             "host",
+                             "use jnp instead, or convert outside jit")
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id == "print" \
+                        and any(tainted(a) for a in node.args):
+                    ctx.emit("JL003", node,
+                             "print() of a traced value syncs (and only "
+                             "prints at trace time)",
+                             "use jax.debug.print for runtime values")
+                # JL005: impure calls
+                if name and _IMPURE_RE.match(name):
+                    ctx.emit("JL005", node,
+                             f"{name}() inside a traced function is "
+                             "evaluated ONCE at trace time and baked into "
+                             "the program",
+                             "pass the value in as an argument (or use "
+                             "jax.random with a threaded key)")
+            # JL002: control flow on traced conditions
+            if isinstance(node, (ast.If, ast.While)) \
+                    and _contains_traced_call(node.test):
+                kw = "while" if isinstance(node, ast.While) else "if"
+                ctx.emit("JL002", node,
+                         f"`{kw}` on a jnp/lax expression — Python "
+                         "control flow cannot branch on a tracer",
+                         "use jnp.where for selects or lax.cond/"
+                         "lax.while_loop for real branches")
+            if isinstance(node, ast.IfExp) \
+                    and _contains_traced_call(node.test):
+                ctx.emit("JL002", node,
+                         "ternary on a jnp/lax expression — cannot branch "
+                         "on a tracer", "use jnp.where")
+            # JL004: Python-loop compute
+            if isinstance(node, (ast.For, ast.While)):
+                loop_body_calls = any(
+                    isinstance(n, ast.Call) and _is_traced_call(n)
+                    and id(n) not in nested
+                    for b in node.body for n in ast.walk(b))
+                if loop_body_calls:
+                    ctx.emit("JL004", node,
+                             "jnp/lax compute inside a Python loop "
+                             "unrolls into the traced program "
+                             "(compile time and code size scale with the "
+                             "trip count)",
+                             "rewrite as lax.scan / lax.fori_loop, or "
+                             "vmap over the axis; suppress if the unroll "
+                             "is small and static")
+
+
+_IMPURE_RE = re.compile(
+    r"^(time\.(time|perf_counter|monotonic|process_time)"
+    r"|np\.random\.\w+|numpy\.random\.\w+"
+    r"|random\.(random|randint|uniform|choice|shuffle|gauss|randrange|sample)"
+    r"|datetime\.(datetime\.)?(now|utcnow|today))$")
+
+
+def _lint_module(tree: ast.Module, ctx: _Ctx) -> None:
+    traced_names, traced_lambdas = _collect_traced_names(tree)
+
+    # JL006: jax.jit(step_like) / decorated step-like without donation
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and _dotted(node.func) in ("jax.jit", "jit") \
+                and node.args and isinstance(node.args[0], ast.Name) \
+                and _STEP_NAME.search(node.args[0].id) \
+                and not any(k.arg in ("donate_argnums", "donate_argnames")
+                            for k in node.keywords):
+            ctx.emit("JL006", node,
+                     f"jax.jit({node.args[0].id}) looks like a training "
+                     "step but donates no buffers — old params/opt state "
+                     "stay alive across the update (2x peak HBM)",
+                     "pass donate_argnums for the state arguments the "
+                     "caller overwrites")
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and _STEP_NAME.search(node.name):
+            for dec in node.decorator_list:
+                dn = _dotted(dec if not isinstance(dec, ast.Call)
+                             else dec.func)
+                is_jit = dn in ("jax.jit", "jit")
+                if (dn in ("partial", "functools.partial")
+                        and isinstance(dec, ast.Call) and dec.args):
+                    is_jit = _dotted(dec.args[0]) in ("jax.jit", "jit")
+                if is_jit and (
+                        not isinstance(dec, ast.Call)
+                        or not any(k.arg in ("donate_argnums",
+                                             "donate_argnames")
+                                   for k in dec.keywords)):
+                    # anchor to the decorator line: that is where the
+                    # inline suppression comment lives in both forms
+                    ctx.emit("JL006", dec,
+                             f"@jax.jit on {node.name}() looks like a "
+                             "training step but donates no buffers",
+                             "use @partial(jax.jit, donate_argnums=...)")
+
+    # traced functions: decorated, passed-by-name, or nested inside one
+    def visit(node: ast.AST, in_traced: bool) -> None:
+        traced_here = in_traced
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            traced_here = (in_traced
+                           or any(_decorator_traces(d)
+                                  for d in node.decorator_list)
+                           or node.name in traced_names)
+            if traced_here:
+                _lint_traced_function(node, ctx)
+        elif isinstance(node, ast.Lambda):
+            traced_here = in_traced or id(node) in traced_lambdas
+            if traced_here:
+                _lint_traced_function(node, ctx)
+        for child in ast.iter_child_nodes(node):
+            visit(child, traced_here)
+
+    visit(tree, False)
+
+
+def lint_source(source: str, path: str = "<string>") -> List[Finding]:
+    """Lint one file's source text. Returns findings (suppressed lines
+    already removed; reasonless suppressions reported as JL000)."""
+    findings: List[Finding] = []
+    suppressed = _collect_suppressions(source, findings, path)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        findings.append(Finding(
+            "JL000", Severity.ERROR, f"{path}:{e.lineno or 0}",
+            f"syntax error: {e.msg}", ""))
+        return findings
+    ctx = _Ctx(path=path, suppressed=suppressed, findings=findings)
+    _lint_module(tree, ctx)
+    ctx.findings.sort(key=lambda f: (f.location.rsplit(":", 1)[0],
+                                     int(f.location.rsplit(":", 1)[1])))
+    return ctx.findings
+
+
+def lint_paths(paths: List[str]) -> List[Finding]:
+    """Lint .py files under the given files/directories."""
+    findings: List[Finding] = []
+    files: List[Path] = []
+    for p in paths:
+        pp = Path(p)
+        if pp.is_dir():
+            files.extend(sorted(pp.rglob("*.py")))
+        else:
+            files.append(pp)
+    for f in files:
+        findings.extend(lint_source(f.read_text(encoding="utf-8"), str(f)))
+    return findings

@@ -1,0 +1,197 @@
+"""MemoryReport: parameter-count and HBM/VMEM footprint estimation from a
+config alone — no arrays are ever built (param shapes come from
+``jax.eval_shape`` over each layer's ``init_params``).
+
+Analogue of the reference's ``MemoryReport`` /
+``LayerMemoryReport`` (nn/conf/memory/MemoryReport.java): per-layer
+parameter counts, activation sizes, updater-state multiples, and a total
+standing + working HBM estimate, so a config that cannot fit is rejected
+before it burns a TPU slice.
+
+Model (training step, per replica):
+
+- params:        P * dtype_bytes
+- gradients:     P * dtype_bytes              (live during the update)
+- updater state: P * dtype_bytes * K          (K from the updater family)
+- activations:   sum of per-layer outputs * batch * dtype_bytes
+                 (all stored for backward; under ``remat`` only the two
+                 live layer boundaries count)
+- workspace:     the largest single layer's in+out+params working set —
+                 the VMEM pressure proxy (per-core VMEM is ~16 MiB on
+                 current TPUs; XLA tiles through it, so this is a
+                 *pressure* signal, not a hard bound).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# updater family -> per-param slots of persistent optimizer state
+# (adam keeps m+v, rmsprop/adagrad/adadelta keep 1-2 accumulators,
+# nesterovs keeps velocity, plain sgd keeps nothing)
+UPDATER_STATE_SLOTS = {
+    "sgd": 0, "none": 0,
+    "nesterovs": 1, "adagrad": 1, "rmsprop": 1,
+    "adadelta": 2, "adam": 2, "adamax": 2,
+}
+
+DTYPE_BYTES = {
+    "float64": 8, "float32": 4, "bfloat16": 2, "float16": 2,
+    "int32": 4, "int8": 1,
+}
+
+#: per-core VMEM on current TPU generations (v4/v5 class), the working-set
+#: pressure threshold the report warns against
+VMEM_BYTES = 16 * 1024 * 1024
+#: default per-chip HBM budget used by graphcheck's overflow warning
+DEFAULT_HBM_BYTES = 16 * 1024 ** 3
+
+
+def _dtype_bytes(dtype: str) -> int:
+    return DTYPE_BYTES.get(str(dtype), 4)
+
+
+def param_shapes(layer, name_hint: str = "") -> Dict[str, Tuple[int, ...]]:
+    """Shapes of a layer's params WITHOUT allocating them: abstract-eval
+    ``init_params`` (jax.eval_shape traces but never executes)."""
+    import jax
+    if not layer.has_params():
+        return {}
+    abstract = jax.eval_shape(layer.init_params, jax.random.PRNGKey(0))
+    return {k: tuple(v.shape) for k, v in abstract.items()}
+
+
+def param_count(layer) -> int:
+    return sum(int(np.prod(s)) for s in param_shapes(layer).values())
+
+
+@dataclass
+class LayerMemoryEntry:
+    """One row of the report (ref: LayerMemoryReport)."""
+    name: str
+    layer_type: str
+    n_params: int
+    activation_shape: Tuple[int, ...]   # per-example, batch dim excluded
+    activation_elems: int               # per example
+
+    def row(self) -> str:
+        shape = "x".join(str(d) for d in self.activation_shape) or "-"
+        return (f"  {self.name:<28} {self.layer_type:<24} "
+                f"{self.n_params:>12,} {shape:>16}")
+
+
+@dataclass
+class MemoryReport:
+    """Aggregated estimate. ``to_text()`` renders the per-layer table plus
+    the standing/working HBM split."""
+    entries: List[LayerMemoryEntry] = field(default_factory=list)
+    batch_size: int = 32
+    dtype: str = "float32"
+    updater: str = "sgd"
+    remat: bool = False
+
+    # ------------------------------------------------------------ aggregates
+    @property
+    def total_params(self) -> int:
+        return sum(e.n_params for e in self.entries)
+
+    @property
+    def param_bytes(self) -> int:
+        return self.total_params * _dtype_bytes(self.dtype)
+
+    @property
+    def updater_state_bytes(self) -> int:
+        slots = UPDATER_STATE_SLOTS.get(self.updater, 2)
+        return self.param_bytes * slots
+
+    @property
+    def gradient_bytes(self) -> int:
+        return self.param_bytes
+
+    @property
+    def activation_bytes(self) -> int:
+        per_ex = [e.activation_elems for e in self.entries]
+        if not per_ex:
+            return 0
+        if self.remat:
+            # only the live boundary pair is stored; backward recomputes
+            per_ex = sorted(per_ex)[-2:]
+        return sum(per_ex) * self.batch_size * _dtype_bytes(self.dtype)
+
+    @property
+    def total_hbm_bytes(self) -> int:
+        return (self.param_bytes + self.updater_state_bytes
+                + self.gradient_bytes + self.activation_bytes)
+
+    @property
+    def peak_layer_working_set_bytes(self) -> int:
+        """Largest single-layer in+out+params footprint — the VMEM
+        pressure proxy."""
+        peak = 0
+        prev_elems = 0
+        db = _dtype_bytes(self.dtype)
+        for e in self.entries:
+            ws = (prev_elems + e.activation_elems) * self.batch_size * db \
+                + e.n_params * db
+            peak = max(peak, ws)
+            prev_elems = e.activation_elems
+        return peak
+
+    def vmem_pressure(self) -> float:
+        """Peak working set as a multiple of per-core VMEM (>1 means XLA
+        must tile; >>1 means heavy HBM<->VMEM traffic per step)."""
+        return self.peak_layer_working_set_bytes / VMEM_BYTES
+
+    # ---------------------------------------------------------------- render
+    def to_text(self) -> str:
+        def mb(b: int) -> str:
+            return f"{b / (1024 ** 2):,.1f} MiB"
+
+        lines = [
+            f"MemoryReport  (batch={self.batch_size}, dtype={self.dtype}, "
+            f"updater={self.updater}, remat={self.remat})",
+            f"  {'layer':<28} {'type':<24} {'params':>12} {'act/ex':>16}",
+        ]
+        lines += [e.row() for e in self.entries]
+        lines += [
+            f"  total params:        {self.total_params:,}",
+            f"  params:              {mb(self.param_bytes)}",
+            f"  gradients:           {mb(self.gradient_bytes)}",
+            f"  updater state:       {mb(self.updater_state_bytes)} "
+            f"({UPDATER_STATE_SLOTS.get(self.updater, 2)} slot(s))",
+            f"  activations:         {mb(self.activation_bytes)}"
+            + (" (remat: boundary pair only)" if self.remat else ""),
+            f"  est. HBM (train):    {mb(self.total_hbm_bytes)}",
+            f"  peak layer wset:     {mb(self.peak_layer_working_set_bytes)}"
+            f"  ({self.vmem_pressure():.1f}x VMEM)",
+        ]
+        return "\n".join(lines)
+
+
+def memory_report(conf, batch_size: int = 32, layers=None) -> MemoryReport:
+    """Build a MemoryReport for either configuration type. Requires a
+    shape-resolved config (input types set); layers whose params cannot be
+    abstract-evaluated contribute zero (graphcheck flags those
+    separately). ``layers``: optional pre-inferred (name, layer_conf,
+    out_type) triples from a validation pass already in flight — avoids
+    re-walking shapes."""
+    from deeplearning4j_tpu.analysis.graphcheck import iter_config_layers
+    training = conf.training
+    rep = MemoryReport(batch_size=batch_size, dtype=training.dtype,
+                       updater=training.updater.name,
+                       remat=getattr(training, "remat", False))
+    for name, layer, out_type in (layers if layers is not None
+                                  else iter_config_layers(conf)):
+        try:
+            n = param_count(layer)
+        except Exception:
+            n = 0
+        shape = out_type.example_shape() if out_type is not None else ()
+        rep.entries.append(LayerMemoryEntry(
+            name=name, layer_type=type(layer).__name__, n_params=n,
+            activation_shape=tuple(shape),
+            activation_elems=int(np.prod(shape)) if shape else 0))
+    return rep

@@ -49,7 +49,7 @@ class ClusterSet:
         return np.stack([c.center for c in self.clusters])
 
 
-@partial(jax.jit, static_argnames=("k", "cosine"))
+@partial(jax.jit, static_argnames=("k", "cosine"))  # jaxlint: disable=JL006 -- not a train step: callers reuse `centers` for assignment-only queries after the call
 def _lloyd_step(x, centers, k, cosine=False):
     dist = (cosine_dist(x, centers) if cosine
             else pairwise_sq_dist(x, centers))

@@ -22,6 +22,11 @@ from typing import Optional
 import jax
 import numpy as np
 
+try:
+    enable_x64 = jax.enable_x64
+except AttributeError:  # jax < 0.5 ships it under experimental
+    from jax.experimental import enable_x64
+
 logger = logging.getLogger("deeplearning4j_tpu")
 
 
@@ -45,7 +50,7 @@ class GradientCheckUtil:
         fast for bigger nets while still covering every parameter tensor).
         """
         import jax.numpy as jnp
-        with jax.enable_x64(True):
+        with enable_x64(True):
             # Rebuild everything in f64
             params64 = [
                 {k: jnp.asarray(np.asarray(v), jnp.float64)

@@ -178,6 +178,23 @@ class MultiLayerConfiguration:
     def from_json(s: str) -> "MultiLayerConfiguration":
         return MultiLayerConfiguration.from_dict(json.loads(s))
 
+    # ------------------------------------------------------- static analysis
+    def validate(self, mesh=None, batch_size: Optional[int] = None,
+                 hbm_bytes: Optional[int] = None):
+        """Run graphcheck over this config: shape/dtype walk, loss-head
+        and mesh-legality checks, HBM estimate. Returns a list of
+        ``analysis.Finding`` — empty when the config is clean. Pure
+        metadata; no arrays are built."""
+        from deeplearning4j_tpu.analysis.graphcheck import check_multilayer
+        return check_multilayer(self, mesh=mesh, batch_size=batch_size,
+                                hbm_bytes=hbm_bytes)
+
+    def memory_report(self, batch_size: int = 32):
+        """Parameter-count + HBM/VMEM estimate (``MemoryReport``
+        analogue) for this config at the given batch size."""
+        from deeplearning4j_tpu.analysis.memory import memory_report
+        return memory_report(self, batch_size=batch_size)
+
     def to_yaml(self) -> str:
         """YAML twin of ``to_json`` (the reference serializes configs to
         both JSON and YAML — ref: nn/conf/MultiLayerConfiguration.java
@@ -246,6 +263,21 @@ class ListBuilder:
     def pretrain(self, flag: bool) -> "ListBuilder":
         self._parent._training.pretrain = flag
         return self
+
+    def validate(self, mesh=None, batch_size: Optional[int] = None):
+        """graphcheck without build(): collect findings even for stacks
+        ``build()`` would throw on (its throw becomes a finding). Builds
+        a deep COPY — build() materializes the current global defaults
+        onto the layers, and validating must not freeze them early."""
+        import copy
+        from deeplearning4j_tpu.analysis.findings import Finding, Severity
+        try:
+            conf = copy.deepcopy(self).build()
+        except (ValueError, TypeError) as e:
+            return [Finding("GC005", Severity.ERROR, "<build>", str(e),
+                            "fix the configuration; build() rejects it "
+                            "outright")]
+        return conf.validate(mesh=mesh, batch_size=batch_size)
 
     def build(self) -> MultiLayerConfiguration:
         g = self._parent._global

@@ -98,6 +98,23 @@ class ComputationGraphConfiguration:
     def from_json(s: str) -> "ComputationGraphConfiguration":
         return ComputationGraphConfiguration.from_dict(json.loads(s))
 
+    # ------------------------------------------------------- static analysis
+    def validate(self, mesh=None, batch_size: Optional[int] = None,
+                 hbm_bytes: Optional[int] = None):
+        """Run graphcheck over this DAG: cycle/dangling/dead-vertex
+        detection, shape walk, loss-head and mesh-legality checks.
+        Returns a list of ``analysis.Finding``; never raises on broken
+        graphs (unlike ``_resolve_shapes``)."""
+        from deeplearning4j_tpu.analysis.graphcheck import check_graph
+        return check_graph(self, mesh=mesh, batch_size=batch_size,
+                           hbm_bytes=hbm_bytes)
+
+    def memory_report(self, batch_size: int = 32):
+        """Parameter-count + HBM/VMEM estimate (``MemoryReport``
+        analogue) for this graph at the given batch size."""
+        from deeplearning4j_tpu.analysis.memory import memory_report
+        return memory_report(self, batch_size=batch_size)
+
     def to_yaml(self) -> str:
         """YAML twin of ``to_json`` (ref: ComputationGraphConfiguration
         toYaml/fromYaml mirror NeuralNetConfiguration.java:283-360). The
@@ -215,6 +232,28 @@ class GraphBuilder:
         self._parent._training.tbptt_fwd_length = fwd
         self._parent._training.tbptt_bwd_length = bwd
         return self
+
+    def validate(self, mesh=None, batch_size: Optional[int] = None):
+        """graphcheck without build(): assemble a THROWAWAY copy of the
+        config WITHOUT the throwing shape-resolution pass, so cycles/
+        dangling refs surface as findings rather than exceptions. The
+        copy matters: applying global defaults to the live nodes would
+        freeze the current defaults into the model, silently ignoring
+        any global-setting calls made after validate()."""
+        import copy
+        g = self._parent._global
+        nodes = copy.deepcopy(self._nodes)
+        for node in nodes.values():
+            if node.layer is not None:
+                node.layer.apply_global_defaults(g)
+        conf = ComputationGraphConfiguration(
+            nodes=nodes,
+            network_inputs=list(self._inputs),
+            network_outputs=list(self._outputs),
+            input_types=dict(self._input_types),
+            training=self._parent._training,
+        )
+        return conf.validate(mesh=mesh, batch_size=batch_size)
 
     def build(self) -> ComputationGraphConfiguration:
         if not self._inputs:

@@ -601,7 +601,7 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin):
                     stop_before_loss=False, carries=carries)
                 return ([acts[o] for o in self.conf.network_outputs],
                         new_carries)
-            self._rnn_step_jit = jax.jit(step)
+            self._rnn_step_jit = jax.jit(step)  # jaxlint: disable=JL006 -- inference step: params/states are NOT consumed, they persist across streaming calls
         outs_list, new_carries = self._rnn_step_jit(
             self.params, self.states, in_map, self._rnn_carries)
         self._rnn_carries = {**self._rnn_carries, **new_carries}
@@ -688,16 +688,19 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin):
         layer_opt = tx.init(self.params[name])
         step = make_pretrain_step(layer, tx)
 
-        p = self.params[name]
         for _ in range(epochs):
             iterator.reset()
             for batch in iterator:
                 inputs, _, masks, _ = self._split(batch)
                 x = self._activations_to(name, inputs, masks)
                 self._rng, k = jax.random.split(self._rng)
-                p, layer_opt, loss = step(p, layer_opt, x, k)
+                # reassign every step: the jitted step donates its param
+                # buffer, so a stale self.params[name] would alias a
+                # deleted Array on donation-capable backends
+                p, layer_opt, loss = step(self.params[name], layer_opt,
+                                          x, k)
+                self.params[name] = p
                 self.score_value = loss
-        self.params[name] = p
 
     # ----------------------------------------------------------- param access
     def num_params(self) -> int:

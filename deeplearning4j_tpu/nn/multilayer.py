@@ -587,7 +587,7 @@ class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin):
                                            state=states[-1],
                                            train=False, rng=None)
                 return h, new_carries
-            self._rnn_step_jit = jax.jit(step)
+            self._rnn_step_jit = jax.jit(step)  # jaxlint: disable=JL006 -- inference step: params/states are NOT consumed, they persist across streaming calls
         h, new_carries = self._rnn_step_jit(self.params, self.states, x,
                                             self._rnn_carries)
         # keep existing carries for non-RNN layers

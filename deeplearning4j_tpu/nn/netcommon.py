@@ -105,7 +105,9 @@ def make_pretrain_step(layer, tx):
                 lambda pp: layer.pretrain_loss(pp, x, rng=rng))(p)
             updates, opt = tx.update(grads, opt, p)
             return jax.tree.map(lambda a, u: a + u, p, updates), opt, loss
-    return jax.jit(step)
+    # both pretrain drivers overwrite (params, opt) with the step's
+    # returns, so the old buffers are donatable
+    return jax.jit(step, donate_argnums=(0, 1))
 
 
 def emit_scan_burst(net, losses, n, t0, stats=None):

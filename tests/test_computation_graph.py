@@ -178,7 +178,9 @@ def test_graph_gradient_check():
     import jax
     import jax.numpy as jnp
 
-    with jax.enable_x64(True):
+    from deeplearning4j_tpu.gradientcheck.check import enable_x64
+
+    with enable_x64(True):
         params64 = {n: {k: jnp.asarray(np.asarray(v), jnp.float64)
                         for k, v in p.items()} for n, p in net.params.items()}
         states64 = {n: {k: jnp.asarray(np.asarray(v), jnp.float64)

@@ -38,6 +38,7 @@ def test_cut_points_respect_skip_connections():
     assert "s0b0_b_act" not in cuts
 
 
+@pytest.mark.slow  # ~3 min on the 8-device CPU mesh; dominates tier-1
 def test_graph_pipeline_resnet_first_step_parity_and_converges():
     """ResNet-50 body pipelined over 2 stages: the first step's loss
     matches the single-device step (same params, same whole-batch BN at
@@ -170,9 +171,17 @@ def test_graph_pipeline_dropout_cross_process_deterministic():
     import textwrap
 
     prog = textwrap.dedent("""
+        import os
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
         import jax
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 8)
+        try:
+            jax.config.update("jax_num_cpu_devices", 8)
+        except AttributeError:
+            pass  # jax 0.4.x: the XLA_FLAGS path above provides devices
         import numpy as np
         from jax.sharding import Mesh
         from deeplearning4j_tpu import InputType, NeuralNetConfiguration

@@ -1,0 +1,272 @@
+"""graphcheck: known-bad configs produce their named findings, seed model
+families validate clean, mesh-legality rules fire, and the MemoryReport
+aggregates sensibly — all without building a single array."""
+
+import pytest
+
+from deeplearning4j_tpu.analysis import (
+    check_graph, check_multilayer, memory_report, validate_config,
+)
+from deeplearning4j_tpu.analysis import fixtures
+from deeplearning4j_tpu.analysis.findings import (
+    Severity, has_errors, max_severity,
+)
+from deeplearning4j_tpu.nn.conf.builder import (
+    MultiLayerConfiguration, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.conf.graph_builder import (
+    ComputationGraphConfiguration, NodeConf,
+)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel.expert import MoELayer
+
+
+# ---------------------------------------------------------------- known-bad
+
+@pytest.mark.parametrize("name,rule,make", fixtures.KNOWN_BAD,
+                         ids=[n for n, _, _ in fixtures.KNOWN_BAD])
+def test_known_bad_produces_named_finding(name, rule, make):
+    conf, kw = make()
+    findings = validate_config(conf, **kw)
+    rules = {f.rule for f in findings}
+    assert rule in rules, f"{name}: wanted {rule}, got {sorted(rules)}"
+    hit = next(f for f in findings if f.rule == rule)
+    assert hit.message and hit.hint, "findings must carry message + hint"
+    assert hit.location, "findings must carry a location"
+
+
+@pytest.mark.parametrize("name,make", fixtures.KNOWN_GOOD,
+                         ids=[n for n, _ in fixtures.KNOWN_GOOD])
+def test_known_good_validates_clean(name, make):
+    conf, kw = make()
+    assert validate_config(conf, **kw) == []
+
+
+# ------------------------------------------------------------ rule details
+
+def test_shape_mismatch_is_error_with_location():
+    conf, kw = fixtures.bad_shape_mismatch()
+    f = next(f for f in check_multilayer(conf, **kw) if f.rule == "GC005")
+    assert f.severity == Severity.ERROR
+    assert "layer[1]" in f.location
+    assert "256" in f.message  # names the inferred width
+
+
+def test_cycle_names_participants():
+    conf, kw = fixtures.bad_graph_cycle()
+    f = next(f for f in check_graph(conf, **kw) if f.rule == "GC002")
+    assert {"a", "b", "c"} <= set(f.location.split(","))
+
+
+def test_dead_vertex_warning():
+    nodes = {
+        "in": NodeConf(name="in", kind="input"),
+        "used": NodeConf(name="used", kind="layer", inputs=["in"],
+                         layer=DenseLayer(n_in=8, n_out=8,
+                                          activation="relu")),
+        "orphan": NodeConf(name="orphan", kind="layer", inputs=["in"],
+                           layer=DenseLayer(n_in=8, n_out=4,
+                                            activation="relu")),
+        "out": NodeConf(name="out", kind="layer", inputs=["used"],
+                        layer=OutputLayer(n_in=8, n_out=2,
+                                          activation="softmax")),
+    }
+    conf = ComputationGraphConfiguration(
+        nodes=nodes, network_inputs=["in"], network_outputs=["out"],
+        input_types={"in": InputType.feed_forward(8)})
+    f = next(f for f in check_graph(conf) if f.rule == "GC004")
+    assert f.severity == Severity.WARNING
+    assert f.location == "orphan"
+
+
+def test_duplicate_layer_names_flagged():
+    conf = MultiLayerConfiguration(layers=[
+        DenseLayer(name="h", n_in=8, n_out=8, activation="relu"),
+        DenseLayer(name="h", n_in=8, n_out=8, activation="relu"),
+        OutputLayer(n_in=8, n_out=2, activation="softmax"),
+    ])
+    assert any(f.rule == "GC001" for f in check_multilayer(conf))
+
+
+def test_missing_loss_head_is_warning_only():
+    conf = (NeuralNetConfiguration.builder().list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(DenseLayer(n_out=4, activation="relu"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    findings = conf.validate()
+    assert [f.rule for f in findings] == ["GC006"]
+    assert max_severity(findings) == Severity.WARNING
+    assert not has_errors(findings)
+
+
+def test_moe_expert_mesh_mismatch():
+    conf = (NeuralNetConfiguration.builder().list()
+            .layer(MoELayer(n_experts=6, hidden=16))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    findings = conf.validate(mesh={"ep": 4}, batch_size=32)
+    assert any(f.rule == "GC010" and f.severity == Severity.ERROR
+               for f in findings)
+    # divisible expert count: clean
+    conf2 = (NeuralNetConfiguration.builder().list()
+             .layer(MoELayer(n_experts=8, hidden=16))
+             .layer(OutputLayer(n_out=2, activation="softmax"))
+             .set_input_type(InputType.feed_forward(8))
+             .build())
+    assert conf2.validate(mesh={"ep": 4}, batch_size=32) == []
+
+
+def test_mesh_accepts_jax_mesh_object():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices()[:8]).reshape(8)
+    mesh = Mesh(devs, ("dp",))
+    conf, _ = fixtures.good_mlp()
+    assert check_multilayer(conf, mesh=mesh, batch_size=64) == []
+    assert any(f.rule == "GC008"
+               for f in check_multilayer(conf, mesh=mesh, batch_size=33))
+
+
+def test_pp_more_stages_than_layers_warns():
+    conf, _ = fixtures.good_mlp()  # 2 body layers
+    findings = conf.validate(mesh={"pp": 8}, batch_size=32)
+    assert any(f.rule == "GC009" for f in findings)
+
+
+def test_tbptt_non_rnn_head_flagged_on_deserialized_conf():
+    # the builder raises at build(); a hand-edited JSON can still carry
+    # the broken combination — graphcheck must catch it
+    conf, _ = fixtures.good_rnn()
+    d = conf.to_dict()
+    d["training"]["backprop_type"] = "truncated_bptt"
+    d["layers"][-1] = {"@type": "OutputLayer", "n_in": 32, "n_out": 5,
+                       "activation": "softmax", "loss": "mcxent"}
+    broken = MultiLayerConfiguration.from_dict(d)
+    assert any(f.rule == "GC005" and "truncated_bptt" in f.message
+               for f in broken.validate())
+
+
+# ------------------------------------------------------- builder validate()
+
+def test_list_builder_validate_without_build():
+    b = (NeuralNetConfiguration.builder().list()
+         .layer(DenseLayer(n_out=8, activation="relu"))
+         .layer(OutputLayer(n_out=2, activation="softmax"))
+         .set_input_type(InputType.feed_forward(4)))
+    assert b.validate(mesh={"dp": 2}, batch_size=8) == []
+    # a stack build() throws on still yields findings, not an exception
+    b2 = NeuralNetConfiguration.builder().list().layer(
+        DenseLayer(n_out=8, activation="relu"))
+    findings = b2.validate()
+    assert findings and findings[0].severity == Severity.ERROR
+
+
+def test_graph_builder_validate_reports_instead_of_raising():
+    gb = (NeuralNetConfiguration.builder().graph_builder()
+          .add_inputs("in")
+          .set_input_types(InputType.feed_forward(8))
+          .add_layer("h", DenseLayer(n_out=8, activation="relu"), "ghost")
+          .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "h")
+          .set_outputs("out"))
+    findings = gb.validate()
+    assert any(f.rule == "GC003" for f in findings)
+
+
+def test_builder_validate_does_not_freeze_global_defaults():
+    """validate() must not materialize the CURRENT global defaults onto
+    the live layers — settings made after validate() must still apply."""
+    nb = NeuralNetConfiguration.builder()
+    lb = (nb.list()
+          .layer(DenseLayer(n_out=8))
+          .layer(OutputLayer(n_out=2, activation="softmax"))
+          .set_input_type(InputType.feed_forward(4)))
+    assert [f.rule for f in lb.validate()] == []
+    nb.activation("tanh").l2(0.01)
+    conf = lb.build()
+    assert conf.layers[0].activation == "tanh"
+    assert conf.layers[0].l2 == 0.01
+
+    gb = (NeuralNetConfiguration.builder()
+          .graph_builder().add_inputs("in")
+          .set_input_types(InputType.feed_forward(4))
+          .add_layer("h", DenseLayer(n_out=8), "in")
+          .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "h")
+          .set_outputs("out"))
+    gb.validate()
+    gb._parent.activation("tanh")
+    conf = gb.build()
+    assert conf.nodes["h"].layer.activation == "tanh"
+
+
+def test_serialized_duplicate_node_names_flagged():
+    """The dict form can carry name collisions the node map cannot —
+    the lenient loader must surface them as GC001, not silently collapse
+    the graph."""
+    from deeplearning4j_tpu.analysis.graphcheck import load_config_dict
+    conf, _ = fixtures.good_graph_merge()
+    d = conf.to_dict()
+    clash = next(n for n in d["nodes"] if n["name"] == "db")
+    clash["name"] = "da"
+    loaded = load_config_dict(d)
+    assert any(f.rule == "GC001" and f.location == "da"
+               for f in check_graph(loaded))
+
+
+# ------------------------------------------------------------ memory report
+
+def test_memory_report_matches_real_param_count():
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf, _ = fixtures.good_mlp()
+    rep = memory_report(conf, batch_size=64)
+    net = MultiLayerNetwork(conf)
+    net.init()
+    assert rep.total_params == net.num_params()
+    assert rep.total_hbm_bytes > rep.param_bytes
+    assert "MemoryReport" in rep.to_text()
+
+
+def test_memory_report_remat_shrinks_activations():
+    conf, _ = fixtures.good_cnn()
+    full = memory_report(conf, batch_size=128)
+    conf.training.remat = True
+    lean = memory_report(conf, batch_size=128)
+    assert lean.activation_bytes < full.activation_bytes
+
+
+def test_nested_wrapper_n_in_mismatch_found_without_mutation():
+    """A declared width on a WRAPPED layer (TimeDistributed.inner) must
+    surface as GC005, and validate() must not rewrite the user's config
+    while probing (shallow-copy probes would share the inner object)."""
+    from deeplearning4j_tpu.nn.layers.shape import TimeDistributedLayer
+    inner = DenseLayer(n_in=999, n_out=8, activation="relu")
+    conf = MultiLayerConfiguration(
+        layers=[TimeDistributedLayer(inner=inner),
+                OutputLayer(n_in=8, n_out=2, activation="softmax")],
+        input_type=InputType.recurrent(7, 5))
+    findings = check_multilayer(conf)
+    assert any(f.rule == "GC005" and "999" in f.message for f in findings)
+    assert inner.n_in == 999  # probe never mutates the real config
+
+
+def test_lenient_graph_memory_report_keeps_activations():
+    """A graph loaded WITHOUT shape resolution (the CLI path) must still
+    report activation memory — dropping it would pass the GC007 budget
+    check for activation-dominated models."""
+    from deeplearning4j_tpu.analysis.graphcheck import load_config_dict
+    conf, _ = fixtures.good_graph_merge()
+    built = memory_report(conf, batch_size=64)
+    lenient = memory_report(load_config_dict(conf.to_dict()), batch_size=64)
+    assert built.activation_bytes > 0
+    assert lenient.activation_bytes == built.activation_bytes
+    assert lenient.total_params == built.total_params
+
+
+def test_hbm_overflow_warning():
+    conf, _ = fixtures.good_mlp()
+    findings = check_multilayer(conf, batch_size=64,
+                                hbm_bytes=1024 * 1024)  # absurd 1 MiB chip
+    assert any(f.rule == "GC007" for f in findings)

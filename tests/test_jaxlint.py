@@ -1,0 +1,248 @@
+"""jaxlint unit tests: each rule fires on a fixture snippet, the
+suppression comment silences it (with a reason required), and the
+repository's own source tree stays lint-clean — the gate future PRs
+inherit."""
+
+import textwrap
+from pathlib import Path
+
+from deeplearning4j_tpu.analysis.jaxlint import (
+    RULES, lint_paths, lint_source,
+)
+
+
+def rules_of(src):
+    return [f.rule for f in lint_source(textwrap.dedent(src), "snippet.py")]
+
+
+# ------------------------------------------------------------- rule firing
+
+def test_jl001_float_cast_on_tracer():
+    assert rules_of("""
+        import jax
+        @jax.jit
+        def f(x):
+            return float(x) + 1
+    """) == ["JL001"]
+
+
+def test_jl001_item_in_scan_body():
+    assert rules_of("""
+        from jax import lax
+        def body(carry, t):
+            return carry.item(), None
+        out = lax.scan(body, 0.0, None)
+    """) == ["JL001"]
+
+
+def test_jl001_skips_static_shape_math():
+    # int(np.prod(...)) over metadata is host-side shape math, not a cast
+    assert rules_of("""
+        import jax, numpy as np
+        @jax.jit
+        def f(x, shp):
+            n = int(np.prod(shp))
+            m = int(x.shape[0])
+            return x[:n + m]
+    """) == []
+
+
+def test_jl002_if_on_jnp_expression():
+    assert rules_of("""
+        import jax, jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            if jnp.any(x > 0):
+                return x
+            return -x
+    """) == ["JL002"]
+
+
+def test_jl002_allows_static_conditionals():
+    assert rules_of("""
+        import jax, jax.numpy as jnp
+        @jax.jit
+        def f(x, axis=None):
+            if axis is not None:
+                x = jnp.sum(x, axis=axis)
+            if jnp.issubdtype(x.dtype, jnp.integer):
+                x = x.astype(jnp.float32)
+            return x
+    """) == []
+
+
+def test_jl003_host_syncs():
+    found = rules_of("""
+        import jax, numpy as np
+        @jax.jit
+        def f(x):
+            y = np.asarray(x)
+            print(x)
+            jax.device_get(x)
+            return y
+    """)
+    assert found == ["JL003", "JL003", "JL003"]
+
+
+def test_jl004_loop_compute():
+    assert rules_of("""
+        import jax, jax.numpy as jnp
+        @jax.jit
+        def f(h, W):
+            for _ in range(100):
+                h = jnp.tanh(jnp.dot(h, W))
+            return h
+    """) == ["JL004"]
+
+
+def test_jl005_impure_calls():
+    assert rules_of("""
+        import jax, time, numpy as np
+        @jax.jit
+        def f(x):
+            t0 = time.time()
+            return x + np.random.normal() + t0
+    """) == ["JL005", "JL005"]
+
+
+def test_jl006_jitted_step_without_donation():
+    assert rules_of("""
+        import jax
+        def train_step(p, g):
+            return p - g
+        fn = jax.jit(train_step)
+    """) == ["JL006"]
+    # with donation: clean
+    assert rules_of("""
+        import jax
+        def train_step(p, g):
+            return p - g
+        fn = jax.jit(train_step, donate_argnums=(0,))
+    """) == []
+
+
+def test_jl006_accepts_donate_argnames():
+    # donate_argnames is jax.jit's equally-valid donation keyword
+    assert rules_of("""
+        import jax
+        def train_step(p, g):
+            return p - g
+        fn = jax.jit(train_step, donate_argnames=("p",))
+    """) == []
+    assert rules_of("""
+        import jax
+        from functools import partial
+        @partial(jax.jit, donate_argnames=("p",))
+        def train_step(p, g):
+            return p - g
+    """) == []
+
+
+def test_decorated_partial_jit_is_traced():
+    assert rules_of("""
+        import jax
+        from functools import partial
+        @partial(jax.jit, static_argnames=("k",))
+        def f(x, k):
+            return bool(x)
+    """) == ["JL001"]
+
+
+def test_nested_function_inherits_traced_context():
+    assert rules_of("""
+        import jax
+        @jax.jit
+        def outer(x):
+            def inner(y):
+                return float(y)
+            return inner(x)
+    """) == ["JL001"]
+
+
+def test_untraced_function_is_not_linted():
+    # same anti-patterns OUTSIDE any traced context: no findings
+    assert rules_of("""
+        import numpy as np, time
+        def host_helper(x):
+            t0 = time.time()
+            for _ in range(10):
+                x = float(x) + np.random.normal()
+            return x, t0
+    """) == []
+
+
+# ------------------------------------------------------------- suppression
+
+def test_suppression_with_reason_silences():
+    assert rules_of("""
+        import jax, jax.numpy as jnp
+        @jax.jit
+        def f(h, W):
+            for _ in range(4):  # jaxlint: disable=JL004 -- tiny static unroll
+                h = jnp.tanh(h @ W)
+            return h
+    """) == []
+
+
+def test_suppression_without_reason_is_jl000():
+    assert rules_of("""
+        import jax, jax.numpy as jnp
+        @jax.jit
+        def f(h, W):
+            for _ in range(4):  # jaxlint: disable=JL004
+                h = jnp.tanh(h @ W)
+            return h
+    """) == ["JL000"]
+
+
+def test_jl006_bare_decorator_suppressible_on_its_line():
+    # the finding anchors to the decorator line in BOTH forms, so the
+    # documented inline suppression works there
+    assert rules_of("""
+        import jax
+        @jax.jit  # jaxlint: disable=JL006 -- params persist across calls
+        def train_step(p, g):
+            return p - g
+    """) == []
+    assert rules_of("""
+        import jax
+        @jax.jit
+        def train_step(p, g):
+            return p - g
+    """) == ["JL006"]
+
+
+def test_suppress_all():
+    assert rules_of("""
+        import jax
+        @jax.jit
+        def f(x):
+            return float(x)  # jaxlint: disable=all -- test scaffolding
+    """) == []
+
+
+def test_suppression_only_covers_its_line():
+    assert rules_of("""
+        import jax
+        @jax.jit
+        def f(x, y):
+            a = float(x)  # jaxlint: disable=JL001 -- known host scalar
+            b = float(y)
+            return a + b
+    """) == ["JL001"]
+
+
+# ---------------------------------------------------------------- the gate
+
+def test_repo_source_tree_is_lint_clean():
+    """The acceptance gate: zero unsuppressed findings over the package.
+    New code that trips a rule must be fixed or carry a reasoned
+    suppression."""
+    pkg = Path(__file__).resolve().parents[1] / "deeplearning4j_tpu"
+    findings = lint_paths([str(pkg)])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_rule_table_is_complete():
+    assert set(RULES) == {"JL000", "JL001", "JL002", "JL003", "JL004",
+                          "JL005", "JL006"}

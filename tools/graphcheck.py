@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""graphcheck CLI: static model-config validation.
+
+Usage:
+    python tools/graphcheck.py model.json [--mesh dp=8,pp=2] \
+        [--batch-size 64] [--memory]
+    python tools/graphcheck.py --self-check
+
+File mode loads a serialized ``MultiLayerConfiguration`` or
+``ComputationGraphConfiguration`` (JSON or YAML, dispatched on the
+``format`` tag), runs every graphcheck rule, prints findings, and exits
+1 when any ERROR finding is present. ``--memory`` additionally prints
+the MemoryReport (parameter counts + HBM/VMEM estimate).
+
+``--self-check`` validates the analyzer itself: the five known-bad
+fixture configs must each produce their named finding and the seed model
+families (MLP, CNN, RNN, graph merge) must validate clean — the CI gate
+tools/run_checks.sh runs.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from deeplearning4j_tpu.analysis.findings import (  # noqa: E402
+    Severity, format_findings, has_errors,
+)
+from deeplearning4j_tpu.analysis.graphcheck import (  # noqa: E402
+    load_config_dict, validate_config,
+)
+
+
+def _parse_mesh(spec):
+    """'dp=8,pp=2' -> {'dp': 8, 'pp': 2}."""
+    if not spec:
+        return None
+    axes = {}
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        if not size:
+            raise SystemExit(f"bad --mesh entry {part!r}; want axis=size")
+        axes[name.strip()] = int(size)
+    return axes
+
+
+def _self_check() -> int:
+    from deeplearning4j_tpu.analysis.fixtures import KNOWN_BAD, KNOWN_GOOD
+    ok = True
+    for name, rule, make in KNOWN_BAD:
+        conf, kw = make()
+        rules = {f.rule for f in validate_config(conf, **kw)}
+        if rule in rules:
+            print(f"  known-bad  {name:<24} rejected with {rule} (ok)")
+        else:
+            ok = False
+            print(f"  known-bad  {name:<24} FAILED: wanted {rule}, "
+                  f"got {sorted(rules) or 'no findings'}")
+    for name, make in KNOWN_GOOD:
+        conf, kw = make()
+        findings = validate_config(conf, **kw)
+        if findings:
+            ok = False
+            print(f"  known-good {name:<24} FAILED: unexpected findings")
+            for f in findings:
+                print(f"    {f}")
+        else:
+            print(f"  known-good {name:<24} clean (ok)")
+    print("graphcheck self-check:", "PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("config", nargs="?", help="serialized config (.json/.yaml)")
+    ap.add_argument("--mesh", default=None,
+                    help="mesh axes, e.g. dp=8,pp=2,ep=4")
+    ap.add_argument("--batch-size", type=int, default=None,
+                    help="global batch size for dp/HBM checks")
+    ap.add_argument("--memory", action="store_true",
+                    help="print the MemoryReport too")
+    ap.add_argument("--self-check", action="store_true",
+                    help="validate the analyzer against its known-bad/"
+                         "known-good fixtures")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        return _self_check()
+    if not args.config:
+        ap.error("a config file (or --self-check) is required")
+
+    with open(args.config, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    if args.config.endswith((".yaml", ".yml")):
+        import yaml
+        d = yaml.safe_load(text)
+    else:
+        d = json.loads(text)
+    conf = load_config_dict(d)
+    findings = validate_config(conf, mesh=_parse_mesh(args.mesh),
+                               batch_size=args.batch_size)
+    if findings:
+        print(format_findings(findings, header=f"{args.config}:"))
+    else:
+        print(f"{args.config}: clean")
+    if args.memory:
+        from deeplearning4j_tpu.analysis.memory import memory_report
+        print(memory_report(conf, batch_size=args.batch_size or 32).to_text())
+    return 1 if has_errors(findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""jaxlint CLI: AST lint for JAX anti-patterns in traced code.
+
+Usage:
+    python tools/jaxlint.py <file-or-dir> [...]   # lint (default: package)
+    python tools/jaxlint.py --list-rules          # print the rule table
+
+Exit status: 0 when no findings survive suppression, 1 otherwise.
+Suppress a finding inline with ``# jaxlint: disable=<RULE> -- <reason>``
+(the reason is mandatory — reasonless suppressions are JL000 findings).
+
+No jax import, no code execution: safe to run anywhere, fast enough for
+a pre-commit hook. Wired into tools/run_checks.sh as the lint gate.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.analysis.findings import format_findings  # noqa: E402
+from deeplearning4j_tpu.analysis.jaxlint import RULES, RULE_SEVERITY, lint_paths  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to lint "
+                         "(default: deeplearning4j_tpu)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, (slug, desc) in sorted(RULES.items()):
+            print(f"{rule}  {slug:<22} {RULE_SEVERITY[rule]:<8} {desc}")
+        return 0
+
+    paths = args.paths or [os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "deeplearning4j_tpu")]
+    findings = lint_paths(paths)
+    if findings:
+        print(format_findings(findings, header="jaxlint findings:"))
+        return 1
+    print("jaxlint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# The repo's check entrypoint: lint gate + analyzer self-check + tier-1
+# tests. Exits nonzero on ANY failure. This is what a PR must pass.
+#
+#   tools/run_checks.sh            # everything (tests take ~10 min)
+#   tools/run_checks.sh --fast     # static checks only (seconds)
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+fail=0
+
+echo "== jaxlint (deeplearning4j_tpu) =="
+python tools/jaxlint.py deeplearning4j_tpu || fail=1
+
+echo "== graphcheck --self-check =="
+JAX_PLATFORMS=cpu python tools/graphcheck.py --self-check || fail=1
+
+if [ "${1:-}" != "--fast" ]; then
+    echo "== tier-1 tests (ROADMAP.md) =="
+    rm -f /tmp/_t1.log
+    timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+        -m 'not slow' --continue-on-collection-errors \
+        -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
+        | tee /tmp/_t1.log
+    rc=${PIPESTATUS[0]}
+    echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+        | tr -cd . | wc -c)
+    [ "$rc" -ne 0 ] && fail=1
+fi
+
+if [ "$fail" -eq 0 ]; then
+    echo "run_checks: ALL CHECKS PASSED"
+else
+    echo "run_checks: FAILURES (see above)" >&2
+fi
+exit $fail
