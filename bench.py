@@ -35,6 +35,14 @@ drove this design:
 - After the first successful rung on TPU, the child runs a
   compiled-Pallas-vs-scan LSTM parity check (VERDICT r2 #2) and stamps
   ``pallas_lstm_parity`` into subsequent records.
+- Profiling (ISSUE 2): every rung runs inside spans of the process-
+  global tracer (deeplearning4j_tpu/profiling) and its record carries
+  ``flops_per_step`` / ``analytic_mfu`` / ``compile_s`` from XLA's
+  compiled-step cost analysis (BENCH_COST=0 skips). Rung failures and
+  the per-rung watchdog (BENCH_RUNG_WALL, default 600s, report-only)
+  print failure records whose ``error.open_spans`` names the phase in
+  flight — the diagnosis the r01-r05 dead rounds never had. Set
+  BENCH_TRACE=<path> to export the full Perfetto timeline.
 
 Model init is one jitted program (nn/graph.py ``init``): eager per-tensor
 init would compile+dispatch hundreds of tiny programs — minutes over a
@@ -48,10 +56,17 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 import traceback
 
 import numpy as np
+
+# stdlib-only imports (no jax at module load): the process-global span
+# tracer every rung emits into (failure/timeout records carry its open-
+# span stack — the diagnosis r01-r05's dead rounds never had) and the
+# single peak-FLOPs table both MFU fields are computed against.
+from deeplearning4j_tpu.profiling import get_tracer, peak_flops
 
 # First-EVER recorded value per metric — the fixed vs_baseline
 # denominator. Do NOT update on later improvements (that would hide the
@@ -71,18 +86,10 @@ BENCH_HISTORY = {
     "vgg16_cifar10_b128_bf16_samples_per_sec_per_chip": None,
 }
 
-# Peak bf16 matmul FLOP/s per chip, by device_kind substring (public cloud
-# specs), for the MFU estimate.
-_CHIP_PEAK_FLOPS = (
-    ("v6", 918e12),       # TPU v6e (Trillium)
-    ("v5p", 459e12),
-    ("v5 lite", 197e12),  # v5e reports device_kind "TPU v5 lite"
-    ("v5e", 197e12),
-    ("v5litepod", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-)
+# Peak FLOP/s per chip: ONE table for both MFU fields (the hand-model
+# `mfu` and the cost-analysis `analytic_mfu`) — profiling/cost.py's
+# PEAK_FLOPS_PER_CHIP, via peak_flops(). A second copy here would let
+# the two numbers silently disagree when a chip generation is added.
 
 T0 = time.perf_counter()
 
@@ -183,12 +190,57 @@ def _stamp(msg: str) -> None:
           file=sys.stderr, flush=True)
 
 
-def _chip_peak(device_kind: str):
-    kind = device_kind.lower()
-    for key, peak in _CHIP_PEAK_FLOPS:
-        if key in kind:
-            return peak
-    return None
+def _failure_record(metric: str, detail: str, open_spans, kind: str
+                    ) -> dict:
+    """A rung failure as a first-class JSON record: value 0, marked
+    ``failed`` (the supervisor's headline selection skips it), and the
+    open/error span stack naming the phase that hung or raised."""
+    return {"metric": metric, "value": 0.0, "unit": "samples/sec/chip",
+            "vs_baseline": 0.0, "failed": True,
+            "error": {"kind": kind, "detail": detail,
+                      "open_spans": list(open_spans)}}
+
+
+class _RungWatchdog:
+    """Report-only per-rung timer: if the rung outlives ``wall_s`` the
+    watchdog prints a timeout failure record naming the tracer's open
+    spans to stdout IMMEDIATELY — it never kills anything (a hung XLA
+    call is not interruptible anyway), but the record is already on
+    stdout when the supervisor's kill harvests the child, so the hang
+    arrives diagnosed instead of silent. ``wall_s <= 0`` disables."""
+
+    def __init__(self, metric: str, wall_s: float, tracer,
+                 emit=None):
+        self.metric = metric
+        self.wall_s = wall_s
+        self.tracer = tracer
+        self.emit = emit or (lambda line: print(line, flush=True))
+        self.fired = False
+        self._timer = None
+
+    def _fire(self):
+        self.fired = True
+        spans = self.tracer.open_span_stack()
+        rec = _failure_record(
+            self.metric,
+            f"rung exceeded {self.wall_s:.0f}s (BENCH_RUNG_WALL); "
+            "still running — open spans name the phase in flight",
+            spans, kind="timeout")
+        self.emit(json.dumps(rec))
+        _stamp(f"RUNG WATCHDOG: {self.metric} over budget; open spans: "
+               f"{' > '.join(spans) or '(none)'}")
+
+    def __enter__(self):
+        if self.wall_s > 0:
+            self._timer = threading.Timer(self.wall_s, self._fire)
+            self._timer.daemon = True
+            self._timer.start()
+        return self
+
+    def __exit__(self, *exc):
+        if self._timer is not None:
+            self._timer.cancel()
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -360,47 +412,49 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
     batch, steps, warmup = cfg["batch"], cfg["steps"], cfg["warmup"]
     height, width = cfg["height"], cfg["width"]
     _stamp(f"rung '{rung}': {cfg}")
+    tracer = get_tracer()
 
     from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.datasets.iterator import (
         DevicePrefetchIterator, ListDataSetIterator)
 
     t = time.perf_counter()
-    if cfg["model"] == "lenet":
-        from deeplearning4j_tpu.models.lenet import lenet_mnist
-        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-        net = MultiLayerNetwork(lenet_mnist(
-            height=height, width=width, updater="nesterovs",
-            learning_rate=0.01)).init()
-    elif cfg["model"] == "vgg16":
-        from deeplearning4j_tpu.models.vgg import vgg16_cifar10
-        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-        net = MultiLayerNetwork(vgg16_cifar10(
-            height=height, width=width, dtype=cfg["dtype"],
-            updater="nesterovs", learning_rate=0.01)).init()
-    elif cfg["model"] == "charlstm":
-        from deeplearning4j_tpu import (InputType,
-                                        NeuralNetConfiguration)
-        from deeplearning4j_tpu.nn.layers import (GravesLSTM,
-                                                  RnnOutputLayer)
-        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-        T, K = cfg["channels"], cfg["classes"]
-        net = MultiLayerNetwork(
-            NeuralNetConfiguration.builder().seed(7)
-            .updater("rmsprop", learning_rate=1e-3).weight_init("xavier")
-            .list()
-            .layer(GravesLSTM(n_out=256, activation="tanh"))
-            .layer(GravesLSTM(n_out=256, activation="tanh"))
-            .layer(RnnOutputLayer(n_out=K, activation="softmax",
-                                  loss="mcxent"))
-            .set_input_type(InputType.recurrent(K, T)).build()).init()
-    else:
-        from deeplearning4j_tpu.models.resnet import resnet50
-        from deeplearning4j_tpu.nn.graph import ComputationGraph
-        net = ComputationGraph(resnet50(
-            height=height, width=width, dtype=cfg["dtype"],
-            updater="nesterovs", learning_rate=0.1)).init()
-    jax.block_until_ready(net.params)
+    with tracer.span("build_model", model=cfg["model"]):
+        if cfg["model"] == "lenet":
+            from deeplearning4j_tpu.models.lenet import lenet_mnist
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+            net = MultiLayerNetwork(lenet_mnist(
+                height=height, width=width, updater="nesterovs",
+                learning_rate=0.01)).init()
+        elif cfg["model"] == "vgg16":
+            from deeplearning4j_tpu.models.vgg import vgg16_cifar10
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+            net = MultiLayerNetwork(vgg16_cifar10(
+                height=height, width=width, dtype=cfg["dtype"],
+                updater="nesterovs", learning_rate=0.01)).init()
+        elif cfg["model"] == "charlstm":
+            from deeplearning4j_tpu import (InputType,
+                                            NeuralNetConfiguration)
+            from deeplearning4j_tpu.nn.layers import (GravesLSTM,
+                                                      RnnOutputLayer)
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+            T, K = cfg["channels"], cfg["classes"]
+            net = MultiLayerNetwork(
+                NeuralNetConfiguration.builder().seed(7)
+                .updater("rmsprop", learning_rate=1e-3).weight_init("xavier")
+                .list()
+                .layer(GravesLSTM(n_out=256, activation="tanh"))
+                .layer(GravesLSTM(n_out=256, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=K, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(K, T)).build()).init()
+        else:
+            from deeplearning4j_tpu.models.resnet import resnet50
+            from deeplearning4j_tpu.nn.graph import ComputationGraph
+            net = ComputationGraph(resnet50(
+                height=height, width=width, dtype=cfg["dtype"],
+                updater="nesterovs", learning_rate=0.1)).init()
+        jax.block_until_ready(net.params)
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(net.params))
     _stamp(f"model built, init'd on device in {time.perf_counter() - t:.1f}s "
@@ -429,32 +483,36 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
     # of samples/sec/chip, independent of this harness's slow host link.
     t = time.perf_counter()
     n_stage = 2 if smoke else 4
-    staged = list(DevicePrefetchIterator(
-        ListDataSetIterator(batches(n_stage)),
-        dtype="bfloat16" if on_accel and cfg["dtype"] == "bfloat16"
-        else None))
-    jax.block_until_ready([d.features for d in staged])
+    with tracer.span("stage_batches", n=n_stage):
+        staged = list(DevicePrefetchIterator(
+            ListDataSetIterator(batches(n_stage)),
+            dtype="bfloat16" if on_accel and cfg["dtype"] == "bfloat16"
+            else None))
+        jax.block_until_ready([d.features for d in staged])
     mb = sum(d.features.nbytes + d.labels.nbytes for d in staged) / 1e6
     _stamp(f"{n_stage} batches staged on device in "
            f"{time.perf_counter() - t:.1f}s ({mb:.1f}MB)")
 
     t = time.perf_counter()
-    for i in range(warmup):
-        loss = net.fit_batch(staged[i % len(staged)])
-        jax.block_until_ready(net.params)
-        _stamp(f"warmup step {i + 1}/{warmup} done "
-               f"(+{time.perf_counter() - t:.1f}s, loss={float(loss):.3f})")
+    with tracer.span("warmup", steps=warmup):
+        for i in range(warmup):
+            loss = net.fit_batch(staged[i % len(staged)])
+            jax.block_until_ready(net.params)
+            _stamp(f"warmup step {i + 1}/{warmup} done "
+                   f"(+{time.perf_counter() - t:.1f}s, "
+                   f"loss={float(loss):.3f})")
     compile_s = time.perf_counter() - t
 
     # timed region A (loop): pure async dispatch + ONE final sync — any
     # stamp or block_until_ready inside would serialize the pipeline (a
     # device round-trip per step on a remote-TPU link) and bias low
     _stamp(f"timing {steps} steps (loop)...")
-    t0 = time.perf_counter()
-    for i in range(steps):
-        net.fit_batch(staged[i % len(staged)])
-    jax.block_until_ready(net.params)
-    dt_loop = time.perf_counter() - t0
+    with tracer.span("timed_loop", steps=steps):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            net.fit_batch(staged[i % len(staged)])
+        jax.block_until_ready(net.params)
+        dt_loop = time.perf_counter() - t0
     sps_loop = batch * steps / dt_loop
     _stamp(f"loop: {steps} steps in {dt_loop:.2f}s -> "
            f"{sps_loop:.1f} samples/s")
@@ -475,16 +533,17 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
     try:
         if not scan_this:
             raise _SkipScan
-        window = [staged[i % len(staged)] for i in range(steps)]
-        t0 = time.perf_counter()
-        net.fit_batches_scan(window)   # warmup: compiles the scan program
-        jax.block_until_ready(net.params)
-        _stamp(f"scan program compiled+warm in "
-               f"{time.perf_counter() - t0:.1f}s; timing...")
-        t0 = time.perf_counter()
-        net.fit_batches_scan(window)
-        jax.block_until_ready(net.params)
-        dt_scan = time.perf_counter() - t0
+        with tracer.span("timed_scan", steps=steps):
+            window = [staged[i % len(staged)] for i in range(steps)]
+            t0 = time.perf_counter()
+            net.fit_batches_scan(window)  # warmup: compiles the program
+            jax.block_until_ready(net.params)
+            _stamp(f"scan program compiled+warm in "
+                   f"{time.perf_counter() - t0:.1f}s; timing...")
+            t0 = time.perf_counter()
+            net.fit_batches_scan(window)
+            jax.block_until_ready(net.params)
+            dt_scan = time.perf_counter() - t0
         sps_scan = batch * steps / dt_scan
         _stamp(f"scan: {steps} steps in {dt_scan:.2f}s -> "
                f"{sps_scan:.1f} samples/s")
@@ -506,27 +565,54 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
     from deeplearning4j_tpu.optimize.training_stats import TrainingStats
     phase_breakdown = None
     try:
-        stats = TrainingStats()
-        n_phase = 2 if smoke else 6
-        for i in range(n_phase):
-            with stats.phase("data_wait"):
-                fresh = batches(1)
-            with stats.phase("shard"):
-                put = list(DevicePrefetchIterator(
-                    ListDataSetIterator(fresh),
-                    dtype="bfloat16" if on_accel and cfg["dtype"] == "bfloat16"
-                    else None))
-                jax.block_until_ready([d.features for d in put])
-            with stats.phase("step"):
-                net.fit_batch(staged[i % len(staged)])
-                jax.block_until_ready(net.params)
-        phase_breakdown = {
-            name: round(p["mean_s"], 4)
-            for name, p in stats.export()["phases"].items()}
+        with tracer.span("phase_breakdown"):
+            stats = TrainingStats()
+            n_phase = 2 if smoke else 6
+            for i in range(n_phase):
+                with stats.phase("data_wait"):
+                    fresh = batches(1)
+                with stats.phase("shard"):
+                    put = list(DevicePrefetchIterator(
+                        ListDataSetIterator(fresh),
+                        dtype="bfloat16"
+                        if on_accel and cfg["dtype"] == "bfloat16"
+                        else None))
+                    jax.block_until_ready([d.features for d in put])
+                with stats.phase("step"):
+                    net.fit_batch(staged[i % len(staged)])
+                    jax.block_until_ready(net.params)
+            phase_breakdown = {
+                name: round(p["mean_s"], 4)
+                for name, p in stats.export()["phases"].items()}
         _stamp(f"phase breakdown (s/step over {n_phase}): {phase_breakdown}")
     except Exception:  # noqa: BLE001 — telemetry must never cost the rung
         _stamp("phase breakdown FAILED (headline number stands):\n"
                + traceback.format_exc(limit=10))
+
+    # XLA cost analysis of the REAL compiled train step (profiling/cost):
+    # FLOPs + bytes per step and the analytic MFU — platform-independent
+    # compile-time numbers (the same fields a CPU smoke run reports).
+    # Runs AFTER the timed regions (it pays one AOT recompile) and can
+    # never cost the rung. BENCH_COST=0 skips.
+    flops_per_step = bytes_accessed = analytic = None
+    if os.environ.get("BENCH_COST", "1") == "1":
+        t = time.perf_counter()
+        try:
+            with tracer.span("cost_analysis"):
+                cost = net.cost_analysis(staged[0])
+            flops_per_step = cost.get("flops_per_step")
+            bytes_accessed = cost.get("bytes_accessed")
+            peak = cost.get("peak_flops_per_chip")
+            if flops_per_step and peak and sps > 0:
+                from deeplearning4j_tpu.profiling.cost import analytic_mfu
+                analytic = round(
+                    analytic_mfu(flops_per_step, batch / sps, peak), 4)
+            _stamp(f"cost analysis in {time.perf_counter() - t:.1f}s: "
+                   f"{(flops_per_step or 0):.3e} FLOPs/step, "
+                   f"analytic_mfu={analytic}")
+        except Exception:  # noqa: BLE001 — telemetry must never cost it
+            _stamp("cost analysis FAILED (headline number stands):\n"
+                   + traceback.format_exc(limit=10))
 
     # MFU estimate: analytic fwd FLOPs x3 (fwd+bwd) over chip peak.
     # ResNet-50 @224 fwd ~= 4.09e9 FLOPs/image, scaled by area; LeNet is
@@ -537,7 +623,10 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
         # towers dominate both; VGG's CIFAR fc head is negligible)
         fwd224 = 4.09e9 if cfg["model"] == "resnet50" else 15.47e9
         fwd = fwd224 * (height * width) / (224 * 224)
-        peak = _chip_peak(device_kind)
+        # on_accel gate: the shared table has a nominal CPU entry (for
+        # analytic_mfu off-chip); the hand-model `mfu` stays a real-
+        # hardware-only field as before
+        peak = peak_flops(device_kind) if on_accel else None
         if peak:
             mfu = round(3.0 * fwd * sps / peak, 4)
 
@@ -560,7 +649,11 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
         "step_ms": round(1000 * dt / steps, 2),
         "timing_mode": timing_mode,
         "loop_samples_per_sec": round(sps_loop, 2),
-        "warmup_compile_s": round(compile_s, 1),
+        "compile_s": round(compile_s, 1),
+        "warmup_compile_s": round(compile_s, 1),  # legacy alias
+        "flops_per_step": flops_per_step,
+        "bytes_accessed_per_step": bytes_accessed,
+        "analytic_mfu": analytic,
         "phase_breakdown_s_per_step": phase_breakdown,
         "pallas_lstm_parity": parity,
     }
@@ -585,6 +678,15 @@ def _run_child() -> int:
     _stamp(f"backend up in {time.perf_counter() - t:.1f}s: "
            f"{len(devices)}x {device_kind} ({platform})")
     on_accel = platform not in ("cpu",)
+    try:
+        # count + time every jit trace/lower/compile of the ladder into
+        # the metrics registry and mirror compiles into the trace
+        # timeline (BENCH_TRACE) — a surprise recompile is the r03 bug
+        # class this run should self-report
+        from deeplearning4j_tpu.profiling import CompileWatcher
+        CompileWatcher().install()
+    except Exception:  # noqa: BLE001 — telemetry must never stop a bench
+        _stamp("CompileWatcher unavailable (non-fatal)")
 
     # tiny sanity op: separates "tunnel dead" from "model too big"
     t = time.perf_counter()
@@ -596,18 +698,44 @@ def _run_child() -> int:
     parity = ("skipped (not tpu)" if platform != "tpu"
               else "pending (check did not complete — see stamps)")
     banked = []
+    tracer = get_tracer()
+    rung_wall = float(os.environ.get("BENCH_RUNG_WALL", "600"))
     for rung in rungs:
+        metric = f"{rung}_samples_per_sec_per_chip"  # fallback name
         try:
-            rec = _run_rung(jax, rung, smoke, on_accel, device_kind,
-                            platform, parity)
+            metric = _rung_config(rung, smoke)["metric"] + (
+                "" if on_accel and not smoke else "_SMOKE")
+            with _RungWatchdog(metric, rung_wall, tracer), \
+                    tracer.span(f"rung:{rung}"):
+                rec = _run_rung(jax, rung, smoke, on_accel, device_kind,
+                                platform, parity)
             print(json.dumps(rec), flush=True)  # banked — a later hang
             banked.append(rec)                  # cannot lose this
             if on_accel and not smoke:
                 _bank_record(rec)  # durable: survives any later failure
         except Exception:  # noqa: BLE001 — keep climbing on rung failure
-            _stamp(f"rung '{rung}' FAILED:\n"
-                   + traceback.format_exc(limit=20))
+            tb = traceback.format_exc(limit=20)
+            _stamp(f"rung '{rung}' FAILED:\n" + tb)
+            # failure record with the span stack the exception unwound
+            # through PLUS any spans still open (other threads / async
+            # work) — the next dead round arrives as a diagnosis, not a
+            # shrug. Concatenate, not `or`: the outer rung span always
+            # populates the error stack, which must not mask open spans.
+            err = tracer.error_span_stack()
+            spans = err + [s for s in tracer.open_span_stack()
+                           if s not in err]
+            print(json.dumps(_failure_record(
+                metric, tb.strip().splitlines()[-1][:300], spans,
+                kind="exception")), flush=True)
     _stamp(f"ladder done: {len(banked)}/{len(rungs)} rungs banked")
+    trace_path = os.environ.get("BENCH_TRACE")
+    if trace_path:
+        try:
+            tracer.save(trace_path)
+            _stamp(f"chrome trace ({tracer.event_count()} events) -> "
+                   f"{trace_path}")
+        except OSError:
+            _stamp("trace export failed (non-fatal)")
 
     if platform == "tpu" and banked:
         # LAST, after every number is banked: a Mosaic-compile hang here
@@ -717,7 +845,8 @@ def _supervise() -> int:
         return 1
     recs, note = _launch_child(wall - (time.perf_counter() - T0) - 20.0)
     remaining = wall - (time.perf_counter() - T0) - 40.0
-    if not recs and note != "timeout" and remaining > 180.0:
+    if not [r for r in recs if not r.get("failed")] \
+            and note != "timeout" and remaining > 180.0:
         # r01-style transient (backend UNAVAILABLE — probes show it can
         # take minutes to raise): one retry in a FRESH process (JAX
         # caches a failed backend for the life of a process). Never after
@@ -725,20 +854,35 @@ def _supervise() -> int:
         _stamp("child failed with nothing banked — retrying once in 20s")
         time.sleep(20.0)
         recs, note = _launch_child(remaining - 20.0)
-    if recs:
+    ok = [r for r in recs if not r.get("failed")]
+    # a report-only watchdog can't retract: a slow-but-successful rung
+    # leaves both a timeout record and a success record on stdout — the
+    # success supersedes its failure here
+    done = {r["metric"] for r in ok}
+    failures = [r for r in recs
+                if r.get("failed") and r["metric"] not in done]
+    if ok:
         # headline = the BASELINE config (ResNet-50 b64@224, rung 'full')
         # when banked; otherwise the last (deepest) banked rung. r03
         # showed why "last" alone is wrong: an 'xl' rung corrupted by an
         # in-region recompile displaced a healthy 'full' number.
-        best = next((r for r in recs if r.get("rung") == "full"), recs[-1])
+        best = next((r for r in ok if r.get("rung") == "full"), ok[-1])
         best["ladder"] = {r.get("rung", f"#{i}"): r.get("value")
-                          for i, r in enumerate(recs)}
+                          for i, r in enumerate(ok)}
         # the ladder-final parity verdict is stamped on the last record
-        if recs[-1].get("pallas_lstm_parity"):
-            best["pallas_lstm_parity"] = recs[-1]["pallas_lstm_parity"]
+        if ok[-1].get("pallas_lstm_parity"):
+            best["pallas_lstm_parity"] = ok[-1]["pallas_lstm_parity"]
+        if failures:  # partial ladder: carry the diagnosed failures too
+            best["rung_failures"] = [r["error"] for r in failures]
         best["child_exit"] = note
         print(json.dumps(best), flush=True)
         return 0
+    if failures:
+        # nothing measured, but the failure records carry the open-span
+        # stack — print the last one as the final diagnosed selection
+        final = dict(failures[-1], child_exit=note)
+        print(json.dumps(final), flush=True)
+        return 1
     print(json.dumps({
         "metric": "resnet50_b64_bf16_samples_per_sec_per_chip",
         "value": 0.0,

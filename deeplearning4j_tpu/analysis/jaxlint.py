@@ -20,12 +20,18 @@ Rules (stable ids):
 - JL004 loop-compute   (warning) a Python ``for``/``while`` loop inside a
         traced function whose body calls jnp/jax.lax — unrolls into the
         program; usually wants ``lax.scan``/``fori_loop``/``vmap``
-- JL005 impure-jit     (error)   ``time.time()``/``time.perf_counter()``/
-        ``np.random.*``/``random.*``/``datetime.now()`` inside a traced
-        function — baked in as a trace-time constant
+- JL005 impure-jit     (error)   ``np.random.*``/``random.*``/
+        ``datetime.now()`` inside a traced function — baked in as a
+        trace-time constant
 - JL006 missing-donate (warning) ``jax.jit`` applied to a function whose
         name marks it as a training step without ``donate_argnums`` —
         doubles peak HBM by keeping dead input buffers alive
+- JL007 host-timer-in-trace (error) ``time.time()``/``perf_counter()``/
+        ``monotonic()``/``process_time()`` — or a profiling span/phase
+        context (``tracer.span(...)``, ``stats.phase(...)``,
+        ``maybe_phase(...)``) — inside a traced function: a host timer
+        there is a trace-time constant, not a measurement, and a span
+        times the trace, not the run
 
 Traced-context detection is lexical: a function counts as traced when it
 is (a) decorated with ``jax.jit``/``pmap``/``vmap``/``shard_map`` (bare
@@ -70,11 +76,15 @@ RULES: Dict[str, Tuple[str, str]] = {
               "jnp/lax compute inside a Python loop in a traced function; "
               "use lax.scan / fori_loop / vmap"),
     "JL005": ("impure-jit",
-              "time/np.random/random/datetime call inside a traced "
+              "np.random/random/datetime call inside a traced "
               "function is baked in at trace time"),
     "JL006": ("missing-donate",
               "jitted train step without donate_argnums keeps dead input "
               "buffers alive (2x peak HBM)"),
+    "JL007": ("host-timer-in-trace",
+              "host timer (time.time/perf_counter) or profiling span/"
+              "phase inside a traced function is a trace-time constant, "
+              "not a measurement"),
 }
 
 RULE_SEVERITY = {
@@ -85,6 +95,7 @@ RULE_SEVERITY = {
     "JL004": Severity.WARNING,
     "JL005": Severity.ERROR,
     "JL006": Severity.WARNING,
+    "JL007": Severity.ERROR,
 }
 
 # decorators / callables whose function argument is traced
@@ -369,6 +380,37 @@ def _lint_traced_function(fn: FunctionNode, ctx: _Ctx) -> None:
                              "the program",
                              "pass the value in as an argument (or use "
                              "jax.random with a threaded key)")
+                # JL007: host timers measure the trace, not the run
+                if name and _HOST_TIMER_RE.match(name):
+                    ctx.emit("JL007", node,
+                             f"{name}() inside a traced function is a "
+                             "trace-time constant, not a measurement — "
+                             "the program runs later, asynchronously",
+                             "time outside jit around a block_until_ready"
+                             ", or use the profiling tracer at the call "
+                             "site")
+            # JL007: `with tracer.span(...)` / `with stats.phase(...)` /
+            # `with maybe_phase(...)` in a traced function — the context
+            # opens and closes during the single trace, so it times
+            # tracing, not execution
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    cexpr = item.context_expr
+                    if not isinstance(cexpr, ast.Call):
+                        continue
+                    is_span = (isinstance(cexpr.func, ast.Attribute)
+                               and cexpr.func.attr in _SPAN_ATTRS)
+                    is_span = is_span or (
+                        isinstance(cexpr.func, ast.Name)
+                        and cexpr.func.id in _SPAN_FNS)
+                    if is_span:
+                        ctx.emit("JL007", node,
+                                 "profiling span/phase context inside a "
+                                 "traced function times the TRACE (runs "
+                                 "once at trace time), not the compiled "
+                                 "step",
+                                 "move the span outside jit, around the "
+                                 "dispatch + sync")
             # JL002: control flow on traced conditions
             if isinstance(node, (ast.If, ast.While)) \
                     and _contains_traced_call(node.test):
@@ -401,10 +443,21 @@ def _lint_traced_function(fn: FunctionNode, ctx: _Ctx) -> None:
 
 
 _IMPURE_RE = re.compile(
-    r"^(time\.(time|perf_counter|monotonic|process_time)"
-    r"|np\.random\.\w+|numpy\.random\.\w+"
+    r"^(np\.random\.\w+|numpy\.random\.\w+"
     r"|random\.(random|randint|uniform|choice|shuffle|gauss|randrange|sample)"
     r"|datetime\.(datetime\.)?(now|utcnow|today))$")
+
+# JL007: host timers are their own rule (not JL005) because the fix is
+# different — an impure VALUE wants to become an argument; a TIMER wants
+# to move outside jit entirely (there is nothing to measure in a trace)
+_HOST_TIMER_RE = re.compile(
+    r"^time\.(time|perf_counter|perf_counter_ns|monotonic|monotonic_ns"
+    r"|process_time|process_time_ns)$")
+
+# profiling context attrs whose `with` inside a traced function times
+# the trace, not the run (tracer.span / TrainingStats.phase)
+_SPAN_ATTRS = {"span", "phase"}
+_SPAN_FNS = {"maybe_phase"}
 
 
 def _lint_module(tree: ast.Module, ctx: _Ctx) -> None:

@@ -27,8 +27,9 @@ from deeplearning4j_tpu.datasets.iterator import (
 from deeplearning4j_tpu.nn.conf.graph import (
     DuplicateToTimeSeriesVertex, LastTimeStepVertex)
 from deeplearning4j_tpu.nn.conf.graph_builder import ComputationGraphConfiguration
-from deeplearning4j_tpu.nn.netcommon import (EvalMixin, LazyScoreMixin,
-                                              jit_init, ScanFitMixin,
+from deeplearning4j_tpu.nn.netcommon import (CostAnalysisMixin, EvalMixin,
+                                              LazyScoreMixin, jit_init,
+                                              ScanFitMixin,
 )
 from deeplearning4j_tpu.nn.updater import build_optimizer, compute_updates
 from deeplearning4j_tpu.optimize.listeners import IterationListener, TrainingListener
@@ -57,7 +58,8 @@ def _time_slice(d: Optional[Dict[str, Array]], lo: int, hi: int,
             for k, v in d.items()}
 
 
-class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin):
+class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
+                       CostAnalysisMixin):
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
         self.params: Optional[Dict[str, Dict[str, Array]]] = None
@@ -402,10 +404,14 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin):
             self._train_step_fn = self._build_train_step()
         inputs, labels, masks, lmasks = self._split(data)
         self._rng, step_rng = jax.random.split(self._rng)
-        self.params, self.opt_state, self.states, loss, self.last_grads = \
-            self._train_step_fn(
-                self.params, self.opt_state, self.states, inputs, labels,
-                masks, lmasks, step_rng)
+        from deeplearning4j_tpu.profiling import get_tracer
+        # host-side span: the (async) step dispatch — what hangs when a
+        # compile or transfer wedges (see MultiLayerNetwork.fit_batch)
+        with get_tracer().span("fit_batch", it=self.iteration_count + 1):
+            self.params, self.opt_state, self.states, loss, self.last_grads \
+                = self._train_step_fn(
+                    self.params, self.opt_state, self.states, inputs, labels,
+                    masks, lmasks, step_rng)
         self.last_batch_size = data.num_examples()
         # raw device scalar — see MultiLayerNetwork.fit_batch: converting
         # eagerly would sync the pipeline every step

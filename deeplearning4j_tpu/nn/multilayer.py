@@ -35,8 +35,9 @@ from deeplearning4j_tpu.datasets.iterator import (
 )
 from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers.base import BaseLayerConf
-from deeplearning4j_tpu.nn.netcommon import (EvalMixin, LazyScoreMixin,
-                                              jit_init, ScanFitMixin,
+from deeplearning4j_tpu.nn.netcommon import (CostAnalysisMixin, EvalMixin,
+                                              LazyScoreMixin, jit_init,
+                                              ScanFitMixin,
 )
 from deeplearning4j_tpu.nn.updater import (
     build_optimizer, compute_updates, l1_l2_penalty,
@@ -64,7 +65,8 @@ def _sum_aux_losses(states) -> Array:
     return total
 
 
-class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin):
+class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin,
+                        CostAnalysisMixin):
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
         self.layers: List[BaseLayerConf] = conf.layers
@@ -338,11 +340,16 @@ class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin):
         self._rng, step_rng = jax.random.split(self._rng)
         fmask = None if dataset.features_mask is None else jnp.asarray(dataset.features_mask)
         lmask = None if dataset.labels_mask is None else jnp.asarray(dataset.labels_mask)
-        self.params, self.opt_state, self.states, loss, self.last_grads = \
-            self._train_step_fn(
-                self.params, self.opt_state, self.states,
-                jnp.asarray(dataset.features), jnp.asarray(dataset.labels),
-                fmask, lmask, step_rng)
+        from deeplearning4j_tpu.profiling import get_tracer
+        # host-side span: measures the (async) step dispatch, which is
+        # exactly what hangs when a compile or transfer wedges
+        with get_tracer().span("fit_batch", it=self.iteration_count + 1):
+            self.params, self.opt_state, self.states, loss, self.last_grads \
+                = self._train_step_fn(
+                    self.params, self.opt_state, self.states,
+                    jnp.asarray(dataset.features),
+                    jnp.asarray(dataset.labels),
+                    fmask, lmask, step_rng)
         self.last_batch_size = dataset.num_examples()
         self.last_input = dataset.features  # for visualization listeners
         # store the RAW device scalar: converting here would force a

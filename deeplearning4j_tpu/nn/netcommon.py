@@ -85,6 +85,19 @@ class EvalMixin:
         return self._drive_eval(RegressionEvaluation(), iterator)
 
 
+class CostAnalysisMixin:
+    """``cost_analysis(batch)`` for both containers: XLA's compile-time
+    cost model over the REAL jitted train step — FLOPs and bytes
+    accessed per optimization step, plus the chip's peak for an analytic
+    MFU. Pure compile-time work (runs on CPU, no accelerator needed);
+    pays one AOT compile per call, so call it once per batch shape, not
+    per step."""
+
+    def cost_analysis(self, batch, peak=None) -> dict:
+        from deeplearning4j_tpu.profiling.cost import train_step_cost
+        return train_step_cost(self, batch, peak=peak)
+
+
 def make_pretrain_step(layer, tx):
     """Jitted single-layer pretraining step for the greedy layerwise walk
     both containers run (ref: MultiLayerNetwork.pretrain /

@@ -107,9 +107,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
     hi = jnp.minimum(qi + 1, n_kv) if causal else n_kv
     acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
     l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = jnp.where(l[:, None] > 0, acc / l_safe[:, None],
+    # a fully-masked row (zero valid keys) never raises m off NEG_INF —
+    # float absorption keeps l > 0 there (exp(s - m) == exp(0)), so the
+    # validity test must be on m, not l: masked rows emit a zero output
+    # and an EXACT NEG_INF lse, which is what the backward kernels gate
+    # their recomputed probabilities on (ADVICE r5)
+    valid = m > NEG_INF / 2
+    o_ref[0] = jnp.where(valid[:, None], acc / l_safe[:, None],
                          0.0).astype(o_ref.dtype)
-    lse_ref[0] = jnp.where(l > 0, m + jnp.log(l_safe), NEG_INF)
+    lse_ref[0] = jnp.where(valid, m + jnp.log(l_safe), NEG_INF)
 
 
 def _run_fwd(q, k, v, bias, causal, interpret):
@@ -164,7 +170,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dvec_ref,
             k_pos = j * _BLK + jax.lax.broadcasted_iota(
                 jnp.int32, (Bq, _BLK), 1)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])                 # [Bq, BLK]
+        # fully-masked query rows (zero valid keys) carry lse == NEG_INF
+        # from the forward; exp(s - lse) there is garbage (float
+        # absorption, not inf) — gate them to zero probability so the
+        # row's gradients are exactly zero (ADVICE r5)
+        p = jnp.where(lse[:, None] > NEG_INF / 2,
+                      jnp.exp(s - lse[:, None]), 0.0)  # [Bq, BLK]
         dp = jax.lax.dot_general(
             do, vblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -201,7 +212,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dvec_ref,
             q_pos = i * _BLK + jax.lax.broadcasted_iota(
                 jnp.int32, (_BLK, Bk), 0)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])                 # [Bq, Bk]
+        # same masked-row gate as _dq_kernel: rows with lse == NEG_INF
+        # (no valid key) must contribute zero to dk/dv
+        p = jnp.where(lse[:, None] > NEG_INF / 2,
+                      jnp.exp(s - lse[:, None]), 0.0)  # [Bq, Bk]
         dv = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)

@@ -45,6 +45,7 @@ class TrainingStats:
         self.phases: Dict[str, dict] = {}
         self._t0: Optional[float] = None
         self._t_last: Optional[float] = None
+        self._cost: Optional[dict] = None
 
     # ------------------------------------------------------------- recording
     def record(self, phase: str, seconds: float) -> None:
@@ -83,6 +84,13 @@ class TrainingStats:
             self.record(phase, time.perf_counter() - t)
             yield item
 
+    def set_cost(self, cost: Optional[dict]) -> None:
+        """Attach a compiled-step cost analysis (the dict from
+        ``profiling.cost.train_step_cost``). ``export()`` then reports
+        it and, when the ``step`` phase has samples, derives
+        ``analytic_mfu`` from the measured mean step time."""
+        self._cost = cost
+
     # --------------------------------------------------------------- exports
     def wall_s(self) -> float:
         if self._t0 is None:
@@ -101,6 +109,15 @@ class TrainingStats:
                 fraction=(p["total_s"] / wall) if wall > 0 else 0.0)
         out["covered_fraction"] = (
             self.total_phase_s() / wall if wall > 0 else 0.0)
+        if self._cost:
+            out["cost_analysis"] = dict(self._cost)
+            step = self.phases.get("step")
+            flops = self._cost.get("flops_per_step")
+            peak = self._cost.get("peak_flops_per_chip")
+            if step and step["count"] and flops and peak:
+                from deeplearning4j_tpu.profiling.cost import analytic_mfu
+                out["analytic_mfu"] = analytic_mfu(
+                    flops, step["total_s"] / step["count"], peak)
         return out
 
     def to_json(self, indent: int = 2) -> str:

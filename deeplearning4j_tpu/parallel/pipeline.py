@@ -26,6 +26,7 @@ TrainingMaster.java:29 — the strategy seam this plugs into).
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -50,6 +51,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.nn.updater import compute_updates, l1_l2_penalty
+from deeplearning4j_tpu.profiling import get_tracer
+
+logger = logging.getLogger(__name__)
+
+# one process-wide aux-loss semantics warning (see PipelineTrainer)
+_WARNED_AUX_MICROBATCH = False
 
 
 def _pvary(x, axis):
@@ -321,30 +328,39 @@ class _RingFitMixin:
             self._step = self._build_step(b_mb)
             self._b_mb = b_mb
         stats = self.training_stats
-        t_shard = time.perf_counter() if stats else 0.0
-        x = feats.reshape(self.M, b_mb, -1)
-        xs = jnp.pad(x, ((0, 0), (0, 0), (0, self._amax - x.shape[-1])))
-        if stats:
-            jax.block_until_ready(xs)
-            stats.record("shard", time.perf_counter() - t_shard)
-            t_step = time.perf_counter()
-        net._rng, step_rng = jax.random.split(net._rng)
-        cbuf = jnp.zeros((self.S, getattr(self, "_cmax", 1)), jnp.float32)
-        net.params, net.opt_state, net.states, _, loss = self._step(
-            net.params, net.opt_state, net.states, cbuf, xs, labels,
-            step_rng)
-        if stats:
-            jax.block_until_ready(loss)
-            stats.record("step", time.perf_counter() - t_step)
+        # `with` spans (not bare begin/end): a raising step must close
+        # its span and note it on the tracer's error stack, or a caught
+        # exception would leak an open span into later hang diagnoses
+        tracer = get_tracer()
+        with tracer.span("shard"):
+            t_shard = time.perf_counter() if stats else 0.0
+            x = feats.reshape(self.M, b_mb, -1)
+            xs = jnp.pad(x, ((0, 0), (0, 0),
+                             (0, self._amax - x.shape[-1])))
+            if stats:
+                jax.block_until_ready(xs)
+                stats.record("shard", time.perf_counter() - t_shard)
+                t_step = time.perf_counter()
+        with tracer.span("step", microbatches=self.M):
+            net._rng, step_rng = jax.random.split(net._rng)
+            cbuf = jnp.zeros((self.S, getattr(self, "_cmax", 1)),
+                             jnp.float32)
+            net.params, net.opt_state, net.states, _, loss = self._step(
+                net.params, net.opt_state, net.states, cbuf, xs, labels,
+                step_rng)
+            if stats:
+                jax.block_until_ready(loss)
+                stats.record("step", time.perf_counter() - t_step)
         net.last_batch_size = B
         net.score_value = loss
         net.iteration_count += 1
-        t_l = time.perf_counter() if stats else 0.0
-        for listener in net.listeners:
-            listener.iteration_done(net, net.iteration_count,
-                                    net.score_value)
-        if stats:
-            stats.record("listener", time.perf_counter() - t_l)
+        with tracer.span("listener"):
+            t_l = time.perf_counter() if stats else 0.0
+            for listener in net.listeners:
+                listener.iteration_done(net, net.iteration_count,
+                                        net.score_value)
+            if stats:
+                stats.record("listener", time.perf_counter() - t_l)
         return net._score_raw
 
     def _fit_batch_tbptt(self, feats, labels, b_mb: int, B: int) -> float:
@@ -643,6 +659,16 @@ class PipelineTrainer(_RingFitMixin):
     PRNG key folded from the step rng by (stage, tick[, dp shard]), so
     masks differ per microbatch/stage/shard and a fixed seed reproduces.
 
+    MoE aux-loss semantics under microbatching: with
+    ``n_microbatches == M > 1`` each microbatch computes its balancing
+    loss over its OWN 1/M slice of the batch and the objective takes
+    the mean of those per-microbatch values — which differs from the
+    single-device step's aux computed over the full batch (mean of
+    per-slice balance != full-batch balance; the same approximation the
+    dp gradient all-reduce makes). Exact parity holds only at M=1 on a
+    pp-only mesh; a one-time ``logger.warning`` marks runs that train
+    aux layers with M > 1 (see PARITY.md).
+
     Recurrent layers pipeline too: a stage runs its layer's full
     sequence scan in-stage (plain BPTT, zero carry per batch), and under
     truncated BPTT the final carries ride the ring's no-grad carry
@@ -692,6 +718,16 @@ class PipelineTrainer(_RingFitMixin):
         # dp gradient all-reduce already makes.
         self._aux_layers = [i for i, l in enumerate(body)
                             if "aux_loss" in net.states[i]]
+        global _WARNED_AUX_MICROBATCH
+        if self._aux_layers and self.M > 1 and not _WARNED_AUX_MICROBATCH:
+            _WARNED_AUX_MICROBATCH = True
+            logger.warning(
+                "PipelineTrainer: %d aux-loss layer(s) with "
+                "n_microbatches=%d — the balancing loss is a mean of "
+                "per-microbatch values, not the full-batch aux; exact "
+                "single-device parity holds only at n_microbatches=1 "
+                "(see the class docstring / PARITY.md)",
+                len(self._aux_layers), self.M)
         # recurrent layers run their full sequence INSIDE their stage
         # (zero initial carry per batch, exactly layer.apply); under
         # tBPTT the final carries additionally thread through the ring's

@@ -34,6 +34,7 @@ from deeplearning4j_tpu.optimize.training_stats import (
 from deeplearning4j_tpu.parallel.mesh import (
     MeshContext, sequence_parallel_scope,
 )
+from deeplearning4j_tpu.profiling import get_tracer
 
 
 class ParallelTrainer:
@@ -145,49 +146,58 @@ class ParallelTrainer:
             self._step = self._build_step()
         net = self.net
         stats = self.training_stats
-        t_shard = time.perf_counter() if stats else 0.0
-        if self._is_graph:
-            # name-keyed dicts (DataSet or MultiDataSet), every leaf
-            # sharded over the data axis
-            inputs, lbls, masks, lmasks_d = net._split(batch)
-            shard = lambda t: jax.tree.map(self.mesh.shard_batch, t)
-            feats, labels = shard(inputs), shard(lbls)
-            fmask, lmask = shard(masks), shard(lmasks_d)
-        else:
-            feats = jnp.asarray(batch.features)
-            labels = jnp.asarray(batch.labels)
-            feats, labels = self.mesh.shard_batch(feats, labels)
-            fmask = lmask = None
-            if batch.features_mask is not None:
-                fmask = self.mesh.shard_batch(
-                    jnp.asarray(batch.features_mask))
-            if batch.labels_mask is not None:
-                lmask = self.mesh.shard_batch(
-                    jnp.asarray(batch.labels_mask))
-        if stats:
-            # sync the async device_put so transfer time lands in 'shard',
-            # not 'step' — over a remote tunnel that distinction is the
-            # whole point of the phase
-            jax.block_until_ready((feats, labels))
-            stats.record("shard", time.perf_counter() - t_shard)
-            t_step = time.perf_counter()
-        net._rng, step_rng = jax.random.split(net._rng)
-        # the scope routes SelfAttentionLayer through ring attention over
-        # the mesh's 'sp' axis at trace time (no-op without one)
-        with sequence_parallel_scope(self.mesh):
-            net.params, net.opt_state, net.states, loss = self._step(
-                net.params, net.opt_state, net.states, feats, labels, fmask,
-                lmask, step_rng)
-        if stats:
-            jax.block_until_ready(loss)
-            stats.record("step", time.perf_counter() - t_step)
+        # global-tracer spans (profiling/): host-side timeline of the
+        # same phases the stats flag times — unconditional because the
+        # tracer is cheap and the open-span stack is the hang diagnosis.
+        # `with` (not bare begin/end): a raising step must close the
+        # span AND note it on the tracer's error stack, or one caught
+        # exception would leak an open span into every later diagnosis
+        tracer = get_tracer()
+        with tracer.span("shard"):
+            t_shard = time.perf_counter() if stats else 0.0
+            if self._is_graph:
+                # name-keyed dicts (DataSet or MultiDataSet), every leaf
+                # sharded over the data axis
+                inputs, lbls, masks, lmasks_d = net._split(batch)
+                shard = lambda t: jax.tree.map(self.mesh.shard_batch, t)
+                feats, labels = shard(inputs), shard(lbls)
+                fmask, lmask = shard(masks), shard(lmasks_d)
+            else:
+                feats = jnp.asarray(batch.features)
+                labels = jnp.asarray(batch.labels)
+                feats, labels = self.mesh.shard_batch(feats, labels)
+                fmask = lmask = None
+                if batch.features_mask is not None:
+                    fmask = self.mesh.shard_batch(
+                        jnp.asarray(batch.features_mask))
+                if batch.labels_mask is not None:
+                    lmask = self.mesh.shard_batch(
+                        jnp.asarray(batch.labels_mask))
+            if stats:
+                # sync the async device_put so transfer time lands in
+                # 'shard', not 'step' — over a remote tunnel that
+                # distinction is the whole point of the phase
+                jax.block_until_ready((feats, labels))
+                stats.record("shard", time.perf_counter() - t_shard)
+                t_step = time.perf_counter()
+        with tracer.span("step"):
+            net._rng, step_rng = jax.random.split(net._rng)
+            # the scope routes SelfAttentionLayer through ring attention
+            # over the mesh's 'sp' axis at trace time (no-op without one)
+            with sequence_parallel_scope(self.mesh):
+                net.params, net.opt_state, net.states, loss = self._step(
+                    net.params, net.opt_state, net.states, feats, labels,
+                    fmask, lmask, step_rng)
+            if stats:
+                jax.block_until_ready(loss)
+                stats.record("step", time.perf_counter() - t_step)
         net.last_batch_size = batch.num_examples()
         net.last_grads = None  # SPMD step doesn't collect gradients
         # raw device scalar: converting here would sync the SPMD pipeline
         # every step (see MultiLayerNetwork.score_value)
         net.score_value = loss
         net.iteration_count += 1
-        with maybe_phase(stats, "listener"):
+        with tracer.span("listener"), maybe_phase(stats, "listener"):
             for listener in net.listeners:
                 listener.iteration_done(net, net.iteration_count,
                                         net.score_value)
@@ -262,21 +272,24 @@ class ParallelTrainer:
             return jax.device_put(stacked, NamedSharding(mesh, spec))
 
         stats = self.training_stats
-        t_shard = time.perf_counter() if stats else 0.0
-        feats = place([b.features for b in batches])
-        labels = place([b.labels for b in batches])
-        if stats:
-            jax.block_until_ready((feats, labels))
-            stats.record("shard", time.perf_counter() - t_shard)
-            t_step = time.perf_counter()
-        t0 = time.perf_counter()
-        net._rng, r = jax.random.split(net._rng)
-        with sequence_parallel_scope(self.mesh):
-            net.params, net.opt_state, net.states, losses = scan_fn(
-                net.params, net.opt_state, net.states, feats, labels, r)
-        if stats:
-            jax.block_until_ready(losses)
-            stats.record("step", time.perf_counter() - t_step)
+        tracer = get_tracer()
+        with tracer.span("shard", window=len(batches)):
+            t_shard = time.perf_counter() if stats else 0.0
+            feats = place([b.features for b in batches])
+            labels = place([b.labels for b in batches])
+            if stats:
+                jax.block_until_ready((feats, labels))
+                stats.record("shard", time.perf_counter() - t_shard)
+                t_step = time.perf_counter()
+        with tracer.span("scan_step", window=len(batches)):
+            t0 = time.perf_counter()
+            net._rng, r = jax.random.split(net._rng)
+            with sequence_parallel_scope(self.mesh):
+                net.params, net.opt_state, net.states, losses = scan_fn(
+                    net.params, net.opt_state, net.states, feats, labels, r)
+            if stats:
+                jax.block_until_ready(losses)
+                stats.record("step", time.perf_counter() - t_step)
         net.last_batch_size = batches[-1].num_examples()
         net.last_grads = None
         if net.listeners:

@@ -30,6 +30,7 @@ from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator, DataSetIt
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn.updater import compute_updates
 from deeplearning4j_tpu.parallel.mesh import MeshContext
+from deeplearning4j_tpu.profiling import get_tracer
 
 
 class ParallelWrapper:
@@ -142,24 +143,31 @@ class ParallelWrapper:
 
     def _parallel_iteration(self, batches: List[DataSet]) -> None:
         net = self.net
-        feats = jnp.stack([jnp.asarray(b.features) for b in batches])
-        labels = jnp.stack([jnp.asarray(b.labels) for b in batches])
-        net._rng, k = jax.random.split(net._rng)
-        rngs = jax.random.split(k, self.workers)
-        self._iter_since_avg += 1
-        do_avg = jnp.asarray(self._iter_since_avg >= self.averaging_frequency)
-        (self._stacked_params, self._stacked_opt, self._stacked_states,
-         losses) = self._vstep(self._stacked_params, self._stacked_opt,
-                               self._stacked_states, feats, labels, rngs,
-                               do_avg)
-        if bool(do_avg):
-            self._iter_since_avg = 0
+        tracer = get_tracer()
+        # global-tracer span (profiling/): the vmapped all-worker step —
+        # the open-span stack names this phase if a dispatch ever hangs
+        with tracer.span("parallel_iteration", workers=self.workers):
+            feats = jnp.stack([jnp.asarray(b.features) for b in batches])
+            labels = jnp.stack([jnp.asarray(b.labels) for b in batches])
+            net._rng, k = jax.random.split(net._rng)
+            rngs = jax.random.split(k, self.workers)
+            self._iter_since_avg += 1
+            do_avg = jnp.asarray(
+                self._iter_since_avg >= self.averaging_frequency)
+            (self._stacked_params, self._stacked_opt, self._stacked_states,
+             losses) = self._vstep(self._stacked_params, self._stacked_opt,
+                                   self._stacked_states, feats, labels, rngs,
+                                   do_avg)
+            if bool(do_avg):
+                self._iter_since_avg = 0
         net.iteration_count += 1
         net.last_grads = None  # vmapped worker step doesn't collect grads
         net.score_value = float(jnp.mean(losses))
         net.last_batch_size = sum(b.num_examples() for b in batches)
-        for listener in net.listeners:
-            listener.iteration_done(net, net.iteration_count, net.score_value)
+        with tracer.span("listener"):
+            for listener in net.listeners:
+                listener.iteration_done(net, net.iteration_count,
+                                        net.score_value)
 
     def _sync_to_net(self) -> None:
         """Write worker-0 (post-averaging) state back into the wrapped net,

@@ -1,0 +1,152 @@
+"""Compiled-step cost analysis: FLOPs, bytes accessed, analytic MFU.
+
+XLA attaches a cost model to every compiled executable —
+``jitted.lower(args).compile().cost_analysis()`` — with per-program
+FLOP and bytes-accessed totals. Because the cost model runs at compile
+time, the whole analysis works on CPU with no accelerator attached:
+lower the container's real train step for the real batch shapes, read
+the FLOPs, divide by a chip's peak — an **analytic MFU** you can compute
+(and regress against) before paying any device time, the way µ-cuDNN
+picked convolution configurations from per-layer cost models instead of
+device sweeps.
+
+``train_step_cost(net, batch)`` drives it for either container (and for
+the SPMD ``ParallelTrainer``'s step via the net it wraps). The numbers
+feed three consumers: ``bench.py`` rung records (``flops_per_step``,
+``analytic_mfu``), ``TrainingStats.export()`` (set ``stats.set_cost``),
+and direct calls from perf work.
+
+NOTE: the AOT ``lower().compile()`` pays one real XLA compile and its
+executable is NOT reused by later ``net.fit_batch`` calls (jax's jit
+dispatch cache is separate from the AOT path) — call it once per
+(model, batch shape), not per step.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# Peak dense matmul FLOP/s per chip (bf16 where the chip has bf16 MXUs),
+# by device_kind substring, public cloud specs. First match wins, so
+# longer/more-specific keys come first. The "cpu" entry is a nominal
+# 1 TFLOP/s placeholder so off-chip runs still get a defined ratio —
+# treat CPU "MFU" as a relative number, not a utilization claim.
+PEAK_FLOPS_PER_CHIP = (
+    ("v6", 918e12),       # TPU v6e (Trillium)
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),  # v5e reports device_kind "TPU v5 lite"
+    ("v5litepod", 197e12),
+    ("v5e", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+    ("cpu", 1e12),
+)
+
+
+def peak_flops(device_kind: str) -> Optional[float]:
+    """Peak FLOP/s for a ``device_kind`` string (substring match), or
+    None when the chip is unknown."""
+    kind = (device_kind or "").lower()
+    for key, peak in PEAK_FLOPS_PER_CHIP:
+        if key in kind:
+            return peak
+    return None
+
+
+def analytic_mfu(flops_per_step: float, step_seconds: float,
+                 peak_flops_per_chip: float, n_chips: int = 1
+                 ) -> Optional[float]:
+    """Model FLOPs utilization: achieved FLOP/s over peak.
+
+    ``flops_per_step`` is the compiled program's total (fwd+bwd+update,
+    as XLA counts it), ``step_seconds`` the measured (or target) wall
+    time per step, ``n_chips`` how many chips share the program's FLOPs
+    (SPMD: the cost analysis of the sharded program is already
+    per-device on most jax versions — pass n_chips=1 then).
+    """
+    if not flops_per_step or not step_seconds or not peak_flops_per_chip:
+        return None
+    if step_seconds <= 0 or peak_flops_per_chip <= 0:
+        return None
+    return flops_per_step / (step_seconds * peak_flops_per_chip
+                             * max(n_chips, 1))
+
+
+def _normalize_cost(raw) -> dict:
+    """``cost_analysis()`` returns a dict in newer jax, a 1-list of
+    dicts in 0.4.x, and occasionally None (backend without a cost
+    model). Normalize to {flops, bytes_accessed, ...} floats."""
+    if raw is None:
+        return {}
+    if isinstance(raw, (list, tuple)):
+        raw = raw[0] if raw else {}
+    out = {}
+    for key, val in dict(raw).items():
+        if key == "flops":
+            out["flops"] = float(val)
+        elif key in ("bytes accessed", "bytes_accessed"):
+            out["bytes_accessed"] = float(val)
+        elif key in ("optimal_seconds", "optimal seconds"):
+            out["optimal_seconds"] = float(val)
+    return out
+
+
+def compiled_cost(jitted, *args, **kwargs) -> dict:
+    """Lower + compile ``jitted`` for the given example args and return
+    its normalized cost analysis (one real XLA compile)."""
+    lowered = jitted.lower(*args, **kwargs)
+    return _normalize_cost(lowered.compile().cost_analysis())
+
+
+def train_step_cost(net, batch, peak: Optional[float] = None) -> dict:
+    """Cost-analyze a container's jitted train step on ``batch``.
+
+    ``net``: an initialized MultiLayerNetwork or ComputationGraph.
+    Returns {flops_per_step, flops_per_example, bytes_accessed,
+    arithmetic_intensity, batch, device_kind, peak_flops_per_chip}, plus
+    ``mfu_at(step_seconds)`` left to the caller via ``analytic_mfu``.
+    Pure compile-time work — runs on CPU without a chip.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    net._check_init()
+    if net._train_step_fn is None:
+        net._train_step_fn = net._build_train_step()
+    rng = jax.random.PRNGKey(0)
+    if hasattr(net, "_split"):  # ComputationGraph: name-keyed dicts
+        inputs, labels, masks, lmasks = net._split(batch)
+        args = (net.params, net.opt_state, net.states, inputs, labels,
+                masks, lmasks, rng)
+        n_examples = batch.num_examples()
+    else:
+        fmask = (None if batch.features_mask is None
+                 else jnp.asarray(batch.features_mask))
+        lmask = (None if batch.labels_mask is None
+                 else jnp.asarray(batch.labels_mask))
+        args = (net.params, net.opt_state, net.states,
+                jnp.asarray(batch.features), jnp.asarray(batch.labels),
+                fmask, lmask, rng)
+        n_examples = batch.num_examples()
+    cost = compiled_cost(net._train_step_fn, *args)
+    try:
+        device_kind = str(getattr(jax.devices()[0], "device_kind",
+                                  jax.devices()[0].platform))
+    except Exception:  # noqa: BLE001 — cost numbers stand without a device
+        device_kind = "unknown"
+    peak = peak if peak is not None else peak_flops(device_kind)
+    flops = cost.get("flops")
+    out = {
+        "flops_per_step": flops,
+        "flops_per_example": (flops / n_examples
+                              if flops and n_examples else None),
+        "bytes_accessed": cost.get("bytes_accessed"),
+        "arithmetic_intensity": (
+            flops / cost["bytes_accessed"]
+            if flops and cost.get("bytes_accessed") else None),
+        "batch": n_examples,
+        "device_kind": device_kind,
+        "peak_flops_per_chip": peak,
+    }
+    return out

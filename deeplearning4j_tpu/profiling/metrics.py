@@ -1,0 +1,263 @@
+"""Metrics registry: counters, gauges, fixed-bucket histograms.
+
+Prometheus-flavored, stdlib-only. Instruments are created through a
+``MetricsRegistry`` and are safe to update from any thread; the registry
+renders to JSON (``to_dict()``, the ui server's ``/api/metrics.json``)
+and to the Prometheus text exposition format (``to_prometheus()``,
+served at ``/api/metrics`` so a standard scraper can poll a training
+run). Histograms use FIXED bucket edges chosen at creation — cumulative
+``le`` counts, exactly the Prometheus histogram contract — because
+merging/aggregating across processes only works when every process
+shares the same edges.
+
+A process-global default registry (``get_registry()``) is what the
+compile watcher and memory watermark sampler feed.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# default seconds-scale bucket edges (compile / step / wait times)
+DEFAULT_TIME_BUCKETS = (0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0, 60.0,
+                        300.0)
+
+
+def _fmt_labels(labels: Optional[Dict[str, str]]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+class Counter:
+    """Monotonically increasing value."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def _render(self) -> List[str]:
+        return [f"{self.name} {_fmt_value(self._value)}"]
+
+    _prom_type = "counter"
+
+    def _json(self):
+        return self._value
+
+
+class Gauge:
+    """Set-to-current value (watermarks, queue depths, bytes in use)."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def add(self, amount: float) -> None:
+        with self._lock:
+            self._value += amount
+
+    def set_max(self, value: float) -> None:
+        """Ratchet: keep the maximum ever seen (high-watermark form)."""
+        with self._lock:
+            self._value = max(self._value, float(value))
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def _render(self) -> List[str]:
+        return [f"{self.name} {_fmt_value(self._value)}"]
+
+    _prom_type = "gauge"
+
+    def _json(self):
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram with cumulative ``le`` counts."""
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Sequence[float] = DEFAULT_TIME_BUCKETS):
+        if list(buckets) != sorted(buckets) or len(set(buckets)) != len(
+                list(buckets)):
+            raise ValueError(f"bucket edges must be strictly increasing: "
+                             f"{buckets}")
+        self.name = name
+        self.help = help
+        self.buckets: Tuple[float, ...] = tuple(float(b) for b in buckets)
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)  # +1: the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._sum += v
+            self._count += 1
+            for i, edge in enumerate(self.buckets):
+                if v <= edge:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def cumulative(self) -> List[Tuple[float, int]]:
+        """[(le_edge, cumulative_count)] including (+Inf, total)."""
+        out, acc = [], 0
+        with self._lock:
+            for edge, c in zip(self.buckets, self._counts):
+                acc += c
+                out.append((edge, acc))
+            out.append((math.inf, acc + self._counts[-1]))
+        return out
+
+    def _render(self) -> List[str]:
+        lines = []
+        for edge, cum in self.cumulative():
+            lines.append(
+                f'{self.name}_bucket{{le="{_fmt_value(edge)}"}} {cum}')
+        lines.append(f"{self.name}_sum {_fmt_value(self._sum)}")
+        lines.append(f"{self.name}_count {self._count}")
+        return lines
+
+    _prom_type = "histogram"
+
+    def _json(self):
+        return {"buckets": [[e if e != math.inf else "+Inf", c]
+                            for e, c in self.cumulative()],
+                "sum": self._sum, "count": self._count}
+
+
+class MetricsRegistry:
+    """Named instrument store. ``counter``/``gauge``/``histogram`` are
+    get-or-create (same name returns the same instrument; a kind clash
+    raises)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def _get_or_create(self, cls, name: str, help: str, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help=help, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_TIME_BUCKETS
+                  ) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    # --------------------------------------------------------------- exports
+    def to_dict(self) -> dict:
+        """JSON view: name -> value (number, or histogram dict)."""
+        with self._lock:
+            items = list(self._metrics.items())
+        return {name: m._json() for name, m in sorted(items)}
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format (version 0.0.4)."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        lines: List[str] = []
+        for name, m in items:
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m._prom_type}")
+            lines.extend(m._render())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def timed(self, histogram_name: str, help: str = ""):
+        """Context manager observing elapsed seconds into a histogram."""
+        registry = self
+
+        class _Timed:
+            def __enter__(self):
+                self._t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                registry.histogram(histogram_name, help=help).observe(
+                    time.perf_counter() - self._t0)
+                return False
+
+        return _Timed()
+
+
+# ---------------------------------------------------------------------------
+# process-global default registry
+# ---------------------------------------------------------------------------
+
+_default = MetricsRegistry()
+_default_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global registry the ui server serves and the
+    watchers feed."""
+    return _default
+
+
+def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
+    """Swap the global registry (tests). Returns the previous one."""
+    global _default
+    with _default_lock:
+        prev, _default = _default, registry
+    return prev

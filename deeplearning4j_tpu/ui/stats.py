@@ -199,8 +199,11 @@ class StatsListener(TrainingListener):
         except Exception:
             pass
         try:
-            import jax
-            ms = jax.devices()[0].memory_stats()
+            # shared memory_stats probe (profiling/watchers.py) — the
+            # same one the DeviceMemoryWatermark sampler polls
+            from deeplearning4j_tpu.profiling.watchers import (
+                device_memory_stats)
+            ms = device_memory_stats()
             if ms and "bytes_in_use" in ms:
                 series["mem:device_mb"] = np.array(
                     [ms["bytes_in_use"] / 2**20], np.float32)

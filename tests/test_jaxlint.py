@@ -97,12 +97,51 @@ def test_jl004_loop_compute():
 
 def test_jl005_impure_calls():
     assert rules_of("""
-        import jax, time, numpy as np
+        import jax, numpy as np, random
+        @jax.jit
+        def f(x):
+            return x + np.random.normal() + random.random()
+    """) == ["JL005", "JL005"]
+
+
+def test_jl007_host_timer_in_trace():
+    # host timers are their own rule (JL007, not JL005): the fix is
+    # "move the timer outside jit", not "pass the value in"
+    assert rules_of("""
+        import jax, time
         @jax.jit
         def f(x):
             t0 = time.time()
-            return x + np.random.normal() + t0
-    """) == ["JL005", "JL005"]
+            t1 = time.perf_counter()
+            return x * (t1 - t0)
+    """) == ["JL007", "JL007"]
+
+
+def test_jl007_span_context_in_trace():
+    assert rules_of("""
+        import jax
+        @jax.jit
+        def f(x, tracer, stats):
+            with tracer.span("step"):
+                x = x * 2
+            with stats.phase("shard"):
+                x = x + 1
+            with maybe_phase(stats, "listener"):
+                x = x - 1
+            return x
+    """) == ["JL007", "JL007", "JL007"]
+
+
+def test_jl007_host_side_timing_is_clean():
+    # the correct pattern — timer outside jit around dispatch + sync —
+    # must not fire
+    assert rules_of("""
+        import jax, time
+        def host_fit(step, x):
+            t0 = time.perf_counter()
+            jax.block_until_ready(step(x))
+            return time.perf_counter() - t0
+    """) == []
 
 
 def test_jl006_jitted_step_without_donation():
@@ -169,6 +208,17 @@ def test_untraced_function_is_not_linted():
                 x = float(x) + np.random.normal()
             return x, t0
     """) == []
+
+
+def test_cli_self_check_passes():
+    """tools/jaxlint.py --self-check: every rule's bad fixture fires
+    exactly its rule, every good twin is clean (the run_checks gate)."""
+    import importlib.util
+    path = Path(__file__).resolve().parents[1] / "tools" / "jaxlint.py"
+    spec = importlib.util.spec_from_file_location("jaxlint_cli", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.self_check() == 0
 
 
 # ------------------------------------------------------------- suppression
@@ -245,4 +295,4 @@ def test_repo_source_tree_is_lint_clean():
 
 def test_rule_table_is_complete():
     assert set(RULES) == {"JL000", "JL001", "JL002", "JL003", "JL004",
-                          "JL005", "JL006"}
+                          "JL005", "JL006", "JL007"}

@@ -149,3 +149,42 @@ def test_flash_multi_block_causal_masked():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-4,
                                    err_msg=f"d{name}")
+
+
+def test_flash_zero_valid_key_row_fwd_bwd():
+    """A batch row whose kv_mask has ZERO valid keys (all-padding
+    sequence): forward emits exactly zero for that row, backward emits
+    exactly zero (and finite) gradients — the lse == NEG_INF gate in
+    _dq_kernel/_dkv_kernel (ADVICE r5: recomputed probabilities on
+    fully-masked rows were float-absorption garbage, not inf, so the
+    old l > 0 test never fired). The valid batch row keeps full fwd/bwd
+    parity with the reference."""
+    q, k, v = _qkv(B=2, H=2, T=12, D=8)
+    mask = jnp.ones((2, 12)).at[0].set(0.0)  # batch 0: no valid key
+    cot = jnp.asarray(RNG.normal(size=q.shape).astype(np.float32))
+
+    out = flash_attention(q, k, v, kv_mask=mask, interpret=True)
+    assert float(jnp.max(jnp.abs(out[0]))) == 0.0  # masked row: zeros
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) * cot)
+
+    g_fl = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, kv_mask=mask, interpret=True)), argnums=(0, 1, 2))(q, k, v)
+    for g, name in zip(g_fl, "qkv"):
+        assert bool(jnp.all(jnp.isfinite(g))), f"d{name} not finite"
+        assert float(jnp.max(jnp.abs(g[0]))) == 0.0, \
+            f"d{name}: masked row must have zero gradients"
+
+    # the valid batch row is untouched by the gate: parity holds
+    ref1 = attention_reference(q[1:], k[1:], v[1:], mask=mask[1:])
+    np.testing.assert_allclose(np.asarray(out[1:]), np.asarray(ref1),
+                               atol=2e-5, rtol=2e-5)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(
+            attention_reference(q, k, v, mask=mask[1:]) * cot[1:]),
+        argnums=(0, 1, 2))(q[1:], k[1:], v[1:])
+    for a, b, name in zip(g_fl, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a[1:]), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name} (valid row)")

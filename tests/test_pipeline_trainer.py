@@ -569,3 +569,30 @@ def test_pipeline_moe_on_dp_times_pp_mesh():
     for _ in range(10):
         last = float(tr.fit_batch(batch))
     assert np.isfinite(last) and last < first, (first, last)
+
+
+def test_pipeline_moe_microbatch_aux_warns_once(caplog):
+    """M>1 with aux-loss layers trains a per-microbatch-mean balancing
+    objective, not the full-batch aux — a one-time logger.warning marks
+    such runs (ISSUE 2 satellite; semantics documented in the class
+    docstring and PARITY.md)."""
+    import logging
+
+    from deeplearning4j_tpu.parallel import pipeline as pl_mod
+
+    pl_mod._WARNED_AUX_MICROBATCH = False  # fresh process-wide latch
+    with caplog.at_level(logging.WARNING,
+                         logger="deeplearning4j_tpu.parallel.pipeline"):
+        net = MultiLayerNetwork(_moe_conf()).init()
+        PipelineTrainer(net, mesh=_pp_mesh(2), n_microbatches=2)
+        net2 = MultiLayerNetwork(_moe_conf()).init()
+        PipelineTrainer(net2, mesh=_pp_mesh(2), n_microbatches=2)
+    warns = [r for r in caplog.records
+             if "aux-loss" in r.message and "n_microbatches" in r.message]
+    assert len(warns) == 1  # once per process, not per trainer
+    caplog.clear()
+    with caplog.at_level(logging.WARNING,
+                         logger="deeplearning4j_tpu.parallel.pipeline"):
+        net3 = MultiLayerNetwork(_moe_conf()).init()
+        PipelineTrainer(net3, mesh=_pp_mesh(2), n_microbatches=1)  # M=1
+    assert not [r for r in caplog.records if "aux-loss" in r.message]

@@ -1,0 +1,438 @@
+"""Profiling subsystem: span tracer (Chrome trace-event schema), metrics
+registry (JSON + Prometheus text), compile watcher, memory watermark,
+compiled-step cost analysis (analytic MFU vs a hand-computed LeNet FLOP
+count), and the bench failure-record/watchdog path."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.profiling import (
+    CompileWatcher, Counter, DeviceMemoryWatermark, Gauge, Histogram,
+    MetricsRegistry, Tracer, analytic_mfu, get_registry, get_tracer,
+    peak_flops, set_tracer, train_step_cost,
+)
+
+
+# ---------------------------------------------------------------- tracer
+
+def test_span_nesting_and_chrome_schema_roundtrip():
+    tr = Tracer()
+    with tr.span("outer", rung="lenet"):
+        with tr.span("inner"):
+            pass
+    blob = json.loads(tr.to_json())  # schema round-trip through JSON
+    evs = blob["traceEvents"]
+    assert [e["name"] for e in evs] == ["inner", "outer"]  # close order
+    for e in evs:
+        # the Chrome trace-event contract Perfetto parses: complete
+        # events with numeric microsecond ts/dur and pid/tid
+        assert e["ph"] == "X"
+        assert isinstance(e["ts"], (int, float))
+        assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    outer = next(e for e in evs if e["name"] == "outer")
+    inner = next(e for e in evs if e["name"] == "inner")
+    assert outer["args"] == {"rung": "lenet"}
+    # containment: inner lies within outer
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+
+
+def test_open_span_stack_names_the_hang():
+    tr = Tracer()
+    h1 = tr.begin("rung:full")
+    h2 = tr.begin("warmup")
+    assert tr.open_span_stack() == ["rung:full", "warmup"]
+    tr.end(h2)
+    assert tr.open_span_stack() == ["rung:full"]
+    tr.end(h1)
+    assert tr.open_span_stack() == []
+
+
+def test_error_span_stack_survives_context_unwind():
+    tr = Tracer()
+    with pytest.raises(RuntimeError):
+        with tr.span("rung:lenet"):
+            with tr.span("warmup"):
+                raise RuntimeError("boom")
+    assert tr.open_span_stack() == []  # contexts closed on unwind...
+    # ...but the stack the exception unwound through is preserved
+    assert tr.error_span_stack() == ["rung:lenet", "warmup"]
+
+
+def test_begin_end_across_threads():
+    tr = Tracer()
+    h = tr.begin("prefetch")  # async-work pattern: end on another thread
+    t = threading.Thread(target=tr.end, args=(h,))
+    t.start()
+    t.join()
+    assert tr.open_span_stack() == []
+    assert [e["name"] for e in tr.export()["traceEvents"]] == ["prefetch"]
+
+
+def test_tracer_bounded_buffer_drops_and_counts():
+    tr = Tracer(max_events=10)
+    for i in range(25):
+        with tr.span(f"s{i}"):
+            pass
+    assert tr.event_count() <= 10
+    assert tr.dropped >= 10
+    assert tr.export()["otherData"]["dropped_events"] == tr.dropped
+    # every event source is bounded, not just end(): a compile-watcher
+    # recompile storm (complete) or marker flood (instant) can't leak
+    for i in range(30):
+        tr.complete(f"c{i}", 0.0, 1.0)
+        tr.instant(f"i{i}")
+    assert tr.event_count() <= 10
+
+
+def test_tracer_thread_safety_smoke():
+    tr = Tracer()
+
+    def work(n):
+        for i in range(200):
+            with tr.span(f"t{n}"):
+                pass
+
+    threads = [threading.Thread(target=work, args=(k,)) for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert tr.event_count() == 800
+    assert tr.open_span_stack() == []
+
+
+def test_global_tracer_swap():
+    mine = Tracer()
+    prev = set_tracer(mine)
+    try:
+        assert get_tracer() is mine
+    finally:
+        set_tracer(prev)
+    assert get_tracer() is prev
+
+
+def test_trainers_emit_into_global_tracer():
+    """The containers and ParallelTrainer emit spans into the default
+    tracer during a real fit."""
+    from deeplearning4j_tpu import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel import MeshContext, ParallelTrainer
+
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater("sgd", learning_rate=0.05).weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(8, 6)).astype(np.float32),
+                 np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)])
+    mine = Tracer()
+    prev = set_tracer(mine)
+    try:
+        net = MultiLayerNetwork(conf).init()
+        net.fit_batch(ds)
+        names = {e["name"] for e in mine.export()["traceEvents"]}
+        assert "fit_batch" in names
+        tr = ParallelTrainer(MultiLayerNetwork(conf).init(),
+                             MeshContext.create(n_data=2, n_model=1))
+        tr.fit_batch(ds)
+        names = {e["name"] for e in mine.export()["traceEvents"]}
+        assert {"shard", "step", "listener"} <= names
+    finally:
+        set_tracer(prev)
+
+
+# --------------------------------------------------------------- metrics
+
+def test_counter_gauge_histogram_math():
+    reg = MetricsRegistry()
+    c = reg.counter("steps_total")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("bytes_in_use")
+    g.set(100)
+    g.set_max(40)   # ratchet keeps the max
+    assert g.value == 100
+    g.set_max(250)
+    assert g.value == 250
+    h = reg.histogram("step_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.7, 5.0, 99.0):
+        h.observe(v)
+    assert h.count == 5 and abs(h.sum - 105.25) < 1e-9
+    cum = dict(h.cumulative())
+    assert cum[0.1] == 1 and cum[1.0] == 3 and cum[10.0] == 4
+    assert cum[float("inf")] == 5
+
+
+def test_registry_get_or_create_and_kind_clash():
+    reg = MetricsRegistry()
+    assert reg.counter("x") is reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+    with pytest.raises(ValueError):
+        reg.histogram("h", buckets=(1.0, 1.0, 2.0))  # non-increasing
+
+
+def test_prometheus_text_format():
+    reg = MetricsRegistry()
+    reg.counter("jax_compile_total", help="compiles").inc(3)
+    reg.gauge("device_bytes_in_use").set(2048)
+    h = reg.histogram("lat", buckets=(0.5, 2.0))
+    h.observe(0.3)
+    h.observe(1.0)
+    text = reg.to_prometheus()
+    assert "# TYPE jax_compile_total counter" in text
+    assert "jax_compile_total 3" in text
+    assert "# HELP jax_compile_total compiles" in text
+    assert "device_bytes_in_use 2048" in text
+    assert 'lat_bucket{le="0.5"} 1' in text
+    assert 'lat_bucket{le="2"} 2' in text
+    assert 'lat_bucket{le="+Inf"} 2' in text
+    assert "lat_sum 1.3" in text and "lat_count 2" in text
+    d = reg.to_dict()
+    assert d["jax_compile_total"] == 3
+    assert d["lat"]["count"] == 2
+
+
+def test_registry_timed_context():
+    reg = MetricsRegistry()
+    with reg.timed("op_seconds"):
+        time.sleep(0.01)
+    h = reg.get("op_seconds")
+    assert h.count == 1 and h.sum >= 0.01
+
+
+# -------------------------------------------------------------- watchers
+
+def test_compile_watcher_counts_compiles():
+    import jax
+    import jax.numpy as jnp
+
+    reg = MetricsRegistry()
+    w = CompileWatcher(registry=reg, tracer=Tracer()).install()
+    try:
+        jax.jit(lambda x: x * 3 + 1)(jnp.ones((5,)))
+    finally:
+        w.uninstall()
+    assert reg.counter("jax_trace_total").value >= 1
+    assert reg.counter("jax_compile_total").value >= 1
+    assert reg.counter("jax_compile_seconds_total").value > 0
+    assert reg.get("jax_compile_seconds").count >= 1
+
+
+def test_compile_watcher_wrap_warns_on_shape_change(caplog):
+    import logging
+
+    reg = MetricsRegistry()
+    w = CompileWatcher(registry=reg, tracer=Tracer())
+    calls = []
+    fn = w.wrap(lambda x: calls.append(np.shape(x)), "train_step")
+    with caplog.at_level(logging.WARNING,
+                         logger="deeplearning4j_tpu.profiling.watchers"):
+        fn(np.zeros((4, 2)))
+        fn(np.zeros((4, 2)))   # same signature: silent
+        assert reg.counter("jit_shape_recompiles_total").value == 0
+        fn(np.zeros((8, 2)))   # shape change: counted + warned
+    assert reg.counter("jit_shape_recompiles_total").value == 1
+    assert any("argument shapes changed" in r.message
+               for r in caplog.records)
+    assert len(calls) == 3  # pass-through untouched
+
+
+def test_memory_watermark_sampler_cpu_safe():
+    # CPU memory_stats() returns None: the sampler degrades to a no-op
+    # without touching the registry or raising
+    reg = MetricsRegistry()
+    s = DeviceMemoryWatermark(registry=reg, interval_s=0.01)
+    assert s.sample() is None or isinstance(s.sample(), dict)
+    s.start()
+    time.sleep(0.05)
+    s.stop()  # clean shutdown, no exception
+
+
+def test_memory_watermark_ratchets(monkeypatch):
+    import deeplearning4j_tpu.profiling.watchers as W
+    seq = iter([{"bytes_in_use": 100}, {"bytes_in_use": 900},
+                {"bytes_in_use": 300}])
+    monkeypatch.setattr(W, "device_memory_stats", lambda device=None:
+                        next(seq))
+    reg = MetricsRegistry()
+    s = DeviceMemoryWatermark(registry=reg)
+    for _ in range(3):
+        s.sample()
+    assert reg.gauge("device_bytes_in_use").value == 300  # latest
+    assert reg.gauge("device_bytes_in_use_watermark").value == 900
+
+
+# ------------------------------------------------- cost analysis / MFU
+
+def test_analytic_mfu_arithmetic():
+    # 1e12 FLOPs in 0.5s on a 2e12-peak chip = 100% MFU
+    assert analytic_mfu(1e12, 0.5, 2e12) == pytest.approx(1.0)
+    assert analytic_mfu(1e12, 1.0, 2e12) == pytest.approx(0.5)
+    assert analytic_mfu(1e12, 1.0, 2e12, n_chips=2) == pytest.approx(0.25)
+    assert analytic_mfu(0, 1.0, 2e12) is None
+    assert analytic_mfu(1e12, 0.0, 2e12) is None
+    assert analytic_mfu(1e12, 1.0, None) is None
+
+
+def test_peak_flops_table():
+    assert peak_flops("TPU v5 lite") == 197e12
+    assert peak_flops("TPU v4") == 275e12
+    assert peak_flops("cpu") == 1e12
+    assert peak_flops("quantum abacus") is None
+
+
+def test_lenet_train_step_cost_matches_hand_count():
+    """XLA's cost model for the REAL LeNet train step vs the
+    hand-computed forward FLOPs: conv towers + dense head, valid
+    convolutions (28->24->12->8->4), 2 FLOPs per MAC. A training step
+    is fwd + bwd ~= 3x forward; the XLA count must land in that band —
+    the arithmetic pin for every MFU this subsystem reports."""
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.models.lenet import lenet_mnist
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    B = 8
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.normal(size=(B, 28, 28, 1)).astype(np.float32),
+                 np.eye(10, dtype=np.float32)[rng.integers(0, 10, B)])
+    net = MultiLayerNetwork(lenet_mnist()).init()
+    cost = net.cost_analysis(ds)
+    # hand count, MACs per example (2 FLOPs each):
+    #   conv1: 24*24*20 outputs x 5*5*1  kernel = 288,000
+    #   conv2:   8*8*50 outputs x 5*5*20 kernel = 1,600,000
+    #   dense:  800 -> 500                      = 400,000
+    #   head:   500 -> 10                       = 5,000
+    fwd = 2 * (288_000 + 1_600_000 + 400_000 + 5_000) * B
+    flops = cost["flops_per_step"]
+    assert flops is not None
+    # fwd+bwd is ~3x fwd; allow pooling/softmax/optimizer slack
+    assert 2.5 * fwd <= flops <= 4.0 * fwd, (flops, fwd)
+    assert cost["flops_per_example"] == pytest.approx(flops / B)
+    assert cost["bytes_accessed"] and cost["bytes_accessed"] > 0
+    assert cost["arithmetic_intensity"] == pytest.approx(
+        flops / cost["bytes_accessed"])
+    assert cost["batch"] == B
+    # CPU run: the table's CPU fallback peak keeps MFU defined off-chip
+    assert cost["peak_flops_per_chip"] == 1e12
+    mfu = analytic_mfu(flops, 0.01, cost["peak_flops_per_chip"])
+    assert mfu == pytest.approx(flops / 1e10)
+
+
+def test_graph_container_cost_analysis():
+    """ComputationGraph surfaces the same cost analysis."""
+    from deeplearning4j_tpu import NeuralNetConfiguration
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater("sgd", learning_rate=0.1).weight_init("xavier")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=16, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=4, activation="softmax",
+                                          loss="mcxent"), "d")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(8)).build())
+    rng = np.random.default_rng(1)
+    ds = DataSet(rng.normal(size=(4, 8)).astype(np.float32),
+                 np.eye(4, dtype=np.float32)[rng.integers(0, 4, 4)])
+    net = ComputationGraph(conf).init()
+    cost = net.cost_analysis(ds)
+    # dense 8->16 + head 16->4: tiny but nonzero and batch-scaled
+    assert cost["flops_per_step"] and cost["flops_per_step"] > 0
+    assert cost["batch"] == 4
+
+
+def test_training_stats_folds_cost_analysis():
+    from deeplearning4j_tpu.optimize.training_stats import TrainingStats
+
+    s = TrainingStats()
+    s.record("step", 0.01)
+    s.record("step", 0.01)
+    s.set_cost({"flops_per_step": 2e9, "peak_flops_per_chip": 1e12,
+                "bytes_accessed": 1e6})
+    e = s.export()
+    assert e["cost_analysis"]["flops_per_step"] == 2e9
+    # mean step 0.01s: 2e9 / (0.01 * 1e12) = 0.2
+    assert e["analytic_mfu"] == pytest.approx(0.2)
+    # without a step phase there is no MFU (nothing measured)
+    s2 = TrainingStats()
+    s2.set_cost({"flops_per_step": 2e9, "peak_flops_per_chip": 1e12})
+    assert "analytic_mfu" not in s2.export()
+
+
+# ------------------------------------------------- bench failure records
+
+def test_bench_failure_record_names_open_span():
+    import bench
+
+    tr = Tracer()
+    h = tr.begin("rung:full")
+    tr.begin("warmup")
+    rec = bench._failure_record("m", "detail", tr.open_span_stack(),
+                                kind="timeout")
+    assert rec["failed"] is True and rec["value"] == 0.0
+    assert rec["error"]["open_spans"] == ["rung:full", "warmup"]
+    assert json.loads(json.dumps(rec)) == rec  # JSON-clean
+    del h
+
+
+def test_bench_rung_watchdog_simulated_timeout():
+    """The acceptance path: a rung exceeding its wall emits a failure
+    record naming the open span stack — without killing anything."""
+    import bench
+
+    tr = Tracer()
+    emitted = []
+    h = tr.begin("rung:lenet")
+    tr.begin("stage_batches")
+    with bench._RungWatchdog("lenet_metric", 0.05, tr,
+                             emit=emitted.append):
+        time.sleep(0.3)  # the "hung" rung
+    assert len(emitted) == 1
+    rec = json.loads(emitted[0])
+    assert rec["failed"] and rec["error"]["kind"] == "timeout"
+    assert rec["error"]["open_spans"] == ["rung:lenet", "stage_batches"]
+    # a fast rung never fires
+    emitted.clear()
+    with bench._RungWatchdog("m", 5.0, tr, emit=emitted.append):
+        pass
+    assert emitted == []
+    del h
+
+
+def test_ui_server_serves_metrics_endpoints():
+    import urllib.request
+
+    from deeplearning4j_tpu.ui.server import UIServer
+
+    reg = get_registry()
+    reg.counter("bench_smoke_total").inc(7)
+    srv = UIServer(port=0).start()
+    try:
+        base = srv.url
+        text = urllib.request.urlopen(f"{base}/api/metrics").read().decode()
+        assert "bench_smoke_total 7" in text
+        assert "# TYPE bench_smoke_total counter" in text
+        blob = json.loads(urllib.request.urlopen(
+            f"{base}/api/metrics.json").read().decode())
+        assert blob["bench_smoke_total"] == 7
+    finally:
+        srv.stop()

@@ -115,3 +115,36 @@ def test_scan_fit_records_phases():
     e = tr.training_stats.export()
     assert e["phases"]["step"]["count"] >= 1
     assert e["phases"]["shard"]["count"] >= 1
+
+
+def test_timed_iter_attributes_slow_iterator_to_data_wait():
+    """data_wait attribution (ISSUE satellite): a deliberately slow
+    iterator's next() time lands in the data_wait phase, per item, and
+    dominates a fast consumer's phase split."""
+    class SlowIter:
+        def __iter__(self):
+            for i in range(3):
+                time.sleep(0.02)  # simulated starving input pipeline
+                yield i
+
+    s = TrainingStats()
+    consumed = []
+    for item in s.timed_iter(SlowIter()):
+        with s.phase("step"):
+            consumed.append(item)  # ~free consumer
+    assert consumed == [0, 1, 2]
+    dw = s.phases["data_wait"]
+    assert dw["count"] == 3
+    assert dw["total_s"] >= 0.05          # the sleeps were attributed
+    assert dw["min_s"] >= 0.015           # each next() was timed alone
+    e = s.export()
+    assert e["phases"]["data_wait"]["total_s"] > \
+        e["phases"]["step"]["total_s"] * 5
+
+
+def test_timed_iter_fast_iterator_near_zero_wait():
+    s = TrainingStats()
+    list(s.timed_iter(range(50)))
+    assert s.phases["data_wait"]["count"] == 50
+    # prefetched/fast input: waits are microseconds, not milliseconds
+    assert s.phases["data_wait"]["total_s"] < 0.05

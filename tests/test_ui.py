@@ -369,3 +369,31 @@ def test_ui_auth_cookie_carries_dashboard_fetches():
         assert r2.status == 200
     finally:
         srv.stop()
+
+
+def test_ui_auth_cookie_hardening_flags():
+    """ADVICE r5: the session cookie carries Max-Age (bounded lifetime)
+    always, and Secure only when the deployment opts in via
+    secure_cookie=True — forcing it off-loopback would make browsers
+    drop the cookie over the documented plain-http LAN mode."""
+    from urllib.request import urlopen
+    from deeplearning4j_tpu.ui.server import UIServer
+
+    srv = UIServer(port=0, auth_token="sekrit").start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        cookie = urlopen(base + "/?token=sekrit",
+                         timeout=5).headers.get("Set-Cookie", "")
+        assert "Max-Age=" in cookie, cookie
+        assert "HttpOnly" in cookie and "SameSite=Strict" in cookie
+        assert "Secure" not in cookie  # plain http default: usable
+    finally:
+        srv.stop()
+    srv = UIServer(port=0, auth_token="sekrit", secure_cookie=True).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        cookie = urlopen(base + "/?token=sekrit",
+                         timeout=5).headers.get("Set-Cookie", "")
+        assert "Secure" in cookie, cookie
+    finally:
+        srv.stop()

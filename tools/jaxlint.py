@@ -4,6 +4,11 @@
 Usage:
     python tools/jaxlint.py <file-or-dir> [...]   # lint (default: package)
     python tools/jaxlint.py --list-rules          # print the rule table
+    python tools/jaxlint.py --self-check          # fixture gate (CI)
+
+``--self-check`` lints one bad/good fixture pair per rule: the bad
+snippet must fire exactly its rule, the good twin must be clean — the
+same fixture-gate shape as graphcheck's. Run by tools/run_checks.sh.
 
 Exit status: 0 when no findings survive suppression, 1 otherwise.
 Suppress a finding inline with ``# jaxlint: disable=<RULE> -- <reason>``
@@ -20,7 +25,69 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from deeplearning4j_tpu.analysis.findings import format_findings  # noqa: E402
-from deeplearning4j_tpu.analysis.jaxlint import RULES, RULE_SEVERITY, lint_paths  # noqa: E402
+from deeplearning4j_tpu.analysis.jaxlint import (  # noqa: E402
+    RULES, RULE_SEVERITY, lint_paths, lint_source,
+)
+
+# --self-check fixtures: rule -> (bad snippet firing exactly it,
+#                                 good twin staying clean)
+_FIXTURES = {
+    "JL001": ("import jax\n@jax.jit\ndef f(x):\n    return float(x)\n",
+              "import jax\n@jax.jit\ndef f(x):\n"
+              "    return x.astype('float32')\n"),
+    "JL002": ("import jax, jax.numpy as jnp\n@jax.jit\ndef f(x):\n"
+              "    if jnp.any(x > 0):\n        return x\n    return -x\n",
+              "import jax, jax.numpy as jnp\n@jax.jit\ndef f(x):\n"
+              "    return jnp.where(x > 0, x, -x)\n"),
+    "JL003": ("import jax, numpy as np\n@jax.jit\ndef f(x):\n"
+              "    return np.asarray(x)\n",
+              "import jax, jax.numpy as jnp\n@jax.jit\ndef f(x):\n"
+              "    return jnp.asarray(x)\n"),
+    "JL004": ("import jax, jax.numpy as jnp\n@jax.jit\ndef f(h, W):\n"
+              "    for _ in range(64):\n        h = jnp.tanh(h @ W)\n"
+              "    return h\n",
+              "import jax, jax.numpy as jnp\n@jax.jit\ndef f(h, W):\n"
+              "    return jax.lax.fori_loop(\n"
+              "        0, 64, lambda i, a: jnp.tanh(a @ W), h)\n"),
+    "JL005": ("import jax, numpy as np\n@jax.jit\ndef f(x):\n"
+              "    return x + np.random.normal()\n",
+              "import jax\n@jax.jit\ndef f(x, key):\n"
+              "    return x + jax.random.normal(key, x.shape)\n"),
+    "JL006": ("import jax\ndef train_step(p, g):\n    return p - g\n"
+              "fn = jax.jit(train_step)\n",
+              "import jax\ndef train_step(p, g):\n    return p - g\n"
+              "fn = jax.jit(train_step, donate_argnums=(0,))\n"),
+    "JL007": ("import jax, time\n@jax.jit\ndef f(x):\n"
+              "    t0 = time.perf_counter()\n    return x * t0\n",
+              "import jax, time\ndef host_fit(step, x):\n"
+              "    t0 = time.perf_counter()\n"
+              "    jax.block_until_ready(step(x))\n"
+              "    return time.perf_counter() - t0\n"),
+}
+
+
+def self_check() -> int:
+    """Every rule's bad fixture fires exactly that rule; every good
+    twin is clean. Nonzero exit on any drift."""
+    failures = []
+    for rule, (bad, good) in sorted(_FIXTURES.items()):
+        got = [f.rule for f in lint_source(bad, f"<{rule}-bad>")]
+        if got != [rule]:
+            failures.append(f"{rule}: bad fixture fired {got or 'nothing'}, "
+                            f"expected [{rule}]")
+        got = [f.rule for f in lint_source(good, f"<{rule}-good>")]
+        if got:
+            failures.append(f"{rule}: good fixture fired {got}")
+    missing = set(RULES) - set(_FIXTURES) - {"JL000"}  # JL000 = meta rule
+    if missing:
+        failures.append(f"rules without fixtures: {sorted(missing)}")
+    if failures:
+        print("jaxlint --self-check FAILED:")
+        for f in failures:
+            print("  " + f)
+        return 1
+    print(f"jaxlint --self-check: {len(_FIXTURES)} rule fixtures OK")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -30,12 +97,16 @@ def main(argv=None) -> int:
                          "(default: deeplearning4j_tpu)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule table and exit")
+    ap.add_argument("--self-check", action="store_true",
+                    help="lint the built-in per-rule fixtures and exit")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for rule, (slug, desc) in sorted(RULES.items()):
             print(f"{rule}  {slug:<22} {RULE_SEVERITY[rule]:<8} {desc}")
         return 0
+    if args.self_check:
+        return self_check()
 
     paths = args.paths or [os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),

@@ -13,10 +13,16 @@ fail=0
 echo "== jaxlint (deeplearning4j_tpu) =="
 python tools/jaxlint.py deeplearning4j_tpu || fail=1
 
+echo "== jaxlint --self-check =="
+python tools/jaxlint.py --self-check || fail=1
+
 echo "== graphcheck --self-check =="
 JAX_PLATFORMS=cpu python tools/graphcheck.py --self-check || fail=1
 
 if [ "${1:-}" != "--fast" ]; then
+    echo "== profiling smoke (trace export + metrics + cost analysis) =="
+    JAX_PLATFORMS=cpu python tools/profiling_smoke.py || fail=1
+
     echo "== tier-1 tests (ROADMAP.md) =="
     rm -f /tmp/_t1.log
     timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
