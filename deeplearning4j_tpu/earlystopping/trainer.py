@@ -92,6 +92,12 @@ class EarlyStoppingTrainer:
                     break
             if stop:
                 break
+        # drain lag-pending divergence flags BEFORE picking the best
+        # model: a raise-policy sentinel must not let a run whose last
+        # step diverged report a clean result (resilience/sentinel.py)
+        sentinel = getattr(net, "_sentinel", None)
+        if sentinel is not None:
+            sentinel.flush()
         best_model = cfg.model_saver.get_best_model(net)
         result = EarlyStoppingResult(
             termination_reason=reason,

@@ -29,7 +29,7 @@ from deeplearning4j_tpu.nn.conf.graph import (
 from deeplearning4j_tpu.nn.conf.graph_builder import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.netcommon import (CostAnalysisMixin, EvalMixin,
                                               LazyScoreMixin, jit_init,
-                                              ScanFitMixin,
+                                              ScanFitMixin, SentinelMixin,
 )
 from deeplearning4j_tpu.nn.updater import build_optimizer, compute_updates
 from deeplearning4j_tpu.optimize.listeners import IterationListener, TrainingListener
@@ -59,7 +59,7 @@ def _time_slice(d: Optional[Dict[str, Array]], lo: int, hi: int,
 
 
 class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
-                       CostAnalysisMixin):
+                       CostAnalysisMixin, SentinelMixin):
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
         self.params: Optional[Dict[str, Dict[str, Array]]] = None
@@ -338,6 +338,9 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
         tx = self._tx
         training = self.conf.training
         collect_grads = getattr(self, "_collect_grads", False)
+        sentinel = self._sentinel
+        if sentinel is not None:
+            from deeplearning4j_tpu.resilience.sentinel import guard_update
 
         def train_step(params, opt_state, states, inputs, labels, masks,
                        lmasks, rng):
@@ -350,8 +353,15 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
             layer_list = [self.conf.nodes[n].layer for n in self._layer_nodes]
             new_params, new_opt = compute_updates(
                 tx, grads, opt_state, params, layer_list, training)
-            return (new_params, new_opt, new_states, loss,
-                    grads if collect_grads else None)
+            out_grads = grads if collect_grads else None
+            if sentinel is None:
+                return new_params, new_opt, new_states, loss, out_grads
+            # non-finite guard: a diverged update never lands (old state
+            # selected in-program — no host sync; see resilience/sentinel)
+            sel, bad = guard_update(
+                loss, grads, (params, opt_state, states),
+                (new_params, new_opt, new_states))
+            return sel[0], sel[1], sel[2], loss, out_grads, bad
 
         # donate params/opt/states: ResNet-scale nets must not copy their
         # whole state every step (HBM traffic + footprint)
@@ -408,15 +418,17 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
         # host-side span: the (async) step dispatch — what hangs when a
         # compile or transfer wedges (see MultiLayerNetwork.fit_batch)
         with get_tracer().span("fit_batch", it=self.iteration_count + 1):
-            self.params, self.opt_state, self.states, loss, self.last_grads \
-                = self._train_step_fn(
-                    self.params, self.opt_state, self.states, inputs, labels,
-                    masks, lmasks, step_rng)
+            out = self._train_step_fn(
+                self.params, self.opt_state, self.states, inputs, labels,
+                masks, lmasks, step_rng)
+            (self.params, self.opt_state, self.states, loss,
+             self.last_grads) = out[:5]
         self.last_batch_size = data.num_examples()
         # raw device scalar — see MultiLayerNetwork.fit_batch: converting
         # eagerly would sync the pipeline every step
         self.score_value = loss
         self.iteration_count += 1
+        self._observe_sentinel(out[5] if len(out) > 5 else None)
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration_count, self.score_value)
         return self._score_raw
@@ -468,6 +480,9 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
         bwd = training.tbptt_bwd_length or fwd
         data_loss_of = self._data_loss
         rnn_inputs = self._tbptt_rnn_inputs()
+        sentinel = self._sentinel
+        if sentinel is not None:
+            from deeplearning4j_tpu.resilience.sentinel import guard_update
 
         def step(params, opt_state, states, inputs, labels, masks, lmasks,
                  carries, rng):
@@ -527,7 +542,14 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
                 tx, grads, opt_state, params, layer_list, training)
             # stop gradients across tBPTT boundaries
             new_carries = jax.tree.map(jax.lax.stop_gradient, new_carries)
-            return new_params, new_opt, new_states, new_carries, loss
+            if sentinel is None:
+                return new_params, new_opt, new_states, new_carries, loss
+            # non-finite guard incl. carries: a NaN window must not
+            # poison the next window's recurrent state
+            sel, bad = guard_update(
+                loss, grads, (params, opt_state, states, carries),
+                (new_params, new_opt, new_states, new_carries))
+            return sel[0], sel[1], sel[2], sel[3], loss, bad
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
@@ -554,18 +576,20 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
         for start in range(0, T, fwd):
             end = min(start + fwd, T)
             self._rng, step_rng = jax.random.split(self._rng)
+            out = self._tbptt_step_fn(
+                self.params, self.opt_state, self.states,
+                _time_slice(inputs, start, end, only=rnn_inputs),
+                _time_slice(labels, start, end),
+                _time_slice(masks, start, end, 2, rnn_inputs),
+                _time_slice(lmasks, start, end, 2),
+                carries, step_rng)
             (self.params, self.opt_state, self.states, carries, loss) = \
-                self._tbptt_step_fn(
-                    self.params, self.opt_state, self.states,
-                    _time_slice(inputs, start, end, only=rnn_inputs),
-                    _time_slice(labels, start, end),
-                    _time_slice(masks, start, end, 2, rnn_inputs),
-                    _time_slice(lmasks, start, end, 2),
-                    carries, step_rng)
+                out[:5]
             total = total + loss  # device accumulate — no per-slice sync
             slices += 1
             self.iteration_count += 1
             self.score_value = loss
+            self._observe_sentinel(out[5] if len(out) > 5 else None)
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration_count,
                                         self.score_value)

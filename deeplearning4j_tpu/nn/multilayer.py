@@ -37,7 +37,7 @@ from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers.base import BaseLayerConf
 from deeplearning4j_tpu.nn.netcommon import (CostAnalysisMixin, EvalMixin,
                                               LazyScoreMixin, jit_init,
-                                              ScanFitMixin,
+                                              ScanFitMixin, SentinelMixin,
 )
 from deeplearning4j_tpu.nn.updater import (
     build_optimizer, compute_updates, l1_l2_penalty,
@@ -66,7 +66,7 @@ def _sum_aux_losses(states) -> Array:
 
 
 class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin,
-                        CostAnalysisMixin):
+                        CostAnalysisMixin, SentinelMixin):
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
         self.layers: List[BaseLayerConf] = conf.layers
@@ -279,6 +279,9 @@ class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin,
         tx = self._tx
         training = self.conf.training
         collect_grads = getattr(self, "_collect_grads", False)
+        sentinel = self._sentinel
+        if sentinel is not None:
+            from deeplearning4j_tpu.resilience.sentinel import guard_update
         from deeplearning4j_tpu.nn.layers.core import CenterLossOutputLayer
         center_loss_head = isinstance(self.layers[-1], CenterLossOutputLayer)
 
@@ -304,8 +307,15 @@ class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin,
                 # (ref: CenterLossOutputLayer alpha semantics)
                 new_params[-1]["cL"] = self.layers[-1].updated_centers(
                     {"cL": params[-1]["cL"]}, h_last, labels)
-            return (new_params, new_opt, new_states, loss,
-                    grads if collect_grads else None)
+            out_grads = grads if collect_grads else None
+            if sentinel is None:
+                return new_params, new_opt, new_states, loss, out_grads
+            # non-finite guard: a diverged update never lands (the old
+            # state is selected in-program — no host sync)
+            sel, bad = guard_update(
+                loss, grads, (params, opt_state, states),
+                (new_params, new_opt, new_states))
+            return sel[0], sel[1], sel[2], loss, out_grads, bad
 
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
@@ -344,12 +354,13 @@ class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin,
         # host-side span: measures the (async) step dispatch, which is
         # exactly what hangs when a compile or transfer wedges
         with get_tracer().span("fit_batch", it=self.iteration_count + 1):
-            self.params, self.opt_state, self.states, loss, self.last_grads \
-                = self._train_step_fn(
-                    self.params, self.opt_state, self.states,
-                    jnp.asarray(dataset.features),
-                    jnp.asarray(dataset.labels),
-                    fmask, lmask, step_rng)
+            out = self._train_step_fn(
+                self.params, self.opt_state, self.states,
+                jnp.asarray(dataset.features),
+                jnp.asarray(dataset.labels),
+                fmask, lmask, step_rng)
+            (self.params, self.opt_state, self.states, loss,
+             self.last_grads) = out[:5]
         self.last_batch_size = dataset.num_examples()
         self.last_input = dataset.features  # for visualization listeners
         # store the RAW device scalar: converting here would force a
@@ -359,6 +370,7 @@ class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin,
         # float() the return value).
         self.score_value = loss
         self.iteration_count += 1
+        self._observe_sentinel(out[5] if len(out) > 5 else None)
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration_count, self.score_value)
         return self._score_raw
@@ -369,6 +381,9 @@ class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin,
         training = self.conf.training
         fwd = training.tbptt_fwd_length
         bwd = training.tbptt_bwd_length or fwd
+        sentinel = self._sentinel
+        if sentinel is not None:
+            from deeplearning4j_tpu.resilience.sentinel import guard_update
 
         def step(params, opt_state, states, features, labels, fmask, lmask,
                  carries, rng):
@@ -430,7 +445,14 @@ class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin,
                 tx, grads, opt_state, params, self.layers, training)
             # stop gradients across tBPTT boundaries
             new_carries = jax.tree.map(jax.lax.stop_gradient, new_carries)
-            return new_params, new_opt, new_states, new_carries, loss
+            if sentinel is None:
+                return new_params, new_opt, new_states, new_carries, loss
+            # non-finite guard incl. carries: a NaN window must not
+            # poison the next window's recurrent state
+            sel, bad = guard_update(
+                loss, grads, (params, opt_state, states, carries),
+                (new_params, new_opt, new_states, new_carries))
+            return sel[0], sel[1], sel[2], sel[3], loss, bad
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
@@ -459,13 +481,16 @@ class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin,
             lm = (None if dataset.labels_mask is None
                   else jnp.asarray(dataset.labels_mask[:, start:end]))
             self._rng, step_rng = jax.random.split(self._rng)
+            out = self._tbptt_step_fn(self.params, self.opt_state,
+                                      self.states, feats, labs, fm, lm,
+                                      carries, step_rng)
             self.params, self.opt_state, self.states, carries, loss = \
-                self._tbptt_step_fn(self.params, self.opt_state, self.states,
-                                    feats, labs, fm, lm, carries, step_rng)
+                out[:5]
             total = total + loss  # device accumulate — no per-slice sync
             slices += 1
             self.iteration_count += 1
             self.score_value = loss
+            self._observe_sentinel(out[5] if len(out) > 5 else None)
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration_count, self.score_value)
         self.last_batch_size = dataset.num_examples()

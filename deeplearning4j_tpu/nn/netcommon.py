@@ -49,6 +49,39 @@ def jit_init(build, seed: int):
     return jax.jit(build)(jax.random.PRNGKey(seed))
 
 
+class SentinelMixin:
+    """Divergence-sentinel attachment shared by both containers (and
+    read by all three parallel trainers at step-build time).
+
+    With a sentinel attached, every compiled train step grows an
+    in-step non-finite guard (``resilience/sentinel.py:guard_update``):
+    a NaN/inf loss or grad-norm means the update never lands, and the
+    step returns one extra device-scalar flag that ``fit_batch`` hands
+    to the sentinel's lag-based drain. Attaching/detaching drops the
+    container's cached jitted steps here (guarded and unguarded steps
+    are different programs); the parallel trainers detect the change
+    themselves at their next ``fit_batch`` and rebuild their own cached
+    steps.
+    """
+
+    _sentinel = None
+
+    def set_divergence_sentinel(self, sentinel):
+        self._sentinel = sentinel
+        self._train_step_fn = None
+        # derived caches key on _train_step_fn identity or are rebuilt
+        # lazily; the tBPTT step is cached separately
+        if getattr(self, "_tbptt_step_fn", None) is not None:
+            self._tbptt_step_fn = None
+        return self
+
+    def _observe_sentinel(self, flag) -> None:
+        """Hand the just-completed step's flag to the sentinel (may
+        raise per policy — see resilience/sentinel.py)."""
+        if self._sentinel is not None and flag is not None:
+            self._sentinel.observe(flag, self.iteration_count)
+
+
 class EvalMixin:
     """Shared evaluation drivers (ref: MultiLayerNetwork.evaluate /
     evaluateROC:2436 / evaluateROCMultiClass:2449 / evaluateRegression —
@@ -241,6 +274,10 @@ class ScanFitMixin:
             algo in ("sgd", "stochastic_gradient_descent")
             and self.conf.training.backprop_type != "truncated_bptt"
             and not getattr(self, "_collect_grads", False)
+            # a divergence sentinel needs per-step host observation
+            # (raise/rollback policies); the scan body would silently
+            # drop the flags — train per batch instead
+            and getattr(self, "_sentinel", None) is None
             and not any(has_mask(d) for d in datasets)
             # a ragged batch (short dataset tail) cannot stack — loop it
             and len({shape_sig(d) for d in datasets}) == 1)

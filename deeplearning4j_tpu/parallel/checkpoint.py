@@ -13,6 +13,21 @@ deployments, hand-rolled to keep the format inspectable:
                            mesh axis names/sizes, process count
       shards_p<K>.npz    — process K's addressable shards, keyed
                            "<leaf>|<shard-linear-index>"
+      done_p<K>.json     — process K's commit vote: its shard-file CRC
+                           (multi-process saves only)
+      COMMIT             — written LAST, by process 0 only, after every
+                           per-process shard file has landed; carries
+                           the CRC-32 of each shard file
+
+Crash safety: every file is committed atomically (tmp + fsync +
+rename, ``resilience/atomic.py``), and the ``COMMIT`` marker makes the
+whole multi-file checkpoint transactional — ``restore_sharded`` refuses
+a directory without it, so a reader can never assemble a half-written
+step. Shard-file CRCs are verified on restore; a bit-flipped or
+truncated shard raises ``CheckpointError`` naming the file. (The
+manifests are small atomically-replaced JSON validated by parse +
+shard-coverage checks, so they carry no CRC — which also keeps them
+hand-editable for recovery surgery.)
 
 Restore modes:
 - ``restore_sharded(dir, mesh_ctx)``   -> pytree placed on mesh per the
@@ -24,7 +39,7 @@ Restore modes:
 from __future__ import annotations
 
 import json
-import os
+import time
 from pathlib import Path
 from typing import Any, Dict, Optional, Union
 
@@ -33,8 +48,13 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel.mesh import MeshContext
+from deeplearning4j_tpu.resilience.atomic import (CheckpointError,
+                                                  atomic_path,
+                                                  atomic_write_bytes,
+                                                  crc32_file)
 
 MANIFEST = "manifest.json"
+COMMIT = "COMMIT"
 
 
 def _leaf_key(path) -> str:
@@ -53,8 +73,12 @@ def _index_to_slices(index, shape):
 
 
 def save_sharded(ckpt_dir: Union[str, Path], pytree: Any,
-                 mesh_ctx: Optional[MeshContext] = None) -> None:
-    """Write this process's addressable shards + (on process 0) the manifest.
+                 mesh_ctx: Optional[MeshContext] = None,
+                 commit_timeout: float = 120.0) -> None:
+    """Write this process's addressable shards + (on process 0) the
+    manifest and, once every process's shards have landed, the COMMIT
+    marker. A reader polling the directory sees the checkpoint appear
+    atomically: no COMMIT, no checkpoint.
 
     Works for host numpy / single-device arrays too (one "shard" covering
     the full array), so the same call site serves laptop and pod.
@@ -63,11 +87,23 @@ def save_sharded(ckpt_dir: Union[str, Path], pytree: Any,
     ckpt_dir.mkdir(parents=True, exist_ok=True)
     proc = jax.process_index()
     nproc = jax.process_count()
+    # stale artifacts from a previous save into this directory would
+    # corrupt the commit protocol: a stale COMMIT marks the half-written
+    # new step valid, and a stale done_p<K> vote lets process 0 commit
+    # before process K's new shards land. Every process drops ITS OWN
+    # stale vote; process 0 drops the COMMIT. (Reusing one directory
+    # across save rounds still assumes the callers enter save_sharded
+    # together, as an SPMD program does; CheckpointManager sidesteps the
+    # whole class by writing each step into a fresh directory.)
+    (ckpt_dir / f"done_p{proc}.json").unlink(missing_ok=True)
+    (ckpt_dir / f"manifest_p{proc}.json").unlink(missing_ok=True)
+    if proc == 0:
+        (ckpt_dir / COMMIT).unlink(missing_ok=True)
 
     leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(pytree)
     manifest: Dict[str, Any] = {
         "format": "deeplearning4j_tpu/sharded-checkpoint",
-        "version": 1,
+        "version": 2,
         "process_count": nproc,
         "treedef": None,  # reconstructed from leaf paths on restore
         "leaves": {},
@@ -102,19 +138,111 @@ def save_sharded(ckpt_dir: Union[str, Path], pytree: Any,
             "shape": list(shape), "dtype": dtype, "spec": spec,
             "shards": shards_meta,
         }
-    np.savez(ckpt_dir / f"shards_p{proc}.npz", **shard_arrays)
+    shard_name = f"shards_p{proc}.npz"
+    # stream the npz straight to the tmp file (an in-memory staging
+    # buffer would transiently double host RAM at pod scale), CRC it
+    # from disk, then commit atomically
+    with atomic_path(ckpt_dir / shard_name) as tmp:
+        with open(tmp, "wb") as f:
+            np.savez(f, **shard_arrays)
+        shard_crc = crc32_file(tmp)
 
     if nproc > 1:
         # every process contributes its shard metadata; process files are
         # disjoint, so merge via per-process manifests
-        with open(ckpt_dir / f"manifest_p{proc}.json", "w") as f:
-            json.dump(manifest, f)
+        atomic_write_bytes(ckpt_dir / f"manifest_p{proc}.json",
+                           json.dumps(manifest).encode())
+        # commit vote: "my shard file is fully on disk, CRC attached"
+        atomic_write_bytes(ckpt_dir / f"done_p{proc}.json",
+                           json.dumps({"file": shard_name,
+                                       "crc32": shard_crc}).encode())
     if proc == 0:
-        with open(ckpt_dir / MANIFEST, "w") as f:
-            json.dump(manifest, f, indent=1)
+        atomic_write_bytes(ckpt_dir / MANIFEST,
+                           json.dumps(manifest, indent=1).encode())
+        files = {shard_name: shard_crc}
+        if nproc > 1:
+            deadline = time.monotonic() + commit_timeout
+            missing = set(range(1, nproc))
+            while missing:
+                for k in sorted(missing):
+                    dp = ckpt_dir / f"done_p{k}.json"
+                    if dp.exists():
+                        vote = json.loads(dp.read_text())
+                        files[vote["file"]] = vote["crc32"]
+                        missing.discard(k)
+                if not missing:
+                    break
+                if time.monotonic() > deadline:
+                    raise CheckpointError(
+                        f"checkpoint {ckpt_dir}: processes {sorted(missing)} "
+                        f"never landed their shards within "
+                        f"{commit_timeout:.0f}s — NOT committing a "
+                        "partial checkpoint")
+                time.sleep(0.05)
+        # the transaction point: COMMIT appears only over a complete set
+        atomic_write_bytes(
+            ckpt_dir / COMMIT,
+            json.dumps({"version": 1, "process_count": nproc,
+                        "files": files}).encode())
 
 
-def _merge_manifests(ckpt_dir: Path) -> dict:
+def verify_sharded(ckpt_dir: Union[str, Path]) -> dict:
+    """Integrity gate for a sharded checkpoint directory: COMMIT marker
+    present, every committed shard file present with a matching CRC-32,
+    manifest parseable. Raises ``CheckpointError`` naming the first bad
+    file; returns the parsed COMMIT record."""
+    ckpt_dir = Path(ckpt_dir)
+    mpath = ckpt_dir / MANIFEST
+    commit_path = ckpt_dir / COMMIT
+    if not commit_path.exists():
+        # version-1 checkpoints predate the COMMIT protocol: a complete
+        # old checkpoint (manifest present, version < 2) must stay
+        # restorable — only its coverage check defends it, as before
+        try:
+            manifest = json.loads(mpath.read_text())
+        except (OSError, ValueError):
+            manifest = None
+        if manifest is not None and manifest.get("version", 1) < 2:
+            import logging
+            logging.getLogger(__name__).warning(
+                "checkpoint %s is a pre-COMMIT (v1) sharded checkpoint; "
+                "restoring without checksum verification", ckpt_dir)
+            return {"version": 0, "files": {}}
+        raise CheckpointError(
+            f"checkpoint {ckpt_dir}: missing {COMMIT} marker — the save "
+            "never completed (torn multi-process write)")
+    try:
+        commit = json.loads(commit_path.read_text())
+    except (OSError, ValueError) as e:
+        raise CheckpointError(
+            f"checkpoint {ckpt_dir}: {COMMIT} marker unreadable: "
+            f"{e}") from e
+    for fname, want in commit.get("files", {}).items():
+        fp = ckpt_dir / fname
+        if not fp.exists():
+            raise CheckpointError(
+                f"checkpoint {ckpt_dir}: committed shard file {fname!r} "
+                "is missing")
+        got = crc32_file(fp)
+        if got != want:
+            raise CheckpointError(
+                f"checkpoint {ckpt_dir}: shard file {fname!r} checksum "
+                f"mismatch (got {got:#010x}, COMMIT {want:#010x}) — "
+                "truncated or bit-flipped write")
+    if not mpath.exists():
+        raise CheckpointError(
+            f"checkpoint {ckpt_dir}: missing {MANIFEST}")
+    try:
+        json.loads(mpath.read_text())
+    except ValueError as e:
+        raise CheckpointError(
+            f"checkpoint {ckpt_dir}: {MANIFEST} is corrupt: {e}") from e
+    return commit
+
+
+def _merge_manifests(ckpt_dir: Path, verify: bool = True) -> dict:
+    if verify:
+        verify_sharded(ckpt_dir)
     with open(ckpt_dir / MANIFEST) as f:
         manifest = json.load(f)
     if manifest.get("process_count", 1) > 1:
@@ -130,12 +258,21 @@ def _merge_manifests(ckpt_dir: Path) -> dict:
     return manifest
 
 
+def _load_npz(ckpt_dir: Path, fname: str):
+    try:
+        return np.load(ckpt_dir / fname)
+    except (OSError, ValueError) as e:
+        raise CheckpointError(
+            f"checkpoint {ckpt_dir}: shard file {fname!r} is "
+            f"unreadable: {e}") from e
+
+
 def _assemble(ckpt_dir: Path, meta: dict, npz_cache: Dict[str, Any]) -> np.ndarray:
     out = np.zeros(tuple(meta["shape"]), dtype=meta["dtype"])
     covered = np.zeros(tuple(meta["shape"]), dtype=bool) if meta["shape"] else None
     for s in meta["shards"]:
         if s["file"] not in npz_cache:
-            npz_cache[s["file"]] = np.load(ckpt_dir / s["file"])
+            npz_cache[s["file"]] = _load_npz(ckpt_dir, s["file"])
         data = npz_cache[s["file"]][s["key"]]
         idx = tuple(slice(a, b) for a, b in s["index"])
         out[idx] = data
@@ -149,15 +286,21 @@ def _assemble(ckpt_dir: Path, meta: dict, npz_cache: Dict[str, Any]) -> np.ndarr
 
 
 def restore_sharded(ckpt_dir: Union[str, Path],
-                    mesh_ctx: Optional[MeshContext] = None) -> Dict[str, Any]:
+                    mesh_ctx: Optional[MeshContext] = None,
+                    verify: bool = True) -> Dict[str, Any]:
     """Read a sharded checkpoint into a nested-dict pytree.
 
     With ``mesh_ctx``, each leaf is device_put with its SAVED PartitionSpec
     on the target mesh (axis names must exist there; unknown axes fall back
     to replication). Without, returns host numpy arrays.
+
+    Verifies the COMMIT marker + shard checksums first: a half-written
+    or corrupted step raises ``CheckpointError`` instead of assembling
+    garbage params. ``verify=False`` skips the full-CRC pass when the
+    caller just ran ``verify_sharded`` itself (CheckpointManager does).
     """
     ckpt_dir = Path(ckpt_dir)
-    manifest = _merge_manifests(ckpt_dir)
+    manifest = _merge_manifests(ckpt_dir, verify=verify)
     npz_cache: Dict[str, Any] = {}
     flat: Dict[str, np.ndarray] = {}
     for key, meta in manifest["leaves"].items():
@@ -186,12 +329,14 @@ def restore_sharded(ckpt_dir: Union[str, Path],
 
 
 def restore_sharded_into(ckpt_dir: Union[str, Path], template: Any,
-                         mesh_ctx: Optional[MeshContext] = None) -> Any:
+                         mesh_ctx: Optional[MeshContext] = None,
+                         verify: bool = True) -> Any:
     """Restore into the exact structure of ``template`` (lists stay lists,
     custom pytree nodes stay themselves) — leaf lookup by flattened path.
-    Shapes must match the saved checkpoint."""
+    Shapes must match the saved checkpoint. ``verify=False``: see
+    ``restore_sharded``."""
     ckpt_dir = Path(ckpt_dir)
-    manifest = _merge_manifests(ckpt_dir)
+    manifest = _merge_manifests(ckpt_dir, verify=verify)
     npz_cache: Dict[str, Any] = {}
     leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(template)
     new_leaves = []

@@ -324,9 +324,15 @@ class _RingFitMixin:
                     f"{labels.ndim} {tuple(labels.shape)} — use "
                     "standard backprop for sequence-to-one training")
             return self._fit_batch_tbptt(feats, labels, b_mb, B)
-        if self._step is None or getattr(self, "_b_mb", None) != b_mb:
+        if (self._step is None or getattr(self, "_b_mb", None) != b_mb
+                or getattr(self, "_step_sentinel", None)
+                is not getattr(net, "_sentinel", None)):
+            # microbatch shape OR sentinel changed: different program
+            self._step_sentinel = getattr(net, "_sentinel", None)
             self._step = self._build_step(b_mb)
             self._b_mb = b_mb
+            self._tbptt_cache = getattr(self, "_tbptt_cache", {})
+            self._tbptt_cache.clear()
         stats = self.training_stats
         # `with` spans (not bare begin/end): a raising step must close
         # its span and note it on the tracer's error stack, or a caught
@@ -345,15 +351,18 @@ class _RingFitMixin:
             net._rng, step_rng = jax.random.split(net._rng)
             cbuf = jnp.zeros((self.S, getattr(self, "_cmax", 1)),
                              jnp.float32)
-            net.params, net.opt_state, net.states, _, loss = self._step(
+            out = self._step(
                 net.params, net.opt_state, net.states, cbuf, xs, labels,
                 step_rng)
+            net.params, net.opt_state, net.states, _, loss = out[:5]
             if stats:
                 jax.block_until_ready(loss)
                 stats.record("step", time.perf_counter() - t_step)
         net.last_batch_size = B
         net.score_value = loss
         net.iteration_count += 1
+        if hasattr(net, "_observe_sentinel"):
+            net._observe_sentinel(out[5] if len(out) > 5 else None)
         with tracer.span("listener"):
             t_l = time.perf_counter() if stats else 0.0
             for listener in net.listeners:
@@ -373,6 +382,12 @@ class _RingFitMixin:
         net = self.net
         fwd = net.conf.training.tbptt_fwd_length
         T = feats.shape[1]
+        if (getattr(self, "_tbptt_sentinel", None)
+                is not getattr(net, "_sentinel", None)):
+            # sentinel changed: cached window steps are unguarded (or
+            # stale-guarded) programs — rebuild them
+            self._tbptt_sentinel = getattr(net, "_sentinel", None)
+            self._tbptt_cache.clear()
         cbuf = None
         total, slices = 0.0, 0
         for start in range(0, T, fwd):
@@ -395,9 +410,10 @@ class _RingFitMixin:
                 stats.record("shard", time.perf_counter() - t_shard)
                 t_step = time.perf_counter()
             net._rng, step_rng = jax.random.split(net._rng)
-            net.params, net.opt_state, net.states, cbuf, loss = step(
+            out = step(
                 net.params, net.opt_state, net.states, cbuf, xs, lw,
                 step_rng)
+            net.params, net.opt_state, net.states, cbuf, loss = out[:5]
             if stats:
                 jax.block_until_ready(loss)
                 stats.record("step", time.perf_counter() - t_step)
@@ -405,6 +421,8 @@ class _RingFitMixin:
             slices += 1
             net.score_value = loss
             net.iteration_count += 1
+            if hasattr(net, "_observe_sentinel"):
+                net._observe_sentinel(out[5] if len(out) > 5 else None)
             t_l = time.perf_counter() if stats else 0.0
             for listener in net.listeners:
                 listener.iteration_done(net, net.iteration_count,
@@ -1008,14 +1026,25 @@ class PipelineTrainer(_RingFitMixin):
             return (data_loss + l1_l2_penalty(params, net.layers) + aux,
                     (new_sbuf, new_cbuf))
 
+        sentinel = getattr(net, "_sentinel", None)
+        if sentinel is not None:
+            from deeplearning4j_tpu.resilience.sentinel import guard_update
+
         def step(params, opt_state, states, cbuf, xs, labels, rng):
             sbuf = pack_states(states)
             (loss, (new_sbuf, new_cbuf)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params, sbuf, cbuf, xs, labels, rng)
             new_params, new_opt = compute_updates(
                 tx, grads, opt_state, params, net.layers, training)
-            return (new_params, new_opt, unpack_states(new_sbuf), new_cbuf,
-                    loss)
+            if sentinel is None:
+                return (new_params, new_opt, unpack_states(new_sbuf),
+                        new_cbuf, loss)
+            # non-finite guard incl. the carry buffer: a NaN window must
+            # not poison the next tBPTT window's carries
+            sel, bad = guard_update(
+                loss, grads, (params, opt_state, states, cbuf),
+                (new_params, new_opt, unpack_states(new_sbuf), new_cbuf))
+            return sel[0], sel[1], sel[2], sel[3], loss, bad
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
@@ -1414,14 +1443,25 @@ class GraphPipelineTrainer(_RingFitMixin):
                                 layer_list)
             return data_loss + reg, (new_sbuf, new_cbuf)
 
+        sentinel = getattr(net, "_sentinel", None)
+        if sentinel is not None:
+            from deeplearning4j_tpu.resilience.sentinel import guard_update
+
         def step(params, opt_state, states, cbuf, xs, labels, rng):
             sbuf = pack_states(states)
             (loss, (new_sbuf, new_cbuf)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params, sbuf, cbuf, xs, labels, rng)
             new_params, new_opt = compute_updates(
                 tx, grads, opt_state, params, layer_list, training)
-            return (new_params, new_opt, unpack_states(new_sbuf), new_cbuf,
-                    loss)
+            if sentinel is None:
+                return (new_params, new_opt, unpack_states(new_sbuf),
+                        new_cbuf, loss)
+            # non-finite guard incl. the carry buffer (see the MLN
+            # pipeline step above)
+            sel, bad = guard_update(
+                loss, grads, (params, opt_state, states, cbuf),
+                (new_params, new_opt, unpack_states(new_sbuf), new_cbuf))
+            return sel[0], sel[1], sel[2], sel[3], loss, bad
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 

@@ -84,6 +84,9 @@ class ParallelTrainer:
         training = net.conf.training
         tx = net._tx
         accum = self.gradient_accumulation
+        sentinel = getattr(net, "_sentinel", None)
+        if sentinel is not None:
+            from deeplearning4j_tpu.resilience.sentinel import guard_update
 
         layers = self._layers
 
@@ -135,16 +138,28 @@ class ParallelTrainer:
                 loss = loss / accum
             new_params, new_opt = compute_updates(
                 tx, grads, opt_state, params, layers, training)
-            return new_params, new_opt, new_states, loss
+            if sentinel is None:
+                return new_params, new_opt, new_states, loss
+            # non-finite guard: a diverged all-reduce'd update never
+            # lands (old state selected in-program — no host sync)
+            sel, bad = guard_update(
+                loss, grads, (params, opt_state, states),
+                (new_params, new_opt, new_states))
+            return sel[0], sel[1], sel[2], loss, bad
 
         donate = (0, 1, 2) if self._donate else ()
         return jax.jit(step, donate_argnums=donate)
 
     # ------------------------------------------------------------------- fit
     def fit_batch(self, batch) -> float:
-        if self._step is None:
-            self._step = self._build_step()
         net = self.net
+        if (self._step is None
+                or getattr(self, "_step_sentinel", None)
+                is not getattr(net, "_sentinel", None)):
+            # a sentinel attached/detached after the first build: the
+            # guarded step is a different program — rebuild
+            self._step_sentinel = getattr(net, "_sentinel", None)
+            self._step = self._build_step()
         stats = self.training_stats
         # global-tracer spans (profiling/): host-side timeline of the
         # same phases the stats flag times — unconditional because the
@@ -185,9 +200,10 @@ class ParallelTrainer:
             # the scope routes SelfAttentionLayer through ring attention
             # over the mesh's 'sp' axis at trace time (no-op without one)
             with sequence_parallel_scope(self.mesh):
-                net.params, net.opt_state, net.states, loss = self._step(
+                out = self._step(
                     net.params, net.opt_state, net.states, feats, labels,
                     fmask, lmask, step_rng)
+                net.params, net.opt_state, net.states, loss = out[:4]
             if stats:
                 jax.block_until_ready(loss)
                 stats.record("step", time.perf_counter() - t_step)
@@ -197,6 +213,8 @@ class ParallelTrainer:
         # every step (see MultiLayerNetwork.score_value)
         net.score_value = loss
         net.iteration_count += 1
+        if hasattr(net, "_observe_sentinel"):
+            net._observe_sentinel(out[4] if len(out) > 4 else None)
         with tracer.span("listener"), maybe_phase(stats, "listener"):
             for listener in net.listeners:
                 listener.iteration_done(net, net.iteration_count,
@@ -240,6 +258,9 @@ class ParallelTrainer:
             return np.zeros((0,), np.float32)
         scannable = (
             not self._is_graph
+            # sentinel policies need per-step flags (see netcommon's
+            # fit_batches_scan) — fall back to the fit_batch loop
+            and getattr(net, "_sentinel", None) is None
             and all(isinstance(b, DataSet)
                     and b.features_mask is None and b.labels_mask is None
                     for b in batches)

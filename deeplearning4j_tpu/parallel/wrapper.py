@@ -66,6 +66,9 @@ class ParallelWrapper:
         net = self.net
         training = net.conf.training
         tx = net._tx
+        sentinel = getattr(net, "_sentinel", None)
+        if sentinel is not None:
+            from deeplearning4j_tpu.resilience.sentinel import guard_update
 
         def one_worker(params, opt_state, states, feats, labels, rng):
             def loss_for_grad(p):
@@ -76,12 +79,20 @@ class ParallelWrapper:
                 loss_for_grad, has_aux=True)(params)
             new_params, new_opt = compute_updates(
                 tx, grads, opt_state, params, net.layers, training)
-            return new_params, new_opt, new_states, loss
+            if sentinel is None:
+                return new_params, new_opt, new_states, loss, ()
+            # per-worker non-finite guard: a diverged worker keeps its
+            # previous replica (and would re-sync at the next averaging)
+            sel, bad = guard_update(
+                loss, grads, (params, opt_state, states),
+                (new_params, new_opt, new_states))
+            return sel[0], sel[1], sel[2], loss, bad
 
         vstep = jax.vmap(one_worker)
 
         def step(sp, so, ss, feats, labels, rngs, do_average):
-            sp, so, ss, losses = vstep(sp, so, ss, feats, labels, rngs)
+            sp, so, ss, losses, bads = vstep(sp, so, ss, feats, labels,
+                                             rngs)
 
             def avg(tree, avg_ints: bool):
                 def mean_bcast(x):
@@ -102,19 +113,45 @@ class ParallelWrapper:
                 so2 = so
             ss2 = jax.lax.cond(do_average, lambda t: avg(t, False),
                                lambda t: t, ss)
-            return sp2, so2, ss2, losses
+            return sp2, so2, ss2, losses, bads
 
         # _parallel_iteration overwrites the three stacked-state args with
         # the step's returns; donating them halves peak HBM per update
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------------------- fit
+    def _ensure_vstep(self) -> None:
+        if (self._vstep is None
+                or getattr(self, "_vstep_sentinel", None)
+                is not getattr(self.net, "_sentinel", None)):
+            # sentinel changed since the last build: the guarded step is
+            # a different program — rebuild
+            self._vstep_sentinel = getattr(self.net, "_sentinel", None)
+            self._vstep = self._build_vmapped_step()
+
+    def fit_batch(self, batch: DataSet) -> float:
+        """One parallel iteration on ONE global minibatch, split evenly
+        across the workers — the per-batch seam FaultTolerantTrainer
+        drives (``fit`` remains the reference's round-robin path). The
+        global batch must divide evenly by ``workers``: padding the
+        tail by reuse here would silently double-train examples every
+        step. Syncs worker-0 state back into the wrapped net afterward
+        so a mid-run checkpoint sees current weights."""
+        self._ensure_vstep()
+        n = batch.num_examples()
+        if n % self.workers:
+            raise ValueError(
+                f"global batch of {n} examples not divisible by "
+                f"workers={self.workers}")
+        self._parallel_iteration(batch.batch_by(n // self.workers))
+        self._sync_to_net()
+        return self.net.score_value
+
     def fit(self, iterator: Union[DataSetIterator, DataSet],
             epochs: int = 1) -> "ParallelWrapper":
         """Round-robin dispatch of minibatches to workers; average every
         ``averaging_frequency`` parallel iterations (ref: fit():343-466)."""
-        if self._vstep is None:
-            self._vstep = self._build_vmapped_step()
+        self._ensure_vstep()
         if isinstance(iterator, DataSet):
             from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
             iterator = ListDataSetIterator(
@@ -155,12 +192,15 @@ class ParallelWrapper:
             do_avg = jnp.asarray(
                 self._iter_since_avg >= self.averaging_frequency)
             (self._stacked_params, self._stacked_opt, self._stacked_states,
-             losses) = self._vstep(self._stacked_params, self._stacked_opt,
-                                   self._stacked_states, feats, labels, rngs,
-                                   do_avg)
+             losses, bads) = self._vstep(
+                 self._stacked_params, self._stacked_opt,
+                 self._stacked_states, feats, labels, rngs, do_avg)
             if bool(do_avg):
                 self._iter_since_avg = 0
         net.iteration_count += 1
+        if hasattr(net, "_observe_sentinel"):
+            # per-worker flag vector; the sentinel any()s it on drain
+            net._observe_sentinel(None if isinstance(bads, tuple) else bads)
         net.last_grads = None  # vmapped worker step doesn't collect grads
         net.score_value = float(jnp.mean(losses))
         net.last_batch_size = sum(b.num_examples() for b in batches)

@@ -212,6 +212,17 @@ class MetricsRegistry:
             items = list(self._metrics.items())
         return {name: m._json() for name, m in sorted(items)}
 
+    def snapshot(self, prefix: str = "") -> Dict[str, float]:
+        """Scalar (counter/gauge) values whose name starts with
+        ``prefix`` — the cheap point-in-time view failure records embed
+        (bench.py stamps the ``resilience_*`` counters into rung
+        failures so a crash report carries its own fault history)."""
+        with self._lock:
+            items = list(self._metrics.items())
+        return {name: m.value for name, m in sorted(items)
+                if name.startswith(prefix) and hasattr(m, "value")
+                and not isinstance(m, Histogram)}
+
     def to_prometheus(self) -> str:
         """Prometheus text exposition format (version 0.0.4)."""
         with self._lock:

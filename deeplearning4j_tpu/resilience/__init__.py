@@ -1,0 +1,38 @@
+"""Fault-tolerant training: crash-safe checkpoints, divergence
+sentinel with rollback, resumable fit, and a fault-injection harness.
+
+The reference stack's only fault story is Spark's retry-the-task
+semantics; a TPU-native in-process system must instead survive
+preemptions, flaky hosts, and numeric blow-ups itself. Four legs:
+
+- ``atomic``      — tmp+fsync+rename commit protocol, CRC-32 checksums,
+  ``CheckpointError``. Used by both checkpoint formats.
+- ``sentinel``    — jit-compatible non-finite guard inside every
+  compiled train step + host-side policy (raise / skip_batch /
+  rollback) with lag-based flag draining (no happy-path host sync).
+- ``manager``     — ``CheckpointManager`` (retention, rotation,
+  latest-*valid* discovery that skips torn writes) + ``TrainingCursor``.
+- ``trainer``     — ``FaultTolerantTrainer``: resume from cursor,
+  bounded-backoff retry of transient failures, checkpoint rollback on
+  divergence with escalation.
+- ``faultinject`` — deterministic fault schedules driving the chaos
+  test suite; every injected fault / retry / rollback / skipped batch
+  is counted in the metrics registry and visible as tracer events.
+"""
+
+from deeplearning4j_tpu.resilience.atomic import (  # noqa: F401
+    CheckpointError, atomic_write_bytes, crc32_bytes, crc32_file,
+)
+from deeplearning4j_tpu.resilience.faultinject import (  # noqa: F401
+    Fault, FaultInjected, FaultSchedule, KilledByFault,
+)
+from deeplearning4j_tpu.resilience.manager import (  # noqa: F401
+    CheckpointInfo, CheckpointManager, TrainingCursor,
+)
+from deeplearning4j_tpu.resilience.sentinel import (  # noqa: F401
+    DivergenceError, DivergenceSentinel, RollbackRequested, guard_update,
+    nonfinite_flag,
+)
+from deeplearning4j_tpu.resilience.trainer import (  # noqa: F401
+    FaultTolerantTrainer,
+)

@@ -1,0 +1,203 @@
+"""Deterministic fault-injection harness for chaos testing.
+
+Production fault tolerance that has never seen a fault is a prayer, not
+a property. This module injects *scheduled, reproducible* faults at the
+exact seams the resilience subsystem defends:
+
+- ``raise`` at step N        — a transient host failure (preemption,
+  flaky RPC) thrown immediately before the train step dispatches; the
+  FaultTolerantTrainer's bounded-backoff retry must absorb it.
+- ``nan`` at step N          — poison the minibatch features with NaN so
+  the compiled step produces a non-finite loss AND non-finite grads;
+  the divergence sentinel must catch it *inside* the step. (Poisoning
+  the input keeps every trainer's compiled-step signature unchanged —
+  no debug-only argument threads through the hot path.)
+- ``truncate_checkpoint``    — tear the next checkpoint commit.
+  ``mode="crash"`` truncates the tmp file and raises before the rename
+  (a SIGKILL mid-write: the final path never appears).
+  ``mode="torn"`` lets a truncated file land at the final path (a torn
+  write that the rename protocol cannot see — checksum verification
+  must catch it on restore).
+- ``drop_connection`` at recv N — close the streaming consumer's socket
+  under it; the reconnect/backoff path must recover the stream.
+
+Faults are one-shot: each schedule entry fires once, is counted in the
+metrics registry (``resilience_faults_injected_total``) and stamped as a
+tracer instant event, then disarms. ``step`` indexing is 1-based and
+matches ``net.iteration_count + 1`` (the step about to run).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.profiling.metrics import get_registry
+from deeplearning4j_tpu.profiling.tracer import get_tracer
+
+_KINDS = ("raise", "nan", "truncate_checkpoint", "drop_connection")
+
+
+class FaultInjected(RuntimeError):
+    """A scheduled transient fault (retryable by FaultTolerantTrainer)."""
+
+
+class KilledByFault(RuntimeError):
+    """A scheduled simulated process death (``truncate_checkpoint``
+    crash mode) — NOT retryable: the "process" is gone; a fresh run must
+    resume from the last valid checkpoint."""
+
+
+@dataclass
+class Fault:
+    """One scheduled fault. ``step`` arms raise/nan faults at that
+    training step; ``at_call`` arms checkpoint/connection faults at the
+    Nth commit/recv (1-based, default: the next one)."""
+
+    kind: str
+    step: int = 0
+    at_call: int = 1
+    mode: str = "crash"  # truncate_checkpoint: "crash" | "torn"
+    fired: bool = False
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"one of {_KINDS}")
+
+
+@dataclass
+class FaultSchedule:
+    faults: List[Fault] = field(default_factory=list)
+
+    def pending(self) -> List[Fault]:
+        return [f for f in self.faults if not f.fired]
+
+
+_lock = threading.Lock()
+_schedule: Optional[FaultSchedule] = None
+_commit_calls = 0
+_recv_calls = 0
+
+
+def set_schedule(schedule: Optional[FaultSchedule]) -> None:
+    """Arm a schedule (or disarm with ``None``). Resets call counters so
+    ``at_call`` indices are relative to arming time."""
+    global _schedule, _commit_calls, _recv_calls
+    with _lock:
+        _schedule = schedule
+        _commit_calls = 0
+        _recv_calls = 0
+
+
+def clear() -> None:
+    set_schedule(None)
+
+
+def active() -> bool:
+    return _schedule is not None and bool(_schedule.pending())
+
+
+def _fire(fault: Fault, **args) -> None:
+    fault.fired = True
+    get_registry().counter(
+        "resilience_faults_injected_total",
+        help="faults injected by the chaos harness").inc()
+    get_tracer().instant("fault_injected", kind=fault.kind, **args)
+
+
+def check_raise(step: int) -> None:
+    """Raise a scheduled transient fault for this training step."""
+    with _lock:
+        if _schedule is None:
+            return
+        for f in _schedule.pending():
+            if f.kind == "raise" and f.step == step:
+                _fire(f, step=step)
+                raise FaultInjected(f"injected transient fault at step "
+                                    f"{step}")
+
+
+def poison_batch(batch, step: int):
+    """Return ``batch`` with NaN-poisoned features if a ``nan`` fault is
+    scheduled for ``step``; otherwise the batch unchanged. Works on
+    DataSet (``features`` array) and MultiDataSet (list of arrays); the
+    original batch object is never mutated."""
+    with _lock:
+        hit = None
+        if _schedule is not None:
+            for f in _schedule.pending():
+                if f.kind == "nan" and f.step == step:
+                    hit = f
+                    break
+        if hit is None:
+            return batch
+        _fire(hit, step=step)
+    import copy
+
+    def _poison(f):
+        a = np.array(f, copy=True)
+        if not np.issubdtype(a.dtype, np.floating):
+            a = a.astype(np.float32)
+        a.flat[0] = np.nan
+        return a
+
+    poisoned = copy.copy(batch)
+    feats = batch.features
+    if isinstance(feats, (list, tuple)):
+        poisoned.features = type(feats)(_poison(f) for f in feats)
+    else:
+        poisoned.features = _poison(feats)
+    return poisoned
+
+
+def on_checkpoint_commit(tmp: Path, final: Path) -> None:
+    """Called by ``atomic.atomic_write_bytes`` between fsync and rename.
+
+    crash mode: truncate the tmp file and raise ``KilledByFault`` — the
+    rename never happens, the final path never appears (exactly what a
+    SIGKILL between write and rename leaves behind).
+    torn mode: truncate the tmp file and let the rename proceed — a
+    complete-looking file with half its bytes, catchable only by
+    checksum verification.
+    """
+    global _commit_calls
+    with _lock:
+        if _schedule is None:
+            return
+        _commit_calls += 1
+        hit = None
+        for f in _schedule.pending():
+            if f.kind == "truncate_checkpoint" and f.at_call == _commit_calls:
+                hit = f
+                break
+        if hit is None:
+            return
+        _fire(hit, file=str(final), mode=hit.mode)
+    size = tmp.stat().st_size
+    with open(tmp, "r+b") as fh:
+        fh.truncate(max(size // 2, 1))
+    if hit.mode == "crash":
+        raise KilledByFault(
+            f"simulated SIGKILL mid-checkpoint write of {final}")
+    # torn mode: fall through — atomic_write_bytes renames the stump
+
+
+def on_stream_recv() -> bool:
+    """Called by the streaming consumer before each blocking recv;
+    returns True when the scheduled ``drop_connection`` fault fires (the
+    caller closes its own socket to simulate the drop)."""
+    global _recv_calls
+    with _lock:
+        if _schedule is None:
+            return False
+        _recv_calls += 1
+        for f in _schedule.pending():
+            if f.kind == "drop_connection" and f.at_call == _recv_calls:
+                _fire(f, recv=_recv_calls)
+                return True
+        return False

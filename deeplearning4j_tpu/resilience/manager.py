@@ -1,0 +1,271 @@
+"""CheckpointManager: retention, rotation, and latest-VALID discovery.
+
+``save`` writes one checkpoint per training step through the crash-safe
+writers (``util/serializer.py`` zip format on one host, the
+``parallel/checkpoint.py`` sharded format on a mesh) plus a *training
+cursor* — the tiny JSON record (epoch, step, RNG key, data-iterator
+position) that turns a weights file into a resumable run.
+
+``latest_valid`` is the load-bearing call: it walks checkpoints newest
+first and returns the first that passes full verification (zip member
+checksums / sharded COMMIT marker + per-file CRCs), *skipping* torn or
+corrupt writes instead of crashing on them. A run that died mid-write
+therefore resumes from the previous intact checkpoint — the headline
+crash-safety invariant, proven by the chaos tests.
+
+Retention: ``keep_last=N`` newest checkpoints survive rotation. Rotation
+runs after a successful save and never deletes the checkpoint it just
+wrote.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import shutil
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.profiling.metrics import get_registry
+from deeplearning4j_tpu.profiling.tracer import get_tracer
+from deeplearning4j_tpu.resilience.atomic import (CheckpointError,
+                                                  atomic_write_bytes)
+
+logger = logging.getLogger(__name__)
+
+_STEP_RE = re.compile(r"-(\d+)(?:\.zip)?$")
+
+
+@dataclass
+class TrainingCursor:
+    """Where training stood when the checkpoint was cut. ``step`` is the
+    container's ``iteration_count``; ``data_position`` counts batches
+    already consumed in the current epoch (resume skips that many);
+    ``rng_key`` is the container's raw PRNG key words so the resumed
+    run draws the same dropout/shuffle randomness it would have."""
+
+    epoch: int = 0
+    step: int = 0
+    data_position: int = 0
+    rng_key: Optional[List[int]] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps({"version": 1, "epoch": self.epoch,
+                           "step": self.step,
+                           "data_position": self.data_position,
+                           "rng_key": self.rng_key, "extra": self.extra})
+
+    @staticmethod
+    def from_json(text: str) -> "TrainingCursor":
+        d = json.loads(text)
+        return TrainingCursor(epoch=int(d.get("epoch", 0)),
+                              step=int(d.get("step", 0)),
+                              data_position=int(d.get("data_position", 0)),
+                              rng_key=d.get("rng_key"),
+                              extra=d.get("extra", {}))
+
+    @staticmethod
+    def of(net, epoch: Optional[int] = None,
+           data_position: int = 0) -> "TrainingCursor":
+        key = getattr(net, "_rng", None)
+        return TrainingCursor(
+            epoch=net.epoch_count if epoch is None else epoch,
+            step=net.iteration_count,
+            data_position=data_position,
+            rng_key=None if key is None else
+            [int(x) for x in np.asarray(key).ravel()])
+
+    def apply(self, net) -> None:
+        net.iteration_count = self.step
+        net.epoch_count = self.epoch
+        if self.rng_key is not None and getattr(net, "_rng", None) is not None:
+            import jax.numpy as jnp
+            net._rng = jnp.asarray(np.asarray(self.rng_key,
+                                              dtype=np.uint32))
+
+
+@dataclass
+class CheckpointInfo:
+    step: int
+    path: Path
+    cursor: Optional[TrainingCursor]
+    sharded: bool
+    # set by latest_valid() after full verification; restore() skips
+    # the (expensive: full CRC pass over every file) re-verify then
+    verified: bool = False
+
+
+class CheckpointManager:
+    """Rotating, self-validating checkpoint store for one training run.
+
+    ``sharded=False``: one zip archive per checkpoint (the reference's
+    interchange format, crash-safe via atomic write + member checksums).
+    ``sharded=True``: one directory per checkpoint in the multi-process
+    sharded format (per-process shard files + COMMIT marker).
+    """
+
+    def __init__(self, directory: Union[str, Path], keep_last: int = 3,
+                 prefix: str = "ckpt", sharded: bool = False,
+                 mesh_ctx=None, save_updater: bool = True):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.keep_last = max(1, int(keep_last))
+        self.prefix = prefix
+        self.sharded = sharded
+        self.mesh_ctx = mesh_ctx
+        self.save_updater = save_updater
+        reg = get_registry()
+        self._c_saved = reg.counter("resilience_checkpoints_saved_total",
+                                    help="checkpoints committed")
+        self._c_invalid = reg.counter(
+            "resilience_invalid_checkpoints_total",
+            help="torn/corrupt checkpoints skipped by latest_valid")
+
+    # ----------------------------------------------------------------- naming
+    def _name(self, step: int) -> str:
+        return f"{self.prefix}-{step:08d}"
+
+    def _cursor_path(self, path: Path) -> Path:
+        if self.sharded:
+            return path / "cursor.json"
+        return path.with_name(path.name[:-len(".zip")] + ".cursor.json")
+
+    def checkpoints(self) -> List[CheckpointInfo]:
+        """All on-disk checkpoints (valid or not), step-ascending."""
+        out = []
+        pattern = (f"{self.prefix}-*" if self.sharded
+                   else f"{self.prefix}-*.zip")
+        for p in sorted(self.directory.glob(pattern)):
+            if self.sharded and not p.is_dir():
+                continue
+            m = _STEP_RE.search(p.name)
+            if not m:
+                continue
+            out.append(CheckpointInfo(step=int(m.group(1)), path=p,
+                                      cursor=self._read_cursor(p),
+                                      sharded=self.sharded))
+        out.sort(key=lambda i: i.step)
+        return out
+
+    def _read_cursor(self, path: Path) -> Optional[TrainingCursor]:
+        cp = self._cursor_path(path)
+        try:
+            return TrainingCursor.from_json(cp.read_text())
+        except (OSError, ValueError, KeyError):
+            return None
+
+    # ------------------------------------------------------------------- save
+    def save(self, net, step: Optional[int] = None,
+             cursor: Optional[TrainingCursor] = None) -> Path:
+        """Commit one checkpoint (+ cursor) and rotate old ones.
+
+        The model write is crash-safe end to end: a kill at ANY point
+        leaves either no new checkpoint (resume uses the previous one)
+        or a complete verified one — never a torn file that restores
+        garbage.
+        """
+        step = net.iteration_count if step is None else int(step)
+        cursor = TrainingCursor.of(net) if cursor is None else cursor
+        name = self._name(step)
+        with get_tracer().span("checkpoint_save", step=step):
+            if self.sharded:
+                from deeplearning4j_tpu.parallel.checkpoint import \
+                    save_sharded
+                path = self.directory / name
+                save_sharded(path, {"params": net.params,
+                                    "opt_state": net.opt_state,
+                                    "states": net.states},
+                             self.mesh_ctx)
+            else:
+                from deeplearning4j_tpu.util.serializer import \
+                    ModelSerializer
+                path = self.directory / (name + ".zip")
+                ModelSerializer.write_model(net, path,
+                                            save_updater=self.save_updater)
+            atomic_write_bytes(self._cursor_path(path),
+                               cursor.to_json().encode())
+        self._c_saved.inc()
+        self._rotate(keep=path)
+        return path
+
+    def _rotate(self, keep: Path) -> None:
+        infos = self.checkpoints()
+        for info in infos[:-self.keep_last]:
+            if info.path == keep:
+                continue
+            try:
+                if info.sharded:
+                    shutil.rmtree(info.path, ignore_errors=True)
+                else:
+                    info.path.unlink(missing_ok=True)
+                self._cursor_path(info.path).unlink(missing_ok=True)
+            except OSError as e:  # rotation must never kill training
+                logger.warning("checkpoint rotation failed for %s: %s",
+                               info.path, e)
+
+    # ----------------------------------------------------------- verification
+    def validate(self, path: Union[str, Path]) -> None:
+        """Raise ``CheckpointError`` (naming the bad file) unless the
+        checkpoint at ``path`` is complete and checksum-clean."""
+        path = Path(path)
+        if self.sharded:
+            from deeplearning4j_tpu.parallel.checkpoint import \
+                verify_sharded
+            verify_sharded(path)
+        else:
+            from deeplearning4j_tpu.util.serializer import ModelSerializer
+            ModelSerializer.verify(path)
+
+    def latest_valid(self) -> Optional[CheckpointInfo]:
+        """Newest checkpoint that passes verification; torn or corrupt
+        ones are skipped (and counted) — never returned."""
+        for info in reversed(self.checkpoints()):
+            try:
+                self.validate(info.path)
+                info.verified = True
+                return info
+            except CheckpointError as e:
+                self._c_invalid.inc()
+                get_tracer().instant("invalid_checkpoint",
+                                     path=str(info.path))
+                logger.warning("skipping invalid checkpoint %s: %s",
+                               info.path, e)
+        return None
+
+    # ---------------------------------------------------------------- restore
+    def restore(self, net, info: Optional[CheckpointInfo] = None,
+                load_updater: bool = True) -> Optional[TrainingCursor]:
+        """Load ``info`` (default: latest valid) into an initialized
+        ``net`` and apply its cursor. Returns the cursor (None when no
+        valid checkpoint exists — the caller starts fresh)."""
+        if info is None:
+            info = self.latest_valid()
+            if info is None:
+                return None
+        with get_tracer().span("checkpoint_restore", step=info.step):
+            if self.sharded:
+                from deeplearning4j_tpu.parallel.checkpoint import \
+                    restore_sharded_into
+                tpl = {"params": net.params, "states": net.states}
+                if load_updater and net.opt_state is not None:
+                    tpl["opt_state"] = net.opt_state
+                out = restore_sharded_into(info.path, tpl, self.mesh_ctx,
+                                           verify=not info.verified)
+                net.params = out["params"]
+                net.states = out["states"]
+                if "opt_state" in out:
+                    net.opt_state = out["opt_state"]
+            else:
+                from deeplearning4j_tpu.util.serializer import \
+                    ModelSerializer
+                ModelSerializer.restore_weights(info.path, net,
+                                                load_updater=load_updater,
+                                                verify=not info.verified)
+        cursor = info.cursor or TrainingCursor(step=info.step)
+        cursor.apply(net)
+        return cursor

@@ -1,0 +1,170 @@
+"""Divergence sentinel: in-step non-finite detection with host policy.
+
+A NaN loss at step 40,000 of a pod run is not an exception — it is a
+silent poison that propagates through donated param buffers and turns
+every later step into arithmetic on garbage. The sentinel splits the
+defense across the device/host boundary:
+
+**Traced side** (``guard_update``, called INSIDE every compiled train
+step): compute ``bad = ~isfinite(loss) | ~isfinite(sum(grad^2))`` and
+``jnp.where``-select the PREVIOUS params/opt-state/states when bad. The
+check is a handful of fused reductions on values the step already
+materialized — no extra host sync, no extra pass over the weights — and
+it makes every policy safe by construction: a non-finite update *never
+lands*, whatever the host decides to do about it.
+
+**Host side** (``DivergenceSentinel.observe``): the step returns the
+``bad`` flag as one extra device scalar. Reading it eagerly would force
+a device round-trip per step (exactly what the lazy ``score_value``
+exists to avoid), so the sentinel holds flags in a small deque and only
+converts flags ``lag`` steps old — by then the step has long retired,
+so the read returns without stalling the dispatch pipeline. Policies:
+
+- ``raise``      — raise ``DivergenceError`` naming the step.
+- ``skip_batch`` — count it (the on-device select already skipped the
+  update) and keep training.
+- ``rollback``   — raise ``RollbackRequested``; the FaultTolerantTrainer
+  catches it, reloads the last valid checkpoint, re-randomizes the data
+  order, and escalates to ``raise`` after K consecutive rollbacks.
+
+Flag conversion is ``lag`` steps late, so ``raise``/``rollback`` fire
+one step after the bad batch — harmless: the select kept the model
+state clean, and rollback re-trains from the checkpoint anyway. Set
+``lag=0`` for immediate (synchronous) detection in tests.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Deque, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.profiling.metrics import get_registry
+from deeplearning4j_tpu.profiling.tracer import get_tracer
+
+POLICIES = ("raise", "skip_batch", "rollback")
+
+
+class DivergenceError(RuntimeError):
+    """Non-finite loss/grad-norm under policy='raise' (or escalation
+    after too many consecutive rollbacks)."""
+
+    def __init__(self, message: str, step: int = -1):
+        super().__init__(message)
+        self.step = step
+
+
+class RollbackRequested(RuntimeError):
+    """Non-finite step under policy='rollback'. Handled by
+    FaultTolerantTrainer; reaching user code means a sentinel with
+    rollback policy ran outside a FaultTolerantTrainer."""
+
+    def __init__(self, message: str, step: int = -1):
+        super().__init__(message)
+        self.step = step
+
+
+def nonfinite_flag(loss, grads):
+    """Traced: scalar bool — loss or global grad-norm non-finite.
+
+    ``sum(g^2)`` overflows to inf exactly when the true L2 norm does at
+    float32 — overflow IS divergence here, so the unscaled sum (cheaper
+    than a two-pass stable norm) is the right check.
+    """
+    gsq = jax.tree_util.tree_reduce(
+        lambda acc, g: acc + jnp.sum(jnp.square(g).astype(jnp.float32)),
+        grads, jnp.zeros((), jnp.float32))
+    ok = jnp.isfinite(loss) & jnp.isfinite(gsq)
+    return jnp.logical_not(ok)
+
+
+def _select(bad, old_tree, new_tree):
+    def pick(o, n):
+        if not (hasattr(n, "dtype") or hasattr(o, "dtype")):
+            return n  # non-array leaf (None/empty optax state)
+        return jnp.where(bad, o, n)
+    return jax.tree_util.tree_map(pick, old_tree, new_tree)
+
+
+def guard_update(loss, grads, old, new):
+    """Traced: ``old``/``new`` are same-structure pytrees (typically
+    ``(params, opt_state, states)``); returns ``(selected, bad_flag)``
+    where ``selected`` is the OLD tree when the step went non-finite.
+
+    Safe under buffer donation: the select is inside the same XLA
+    program, so "old" values are read before their buffers are reused.
+    """
+    bad = nonfinite_flag(loss, grads)
+    return _select(bad, old, new), bad
+
+
+class DivergenceSentinel:
+    """Host-side flag drain + policy. Attach with
+    ``net.set_divergence_sentinel(sentinel)`` BEFORE building trainers
+    (the compiled step is rebuilt with the guard when attached)."""
+
+    def __init__(self, policy: str = "raise", lag: int = 1):
+        if policy not in POLICIES:
+            raise ValueError(f"policy must be one of {POLICIES}, "
+                             f"got {policy!r}")
+        self.policy = policy
+        self.lag = max(0, int(lag))
+        self._pending: Deque[Tuple[int, object]] = collections.deque()
+        self._skipped = 0  # THIS sentinel's skips (the registry counter
+        #                    below is process-global and outlives us)
+        reg = get_registry()
+        self._c_nonfinite = reg.counter(
+            "resilience_nonfinite_steps_total",
+            help="train steps whose loss/grad-norm went non-finite")
+        self._c_skipped = reg.counter(
+            "resilience_skipped_batches_total",
+            help="batches skipped by the divergence sentinel")
+
+    # ------------------------------------------------------------------ drain
+    def observe(self, flag, step: int) -> None:
+        """Record the step's device flag; drain flags older than
+        ``lag``. May raise per policy (for the DRAINED step, which is
+        ``lag`` steps behind the one just dispatched)."""
+        self._pending.append((step, flag))
+        while len(self._pending) > self.lag:
+            self._handle(*self._pending.popleft())
+
+    def flush(self) -> None:
+        """Drain everything (end of epoch / end of fit)."""
+        while self._pending:
+            self._handle(*self._pending.popleft())
+
+    def reset(self) -> None:
+        """Drop pending flags without acting on them (after a rollback
+        restored the model, stale flags describe discarded steps)."""
+        self._pending.clear()
+
+    @property
+    def skipped_batches(self) -> int:
+        return self._skipped
+
+    # ----------------------------------------------------------------- policy
+    def _handle(self, step: int, flag) -> None:
+        # flag may be a scalar (containers / SPMD) or a per-worker
+        # vector (ParallelWrapper) — any() covers both. The conversion
+        # blocks only until THIS step retires; with lag>=1 it already
+        # has by the time we look.
+        if not bool(np.any(np.asarray(flag))):
+            return
+        self._c_nonfinite.inc()
+        get_tracer().instant("nonfinite_step", step=step,
+                             policy=self.policy)
+        if self.policy == "skip_batch":
+            self._skipped += 1
+            self._c_skipped.inc()
+            return
+        if self.policy == "rollback":
+            raise RollbackRequested(
+                f"non-finite loss/grad-norm at step {step} "
+                "(policy=rollback)", step=step)
+        raise DivergenceError(
+            f"non-finite loss/grad-norm at step {step} (policy=raise); "
+            "the in-step guard kept the previous params", step=step)

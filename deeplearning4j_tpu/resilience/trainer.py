@@ -1,0 +1,266 @@
+"""FaultTolerantTrainer: resumable fit with retry, rollback, and cursor.
+
+Wraps any "fittable" — a ``MultiLayerNetwork``/``ComputationGraph``
+directly, or one of the parallel trainers (``ParallelTrainer``,
+``ParallelWrapper``, ``PipelineTrainer``) driving it — and supervises
+the batch loop:
+
+- **Resume**: on ``fit`` it asks the CheckpointManager for the latest
+  VALID checkpoint, restores params/updater/layer-states, and continues
+  from the cursor's (epoch, batch position, RNG key). A killed run
+  restarted with the same arguments picks up where the last intact
+  checkpoint left off.
+- **Retry**: transient failures (``FaultInjected``, connection drops,
+  timeouts) raised before the step dispatches are retried in place with
+  bounded exponential backoff + jitter.
+- **Rollback**: when an attached ``DivergenceSentinel`` (policy
+  ``rollback``) trips, the trainer reloads the last valid checkpoint,
+  re-randomizes the remaining data order (a diverging batch sequence
+  should not be replayed verbatim), and resumes; after
+  ``max_consecutive_rollbacks`` with no completed checkpoint in between
+  it escalates to ``DivergenceError`` — flailing forever on a
+  fundamentally broken run helps nobody.
+- **Checkpointing**: every ``checkpoint_every`` steps (and always at
+  epoch end) it cuts a crash-safe checkpoint + cursor through the
+  manager, which also rotates old ones.
+
+Everything observable lands in the PR 2 metrics registry
+(``resilience_retries_total``, ``resilience_rollbacks_total``, …) and
+as tracer spans, so ``/api/metrics`` and the trace timeline show the
+run's fault history next to its step times.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.profiling.metrics import get_registry
+from deeplearning4j_tpu.profiling.tracer import get_tracer
+from deeplearning4j_tpu.resilience import faultinject
+from deeplearning4j_tpu.resilience.atomic import CheckpointError
+from deeplearning4j_tpu.resilience.faultinject import (FaultInjected,
+                                                       KilledByFault)
+from deeplearning4j_tpu.resilience.manager import (CheckpointManager,
+                                                   TrainingCursor)
+from deeplearning4j_tpu.resilience.sentinel import (DivergenceError,
+                                                    DivergenceSentinel,
+                                                    RollbackRequested)
+
+logger = logging.getLogger(__name__)
+
+#: exception types treated as transient (retry with backoff). A
+#: simulated process death (KilledByFault) is deliberately NOT here.
+TRANSIENT_ERRORS = (FaultInjected, ConnectionError, TimeoutError)
+
+
+class FaultTolerantTrainer:
+    def __init__(self, net, manager: CheckpointManager, trainer=None,
+                 sentinel: Optional[DivergenceSentinel] = None,
+                 checkpoint_every: int = 0, max_retries: int = 3,
+                 backoff_base: float = 0.05, backoff_max: float = 2.0,
+                 max_consecutive_rollbacks: int = 3, seed: int = 0,
+                 resume: bool = True):
+        self.net = net
+        self.manager = manager
+        self.target = trainer if trainer is not None else net
+        if not hasattr(self.target, "fit_batch"):
+            raise TypeError(
+                f"{type(self.target).__name__} has no fit_batch(); "
+                "FaultTolerantTrainer drives the per-batch seam — wrap "
+                "a container or a trainer exposing fit_batch")
+        self.sentinel = sentinel
+        if sentinel is not None:
+            if hasattr(net, "set_divergence_sentinel"):
+                net.set_divergence_sentinel(sentinel)
+            else:
+                net._sentinel = sentinel
+        self.checkpoint_every = max(0, int(checkpoint_every))
+        self.max_retries = max(0, int(max_retries))
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.max_consecutive_rollbacks = max(1, int(
+            max_consecutive_rollbacks))
+        self.seed = seed
+        self.resume = resume
+        self._salt = 0  # bumped per rollback: re-randomizes data order
+        self._consecutive_rollbacks = 0
+        self._jrng = np.random.default_rng(seed ^ 0x5EED)
+        reg = get_registry()
+        self._c_retries = reg.counter(
+            "resilience_retries_total",
+            help="transient-failure retries by FaultTolerantTrainer")
+        self._c_rollbacks = reg.counter(
+            "resilience_rollbacks_total",
+            help="checkpoint rollbacks after divergence")
+
+    # ------------------------------------------------------------------- data
+    @staticmethod
+    def _materialize(data) -> List:
+        """Batches as a list: cursor positions index into it and
+        rollback can reshuffle it. Iterators are drained once (their
+        batches, not their samples, are held — the same footprint the
+        async prefetcher's queue already admits)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+        if isinstance(data, DataSetIterator):
+            data.reset()
+            return [b for b in data]
+        if isinstance(data, (list, tuple)):
+            return list(data)
+        if isinstance(data, DataSet):
+            return [data]
+        # MultiDataSet or anything else batch-shaped: single batch
+        return [data]
+
+    def _reshuffle_tail(self, order: List[int], pos: int,
+                        epoch: int) -> List[int]:
+        """Re-randomize the REMAINING data order after a rollback: the
+        consumed prefix ``order[:pos]`` must stay fixed (cursor
+        positions index into it — shuffling it would re-train consumed
+        batches and skip unconsumed ones), only the tail is permuted."""
+        rng = np.random.default_rng(
+            (self.seed * 1_000_003 + epoch) ^ (self._salt * 97))
+        tail = order[pos:]
+        rng.shuffle(tail)
+        return order[:pos] + tail
+
+    # ---------------------------------------------------------------- backoff
+    def _backoff(self, attempt: int) -> None:
+        delay = min(self.backoff_max,
+                    self.backoff_base * (2.0 ** (attempt - 1)))
+        # equal jitter (uniform over [delay/2, delay)): decorrelates a
+        # fleet of workers retrying the same shared dependency while
+        # keeping a floor so retries are never immediate
+        time.sleep(delay * (0.5 + 0.5 * float(self._jrng.random())))
+
+    # ------------------------------------------------------------- checkpoint
+    def _save(self, epoch: int, next_pos: int,
+              order: Optional[List[int]] = None) -> None:
+        cursor = TrainingCursor.of(self.net, epoch=epoch,
+                                   data_position=next_pos)
+        if order is not None and order != list(range(len(order))):
+            # the epoch's (possibly reshuffled) batch order rides with
+            # the cursor so a restart resumes against the SAME order —
+            # a position into a different permutation would re-train
+            # some batches and skip others
+            cursor.extra["order"] = list(order)
+        self.manager.save(self.net, cursor=cursor)
+        # a committed checkpoint is progress: the rollback escalation
+        # counter measures *consecutive* rollbacks with none
+        self._consecutive_rollbacks = 0
+
+    @staticmethod
+    def _cursor_order(cursor: Optional[TrainingCursor],
+                      n: int) -> List[int]:
+        saved = (cursor.extra or {}).get("order") if cursor else None
+        if (isinstance(saved, list)
+                and sorted(int(i) for i in saved) == list(range(n))):
+            return [int(i) for i in saved]
+        return list(range(n))
+
+    # --------------------------------------------------------------- rollback
+    def _rollback(self, cause: RollbackRequested, n_batches: int):
+        """Reload the last valid checkpoint; returns (cursor, order)
+        where ``order`` is the checkpoint's epoch order with the
+        not-yet-consumed tail re-randomized."""
+        self._consecutive_rollbacks += 1
+        self._c_rollbacks.inc()
+        if self._consecutive_rollbacks > self.max_consecutive_rollbacks:
+            raise DivergenceError(
+                f"{self._consecutive_rollbacks} consecutive rollbacks "
+                f"without a completed checkpoint (last divergence at "
+                f"step {cause.step}); escalating", step=cause.step)
+        with get_tracer().span("rollback", step=cause.step,
+                               attempt=self._consecutive_rollbacks):
+            info = self.manager.latest_valid()
+            if info is None:
+                raise CheckpointError(
+                    "rollback requested but no valid checkpoint exists "
+                    f"in {self.manager.directory}") from cause
+            cursor = self.manager.restore(self.net, info)
+        if self.sentinel is not None:
+            self.sentinel.reset()  # pending flags describe undone steps
+        self._salt += 1  # re-randomize the replayed data order
+        order = self._reshuffle_tail(
+            self._cursor_order(cursor, n_batches),
+            cursor.data_position, cursor.epoch)
+        logger.warning("rolled back to step %d after divergence at step "
+                       "%d (rollback %d/%d)", info.step, cause.step,
+                       self._consecutive_rollbacks,
+                       self.max_consecutive_rollbacks)
+        return cursor, order
+
+    # -------------------------------------------------------------------- fit
+    def fit(self, data, epochs: int = 1) -> "FaultTolerantTrainer":
+        net = self.net
+        batches = self._materialize(data)
+        if not batches:
+            return self
+        n = len(batches)
+        epoch, pos = 0, 0
+        cursor = self.manager.restore(net) if self.resume else None
+        order = self._cursor_order(cursor, n)
+        if cursor is not None:
+            epoch, pos = cursor.epoch, cursor.data_position
+            logger.info("resumed from checkpoint at step %d "
+                        "(epoch %d, batch %d)", cursor.step, epoch, pos)
+        else:
+            # anchor checkpoint: divergence on step 1 must still have a
+            # valid state to roll back to
+            self._save(epoch=0, next_pos=0)
+        while epoch < epochs:
+            if pos >= n:
+                epoch, pos, order = epoch + 1, 0, list(range(n))
+                continue
+            try:
+                pos = self._run_epoch_from(batches, order, epoch, pos)
+                if self.sentinel is not None:
+                    self.sentinel.flush()
+                self._save(epoch=epoch + 1, next_pos=0)
+                epoch, pos, order = epoch + 1, 0, list(range(n))
+            except RollbackRequested as rb:
+                cursor, order = self._rollback(rb, n)
+                epoch, pos = cursor.epoch, cursor.data_position
+        return self
+
+    def _run_epoch_from(self, batches: List, order: List[int],
+                        epoch: int, pos: int) -> int:
+        """Batches ``order[pos:]`` (indices into ``batches``) with retry
+        + periodic checkpoints. Raises RollbackRequested through to
+        ``fit``. Returns len(order) on completion."""
+        net = self.net
+        i = pos
+        while i < len(order):
+            step_id = net.iteration_count + 1
+            batch = faultinject.poison_batch(batches[order[i]], step_id)
+            attempt = 0
+            while True:
+                try:
+                    faultinject.check_raise(step_id)
+                    self.target.fit_batch(batch)
+                    break
+                except TRANSIENT_ERRORS as e:
+                    attempt += 1
+                    if attempt > self.max_retries:
+                        raise
+                    self._c_retries.inc()
+                    get_tracer().instant("transient_retry", step=step_id,
+                                         attempt=attempt)
+                    logger.warning("transient failure at step %d "
+                                   "(attempt %d/%d): %s", step_id,
+                                   attempt, self.max_retries, e)
+                    self._backoff(attempt)
+            i += 1
+            if (self.checkpoint_every
+                    and net.iteration_count % self.checkpoint_every == 0):
+                # the step completed; flush the sentinel FIRST so a
+                # diverged-but-lagging flag cannot be checkpointed as
+                # "clean progress"
+                if self.sentinel is not None:
+                    self.sentinel.flush()
+                self._save(epoch=epoch, next_pos=i, order=order)
+        return i

@@ -12,10 +12,12 @@ from __future__ import annotations
 
 import collections
 import io
+import random
 import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -32,24 +34,37 @@ class _Topic:
     def put(self, arr: np.ndarray) -> None:
         with self._cond:
             self._dq.append(arr)
-            self._cond.notify()
+            # notify_all, not notify: a dead subscriber's handler may be
+            # among the waiters and declines the array (see get) — every
+            # live waiter must get a chance at it
+            self._cond.notify_all()
 
     def put_front(self, arr: np.ndarray) -> None:
         with self._cond:
             self._dq.appendleft(arr)
-            self._cond.notify()
+            self._cond.notify_all()
 
-    def get(self, closing: Optional[threading.Event] = None
-            ) -> Optional[np.ndarray]:
+    def get(self, closing: Optional[threading.Event] = None,
+            dead=None) -> Optional[np.ndarray]:
         """Block for the next array; returns None once ``closing`` is set
         (woken by NDArrayServer.stop's notify_all) so idle SUB handler
-        threads exit on shutdown instead of parking forever."""
+        threads exit on shutdown instead of parking forever, or once
+        ``dead()`` reports the consumer vanished — without the dead
+        check, a dropped subscriber's handler keeps competing for the
+        queue and silently eats arrays meant for its reconnected
+        successor."""
         with self._cond:
-            while not self._dq:
+            while True:
                 if closing is not None and closing.is_set():
                     return None
+                # checked BEFORE popping on every wake: a handler woken
+                # by put() whose consumer died mid-wait must decline the
+                # array, not send it into the void
+                if dead is not None and dead():
+                    return None
+                if self._dq:
+                    return self._dq.popleft()
                 self._cond.wait(timeout=0.5)
-            return self._dq.popleft()
 
     def wake_all(self) -> None:
         with self._cond:
@@ -113,9 +128,21 @@ class NDArrayServer:
                             return
                         q.put(arr)
                 elif mode == "SUB":
+                    import select
+
+                    def sub_dead(sock=self.request):
+                        # a SUB client never sends after its header, so
+                        # readability can only mean EOF/RST: the
+                        # consumer hung up (or reconnected elsewhere)
+                        try:
+                            r, _, _ = select.select([sock], [], [], 0)
+                            return bool(r)
+                        except OSError:
+                            return True
+
                     while True:
-                        arr = q.get(closing=outer._closing)
-                        if arr is None:  # server shutting down
+                        arr = q.get(closing=outer._closing, dead=sub_dead)
+                        if arr is None:  # server shutdown or dead consumer
                             return
                         try:
                             _send_array(self.request, arr)
@@ -161,22 +188,90 @@ class NDArrayPublisher:
 
 
 class NDArrayConsumer:
-    """ref: NDArrayConsumer.java — getArrays(count) off a topic."""
+    """ref: NDArrayConsumer.java — getArrays(count) off a topic.
+
+    A dropped connection is an expected event on a long-lived stream
+    (broker restart, LB idle-kill, flaky NIC), not an exception: the
+    consumer reconnects and re-subscribes with bounded exponential
+    backoff + full jitter, raising ``ConnectionError`` only after
+    ``max_retries`` consecutive failed attempts. Reconnects are counted
+    in the metrics registry (``streaming_reconnects_total``).
+
+    Delivery across a drop is at-most-once for in-flight data: the
+    broker requeues the ONE array whose send failed mid-flight at the
+    HEAD of the topic (order preserved), but arrays already sitting in
+    the dead socket's OS buffer are gone. A recv *timeout* is NOT a
+    drop — a quiet stream propagates ``TimeoutError`` to the caller,
+    exactly as before reconnect support existed.
+    """
 
     def __init__(self, host: str, port: int, topic: str,
-                 timeout: Optional[float] = 10.0):
-        self._sock = socket.create_connection((host, port))
-        self._sock.settimeout(timeout)
-        self._sock.sendall(f"SUB {topic}\n".encode())
+                 timeout: Optional[float] = 10.0, max_retries: int = 3,
+                 backoff_base: float = 0.05, backoff_max: float = 2.0):
+        self._host, self._port, self._topic = host, port, topic
+        self._timeout = timeout
+        self._max_retries = max(0, int(max_retries))
+        self._backoff_base = backoff_base
+        self._backoff_max = backoff_max
+        # OS-seeded: a fleet of consumers losing the same broker must
+        # NOT retry in lockstep — that herd is what jitter exists for
+        self._jitter = random.Random()
+        self._sock: Optional[socket.socket] = None
+        self._connect()
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection((self._host, self._port))
+        self._sock.settimeout(self._timeout)
+        self._sock.sendall(f"SUB {self._topic}\n".encode())
+
+    def _close_quietly(self) -> None:
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
 
     def get_array(self) -> np.ndarray:
-        arr = _recv_array(self._sock)
-        if arr is None:
-            raise ConnectionError("stream closed")
-        return arr
+        from deeplearning4j_tpu.resilience import faultinject
+        attempt = 0
+        while True:
+            try:
+                if faultinject.on_stream_recv():
+                    # chaos harness: simulate the broker dropping us
+                    self._close_quietly()
+                arr = _recv_array(self._sock)
+                if arr is None:
+                    raise ConnectionError("stream closed by peer")
+                return arr
+            except (ConnectionError, OSError) as e:
+                if isinstance(e, TimeoutError):
+                    raise  # quiet stream, not a dropped one — caller's call
+                attempt += 1
+                if attempt > self._max_retries:
+                    raise ConnectionError(
+                        f"topic {self._topic!r}: stream lost and "
+                        f"{self._max_retries} reconnect attempts failed "
+                        f"({e})") from e
+                from deeplearning4j_tpu.profiling.metrics import \
+                    get_registry
+                get_registry().counter(
+                    "streaming_reconnects_total",
+                    help="NDArrayConsumer reconnects after a dropped "
+                         "stream").inc()
+                delay = min(self._backoff_max,
+                            self._backoff_base * (2.0 ** (attempt - 1)))
+                # full jitter: uniform over [0, delay)
+                time.sleep(delay * self._jitter.random())
+                self._close_quietly()
+                try:
+                    self._connect()
+                except OSError:
+                    # broker still down: the next recv fails fast on the
+                    # dead socket and consumes the next attempt
+                    continue
 
     def get_arrays(self, count: int) -> List[np.ndarray]:
         return [self.get_array() for _ in range(count)]
 
     def close(self) -> None:
-        self._sock.close()
+        self._close_quietly()

@@ -11,8 +11,21 @@ both containers. We keep the same three-part logical format:
   embedded ``format`` tag)
 - ``coefficients.bin``   — float32 little-endian flat param vector in the
   documented layer/param order (``params_flat`` on either container)
-- ``updaterState.bin``   — flattened optax state leaves (+ a JSON manifest
-  of leaf shapes/dtypes so the pytree is reconstructable)
+- ``updaterState.bin``   — flattened optax state leaves, each in its
+  NATIVE dtype (+ a JSON manifest of leaf shapes/dtypes so the pytree is
+  reconstructable). Earlier archives forced every leaf through ``<f4``,
+  silently corrupting int32 step counters past 2^24 and degrading
+  non-f32 moments; the v2 manifest (``{"version": 2, ...}``) marks
+  native storage, and a bare-list manifest is restored with the legacy
+  all-f4 decode so old archives keep working.
+
+Crash safety (resilience subsystem): the archive is assembled in memory
+and committed with ``atomic_write_bytes`` (tmp + fsync + rename) — a
+kill mid-save can never leave a torn file at the final path — and a
+``checksums.json`` member records each member's CRC-32 so ``verify``/
+restore detect bit-rot and truncated members, raising
+``CheckpointError`` naming the bad file instead of returning garbage
+params.
 
 For sharded multi-host checkpoints use
 ``deeplearning4j_tpu.parallel.checkpoint`` (per-process shard files); this
@@ -24,6 +37,7 @@ from __future__ import annotations
 import io
 import json
 import zipfile
+import zlib
 from pathlib import Path
 from typing import Optional, Union
 
@@ -31,75 +45,170 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.resilience.atomic import (CheckpointError,
+                                                  atomic_path, crc32_bytes)
+
 
 class ModelSerializer:
     CONFIG_NAME = "configuration.json"
     COEFFICIENTS_NAME = "coefficients.bin"
     UPDATER_NAME = "updaterState.bin"
     UPDATER_MANIFEST = "updaterState.json"
+    CHECKSUMS_NAME = "checksums.json"
 
     @staticmethod
     def write_model(net, path: Union[str, Path], save_updater: bool = True) -> None:
-        """(ref: ModelSerializer.writeModel:79-110)"""
+        """(ref: ModelSerializer.writeModel:79-110) — atomic commit: the
+        previous checkpoint at ``path`` stays intact until the new
+        archive is fully on disk."""
         path = Path(path)
-        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
-            z.writestr(ModelSerializer.CONFIG_NAME, net.conf.to_json())
-            flat = net.params_flat().astype("<f4")
-            z.writestr(ModelSerializer.COEFFICIENTS_NAME, flat.tobytes())
-            # layer states (BN running stats) — the reference stores these as
-            # params; we keep them as a separate npz member. MLN states are a
-            # list (key = layer index); CG states a dict (key = node name).
-            state_buf = io.BytesIO()
-            state_arrays = {}
-            state_items = (net.states.items() if isinstance(net.states, dict)
-                           else enumerate(net.states or []))
-            for i, s in state_items:
-                for k, v in s.items():
-                    state_arrays[f"{i}:{k}"] = np.asarray(v)
-            np.savez(state_buf, **state_arrays)
-            z.writestr("layerStates.npz", state_buf.getvalue())
-            if save_updater and net.opt_state is not None:
-                leaves = jax.tree_util.tree_leaves(net.opt_state)
-                arr_leaves = [np.asarray(l) for l in leaves
-                              if hasattr(l, "shape")]
-                manifest = [{"shape": list(a.shape), "dtype": str(a.dtype)}
-                            for a in arr_leaves]
-                flat_state = (np.concatenate([a.astype("<f4").ravel()
-                                              for a in arr_leaves])
-                              if arr_leaves else np.zeros(0, "<f4"))
-                z.writestr(ModelSerializer.UPDATER_NAME, flat_state.tobytes())
-                z.writestr(ModelSerializer.UPDATER_MANIFEST,
-                           json.dumps(manifest))
+        members: dict = {}
+        members[ModelSerializer.CONFIG_NAME] = \
+            net.conf.to_json().encode()
+        flat = net.params_flat().astype("<f4")
+        members[ModelSerializer.COEFFICIENTS_NAME] = flat.tobytes()
+        # layer states (BN running stats) — the reference stores these as
+        # params; we keep them as a separate npz member. MLN states are a
+        # list (key = layer index); CG states a dict (key = node name).
+        state_buf = io.BytesIO()
+        state_arrays = {}
+        state_items = (net.states.items() if isinstance(net.states, dict)
+                       else enumerate(net.states or []))
+        for i, s in state_items:
+            for k, v in s.items():
+                state_arrays[f"{i}:{k}"] = np.asarray(v)
+        np.savez(state_buf, **state_arrays)
+        members["layerStates.npz"] = state_buf.getvalue()
+        if save_updater and net.opt_state is not None:
+            leaves = jax.tree_util.tree_leaves(net.opt_state)
+            arr_leaves = [np.ascontiguousarray(np.asarray(l))
+                          for l in leaves if hasattr(l, "shape")]
+            manifest = {
+                "version": 2,  # native-dtype storage (v1 = all <f4)
+                "leaves": [{"shape": list(a.shape), "dtype": str(a.dtype)}
+                           for a in arr_leaves],
+            }
+            blob = b"".join(a.tobytes() for a in arr_leaves)
+            members[ModelSerializer.UPDATER_NAME] = blob
+            members[ModelSerializer.UPDATER_MANIFEST] = \
+                json.dumps(manifest).encode()
+        checksums = {name: crc32_bytes(data)
+                     for name, data in members.items()}
+        # zip straight into the tmp file — staging the whole archive in
+        # a BytesIO would transiently double host RAM at scale
+        with atomic_path(path) as tmp:
+            with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
+                for name, data in members.items():
+                    z.writestr(name, data)
+                z.writestr(ModelSerializer.CHECKSUMS_NAME,
+                           json.dumps(checksums))
+
+    # --------------------------------------------------------- verification
+    @staticmethod
+    def _read_member(z: zipfile.ZipFile, name: str,
+                     path: Union[str, Path]) -> bytes:
+        """Read one member, mapping every decode failure to a
+        CheckpointError that names the member."""
+        try:
+            return z.read(name)
+        except KeyError:
+            raise CheckpointError(
+                f"checkpoint {path}: missing member {name!r}") from None
+        except (zipfile.BadZipFile, zlib.error, OSError) as e:
+            raise CheckpointError(
+                f"checkpoint {path}: member {name!r} is corrupt "
+                f"({e})") from e
 
     @staticmethod
-    def _restore_into(z: zipfile.ZipFile, net, load_updater: bool):
+    def verify(path: Union[str, Path]) -> None:
+        """Full integrity check: zip structure, member CRCs (both the
+        zip's own and our ``checksums.json``), and the presence of the
+        required members. Raises ``CheckpointError`` naming the first
+        bad file; returns None when the archive is clean."""
+        path = Path(path)
+        try:
+            with zipfile.ZipFile(path, "r") as z:
+                bad = z.testzip()
+                if bad is not None:
+                    raise CheckpointError(
+                        f"checkpoint {path}: member {bad!r} fails its "
+                        "CRC (torn or bit-flipped write)")
+                names = set(z.namelist())
+                for req in (ModelSerializer.CONFIG_NAME,
+                            ModelSerializer.COEFFICIENTS_NAME):
+                    if req not in names:
+                        raise CheckpointError(
+                            f"checkpoint {path}: missing member {req!r}")
+                if ModelSerializer.CHECKSUMS_NAME in names:
+                    sums = json.loads(z.read(
+                        ModelSerializer.CHECKSUMS_NAME).decode())
+                    for name, want in sums.items():
+                        if name not in names:
+                            raise CheckpointError(
+                                f"checkpoint {path}: missing member "
+                                f"{name!r}")
+                        got = crc32_bytes(
+                            ModelSerializer._read_member(z, name, path))
+                        if got != want:
+                            raise CheckpointError(
+                                f"checkpoint {path}: member {name!r} "
+                                f"checksum mismatch (got {got:#010x}, "
+                                f"manifest {want:#010x})")
+        except CheckpointError:
+            # CheckpointError IS an IOError — our own precise diagnoses
+            # must not be re-wrapped by the clause below
+            raise
+        except (zipfile.BadZipFile, OSError) as e:
+            raise CheckpointError(
+                f"checkpoint {path} is unreadable: {e}") from e
+
+    @staticmethod
+    def _restore_into(z: zipfile.ZipFile, net, load_updater: bool,
+                      path: Union[str, Path] = "<archive>"):
         """Shared param/state/updater restore for both containers."""
         flat = np.frombuffer(
-            z.read(ModelSerializer.COEFFICIENTS_NAME), dtype="<f4")
+            ModelSerializer._read_member(
+                z, ModelSerializer.COEFFICIENTS_NAME, path), dtype="<f4")
         net.set_params_flat(flat)
         if "layerStates.npz" in z.namelist():
-            with z.open("layerStates.npz") as f:
-                data = np.load(io.BytesIO(f.read()))
-                for key in data.files:
-                    i_s, name = key.split(":", 1)
-                    idx = i_s if isinstance(net.states, dict) else int(i_s)
-                    net.states[idx][name] = jnp.asarray(data[key])
+            data = np.load(io.BytesIO(
+                ModelSerializer._read_member(z, "layerStates.npz", path)))
+            for key in data.files:
+                i_s, name = key.split(":", 1)
+                idx = i_s if isinstance(net.states, dict) else int(i_s)
+                net.states[idx][name] = jnp.asarray(data[key])
         if load_updater and ModelSerializer.UPDATER_NAME in z.namelist():
-            manifest = json.loads(
-                z.read(ModelSerializer.UPDATER_MANIFEST).decode())
-            blob = np.frombuffer(z.read(ModelSerializer.UPDATER_NAME),
-                                 dtype="<f4")
+            manifest = json.loads(ModelSerializer._read_member(
+                z, ModelSerializer.UPDATER_MANIFEST, path).decode())
+            blob = ModelSerializer._read_member(
+                z, ModelSerializer.UPDATER_NAME, path)
+            if isinstance(manifest, dict):  # v2: native-dtype leaves
+                specs = manifest["leaves"]
+                legacy_f4 = False
+            else:  # v1 legacy: bare list, every leaf stored as <f4
+                specs = manifest
+                legacy_f4 = True
+                blob_f4 = np.frombuffer(blob, dtype="<f4")
             leaves, treedef = jax.tree_util.tree_flatten(net.opt_state)
             pos = 0
             mi = 0
             new_leaves = []
             for leaf in leaves:
                 if hasattr(leaf, "shape"):
-                    spec = manifest[mi]
+                    spec = specs[mi]
                     n = int(np.prod(spec["shape"])) if spec["shape"] else 1
-                    arr = blob[pos:pos + n].reshape(spec["shape"])
-                    new_leaves.append(jnp.asarray(arr, spec["dtype"]))
-                    pos += n
+                    if legacy_f4:
+                        arr = blob_f4[pos:pos + n].reshape(spec["shape"])
+                        new_leaves.append(jnp.asarray(arr, spec["dtype"]))
+                        pos += n
+                    else:
+                        dt = np.dtype(spec["dtype"])
+                        nbytes = n * dt.itemsize
+                        arr = np.frombuffer(
+                            blob[pos:pos + nbytes],
+                            dtype=dt).reshape(spec["shape"])
+                        new_leaves.append(jnp.asarray(arr))
+                        pos += nbytes
                     mi += 1
                 else:
                     new_leaves.append(leaf)
@@ -107,9 +216,37 @@ class ModelSerializer:
         return net
 
     @staticmethod
+    def restore_weights(path: Union[str, Path], net,
+                        load_updater: bool = True, verify: bool = True):
+        """Restore params/states/updater from ``path`` into an EXISTING
+        initialized container (the FaultTolerantTrainer resume path —
+        no re-build, no re-trace). Verifies checksums first;
+        ``verify=False`` skips the full-CRC pass when the caller just
+        verified (CheckpointManager.latest_valid did)."""
+        path = Path(path)
+        if verify:
+            ModelSerializer.verify(path)
+        try:
+            with zipfile.ZipFile(path, "r") as z:
+                return ModelSerializer._restore_into(z, net, load_updater,
+                                                     path=path)
+        except CheckpointError:
+            raise
+        except (zipfile.BadZipFile, OSError) as e:
+            raise CheckpointError(
+                f"checkpoint {path} is unreadable: {e}") from e
+
+    @staticmethod
     def _config_json(path: Union[str, Path]) -> dict:
-        with zipfile.ZipFile(Path(path), "r") as z:
-            return json.loads(z.read(ModelSerializer.CONFIG_NAME).decode())
+        try:
+            with zipfile.ZipFile(Path(path), "r") as z:
+                return json.loads(ModelSerializer._read_member(
+                    z, ModelSerializer.CONFIG_NAME, path).decode())
+        except CheckpointError:
+            raise  # already precisely diagnosed (and IS an IOError)
+        except (zipfile.BadZipFile, OSError) as e:
+            raise CheckpointError(
+                f"checkpoint {path} is unreadable: {e}") from e
 
     @staticmethod
     def restore_multi_layer_network(path: Union[str, Path],
@@ -118,16 +255,15 @@ class ModelSerializer:
         from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
-        with zipfile.ZipFile(Path(path), "r") as z:
-            cfg = json.loads(z.read(ModelSerializer.CONFIG_NAME).decode())
-            if "ComputationGraph" in cfg.get("format", ""):
-                raise ValueError(
-                    "Archive holds a ComputationGraph; use "
-                    "restore_computation_graph")
-            conf = MultiLayerConfiguration.from_dict(cfg)
-            net = MultiLayerNetwork(conf)
-            net.init()
-            return ModelSerializer._restore_into(z, net, load_updater)
+        cfg = ModelSerializer._config_json(path)
+        if "ComputationGraph" in cfg.get("format", ""):
+            raise ValueError(
+                "Archive holds a ComputationGraph; use "
+                "restore_computation_graph")
+        conf = MultiLayerConfiguration.from_dict(cfg)
+        net = MultiLayerNetwork(conf)
+        net.init()
+        return ModelSerializer.restore_weights(path, net, load_updater)
 
     @staticmethod
     def restore_computation_graph(path: Union[str, Path],
@@ -141,16 +277,15 @@ class ModelSerializer:
         )
         from deeplearning4j_tpu.nn.graph import ComputationGraph
 
-        with zipfile.ZipFile(Path(path), "r") as z:
-            cfg = json.loads(z.read(ModelSerializer.CONFIG_NAME).decode())
-            if "ComputationGraph" not in cfg.get("format", ""):
-                raise ValueError(
-                    "Archive holds a MultiLayerNetwork; use "
-                    "restore_multi_layer_network")
-            conf = ComputationGraphConfiguration.from_dict(cfg)
-            net = ComputationGraph(conf)
-            net.init()
-            return ModelSerializer._restore_into(z, net, load_updater)
+        cfg = ModelSerializer._config_json(path)
+        if "ComputationGraph" not in cfg.get("format", ""):
+            raise ValueError(
+                "Archive holds a MultiLayerNetwork; use "
+                "restore_multi_layer_network")
+        conf = ComputationGraphConfiguration.from_dict(cfg)
+        net = ComputationGraph(conf)
+        net.init()
+        return ModelSerializer.restore_weights(path, net, load_updater)
 
     @staticmethod
     def restore_model(path: Union[str, Path], load_updater: bool = True):

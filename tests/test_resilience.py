@@ -1,0 +1,646 @@
+"""Chaos suite for the resilience subsystem.
+
+Proves the two headline invariants of the fault-tolerance PR:
+
+(a) a simulated SIGKILL mid-checkpoint leaves ``latest_valid()``
+    pointing at the previous intact checkpoint, and training resumes
+    from its cursor with matching ``params_flat``;
+(b) an injected NaN triggers the configured sentinel policy (the
+    in-step guard keeps params finite) and every fault/retry/rollback/
+    skip is counted in the metrics registry;
+
+plus the corruption-detection contracts: truncated ``coefficients.bin``,
+bit-flipped shard file, and missing ``COMMIT`` marker each raise a
+``CheckpointError`` naming the bad file — never garbage params.
+"""
+
+import json
+import time
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel.checkpoint import (save_sharded,
+                                                    verify_sharded)
+from deeplearning4j_tpu.profiling.metrics import (MetricsRegistry,
+                                                  set_registry)
+from deeplearning4j_tpu.resilience import (CheckpointError,
+                                           CheckpointManager,
+                                           DivergenceError,
+                                           DivergenceSentinel, Fault,
+                                           FaultInjected, FaultSchedule,
+                                           FaultTolerantTrainer,
+                                           KilledByFault,
+                                           RollbackRequested,
+                                           TrainingCursor, faultinject)
+from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+RNG = np.random.default_rng(7)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry_and_schedule():
+    """Isolate every test's counters and disarm any leftover fault
+    schedule (a leaked schedule would fire in an unrelated test)."""
+    prev = set_registry(MetricsRegistry())
+    yield
+    faultinject.clear()
+    set_registry(prev)
+
+
+def _net(seed: int = 1) -> MultiLayerNetwork:
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder().seed(seed)
+        .updater("adam").learning_rate(0.05).list()
+        .layer(DenseLayer(n_out=8, activation="relu"))
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(4)).build()).init()
+
+
+def _batches(n: int, b: int = 6):
+    return [DataSet(RNG.normal(size=(b, 4)).astype(np.float32),
+                    np.eye(3, dtype=np.float32)[RNG.integers(0, 3, b)])
+            for _ in range(n)]
+
+
+def _registry():
+    from deeplearning4j_tpu.profiling.metrics import get_registry
+    return get_registry()
+
+
+# ---------------------------------------------------------------------------
+# crash-safe zip format
+# ---------------------------------------------------------------------------
+
+def test_atomic_write_crash_leaves_previous_checkpoint(tmp_path):
+    """SIGKILL between write and rename: the final path keeps the OLD
+    complete archive."""
+    net = _net()
+    path = tmp_path / "m.zip"
+    ModelSerializer.write_model(net, path)
+    before = path.read_bytes()
+    net.fit_batch(_batches(1)[0])
+    faultinject.set_schedule(FaultSchedule(
+        [Fault("truncate_checkpoint", at_call=1, mode="crash")]))
+    with pytest.raises(KilledByFault):
+        ModelSerializer.write_model(net, path)
+    assert path.read_bytes() == before  # old archive untouched
+    ModelSerializer.verify(path)  # and still valid
+
+
+def test_torn_zip_write_detected_by_checksum(tmp_path):
+    """torn mode lets a truncated archive land at the final path —
+    verify must reject it, naming the problem."""
+    net = _net()
+    path = tmp_path / "m.zip"
+    faultinject.set_schedule(FaultSchedule(
+        [Fault("truncate_checkpoint", at_call=1, mode="torn")]))
+    ModelSerializer.write_model(net, path)
+    with pytest.raises(CheckpointError):
+        ModelSerializer.verify(path)
+
+
+def test_truncated_coefficients_member_named_in_error(tmp_path):
+    """A checkpoint whose coefficients.bin member was truncated (e.g.
+    storage-layer corruption) raises CheckpointError naming the file —
+    never restores garbage params."""
+    net = _net()
+    path = tmp_path / "m.zip"
+    ModelSerializer.write_model(net, path)
+    # rebuild the archive with a truncated member but the ORIGINAL
+    # checksums manifest — a self-consistent zip our CRCs must catch
+    with zipfile.ZipFile(path) as z:
+        members = {n: z.read(n) for n in z.namelist()}
+    members[ModelSerializer.COEFFICIENTS_NAME] = \
+        members[ModelSerializer.COEFFICIENTS_NAME][:-16]
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        for n, data in members.items():
+            z.writestr(n, data)
+    with pytest.raises(CheckpointError, match="coefficients.bin"):
+        ModelSerializer.verify(path)
+    with pytest.raises(CheckpointError, match="coefficients.bin"):
+        ModelSerializer.restore_weights(path, _net())
+
+
+def test_updater_state_native_dtypes_round_trip(tmp_path):
+    """int32 optax step counters past 2^24 survive exactly (the legacy
+    all-f4 encode rounded them); moments keep their dtype."""
+    import jax
+
+    net = _net()
+    net.fit_batch(_batches(1)[0])
+    # push every integer leaf past f32's exact-integer range
+    big = 2 ** 24 + 5
+
+    def bump(leaf):
+        if hasattr(leaf, "dtype") and jax.numpy.issubdtype(
+                leaf.dtype, jax.numpy.integer):
+            return jax.numpy.full_like(leaf, big)
+        return leaf
+    net.opt_state = jax.tree_util.tree_map(bump, net.opt_state)
+    path = tmp_path / "m.zip"
+    ModelSerializer.write_model(net, path)
+    net2 = _net()
+    ModelSerializer.restore_weights(path, net2)
+    ints = [np.asarray(l) for l in jax.tree_util.tree_leaves(net2.opt_state)
+            if hasattr(l, "dtype") and np.issubdtype(np.asarray(l).dtype,
+                                                     np.integer)]
+    assert ints, "expected an integer step counter in adam state"
+    for arr in ints:
+        assert (arr == big).all()  # 2^24+5 is NOT representable in f4
+
+
+def test_legacy_f4_updater_archive_restores(tmp_path):
+    """Archives written before the native-dtype manifest (bare-list
+    manifest, all leaves <f4) still restore."""
+    import jax
+
+    net = _net()
+    net.fit_batch(_batches(1)[0])
+    path = tmp_path / "legacy.zip"
+    # hand-build the v1 layout the old writer produced
+    leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(net.opt_state)
+              if hasattr(l, "shape")]
+    manifest = [{"shape": list(a.shape), "dtype": str(a.dtype)}
+                for a in leaves]
+    blob = (np.concatenate([a.astype("<f4").ravel() for a in leaves])
+            if leaves else np.zeros(0, "<f4"))
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr(ModelSerializer.CONFIG_NAME, net.conf.to_json())
+        z.writestr(ModelSerializer.COEFFICIENTS_NAME,
+                   net.params_flat().astype("<f4").tobytes())
+        z.writestr(ModelSerializer.UPDATER_NAME, blob.tobytes())
+        z.writestr(ModelSerializer.UPDATER_MANIFEST, json.dumps(manifest))
+    net2 = _net()
+    ModelSerializer.restore_weights(path, net2)
+    np.testing.assert_allclose(net2.params_flat(), net.params_flat(),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(net.opt_state),
+                    jax.tree_util.tree_leaves(net2.opt_state)):
+        if hasattr(a, "shape"):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# sharded format: COMMIT marker + checksums
+# ---------------------------------------------------------------------------
+
+def test_sharded_missing_commit_marker(tmp_path):
+    params = {"W": np.asarray(RNG.normal(size=(8, 4)), np.float32)}
+    ckpt = tmp_path / "ck"
+    save_sharded(ckpt, params)
+    (ckpt / "COMMIT").unlink()
+    with pytest.raises(CheckpointError, match="COMMIT"):
+        verify_sharded(ckpt)
+    from deeplearning4j_tpu.parallel.checkpoint import restore_sharded
+    with pytest.raises(CheckpointError, match="COMMIT"):
+        restore_sharded(ckpt, None)
+
+
+def test_sharded_v1_checkpoint_without_commit_still_restores(tmp_path):
+    """Checkpoints written before the COMMIT protocol (manifest version
+    1, no COMMIT file) must stay restorable — only NEW-format dirs
+    missing their marker are torn writes."""
+    params = {"W": np.asarray(RNG.normal(size=(8, 4)), np.float32)}
+    ckpt = tmp_path / "ck"
+    save_sharded(ckpt, params)
+    (ckpt / "COMMIT").unlink()
+    m = json.loads((ckpt / "manifest.json").read_text())
+    m["version"] = 1
+    (ckpt / "manifest.json").write_text(json.dumps(m))
+    from deeplearning4j_tpu.parallel.checkpoint import restore_sharded
+    out = restore_sharded(ckpt, None)
+    np.testing.assert_array_equal(out["W"], params["W"])
+
+
+def test_sharded_bitflip_detected_and_named(tmp_path):
+    params = {"W": np.asarray(RNG.normal(size=(8, 4)), np.float32)}
+    ckpt = tmp_path / "ck"
+    save_sharded(ckpt, params)
+    shard = ckpt / "shards_p0.npz"
+    raw = bytearray(shard.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF  # one flipped byte in the payload
+    shard.write_bytes(bytes(raw))
+    with pytest.raises(CheckpointError, match="shards_p0.npz"):
+        verify_sharded(ckpt)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: rotation + latest_valid
+# ---------------------------------------------------------------------------
+
+def test_manager_rotation_keeps_last_n(tmp_path):
+    net = _net()
+    mgr = CheckpointManager(tmp_path, keep_last=2)
+    for b in _batches(5):
+        net.fit_batch(b)
+        mgr.save(net)
+    infos = mgr.checkpoints()
+    assert [i.step for i in infos] == [4, 5]
+    assert mgr.latest_valid().step == 5
+
+
+def test_latest_valid_skips_torn_checkpoint(tmp_path):
+    """The newest checkpoint is torn — latest_valid must return the
+    previous intact one, counting the skip."""
+    net = _net()
+    mgr = CheckpointManager(tmp_path, keep_last=3)
+    net.fit_batch(_batches(1)[0])
+    mgr.save(net)
+    good_step = net.iteration_count
+    net.fit_batch(_batches(1)[0])
+    faultinject.set_schedule(FaultSchedule(
+        [Fault("truncate_checkpoint", at_call=1, mode="torn")]))
+    mgr.save(net)  # lands torn
+    faultinject.clear()
+    info = mgr.latest_valid()
+    assert info is not None and info.step == good_step
+    assert _registry().snapshot("resilience_")[
+        "resilience_invalid_checkpoints_total"] >= 1
+
+
+def test_headline_sigkill_mid_checkpoint_resume(tmp_path):
+    """Headline invariant (a): SIGKILL mid-checkpoint write leaves
+    latest_valid() at the previous intact checkpoint; a fresh process
+    resumes from its cursor with matching params_flat."""
+    net = _net()
+    mgr = CheckpointManager(tmp_path, keep_last=3)
+    ft = FaultTolerantTrainer(net, mgr, checkpoint_every=1)
+    batches = _batches(3)
+    ft.fit(batches, epochs=1)
+    intact_params = net.params_flat().copy()
+    intact_step = net.iteration_count
+    # next save dies mid-write (rename never happens)
+    net.fit_batch(_batches(1)[0])
+    faultinject.set_schedule(FaultSchedule(
+        [Fault("truncate_checkpoint", at_call=1, mode="crash")]))
+    with pytest.raises(KilledByFault):
+        mgr.save(net)
+    faultinject.clear()
+    # "fresh process": new net, new manager over the same directory
+    net2 = _net(seed=99)  # different init — restore must overwrite it
+    mgr2 = CheckpointManager(tmp_path, keep_last=3)
+    cursor = mgr2.restore(net2)
+    assert cursor is not None and net2.iteration_count == intact_step
+    np.testing.assert_allclose(net2.params_flat(), intact_params,
+                               rtol=1e-6)
+    # and training continues from there
+    net2.fit_batch(_batches(1)[0])
+    assert net2.iteration_count == intact_step + 1
+
+
+def test_cursor_resume_mid_epoch(tmp_path):
+    """A run killed mid-epoch resumes at the cursor's batch position:
+    the finished run has seen every batch exactly once."""
+    batches = _batches(4)
+    net = _net()
+    mgr = CheckpointManager(tmp_path, keep_last=4)
+    ft = FaultTolerantTrainer(net, mgr, checkpoint_every=1,
+                              max_retries=0)
+    faultinject.set_schedule(FaultSchedule([Fault("raise", step=3)]))
+    with pytest.raises(FaultInjected):  # max_retries=0: aborts the run
+        ft.fit(batches, epochs=1)
+    faultinject.clear()
+    assert net.iteration_count == 2
+    # resume in a fresh trainer: finishes batches 3 and 4 only
+    net2 = _net(seed=5)
+    ft2 = FaultTolerantTrainer(net2, CheckpointManager(tmp_path,
+                                                       keep_last=4))
+    ft2.fit(batches, epochs=1)
+    assert net2.iteration_count == 4
+
+
+# ---------------------------------------------------------------------------
+# sentinel policies (headline invariant b)
+# ---------------------------------------------------------------------------
+
+def test_sentinel_skip_batch_counts_and_keeps_params_finite():
+    net = _net()
+    sentinel = DivergenceSentinel(policy="skip_batch", lag=1)
+    net.set_divergence_sentinel(sentinel)
+    batches = _batches(3)
+    faultinject.set_schedule(FaultSchedule([Fault("nan", step=2)]))
+    ft = None
+    for i, b in enumerate(batches):
+        b = faultinject.poison_batch(b, i + 1)
+        net.fit_batch(b)
+    sentinel.flush()
+    assert sentinel.skipped_batches == 1
+    assert np.isfinite(net.params_flat()).all()
+    snap = _registry().snapshot("resilience_")
+    assert snap["resilience_nonfinite_steps_total"] == 1
+    assert snap["resilience_faults_injected_total"] == 1
+
+
+def test_sentinel_raise_names_step():
+    net = _net()
+    net.set_divergence_sentinel(DivergenceSentinel(policy="raise", lag=0))
+    net.fit_batch(_batches(1)[0])
+    bad = _batches(1)[0]
+    bad.features = np.array(bad.features)
+    bad.features[0, 0] = np.nan
+    with pytest.raises(DivergenceError, match="step 2"):
+        net.fit_batch(bad)
+    assert np.isfinite(net.params_flat()).all()  # guard kept old params
+
+
+def test_sentinel_rollback_outside_ft_trainer_raises():
+    net = _net()
+    net.set_divergence_sentinel(
+        DivergenceSentinel(policy="rollback", lag=0))
+    bad = _batches(1)[0]
+    bad.features = np.array(bad.features)
+    bad.features[0, 0] = np.nan
+    with pytest.raises(RollbackRequested):
+        net.fit_batch(bad)
+
+
+def test_sentinel_no_extra_sync_on_clean_steps():
+    """Step-time sanity: the guarded step with lag=1 must not be
+    grossly slower than the unguarded step on clean batches (the check
+    is a few fused reductions; the flag read is one-step lagged)."""
+    batches = _batches(12, b=16)
+
+    def run(with_sentinel):
+        net = _net()
+        if with_sentinel:
+            net.set_divergence_sentinel(
+                DivergenceSentinel(policy="skip_batch", lag=1))
+        net.fit_batch(batches[0])  # compile
+        float(net.score_value)
+        t0 = time.perf_counter()
+        for b in batches[1:]:
+            net.fit_batch(b)
+        float(net.score_value)
+        return time.perf_counter() - t0
+
+    plain = min(run(False) for _ in range(2))
+    guarded = min(run(True) for _ in range(2))
+    # generous bound: catches an accidental per-step blocking sync
+    # (orders of magnitude), not CI noise
+    assert guarded < plain * 5 + 0.05, (plain, guarded)
+
+
+def test_scan_fit_falls_back_to_per_batch_with_sentinel():
+    """fit_batches_scan with a sentinel attached must take the per-batch
+    path so policy flags are observed (a scan body would drop them)."""
+    net = _net()
+    net.set_divergence_sentinel(
+        DivergenceSentinel(policy="skip_batch", lag=0))
+    batches = _batches(3)
+    bad = DataSet(np.array(batches[1].features), batches[1].labels)
+    bad.features[0, 0] = np.nan
+    losses = net.fit_batches_scan([batches[0], bad, batches[2]])
+    assert net.iteration_count == 3
+    assert net._sentinel.skipped_batches == 1  # flag observed, not dropped
+    assert np.isfinite(net.params_flat()).all()
+    assert len(np.asarray(losses)) == 3
+
+
+def test_sentinel_tbptt_skip_guards_carries():
+    """The tBPTT step is guarded too: a NaN window neither updates
+    params nor poisons the carried recurrent state."""
+    from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+    b = (NeuralNetConfiguration.builder().seed(11)
+         .updater("sgd").learning_rate(0.05).list()
+         .layer(LSTM(n_out=6, activation="tanh"))
+         .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent")))
+    b.backprop_type("truncated_bptt", 3, 3)
+    net = MultiLayerNetwork(
+        b.set_input_type(InputType.recurrent(4, 6)).build()).init()
+    net.set_divergence_sentinel(
+        DivergenceSentinel(policy="skip_batch", lag=0))
+    x = RNG.normal(size=(3, 6, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[RNG.integers(0, 3, (3, 6))]
+    x_bad = x.copy()
+    x_bad[0, 4, 0] = np.nan  # poisons the SECOND tBPTT window only
+    net.fit_batch(DataSet(x_bad, y))
+    assert net._sentinel.skipped_batches == 1  # one window skipped
+    assert np.isfinite(net.params_flat()).all()
+    net.fit_batch(DataSet(x, y))  # clean batch still trains
+    assert np.isfinite(net.params_flat()).all()
+
+
+def test_parallel_trainer_sentinel_skip():
+    from deeplearning4j_tpu.parallel import MeshContext, ParallelTrainer
+    net = _net()
+    net.set_divergence_sentinel(
+        DivergenceSentinel(policy="skip_batch", lag=1))
+    tr = ParallelTrainer(net, MeshContext.create(n_data=8, n_model=1))
+    batches = _batches(3, b=8)
+    bad = DataSet(np.array(batches[1].features), batches[1].labels)
+    bad.features[0, 0] = np.nan
+    tr.fit_batch(batches[0])
+    tr.fit_batch(bad)
+    tr.fit_batch(batches[2])
+    net._sentinel.flush()
+    assert net._sentinel.skipped_batches == 1
+    assert np.isfinite(np.asarray(net.params_flat())).all()
+
+
+def test_parallel_wrapper_sentinel_skip():
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+    net = _net()
+    net.set_divergence_sentinel(
+        DivergenceSentinel(policy="skip_batch", lag=0))
+    pw = ParallelWrapper(net, workers=2)
+    batches = _batches(2, b=4)
+    bad = DataSet(np.array(batches[1].features), batches[1].labels)
+    bad.features[0, 0] = np.nan
+    pw.fit(batches[0], epochs=1)
+    # worker 1 gets the poisoned batch
+    pw._parallel_iteration([batches[0], bad])
+    assert net._sentinel.skipped_batches == 1
+    pw._sync_to_net()
+    assert np.isfinite(np.asarray(net.params_flat())).all()
+
+
+# ---------------------------------------------------------------------------
+# FaultTolerantTrainer: retry + rollback
+# ---------------------------------------------------------------------------
+
+def test_transient_fault_retried_with_backoff(tmp_path):
+    net = _net()
+    mgr = CheckpointManager(tmp_path)
+    ft = FaultTolerantTrainer(net, mgr, max_retries=3,
+                              backoff_base=0.001, backoff_max=0.01)
+    faultinject.set_schedule(FaultSchedule([Fault("raise", step=2)]))
+    ft.fit(_batches(3), epochs=1)
+    assert net.iteration_count == 3
+    assert _registry().snapshot("resilience_")[
+        "resilience_retries_total"] == 1
+
+
+def test_rollback_restores_and_rerandomizes(tmp_path):
+    net = _net()
+    mgr = CheckpointManager(tmp_path, keep_last=3)
+    sentinel = DivergenceSentinel(policy="rollback", lag=0)
+    ft = FaultTolerantTrainer(net, mgr, sentinel=sentinel,
+                              checkpoint_every=1)
+    faultinject.set_schedule(FaultSchedule([Fault("nan", step=3)]))
+    ft.fit(_batches(4), epochs=1)
+    snap = _registry().snapshot("resilience_")
+    assert snap["resilience_rollbacks_total"] == 1
+    assert ft._salt == 1  # data order re-randomized after the rollback
+    assert np.isfinite(net.params_flat()).all()
+    # all four batches (re)trained: the epoch completed
+    assert mgr.latest_valid().cursor.epoch == 1
+
+
+def test_ft_trainer_drives_parallel_wrapper(tmp_path):
+    """ParallelWrapper exposes the per-batch seam the FT trainer needs
+    (one parallel iteration per global minibatch, worker-0 state synced
+    back so checkpoints see current weights)."""
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+    net = _net()
+    pw = ParallelWrapper(net, workers=2)
+    ft = FaultTolerantTrainer(net, CheckpointManager(tmp_path),
+                              trainer=pw, checkpoint_every=2)
+    ft.fit(_batches(3, b=4), epochs=1)
+    assert net.iteration_count == 3
+    assert np.isfinite(np.asarray(net.params_flat())).all()
+    # the mid-run checkpoint restores worker-0's then-current params
+    assert CheckpointManager(tmp_path).latest_valid() is not None
+    with pytest.raises(TypeError, match="fit_batch"):
+        FaultTolerantTrainer(net, CheckpointManager(tmp_path),
+                             trainer=object())
+
+
+def test_cursor_persists_epoch_order(tmp_path):
+    """A reshuffled epoch order rides with the cursor so a restart
+    resumes against the SAME permutation (a position into a different
+    order would re-train some batches and skip others)."""
+    net = _net()
+    mgr = CheckpointManager(tmp_path, keep_last=5)
+    ft = FaultTolerantTrainer(net, mgr, resume=False)
+    order = [2, 0, 1]
+    ft._save(epoch=0, next_pos=1, order=order)
+    info = mgr.latest_valid()
+    assert info.cursor.extra["order"] == order
+    assert FaultTolerantTrainer._cursor_order(info.cursor, 3) == order
+    # a corrupt/non-permutation order falls back to identity
+    info.cursor.extra["order"] = [0, 0, 1]
+    assert FaultTolerantTrainer._cursor_order(info.cursor, 3) == [0, 1, 2]
+
+
+def test_reshuffle_tail_keeps_consumed_prefix(tmp_path):
+    """Rollback re-randomizes only the not-yet-consumed tail: the
+    consumed prefix is what cursor positions index into."""
+    ft = FaultTolerantTrainer(_net(), CheckpointManager(tmp_path),
+                              resume=False)
+    ft._salt = 1
+    out = ft._reshuffle_tail(list(range(10)), 4, epoch=0)
+    assert out[:4] == [0, 1, 2, 3]
+    assert sorted(out[4:]) == [4, 5, 6, 7, 8, 9]
+
+
+def test_rollback_escalates_after_k_consecutive(tmp_path):
+    """A permanently-poisoned dataset rolls back K times, then raises."""
+    net = _net()
+    mgr = CheckpointManager(tmp_path, keep_last=2)
+    sentinel = DivergenceSentinel(policy="rollback", lag=0)
+    ft = FaultTolerantTrainer(net, mgr, sentinel=sentinel,
+                              max_consecutive_rollbacks=2)
+    bad = _batches(1)[0]
+    bad.features = np.array(bad.features)
+    bad.features[:] = np.nan
+    with pytest.raises(DivergenceError, match="consecutive rollbacks"):
+        ft.fit([bad], epochs=1)
+    assert _registry().snapshot("resilience_")[
+        "resilience_rollbacks_total"] == 3  # 2 allowed + the escalating one
+
+
+# ---------------------------------------------------------------------------
+# streaming reconnect
+# ---------------------------------------------------------------------------
+
+def test_consumer_reconnects_after_drop():
+    import threading
+
+    from deeplearning4j_tpu.streaming.ndarray_channel import (
+        NDArrayConsumer, NDArrayPublisher, NDArrayServer)
+    server = NDArrayServer()
+    try:
+        pub = NDArrayPublisher(server.host, server.port, "t")
+        consumer = NDArrayConsumer(server.host, server.port, "t",
+                                   timeout=10.0, max_retries=3,
+                                   backoff_base=0.01, backoff_max=0.05)
+        arrays = [np.full((3, 2), k, np.float32) for k in range(3)]
+        pub.publish(arrays[0])
+        np.testing.assert_array_equal(consumer.get_array(), arrays[0])
+        # drop the socket under the consumer at its next recv; publish
+        # arrives only after the reconnect window opens, so delivery
+        # through the NEW subscription is what's proven
+        faultinject.set_schedule(FaultSchedule(
+            [Fault("drop_connection", at_call=1)]))
+        timer = threading.Timer(0.5, lambda: pub.publish(arrays[1]))
+        timer.start()
+        try:
+            np.testing.assert_array_equal(consumer.get_array(), arrays[1])
+        finally:
+            timer.join()
+        assert _registry().snapshot("streaming_")[
+            "streaming_reconnects_total"] >= 1
+        # the reconnected stream keeps flowing normally
+        pub.publish(arrays[2])
+        np.testing.assert_array_equal(consumer.get_array(), arrays[2])
+        consumer.close()
+        pub.close()
+    finally:
+        server.stop()
+
+
+def test_consumer_bounded_retries_exhaust():
+    from deeplearning4j_tpu.streaming.ndarray_channel import (
+        NDArrayConsumer, NDArrayServer)
+    server = NDArrayServer()
+    consumer = NDArrayConsumer(server.host, server.port, "t",
+                               timeout=0.2, max_retries=2,
+                               backoff_base=0.01, backoff_max=0.02)
+    server.stop()  # broker gone for good
+    with pytest.raises(ConnectionError, match="reconnect"):
+        consumer.get_array()
+
+
+def test_resilience_counters_render_for_metrics_endpoint(tmp_path):
+    """The counters the ui server serves at /api/metrics: creating the
+    resilience components registers them, and the Prometheus rendering
+    carries them (the registry is the same process-global one the ui
+    server reads)."""
+    net = _net()
+    FaultTolerantTrainer(
+        net, CheckpointManager(tmp_path),
+        sentinel=DivergenceSentinel(policy="skip_batch"))
+    text = _registry().to_prometheus()
+    for name in ("resilience_nonfinite_steps_total",
+                 "resilience_skipped_batches_total",
+                 "resilience_retries_total",
+                 "resilience_rollbacks_total",
+                 "resilience_checkpoints_saved_total",
+                 "resilience_invalid_checkpoints_total"):
+        assert name in text
+
+
+# ---------------------------------------------------------------------------
+# cursor round-trip
+# ---------------------------------------------------------------------------
+
+def test_training_cursor_rng_round_trip():
+    net = _net()
+    net.fit_batch(_batches(1)[0])
+    cur = TrainingCursor.of(net, epoch=2, data_position=5)
+    cur2 = TrainingCursor.from_json(cur.to_json())
+    net2 = _net(seed=9)
+    cur2.apply(net2)
+    assert net2.iteration_count == net.iteration_count
+    assert net2.epoch_count == 2
+    np.testing.assert_array_equal(np.asarray(net2._rng),
+                                  np.asarray(net._rng))

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Chaos smoke stage (tools/run_checks.sh): a 3-step LeNet fit on CPU
+with a NaN injected into the batch at step 2 under
+``DivergenceSentinel(policy="skip_batch")`` must (1) finish all three
+steps, (2) report exactly ``skipped_batches == 1`` in the metrics
+registry, (3) keep every parameter finite (the in-step guard dropped
+the poisoned update), and (4) leave a valid resumable checkpoint whose
+``latest_valid`` restore round-trips the final params. Exit 0 = the
+resilience subsystem's happy path is wired end to end.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.models.lenet import lenet_mnist
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.profiling.metrics import (MetricsRegistry,
+                                                      set_registry)
+    from deeplearning4j_tpu.resilience import (CheckpointManager,
+                                               DivergenceSentinel, Fault,
+                                               FaultSchedule,
+                                               FaultTolerantTrainer)
+    from deeplearning4j_tpu.resilience import faultinject
+
+    registry = MetricsRegistry()
+    prev = set_registry(registry)
+    try:
+        rng = np.random.default_rng(0)
+        batches = [
+            DataSet(rng.normal(size=(8, 28, 28, 1)).astype(np.float32),
+                    np.eye(10, dtype=np.float32)[rng.integers(0, 10, 8)])
+            for _ in range(3)]
+        net = MultiLayerNetwork(lenet_mnist()).init()
+        with tempfile.TemporaryDirectory() as d:
+            manager = CheckpointManager(d, keep_last=2)
+            sentinel = DivergenceSentinel(policy="skip_batch", lag=1)
+            trainer = FaultTolerantTrainer(net, manager,
+                                           sentinel=sentinel)
+            faultinject.set_schedule(FaultSchedule([Fault("nan", step=2)]))
+            try:
+                trainer.fit(batches, epochs=1)
+            finally:
+                faultinject.clear()
+
+            skipped = registry.snapshot("resilience_").get(
+                "resilience_skipped_batches_total", 0)
+            if skipped != 1:
+                print(f"chaos_smoke: FAIL skipped_batches == {skipped}, "
+                      "expected 1")
+                return 1
+            if net.iteration_count != 3:
+                print(f"chaos_smoke: FAIL ran {net.iteration_count} "
+                      "steps, expected 3")
+                return 1
+            params = net.params_flat()
+            if not np.isfinite(params).all():
+                print("chaos_smoke: FAIL non-finite params survived "
+                      "skip_batch")
+                return 1
+            info = manager.latest_valid()
+            if info is None:
+                print("chaos_smoke: FAIL no valid checkpoint after fit")
+                return 1
+            net2 = MultiLayerNetwork(lenet_mnist()).init()
+            manager.restore(net2, info)
+            if not np.allclose(net2.params_flat(), params):
+                print("chaos_smoke: FAIL restored params differ")
+                return 1
+        print("chaos_smoke: OK — NaN at step 2 skipped (1 batch), "
+              "3 steps finished, params finite, checkpoint restores")
+        return 0
+    finally:
+        set_registry(prev)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,9 @@ if [ "${1:-}" != "--fast" ]; then
     echo "== profiling smoke (trace export + metrics + cost analysis) =="
     JAX_PLATFORMS=cpu python tools/profiling_smoke.py || fail=1
 
+    echo "== chaos smoke (NaN injection under skip_batch + resume) =="
+    JAX_PLATFORMS=cpu python tools/chaos_smoke.py || fail=1
+
     echo "== tier-1 tests (ROADMAP.md) =="
     rm -f /tmp/_t1.log
     timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
