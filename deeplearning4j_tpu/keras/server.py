@@ -14,7 +14,18 @@ newline-delimited JSON-over-TCP protocol:
      "labels_dir": ..., "nb_epoch": N}
     {"op": "predict", "features": <.npy path>}  -> {"predictions": [...]}
     {"op": "evaluate", "features_dir": ..., "labels_dir": ...}
+    {"op": "health"}  -> {"live": true, "ready": ..., "reasons": [...]}
     {"op": "shutdown"}
+
+Every request may carry ``deadline_ms`` — its deadline budget (the
+server default applies otherwise; <= 0 disables). Requests admit
+through a ``resilience/service.py`` ServiceGuard: past the bounded
+queue they are shed with ``{"error": "SHED", ...}`` instead of queueing
+unboundedly, blown budgets return ``{"error": "DEADLINE", ...}``, and a
+per-model circuit breaker fails fast with ``{"error": "BREAKER_OPEN",
+"retry_after_ms": ...}`` after consecutive failures/timeouts. A
+nonfinite prediction is refused (``{"error": "NONFINITE"}``) — the
+serving analog of the PR 3 divergence sentinel.
 
 Batch files: ``.npy`` or ``.h5`` (one array per file, sorted order), the
 HDF5MiniBatchDataSetIterator layout.
@@ -22,10 +33,12 @@ HDF5MiniBatchDataSetIterator layout.
 
 from __future__ import annotations
 
+import collections
 import json
 import socket
 import socketserver
 import threading
+import time
 from pathlib import Path
 from typing import List, Optional
 
@@ -33,6 +46,16 @@ import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+from deeplearning4j_tpu.profiling.metrics import get_registry
+from deeplearning4j_tpu.profiling.tracer import get_tracer
+from deeplearning4j_tpu.resilience import faultinject
+from deeplearning4j_tpu.resilience.service import (BreakerOpen, Deadline,
+                                                   DeadlineExceeded,
+                                                   NonFiniteOutput,
+                                                   ServiceError,
+                                                   ServiceGuard,
+                                                   register_guard,
+                                                   unregister_guard)
 
 
 def _load_array(path: Path) -> np.ndarray:
@@ -79,12 +102,65 @@ class HDF5MiniBatchDataSetIterator(DataSetIterator):
         return int(_load_array(self._f_files[0]).shape[0])
 
 
-class KerasServer:
-    """The gateway. A loaded model is cached per model path; ``fit`` /
-    ``predict`` / ``evaluate`` operate on it. Runs in a daemon thread."""
+class _DeadlineGatedIterator(DataSetIterator):
+    """Wraps a DataSetIterator so a fit/evaluate checks its deadline
+    budget before every batch — the "next safe seam": the model's
+    parameters are only ever abandoned at a batch boundary, never
+    mid-update."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        self._models = {}
+    def __init__(self, it: DataSetIterator, deadline: Deadline,
+                 what: str):
+        self._it = it
+        self._deadline = deadline
+        self._what = what
+
+    def async_supported(self):
+        # NEVER let fit() wrap this in AsyncDataSetIterator: the
+        # prefetch thread would drain next() (and every deadline
+        # check) ahead of training, turning the per-batch seam into a
+        # no-op for any dataset smaller than the prefetch queue
+        return False
+
+    def reset(self):
+        self._it.reset()
+
+    def has_next(self):
+        return self._it.has_next()
+
+    def next(self):
+        self._deadline.check(self._what)
+        return self._it.next()
+
+    def batch_size(self):
+        return self._it.batch_size()
+
+
+class KerasServer:
+    """The gateway. A loaded model is cached per model path (bounded
+    LRU, ``keep_models``); ``fit`` / ``predict`` / ``evaluate`` operate
+    on it under a per-model lock (a concurrent fit and predict on the
+    same model must never interleave a half-updated parameter tree).
+    Runs in a daemon thread.
+
+    Hardened edge (PR 4): every op admits through a ``ServiceGuard``
+    (bounded concurrency + queue, load shedding, per-model circuit
+    breaker, deadline budgets, graceful ``drain``); the handler socket
+    carries an idle/slow-loris timeout so a dribbling client cannot
+    park a thread forever."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_concurrency: int = 4, queue_depth: int = 8,
+                 default_deadline_ms: Optional[float] = 300_000.0,
+                 max_queue_wait_s: float = 5.0, keep_models: int = 4,
+                 breaker_failures: int = 5,
+                 breaker_cooldown_base: float = 0.5,
+                 breaker_cooldown_max: float = 30.0,
+                 breaker_slow_call_s: float = 30.0,
+                 io_timeout: float = 60.0):
+        self._models = collections.OrderedDict()  # path -> model (LRU)
+        self._model_locks = {}  # path -> per-model op lock
+        self._model_pins = {}  # path -> in-flight ops (pinned != evictable)
+        self._keep_models = max(1, int(keep_models))
         # handler threads (ThreadingTCPServer) share _models/_last; without
         # the lock a predict that omits 'model' could resolve _last mid-swap
         # from another connection and run against the wrong model
@@ -92,76 +168,231 @@ class KerasServer:
         outer = self
 
         class Handler(socketserver.StreamRequestHandler):
+            timeout = io_timeout  # reclaims slow-loris/idle threads
+
             def handle(self):
-                for line in self.rfile:
-                    try:
-                        req = json.loads(line)
-                        resp = outer._dispatch(req)
-                    except Exception as e:  # report, keep serving
-                        resp = {"error": f"{type(e).__name__}: {e}"}
-                    self.wfile.write((json.dumps(resp) + "\n").encode())
-                    self.wfile.flush()
-                    if isinstance(resp, dict) and resp.get("shutdown"):
-                        threading.Thread(target=outer.stop,
-                                         daemon=True).start()
-                        return
+                try:
+                    for line in self.rfile:
+                        try:
+                            req = json.loads(line)
+                            resp = outer._dispatch(req)
+                        except ServiceError as e:  # structured
+                            resp = e.to_response()
+                        except Exception as e:  # report, keep serving
+                            resp = {"error": f"{type(e).__name__}: {e}"}
+                        self.wfile.write((json.dumps(resp) + "\n").encode())
+                        self.wfile.flush()
+                        if isinstance(resp, dict) and resp.get("shutdown"):
+                            threading.Thread(target=outer.stop,
+                                             daemon=True).start()
+                            return
+                except TimeoutError:
+                    # dribbled (slow-loris) or idle connection timed
+                    # out: count it, reclaim the thread cleanly. NOT
+                    # serving_deadline_exceeded_total — no admitted
+                    # request's budget ran out; a well-behaved client
+                    # parking an idle keep-alive must not trip
+                    # deadline alerts
+                    get_registry().counter(
+                        "serving_idle_timeouts_total",
+                        help="connections closed after the handler "
+                             "socket idle/slow-loris timeout").inc()
+                    return
+                except OSError:
+                    return  # client vanished mid-line
 
         self._server = socketserver.ThreadingTCPServer((host, port), Handler)
         self._server.daemon_threads = True
         self.host, self.port = host, self._server.server_address[1]
+        self._guard = register_guard(ServiceGuard(
+            f"keras_server_{self.port}", max_concurrency=max_concurrency,
+            queue_depth=queue_depth,
+            default_deadline_ms=default_deadline_ms,
+            max_queue_wait_s=max_queue_wait_s,
+            breaker_failures=breaker_failures,
+            breaker_cooldown_base=breaker_cooldown_base,
+            breaker_cooldown_max=breaker_cooldown_max,
+            breaker_slow_call_s=breaker_slow_call_s))
+        self._guard.add_ready_check("model_loaded",
+                                    lambda: bool(self._models))
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
 
     # -- ops ----------------------------------------------------------
-    def _get_model(self, path: Optional[str]):
+    def _resolve_key(self, path: Optional[str]) -> str:
+        """The model-cache / breaker key for a request, WITHOUT loading
+        anything (the breaker must be consulted before a possibly
+        expensive/failing load)."""
         with self._state_lock:
             if path is not None:
-                if path not in self._models:
-                    if path.endswith(".zip"):
-                        from deeplearning4j_tpu.util.serializer import (
-                            ModelSerializer)
-                        # container-agnostic: MLN or ComputationGraph
-                        self._models[path] = \
-                            ModelSerializer.restore_model(path)
-                    else:
-                        from deeplearning4j_tpu.keras.keras_import import (
-                            KerasModelImport)
-                        self._models[path] = (KerasModelImport
-                                              .import_keras_model_and_weights(path))
-                self._last = path
-                return self._models[path]
+                return path
             if not self._models:
                 raise ValueError("no model loaded; pass 'model'")
-            return self._models[self._last]
+            if self._last not in self._models:  # evicted since last use
+                self._last = next(reversed(self._models))
+            return self._last
+
+    def _get_model(self, key: str):
+        """(model, per-model lock) for ``key``, loading and LRU-caching
+        on miss, and PINNING the entry: the LRU never evicts a pinned
+        model (an in-flight op keeps its model — and its lock identity —
+        resident; checking ``lock.locked()`` instead would race the
+        window between returning the lock and acquiring it). Callers
+        must ``_unpin(key)`` when the op finishes."""
+        with self._state_lock:
+            if key not in self._models:
+                if key.endswith(".zip"):
+                    from deeplearning4j_tpu.util.serializer import (
+                        ModelSerializer)
+                    # container-agnostic: MLN or ComputationGraph
+                    model = ModelSerializer.restore_model(key)
+                else:
+                    from deeplearning4j_tpu.keras.keras_import import (
+                        KerasModelImport)
+                    model = (KerasModelImport
+                             .import_keras_model_and_weights(key))
+                self._models[key] = model
+            self._models.move_to_end(key)
+            self._model_pins[key] = self._model_pins.get(key, 0) + 1
+            while len(self._models) > self._keep_models:
+                victim = next(
+                    (p for p in self._models
+                     if not self._model_pins.get(p)), None)
+                if victim is None:
+                    break  # everything older is mid-op; over-stay
+                del self._models[victim]
+                self._model_locks.pop(victim, None)
+                get_registry().counter(
+                    "serving_models_evicted_total",
+                    help="models evicted from the KerasServer LRU "
+                         "cache").inc()
+            self._last = key
+            lock = self._model_locks.setdefault(key, threading.Lock())
+            return self._models[key], lock
+
+    def _unpin(self, key: str) -> None:
+        with self._state_lock:
+            n = self._model_pins.get(key, 0) - 1
+            if n <= 0:
+                self._model_pins.pop(key, None)
+            else:
+                self._model_pins[key] = n
 
     def _dispatch(self, req: dict) -> dict:
         op = req.get("op")
+        if op == "health":
+            # never admitted/queued: a health probe must answer even
+            # (especially) when the server is saturated or draining
+            ready, reasons = self._guard.ready()
+            return {"ok": True, "live": True, "ready": ready,
+                    "reasons": reasons, "draining": self._guard.draining}
         if op == "shutdown":
             return {"ok": True, "shutdown": True}
         if op not in ("fit", "predict", "evaluate"):
             raise ValueError(f"unknown op {op!r}")
-        model = self._get_model(req.get("model"))
+        deadline = self._guard.deadline(req)
+        with self._guard.admit(deadline):
+            with get_tracer().span(f"serve:{op}"):
+                return self._serve(op, req, deadline)
+
+    def _serve(self, op: str, req: dict, deadline: Deadline) -> dict:
+        key = self._resolve_key(req.get("model"))
+        # a budget already blown in the admission queue says nothing
+        # about the backend — and checking BEFORE _prepare avoids
+        # loading the whole input from disk for a doomed request
+        deadline.check(f"{op} before dispatch")
+        # client-side input validation/loading happens BEFORE the
+        # breaker scope: a typo'd features path or mismatched batch
+        # dirs is the CLIENT's failure and must not open the circuit
+        # for a healthy model
+        payload = self._prepare(op, req, deadline)
+        breaker = self._guard.breaker(key)
+        if not breaker.allow():
+            raise BreakerOpen(f"model {key!r}: circuit open",
+                              retry_after_ms=breaker.retry_after_ms())
+        pinned = False
+        t0 = time.monotonic()
+        try:
+            # model load IS backend scope: an unloadable model path
+            # should trip its breaker
+            model, lock = self._get_model(key)
+            pinned = True
+            faultinject.on_backend_dispatch(op)
+            with lock:
+                resp = self._run_op(op, req, payload, model, deadline)
+            # post-hoc budget check: the op itself cannot be cancelled
+            # mid-kernel, so a blown budget is detected at this seam
+            # and the (late) result withheld
+            deadline.check(f"{op} after dispatch")
+        except DeadlineExceeded:
+            # a blown CLIENT budget opens the shared breaker only when
+            # the backend was genuinely slow (dispatch ran at least the
+            # guard's slow-call threshold) — an impatient deadline_ms
+            # must not fail-fast everyone else's healthy model
+            if (time.monotonic() - t0
+                    >= self._guard.breaker_slow_call_s):
+                breaker.record_failure()
+            raise
+        except Exception:
+            breaker.record_failure()
+            raise
+        finally:
+            if pinned:
+                self._unpin(key)
+        breaker.record_success()
+        return resp
+
+    def _prepare(self, op: str, req: dict, deadline: Deadline):
+        """Load/validate the request's inputs (not the model)."""
+        if op == "predict":
+            return _load_array(Path(req["features"])).astype(np.float32)
+        return _DeadlineGatedIterator(
+            HDF5MiniBatchDataSetIterator(req["features_dir"],
+                                         req["labels_dir"]),
+            deadline, f"{op} batch")
+
+    def _run_op(self, op: str, req: dict, payload, model,
+                deadline: Deadline) -> dict:
         if op == "fit":
-            it = HDF5MiniBatchDataSetIterator(req["features_dir"],
-                                              req["labels_dir"])
             for _ in range(int(req.get("nb_epoch", 1))):
-                model.fit(it)
+                deadline.check("fit epoch")
+                model.fit(payload)
             return {"ok": True, "score": float(model.score())}
         if op == "predict":
-            x = _load_array(Path(req["features"])).astype(np.float32)
-            return {"ok": True,
-                    "predictions": np.asarray(model.output(x)).tolist()}
+            y = np.asarray(model.output(payload))
+            from deeplearning4j_tpu.resilience.sentinel import \
+                host_nonfinite
+            if host_nonfinite(y):
+                get_registry().counter(
+                    "serving_nonfinite_outputs_total",
+                    help="predictions refused because the model "
+                         "output carried NaN/Inf").inc()
+                raise NonFiniteOutput("prediction contains NaN/Inf")
+            return {"ok": True, "predictions": y.tolist()}
         if op == "evaluate":
-            it = HDF5MiniBatchDataSetIterator(req["features_dir"],
-                                              req["labels_dir"])
-            ev = model.evaluate(it)
+            ev = model.evaluate(payload)
             return {"ok": True, "accuracy": ev.accuracy(), "f1": ev.f1()}
         raise AssertionError("unreachable")  # ops validated above
 
-    def stop(self) -> None:
+    # -- lifecycle ----------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        return self._guard.draining
+
+    def drain(self, grace_s: float = 10.0) -> bool:
+        """Graceful shutdown: stop admitting (new ops get ``DRAINING``),
+        let in-flight ops finish up to ``grace_s``, then close the
+        listener. Returns True when the server emptied in time."""
+        self._guard.start_drain()
+        drained = self._guard.wait_idle(grace_s)
         self._server.shutdown()
         self._server.server_close()
+        unregister_guard(self._guard)
+        return drained
+
+    def stop(self, grace_s: float = 2.0) -> None:
+        self.drain(grace_s)
 
 
 class KerasClient:
@@ -180,8 +411,17 @@ class KerasClient:
             raise ConnectionError("server closed")
         resp = json.loads(line)
         if "error" in resp:
-            raise RuntimeError(resp["error"])
+            # structured serving errors carry a machine-readable code in
+            # "error" ("SHED", "DEADLINE", "BREAKER_OPEN", ...) plus a
+            # human "message"; legacy errors are a single string
+            msg = resp["error"]
+            if "message" in resp:
+                msg = f"{msg}: {resp['message']}"
+            raise RuntimeError(msg)
         return resp
+
+    def health(self) -> dict:
+        return self.request(op="health")
 
     def fit(self, model: str, features_dir: str, labels_dir: str,
             nb_epoch: int = 1) -> dict:

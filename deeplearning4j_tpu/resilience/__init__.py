@@ -18,6 +18,12 @@ preemptions, flaky hosts, and numeric blow-ups itself. Four legs:
 - ``faultinject`` — deterministic fault schedules driving the chaos
   test suite; every injected fault / retry / rollback / skipped batch
   is counted in the metrics registry and visible as tracer events.
+- ``service``     — the serving edge's hardening kit (PR 4):
+  ``ServiceGuard`` composes admission control (bounded queue + load
+  shedding), per-request deadline budgets, per-backend circuit
+  breakers, and health/readiness + graceful drain. Every network
+  server in the repo (KerasServer, NDArrayServer, UIServer) admits
+  through it; new servers MUST too.
 """
 
 from deeplearning4j_tpu.resilience.atomic import (  # noqa: F401
@@ -31,7 +37,12 @@ from deeplearning4j_tpu.resilience.manager import (  # noqa: F401
 )
 from deeplearning4j_tpu.resilience.sentinel import (  # noqa: F401
     DivergenceError, DivergenceSentinel, RollbackRequested, guard_update,
-    nonfinite_flag,
+    host_nonfinite, nonfinite_flag,
+)
+from deeplearning4j_tpu.resilience.service import (  # noqa: F401
+    BreakerOpen, CircuitBreaker, Deadline, DeadlineExceeded, DrainingError,
+    NonFiniteOutput, ServiceError, ServiceGuard, ShedError, ready_report,
+    register_guard, unregister_guard,
 )
 from deeplearning4j_tpu.resilience.trainer import (  # noqa: F401
     FaultTolerantTrainer,

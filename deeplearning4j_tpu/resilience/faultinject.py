@@ -20,6 +20,25 @@ exact seams the resilience subsystem defends:
   must catch it on restore).
 - ``drop_connection`` at recv N — close the streaming consumer's socket
   under it; the reconnect/backoff path must recover the stream.
+  ``mode="pub"`` targets the publisher's Nth send instead (its
+  reconnect path is symmetric but separately counted).
+
+Network fault kinds (PR 4, the serving edge's chaos seams):
+
+- ``slow_loris``       — the Nth ``_send_array`` dribbles its frame
+  header byte-by-byte over ``duration`` seconds; the server's header
+  timeout must reclaim the handler thread.
+- ``hang_backend``     — the Nth KerasServer model dispatch sleeps
+  ``duration`` seconds (a hung accelerator/model); deadline budgets
+  must expire and the circuit breaker must count it.
+- ``burst``            — declarative burst size for chaos harnesses:
+  ``burst_size()`` hands the scheduled ``count`` to the test driver,
+  which fires that many concurrent requests.
+- ``corrupt_frame``    — corrupt the Nth frame on the wire.
+  ``mode="length"`` rewrites the length header to a multi-GB claim,
+  ``mode="crc"`` flips a payload byte (CRC-32 trailer must catch it),
+  ``mode="truncate"`` halves the frame (receiver must see a clean
+  truncation error, never a garbage array).
 
 Faults are one-shot: each schedule entry fires once, is counted in the
 metrics registry (``resilience_faults_injected_total``) and stamped as a
@@ -29,7 +48,9 @@ matches ``net.iteration_count + 1`` (the step about to run).
 
 from __future__ import annotations
 
+import struct
 import threading
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import List, Optional
@@ -39,7 +60,9 @@ import numpy as np
 from deeplearning4j_tpu.profiling.metrics import get_registry
 from deeplearning4j_tpu.profiling.tracer import get_tracer
 
-_KINDS = ("raise", "nan", "truncate_checkpoint", "drop_connection")
+_KINDS = ("raise", "nan", "truncate_checkpoint", "drop_connection",
+          "slow_loris", "hang_backend", "burst", "corrupt_frame")
+_CORRUPT_MODES = ("length", "crc", "truncate")
 
 
 class FaultInjected(RuntimeError):
@@ -55,19 +78,28 @@ class KilledByFault(RuntimeError):
 @dataclass
 class Fault:
     """One scheduled fault. ``step`` arms raise/nan faults at that
-    training step; ``at_call`` arms checkpoint/connection faults at the
-    Nth commit/recv (1-based, default: the next one)."""
+    training step; ``at_call`` arms checkpoint/connection/dispatch/
+    frame faults at the Nth commit/recv/dispatch/send (1-based,
+    default: the next one). ``duration`` is the stall length for
+    slow_loris/hang_backend; ``count`` the burst size for burst."""
 
     kind: str
     step: int = 0
     at_call: int = 1
-    mode: str = "crash"  # truncate_checkpoint: "crash" | "torn"
+    mode: str = "crash"  # truncate_checkpoint: "crash" | "torn";
+    #                      corrupt_frame: "length" | "crc" | "truncate";
+    #                      drop_connection: "sub" (default) | "pub"
+    duration: float = 0.0
+    count: int = 0
     fired: bool = False
 
     def __post_init__(self):
         if self.kind not in _KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}; "
                              f"one of {_KINDS}")
+        if self.kind == "corrupt_frame" and self.mode not in _CORRUPT_MODES:
+            raise ValueError(f"corrupt_frame mode {self.mode!r}; "
+                             f"one of {_CORRUPT_MODES}")
 
 
 @dataclass
@@ -82,16 +114,25 @@ _lock = threading.Lock()
 _schedule: Optional[FaultSchedule] = None
 _commit_calls = 0
 _recv_calls = 0
+_pub_calls = 0
+_dispatch_calls = 0
+_frame_sends = 0
+_loris_sends = 0
 
 
 def set_schedule(schedule: Optional[FaultSchedule]) -> None:
     """Arm a schedule (or disarm with ``None``). Resets call counters so
     ``at_call`` indices are relative to arming time."""
-    global _schedule, _commit_calls, _recv_calls
+    global _schedule, _commit_calls, _recv_calls, _pub_calls
+    global _dispatch_calls, _frame_sends, _loris_sends
     with _lock:
         _schedule = schedule
         _commit_calls = 0
         _recv_calls = 0
+        _pub_calls = 0
+        _dispatch_calls = 0
+        _frame_sends = 0
+        _loris_sends = 0
 
 
 def clear() -> None:
@@ -190,14 +231,112 @@ def on_checkpoint_commit(tmp: Path, final: Path) -> None:
 def on_stream_recv() -> bool:
     """Called by the streaming consumer before each blocking recv;
     returns True when the scheduled ``drop_connection`` fault fires (the
-    caller closes its own socket to simulate the drop)."""
+    caller closes its own socket to simulate the drop). Entries with
+    ``mode="pub"`` belong to ``on_pub_send`` and are skipped here."""
     global _recv_calls
     with _lock:
         if _schedule is None:
             return False
         _recv_calls += 1
         for f in _schedule.pending():
-            if f.kind == "drop_connection" and f.at_call == _recv_calls:
+            if (f.kind == "drop_connection" and f.mode != "pub"
+                    and f.at_call == _recv_calls):
                 _fire(f, recv=_recv_calls)
                 return True
         return False
+
+
+def on_pub_send() -> bool:
+    """Called by the streaming publisher before each send; returns True
+    when a ``drop_connection`` fault with ``mode="pub"`` fires (the
+    publisher closes its own socket to simulate a dropped stream)."""
+    global _pub_calls
+    with _lock:
+        if _schedule is None:
+            return False
+        _pub_calls += 1
+        for f in _schedule.pending():
+            if (f.kind == "drop_connection" and f.mode == "pub"
+                    and f.at_call == _pub_calls):
+                _fire(f, send=_pub_calls)
+                return True
+        return False
+
+
+def on_backend_dispatch(op: str = "") -> None:
+    """Called by KerasServer immediately before the model op; a
+    scheduled ``hang_backend`` fault stalls this dispatch for
+    ``duration`` seconds (the sleep happens OUTSIDE the harness lock —
+    a hung backend must not freeze the whole chaos schedule)."""
+    global _dispatch_calls
+    with _lock:
+        hit = None
+        if _schedule is not None:
+            _dispatch_calls += 1
+            for f in _schedule.pending():
+                if f.kind == "hang_backend" and f.at_call == _dispatch_calls:
+                    hit = f
+                    break
+            if hit is not None:
+                _fire(hit, op=op, dispatch=_dispatch_calls)
+    if hit is not None:
+        time.sleep(max(0.0, hit.duration))
+
+
+def corrupt_wire(frame: bytes) -> bytes:
+    """Called by ``_send_array`` with the complete wire frame (length
+    header + payload [+ CRC trailer]); a scheduled ``corrupt_frame``
+    fault returns a corrupted frame for its Nth send."""
+    global _frame_sends
+    with _lock:
+        hit = None
+        if _schedule is not None:
+            _frame_sends += 1
+            for f in _schedule.pending():
+                if f.kind == "corrupt_frame" and f.at_call == _frame_sends:
+                    hit = f
+                    break
+            if hit is not None:
+                _fire(hit, mode=hit.mode, send=_frame_sends)
+    if hit is None:
+        return frame
+    if hit.mode == "length":
+        # keep the v2 flag bit if present; claim a multi-GB payload
+        (hdr,) = struct.unpack(">Q", frame[:8])
+        flag = hdr & (1 << 63)
+        return struct.pack(">Q", flag | (1 << 40)) + frame[8:]
+    if hit.mode == "crc":
+        i = 8 + max(0, (len(frame) - 8) // 2)
+        i = min(i, len(frame) - 1)
+        return frame[:i] + bytes([frame[i] ^ 0xFF]) + frame[i + 1:]
+    return frame[:max(9, len(frame) // 2)]  # truncate
+
+
+def slow_loris_s() -> float:
+    """Called by ``_send_array`` per frame; returns the total stall to
+    spread over the frame header's bytes when a ``slow_loris`` fault is
+    scheduled for this (``at_call``-th) send — 0.0 = send normally."""
+    global _loris_sends
+    with _lock:
+        if _schedule is None:
+            return 0.0
+        _loris_sends += 1
+        for f in _schedule.pending():
+            if f.kind == "slow_loris" and f.at_call == _loris_sends:
+                _fire(f, duration=f.duration)
+                return max(0.0, f.duration)
+        return 0.0
+
+
+def burst_size() -> int:
+    """Hand a chaos driver the scheduled ``burst`` fault's ``count``
+    (0 when none is armed) — the driver fires that many concurrent
+    requests."""
+    with _lock:
+        if _schedule is None:
+            return 0
+        for f in _schedule.pending():
+            if f.kind == "burst":
+                _fire(f, count=f.count)
+                return int(f.count)
+        return 0

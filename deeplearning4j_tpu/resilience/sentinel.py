@@ -81,6 +81,16 @@ def nonfinite_flag(loss, grads):
     return jnp.logical_not(ok)
 
 
+def host_nonfinite(arr) -> bool:
+    """Host-side companion to ``nonfinite_flag`` for *inference*
+    outputs: True when the array carries any NaN/Inf. The serving edge
+    uses it to refuse to ship garbage predictions (counted as
+    ``serving_nonfinite_outputs_total`` by the caller) — the same
+    never-serve-poison discipline the in-step guard applies to
+    parameter updates."""
+    return not bool(np.isfinite(np.asarray(arr)).all())
+
+
 def _select(bad, old_tree, new_tree):
     def pick(o, n):
         if not (hasattr(n, "dtype") or hasattr(o, "dtype")):

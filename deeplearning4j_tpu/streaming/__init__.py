@@ -11,9 +11,11 @@ incoming batch and publishes predictions.
 """
 
 from deeplearning4j_tpu.streaming.ndarray_channel import (  # noqa: F401
+    FRAME_CAP_BYTES,
     NDArrayConsumer,
     NDArrayPublisher,
     NDArrayServer,
+    ProtocolError,
 )
 from deeplearning4j_tpu.streaming.pipeline import (  # noqa: F401
     ServeRoute,

@@ -35,6 +35,8 @@ class ServeRoute:
         self._thread: Optional[threading.Thread] = None
 
     def _loop(self) -> None:
+        from deeplearning4j_tpu.profiling.metrics import get_registry
+        from deeplearning4j_tpu.resilience.sentinel import host_nonfinite
         while not self._stop.is_set():
             try:
                 x = self._consumer.get_array()
@@ -43,6 +45,14 @@ class ServeRoute:
             if self._transform is not None:
                 x = self._transform(x)
             y = np.asarray(self._model.output(x))
+            if host_nonfinite(y):
+                # never publish poison downstream — the serving analog
+                # of the divergence sentinel's never-land-a-NaN rule
+                get_registry().counter(
+                    "serving_nonfinite_outputs_total",
+                    help="predictions refused because the model output "
+                         "carried NaN/Inf").inc()
+                continue
             self._publisher.publish(y)
 
     def start(self) -> "ServeRoute":

@@ -8,6 +8,15 @@ self-contained HTML page (inline JS drawing SVG charts; zero external
 assets, zero egress) polling JSON endpoints.
 
 Endpoints:
+  GET  /healthz               liveness probe (200 while the process
+                              serves; unauthenticated, never admitted —
+                              a saturated server must still answer)
+  GET  /readyz                readiness probe: 200 when every
+                              registered ServiceGuard in the process
+                              (this server, KerasServer, broker) is
+                              ready — not draining, admission queue
+                              below high-water, no circuit breaker
+                              open; 503 + reasons otherwise
   GET  /                      dashboard page
   GET  /api/sessions          list of session ids
   GET  /api/session?id=S      {init: {...}, reports: [...]} (scalars only)
@@ -376,8 +385,19 @@ def _grid_to_data_url(grid) -> str:
     return f"data:{mime};base64," + base64.b64encode(payload).decode()
 
 
+#: probe routes: no auth, no admission — a liveness/readiness probe
+#: must answer from a saturated, draining, or misconfigured server
+#: (that is its entire job), and it carries no session data.
+_PROBE_PATHS = ("/healthz", "/readyz")
+#: routes exempt from ADMISSION only (auth still applies): the metrics
+#: scrape is the observability channel you need most exactly when
+#: everything else is shedding.
+_UNADMITTED_PATHS = _PROBE_PATHS + ("/api/metrics", "/api/metrics.json")
+
+
 class _Handler(BaseHTTPRequestHandler):
     storage: StatsStorage = None  # set by UIServer
+    guard = None  # ServiceGuard, set by UIServer (None = no admission)
     tsne_data: Optional[dict] = None  # latest posted 2-d embedding
     flow_data: Optional[dict] = None  # network graph (flow view)
     activation_data: Optional[dict] = None  # layer -> PNG data URL
@@ -459,23 +479,44 @@ class _Handler(BaseHTTPRequestHandler):
             return True
         return False
 
-    def do_GET(self):
+    def _handle(self, inner):
+        from deeplearning4j_tpu.resilience.service import (ServiceError,
+                                                           ready_report)
         try:
+            path = urllib.parse.urlparse(self.path).path
+            if path in _PROBE_PATHS:
+                if path == "/healthz":
+                    self._send(200, b'{"live": true}')
+                    return
+                ok, report = ready_report()
+                if self.guard is not None:
+                    g_ok, reasons = self.guard.ready()
+                    report.setdefault(
+                        self.guard.name,
+                        {"ready": g_ok, "reasons": reasons})
+                    ok = ok and g_ok
+                self._send(200 if ok else 503, json.dumps(
+                    {"ready": ok, "guards": report}).encode())
+                return
             if not self._authorized():
                 self._send(401, b'{"error": "unauthorized"}')
                 return
-            self._do_get()
+            if self.guard is not None and path not in _UNADMITTED_PATHS:
+                try:
+                    with self.guard.admit():
+                        inner()
+                except ServiceError as e:
+                    self._send(503, json.dumps(e.to_response()).encode())
+                return
+            inner()
         except Exception as e:  # report instead of dropping the connection
             self._send(500, json.dumps({"error": str(e)}).encode())
 
+    def do_GET(self):
+        self._handle(self._do_get)
+
     def do_POST(self):
-        try:
-            if not self._authorized():
-                self._send(401, b'{"error": "unauthorized"}')
-                return
-            self._do_post()
-        except Exception as e:
-            self._send(500, json.dumps({"error": str(e)}).encode())
+        self._handle(self._do_post)
 
     def _do_get(self):
         url = urllib.parse.urlparse(self.path)
@@ -613,7 +654,8 @@ class UIServer:
                  storage: Optional[StatsStorage] = None,
                  host: str = "127.0.0.1",
                  auth_token: Optional[str] = None,
-                 secure_cookie: bool = False):
+                 secure_cookie: bool = False,
+                 max_concurrency: int = 16, queue_depth: int = 32):
         """``host="0.0.0.0"`` + ``auth_token=...`` serves a multi-host
         run (remote routers point at it); the default stays
         localhost-only with no auth, the reference's Play behavior.
@@ -626,6 +668,8 @@ class UIServer:
         browser history and proxy/access logs — prefer the
         ``Authorization: Bearer`` header for scripted clients and
         rotate a token that ever rode a leaked URL."""
+        from deeplearning4j_tpu.resilience.service import (ServiceGuard,
+                                                           register_guard)
         self.storage = storage or InMemoryStatsStorage()
         handler = type("BoundHandler", (_Handler,),
                        {"storage": self.storage, "_hist_index": {},
@@ -634,6 +678,13 @@ class UIServer:
                         "cookie_secure": bool(secure_cookie)})
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self._httpd.server_address[1]
+        # dashboard requests admit through the same service kit as the
+        # model servers: a poll storm (many browser tabs, a scraper
+        # gone wild) sheds with 503 instead of spawning threads forever
+        self._guard = register_guard(ServiceGuard(
+            f"ui_server_{self.port}", max_concurrency=max_concurrency,
+            queue_depth=queue_depth, default_deadline_ms=None))
+        handler.guard = self._guard
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)
 
@@ -697,8 +748,19 @@ class UIServer:
     def url(self) -> str:
         return f"http://127.0.0.1:{self.port}"
 
-    def stop(self) -> None:
+    def drain(self, grace_s: float = 5.0) -> bool:
+        """Graceful shutdown: ``/readyz`` flips to 503 (an LB pulls the
+        backend), new requests get ``DRAINING``, in-flight responses
+        finish up to ``grace_s``, then the listener closes."""
+        from deeplearning4j_tpu.resilience.service import unregister_guard
+        self._guard.start_drain()
+        drained = self._guard.wait_idle(grace_s)
         self._httpd.shutdown()
         self._httpd.server_close()
+        unregister_guard(self._guard)
         if UIServer._instance is self:
             UIServer._instance = None
+        return drained
+
+    def stop(self, grace_s: float = 1.0) -> None:
+        self.drain(grace_s)

@@ -1,0 +1,853 @@
+"""Chaos suite for the serving edge (PR 4).
+
+Proves the service-hardening kit's headline invariants:
+
+(a) an overload burst against a bounded admission queue SHEDS with
+    structured errors — no crash, no unbounded handler threads;
+(b) a slow-loris header / stalled frame times out and the thread is
+    reclaimed; the corrupt-frame trio (bad length, bad CRC,
+    truncation) never yields a garbage array;
+(c) the per-backend circuit breaker walks open -> half-open -> closed
+    and requests fail fast while it is open;
+(d) drain finishes in-flight work, rejects new work, then closes;
+(e) protocol v2 (frame cap + CRC trailer) still accepts v1 frames.
+"""
+
+import json
+import socket
+import struct
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.iris import load_iris
+from deeplearning4j_tpu.keras.server import KerasClient, KerasServer
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.profiling.metrics import (MetricsRegistry,
+                                                  get_registry,
+                                                  set_registry)
+from deeplearning4j_tpu.resilience import faultinject, service
+from deeplearning4j_tpu.resilience.faultinject import Fault, FaultSchedule
+from deeplearning4j_tpu.resilience.service import (CLOSED, OPEN,
+                                                   CircuitBreaker,
+                                                   Deadline,
+                                                   DeadlineExceeded,
+                                                   DrainingError,
+                                                   ServiceGuard, ShedError)
+from deeplearning4j_tpu.streaming.ndarray_channel import (_recv_array,
+                                                          _send_array,
+                                                          _Topic,
+                                                          NDArrayConsumer,
+                                                          NDArrayPublisher,
+                                                          NDArrayServer,
+                                                          ProtocolError)
+from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry_and_schedule():
+    """Isolate every test's counters, disarm leftover fault schedules,
+    and drop leaked guard registrations (a draining guard leaked from a
+    failed test would flip every later /readyz)."""
+    prev = set_registry(MetricsRegistry())
+    yield
+    faultinject.clear()
+    with service._guards_lock:
+        service._guards.clear()
+    set_registry(prev)
+
+
+def _counter(name: str) -> float:
+    m = get_registry().get(name)
+    return 0.0 if m is None else m.value
+
+
+def _wait_until(cond, timeout=5.0, msg="condition"):
+    t_end = time.monotonic() + timeout
+    while time.monotonic() < t_end:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# service kit units
+# ---------------------------------------------------------------------------
+
+def test_admission_sheds_past_queue_depth():
+    guard = ServiceGuard("t", max_concurrency=1, queue_depth=1,
+                         max_queue_wait_s=0.2)
+    release = threading.Event()
+    entered = threading.Event()
+
+    def hold():
+        with guard.admit():
+            entered.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=hold, daemon=True)
+    t.start()
+    entered.wait(5.0)
+    # slot busy; one waiter fits in the queue (it will time out), the
+    # NEXT is shed immediately
+    waiter_err = []
+
+    def queued():
+        try:
+            with guard.admit():
+                pass
+        except ShedError as e:
+            waiter_err.append(e)
+
+    q = threading.Thread(target=queued, daemon=True)
+    q.start()
+    _wait_until(lambda: guard.queued == 1, msg="waiter queued")
+    with pytest.raises(ShedError, match="at capacity"):
+        guard.admit()
+    assert _counter("serving_shed_total") >= 1
+    q.join(5.0)
+    assert waiter_err, "queued request should shed after wait budget"
+    release.set()
+    t.join(5.0)
+    assert guard.inflight == 0
+    assert _counter("serving_admitted_total") == 1
+
+
+def test_queued_past_own_deadline_is_deadline_not_shed():
+    """A budget blown while queued is DEADLINE (retrying is pointless),
+    not SHED with a retry hint."""
+    guard = ServiceGuard("t", max_concurrency=1, queue_depth=2,
+                         max_queue_wait_s=5.0)
+    release = threading.Event()
+    entered = threading.Event()
+
+    def hold():
+        with guard.admit():
+            entered.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=hold, daemon=True)
+    t.start()
+    entered.wait(5.0)
+    try:
+        with pytest.raises(DeadlineExceeded):
+            guard.admit(Deadline.from_ms(120))
+        assert _counter("serving_deadline_exceeded_total") == 1
+        # and a budget already dead on arrival never even queues
+        d = Deadline.from_ms(1)
+        time.sleep(0.01)
+        with pytest.raises(DeadlineExceeded):
+            guard.admit(d)
+    finally:
+        release.set()
+        t.join(5.0)
+
+
+def test_deadline_budget_and_envelope():
+    d = Deadline.from_request({"deadline_ms": 30}, default_ms=60_000)
+    assert not d.expired()
+    time.sleep(0.05)
+    with pytest.raises(DeadlineExceeded):
+        d.check("op")
+    assert _counter("serving_deadline_exceeded_total") == 1
+    # <= 0 disables; missing key falls back to the server default
+    assert Deadline.from_request({"deadline_ms": 0}, 10).remaining() is None
+    assert Deadline.from_request({}, None).remaining() is None
+    assert Deadline.from_request({}, 1000).remaining() is not None
+
+
+def test_breaker_open_halfopen_closed_lifecycle():
+    b = CircuitBreaker("k", failures=3, cooldown_base=0.05,
+                       cooldown_max=0.1)
+    for _ in range(3):
+        assert b.allow()
+        b.record_failure()
+    assert b.state == OPEN
+    assert not b.allow()
+    assert b.retry_after_ms() >= 0
+    assert get_registry().get("serving_breaker_state").value == OPEN
+    _wait_until(lambda: b.allow(), msg="half-open probe admitted")
+    # exactly one probe: a second concurrent request is still refused
+    assert not b.allow()
+    b.record_success()
+    assert b.state == CLOSED
+    assert get_registry().get("serving_breaker_state").value == CLOSED
+    assert _counter("serving_breaker_transitions_total") >= 3
+
+
+def test_breaker_failed_probe_reopens():
+    b = CircuitBreaker("k", failures=1, cooldown_base=0.04,
+                       cooldown_max=0.08)
+    b.record_failure()
+    assert b.state == OPEN
+    _wait_until(lambda: b.allow(), msg="half-open probe")
+    b.record_failure()  # probe failed
+    assert b.state == OPEN
+
+
+def test_drain_rejects_then_waits_idle():
+    guard = ServiceGuard("t", max_concurrency=2, queue_depth=2)
+    release = threading.Event()
+    entered = threading.Event()
+
+    def hold():
+        with guard.admit():
+            entered.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=hold, daemon=True)
+    t.start()
+    entered.wait(5.0)
+    guard.start_drain()
+    with pytest.raises(DrainingError):
+        guard.admit()
+    assert not guard.wait_idle(0.1)  # in-flight work still running
+    release.set()
+    assert guard.wait_idle(5.0)
+    assert not guard.ready()[0]
+    assert "draining" in guard.ready()[1]
+    assert _counter("serving_drains_total") == 1
+    assert _counter("serving_drain_rejects_total") == 1
+
+
+def test_ready_reports_breaker_and_custom_check():
+    guard = ServiceGuard("t", breaker_failures=1)
+    ok, reasons = guard.ready()
+    assert ok and reasons == []
+    loaded = []
+    guard.add_ready_check("model_loaded", lambda: bool(loaded))
+    assert "model_loaded" in guard.ready()[1]
+    loaded.append(1)
+    assert guard.ready()[0]
+    guard.breaker("m").record_failure()
+    assert any("breaker open" in r for r in guard.ready()[1])
+
+
+# ---------------------------------------------------------------------------
+# frame protocol: cap, CRC, v1 compat, stall
+# ---------------------------------------------------------------------------
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def _npy_bytes(arr):
+    import io as _io
+    buf = _io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return buf.getvalue()
+
+
+def test_v1_frame_still_accepted():
+    tx, rx = _pair()
+    data = _npy_bytes(np.arange(12, dtype=np.float32).reshape(3, 4))
+    tx.sendall(struct.pack(">Q", len(data)) + data)  # v1: no flag, no CRC
+    got = _recv_array(rx)
+    np.testing.assert_array_equal(
+        got, np.arange(12, dtype=np.float32).reshape(3, 4))
+    tx.close(); rx.close()
+
+
+def test_v2_roundtrip_has_crc_and_flag():
+    tx, rx = _pair()
+    arr = np.ones((2, 2), np.float64)
+    _send_array(tx, arr)
+    got = _recv_array(rx)
+    np.testing.assert_array_equal(got, arr)
+    # wire check: flag bit set, CRC trailer present and correct
+    _send_array(tx, arr)
+    raw = b""
+    while len(raw) < 8:
+        raw += rx.recv(8 - len(raw))
+    (word,) = struct.unpack(">Q", raw)
+    assert word >> 63 == 1
+    length = word & ((1 << 63) - 1)
+    payload = b""
+    while len(payload) < length + 4:
+        payload += rx.recv(length + 4 - len(payload))
+    (crc,) = struct.unpack(">I", payload[-4:])
+    assert crc == zlib.crc32(payload[:-4]) & 0xFFFFFFFF
+    tx.close(); rx.close()
+
+
+def test_corrupt_length_header_rejected_not_allocated():
+    """The satellite fix: a corrupt 8-byte header claiming 2^40 bytes
+    must be a clean protocol error, not a multi-GB recv loop."""
+    tx, rx = _pair()
+    tx.sendall(struct.pack(">Q", 1 << 40))  # v1 framing, absurd length
+    with pytest.raises(ProtocolError, match="corrupt or malicious"):
+        _recv_array(rx)
+    assert _counter("streaming_frame_errors_total") == 1
+    tx.close(); rx.close()
+
+
+def test_corrupt_frame_trio_never_yields_garbage():
+    arr = np.linspace(0, 1, 32, dtype=np.float32)
+    # bad length
+    faultinject.set_schedule(FaultSchedule(
+        [Fault("corrupt_frame", at_call=1, mode="length")]))
+    tx, rx = _pair()
+    _send_array(tx, arr)
+    with pytest.raises(ProtocolError, match="corrupt or malicious"):
+        _recv_array(rx)
+    tx.close(); rx.close()
+    # bad CRC
+    faultinject.set_schedule(FaultSchedule(
+        [Fault("corrupt_frame", at_call=1, mode="crc")]))
+    tx, rx = _pair()
+    _send_array(tx, arr)
+    with pytest.raises(ProtocolError, match="CRC-32 mismatch"):
+        _recv_array(rx)
+    tx.close(); rx.close()
+    # truncation (sender dies mid-frame)
+    faultinject.set_schedule(FaultSchedule(
+        [Fault("corrupt_frame", at_call=1, mode="truncate")]))
+    tx, rx = _pair()
+    _send_array(tx, arr)
+    tx.close()
+    with pytest.raises(ProtocolError, match="truncated"):
+        _recv_array(rx)
+    rx.close()
+    assert _counter("streaming_frame_errors_total") == 3
+    assert _counter("resilience_faults_injected_total") == 3
+
+
+def test_oversized_send_refused_at_source():
+    tx, rx = _pair()
+    with pytest.raises(ProtocolError, match="refusing to send"):
+        _send_array(tx, np.zeros(64, np.float32), frame_cap=128)
+    tx.close(); rx.close()
+
+
+def test_stalled_frame_times_out_as_protocol_error():
+    """A frame that starts arriving and stops (slow loris) must not
+    park the receiver forever: the mid-frame clock reclaims it."""
+    tx, rx = _pair()
+    data = _npy_bytes(np.zeros(8, np.float32))
+    tx.sendall(struct.pack(">Q", len(data)) + data[:4])  # ...and stall
+    with pytest.raises(ProtocolError, match="stalled"):
+        _recv_array(rx, io_timeout=0.2)
+    tx.close(); rx.close()
+
+
+def test_dribbled_frame_bounded_by_per_frame_budget():
+    """io_timeout is a PER-FRAME budget, not per-recv: a peer dribbling
+    one byte per window must still be cut off after ~io_timeout."""
+    tx, rx = _pair()
+    data = _npy_bytes(np.zeros(64, np.float32))
+    frame = struct.pack(">Q", len(data)) + data
+    stop = threading.Event()
+
+    def dribble():
+        for i in range(len(frame)):
+            if stop.is_set():
+                return
+            try:
+                tx.sendall(frame[i:i + 1])
+            except OSError:
+                return
+            time.sleep(0.05)  # each byte WITHIN any per-recv window
+
+    t = threading.Thread(target=dribble, daemon=True)
+    t.start()
+    t0 = time.monotonic()
+    with pytest.raises(ProtocolError, match="stalled"):
+        _recv_array(rx, io_timeout=0.3)
+    assert time.monotonic() - t0 < 2.0  # budget, not len(frame)*0.05
+    stop.set()
+    tx.close(); rx.close()
+    t.join(5.0)
+
+
+# ---------------------------------------------------------------------------
+# broker: bounded topics, slow-loris header, drain
+# ---------------------------------------------------------------------------
+
+def test_topic_drop_oldest_bounds_queue():
+    srv = NDArrayServer(max_depth=3)
+    try:
+        pub = NDArrayPublisher(srv.host, srv.port, "t")
+        for k in range(5):
+            pub.publish(np.full((2,), k, np.float32))
+        _wait_until(lambda: _counter("streaming_dropped_total") >= 2,
+                    msg="2 drops counted")
+        sub = NDArrayConsumer(srv.host, srv.port, "t", timeout=5.0)
+        got = [int(sub.get_array()[0]) for _ in range(3)]
+        assert got == [2, 3, 4]  # oldest two evicted, order preserved
+        pub.close(); sub.close()
+    finally:
+        srv.stop()
+
+
+def test_topic_block_policy_honors_deadline():
+    topic = _Topic(max_depth=1, policy="block")
+    assert topic.put(np.zeros(1))
+    t0 = time.monotonic()
+    assert not topic.put(np.ones(1), deadline_s=0.15)
+    assert 0.1 <= time.monotonic() - t0 < 2.0
+    assert _counter("streaming_dropped_total") == 1
+
+
+def test_publisher_reconnects_after_drop():
+    srv = NDArrayServer()
+    try:
+        pub = NDArrayPublisher(srv.host, srv.port, "t",
+                               backoff_base=0.01, backoff_max=0.05)
+        sub = NDArrayConsumer(srv.host, srv.port, "t", timeout=10.0)
+        pub.publish(np.full((2,), 1, np.float32))
+        np.testing.assert_array_equal(sub.get_array(),
+                                      np.full((2,), 1, np.float32))
+        faultinject.set_schedule(FaultSchedule(
+            [Fault("drop_connection", at_call=1, mode="pub")]))
+        pub.publish(np.full((2,), 2, np.float32))  # reconnects inside
+        np.testing.assert_array_equal(sub.get_array(),
+                                      np.full((2,), 2, np.float32))
+        assert _counter("streaming_pub_reconnects_total") >= 1
+        pub.close(); sub.close()
+    finally:
+        srv.stop()
+
+
+def test_broker_slow_loris_header_reclaimed():
+    srv = NDArrayServer(header_timeout=0.2)
+    try:
+        s = socket.create_connection((srv.host, srv.port))
+        s.settimeout(5.0)
+        s.sendall(b"PU")  # ...and never finish the header
+        # the broker must hang up on us, not park a thread forever
+        assert s.recv(1) == b""
+        s.close()
+        # counted as idle/slow-loris, NOT a request deadline (taxonomy
+        # shared with KerasServer: serving_deadline_exceeded_total
+        # means an ADMITTED request's budget ran out)
+        assert _counter("serving_idle_timeouts_total") >= 1
+        assert _counter("streaming_frame_errors_total") >= 1
+    finally:
+        srv.stop()
+
+
+def test_broker_connection_admission_sheds():
+    srv = NDArrayServer(max_connections=1)
+    try:
+        keep = socket.create_connection((srv.host, srv.port))
+        keep.settimeout(5.0)
+        keep.sendall(b"SUB t\n")
+        time.sleep(0.1)  # let the handler claim the only slot
+        extra = socket.create_connection((srv.host, srv.port))
+        extra.settimeout(5.0)
+        extra.sendall(b"SUB t\n")
+        assert extra.recv(1) == b""  # shed: closed without service
+        assert _counter("serving_shed_total") >= 1
+        keep.close(); extra.close()
+    finally:
+        srv.stop()
+
+
+def test_broker_drain_flushes_then_stops():
+    srv = NDArrayServer()
+    pub = NDArrayPublisher(srv.host, srv.port, "t")
+    pub.publish(np.full((2,), 7, np.float32))
+    # no subscriber yet: the array must be QUEUED before drain starts,
+    # so the drain's flush phase is what actually delivers it
+    _wait_until(lambda: sum(len(t) for t in srv._topics.values()) == 1,
+                msg="array queued on the broker")
+    sub = NDArrayConsumer(srv.host, srv.port, "t", timeout=10.0)
+    time.sleep(0.2)  # subscriber handler admitted before drain begins
+    done = {}
+
+    def drain():
+        done["ok"] = srv.drain(grace_s=5.0)
+
+    t = threading.Thread(target=drain, daemon=True)
+    t.start()
+    # the queued array still reaches the subscriber during the grace
+    np.testing.assert_array_equal(sub.get_array(),
+                                  np.full((2,), 7, np.float32))
+    t.join(10.0)
+    assert done.get("ok") is True
+    pub.close(); sub.close()
+
+
+def test_broker_drain_zero_grace_on_empty_broker_is_clean():
+    srv = NDArrayServer()
+    assert srv.drain(grace_s=0.0) is True  # nothing queued: no timeout
+    assert _counter("serving_drain_timeouts_total") == 0
+
+
+# ---------------------------------------------------------------------------
+# keras gateway: burst/shed, deadline, breaker, drain, LRU
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def iris_zip(tmp_path_factory):
+    conf = (NeuralNetConfiguration.builder().updater("adam")
+            .learning_rate(0.05).seed(7).list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    path = tmp_path_factory.mktemp("serving") / "iris.zip"
+    ModelSerializer.write_model(net, str(path))
+    x = tmp_path_factory.mktemp("serving_x") / "x.npy"
+    np.save(x, load_iris().features[:4])
+    return str(path), str(x)
+
+
+def test_keras_health_op_and_envelope(iris_zip):
+    model, x = iris_zip
+    srv = KerasServer()
+    try:
+        cli = KerasClient(srv.host, srv.port)
+        h = cli.health()
+        assert h["live"] and not h["draining"]
+        assert not h["ready"] and "model_loaded" in h["reasons"]
+        cli.predict(x, model=model)
+        h = cli.health()
+        assert h["ready"] and h["reasons"] == []
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_keras_deadline_exceeded_on_hung_backend(iris_zip):
+    model, x = iris_zip
+    srv = KerasServer()
+    try:
+        cli = KerasClient(srv.host, srv.port)
+        cli.predict(x, model=model)  # warm: load + compile
+        faultinject.set_schedule(FaultSchedule(
+            [Fault("hang_backend", at_call=1, duration=0.4)]))
+        with pytest.raises(RuntimeError, match="DEADLINE"):
+            cli.request(op="predict", features=x, model=model,
+                        deadline_ms=100)
+        assert _counter("serving_deadline_exceeded_total") >= 1
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_keras_breaker_lifecycle_over_the_wire(tmp_path, iris_zip):
+    """K consecutive load failures open the model's breaker; requests
+    fail fast while open; once the cause is fixed the half-open probe
+    closes it again."""
+    model, x = iris_zip
+    late = tmp_path / "late.zip"
+    srv = KerasServer(breaker_failures=2, breaker_cooldown_base=0.05,
+                      breaker_cooldown_max=0.1)
+    try:
+        cli = KerasClient(srv.host, srv.port)
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                cli.request(op="predict", features=x, model=str(late))
+        with pytest.raises(RuntimeError, match="BREAKER_OPEN"):
+            cli.request(op="predict", features=x, model=str(late))
+        assert get_registry().get("serving_breaker_state").value == OPEN
+        # fix the backend: now the half-open probe should close it
+        import shutil
+        shutil.copy(model, late)
+
+        def recovered():
+            try:
+                cli.request(op="predict", features=x, model=str(late))
+                return True
+            except RuntimeError:
+                return False
+
+        _wait_until(recovered, msg="breaker recovery")
+        assert get_registry().get("serving_breaker_state").value == CLOSED
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_keras_burst_sheds_breaker_recovers_no_thread_leak(iris_zip):
+    """The acceptance chaos demo: hang_backend + a 50-request burst
+    against queue depth 4 -> structured sheds, breaker opens and later
+    recovers via half-open probe, no handler thread leaks, and the
+    serving_* metrics appear."""
+    model, x = iris_zip
+    n0 = threading.active_count()
+    srv = KerasServer(max_concurrency=1, queue_depth=4,
+                      breaker_failures=3, breaker_cooldown_base=2.0,
+                      breaker_cooldown_max=2.0, io_timeout=30.0,
+                      # hung dispatches (0.5s) must count as slow
+                      # calls; impatient-deadline failures faster than
+                      # this do not open the breaker
+                      breaker_slow_call_s=0.3)
+    try:
+        warm = KerasClient(srv.host, srv.port)
+        warm.predict(x, model=model)  # load + compile outside the storm
+        faultinject.set_schedule(FaultSchedule(
+            [Fault("hang_backend", at_call=k, duration=0.5)
+             for k in (1, 2, 3)] + [Fault("burst", count=50)]))
+        n_burst = faultinject.burst_size()
+        assert n_burst == 50
+        outcomes = []
+        out_lock = threading.Lock()
+
+        def one_request():
+            try:
+                cli = KerasClient(srv.host, srv.port)
+                try:
+                    cli.request(op="predict", features=x, model=model,
+                                deadline_ms=300)
+                    result = "ok"
+                finally:
+                    cli.close()
+            except RuntimeError as e:
+                result = str(e).split(":")[0]
+            except (ConnectionError, OSError):
+                result = "conn"
+            with out_lock:
+                outcomes.append(result)
+
+        threads = [threading.Thread(target=one_request, daemon=True)
+                   for _ in range(n_burst)]
+        for t in threads:
+            t.start()
+            # a burst with a tail, not one instant spike: the three
+            # hung dispatches take ~1.5s to accumulate the breaker's
+            # failure count, and later arrivals must observe the OPEN
+            # state (its cooldown is 1-2s)
+            time.sleep(0.04)
+        for t in threads:
+            t.join(30.0)
+        assert len(outcomes) == n_burst
+        # every outcome is structured: success or a known error code
+        assert set(outcomes) <= {"ok", "SHED", "DEADLINE", "BREAKER_OPEN"}
+        assert _counter("serving_shed_total") > 0
+        assert _counter("serving_deadline_exceeded_total") > 0
+        assert "BREAKER_OPEN" in outcomes  # the breaker opened mid-burst
+        snap = get_registry().snapshot("serving_")
+        for name in ("serving_shed_total",
+                     "serving_deadline_exceeded_total",
+                     "serving_breaker_state"):
+            assert name in snap
+        # recovery: the half-open probe closes the breaker again
+        cli = KerasClient(srv.host, srv.port)
+
+        def recovered():
+            try:
+                cli.request(op="predict", features=x, model=model,
+                            deadline_ms=5000)
+                return True
+            except RuntimeError as e:
+                assert "BREAKER_OPEN" in str(e)
+                return False
+
+        _wait_until(recovered, timeout=10.0, msg="breaker recovery")
+        assert get_registry().get("serving_breaker_state").value == CLOSED
+        cli.close()
+    finally:
+        assert srv.drain(grace_s=5.0)
+    _wait_until(lambda: threading.active_count() <= n0 + 2,
+                timeout=10.0, msg="handler threads reclaimed")
+
+
+def test_impatient_client_deadline_does_not_open_breaker(iris_zip):
+    """A blown CLIENT budget on a fast backend is the client's problem:
+    with the default slow-call threshold (30s), sub-second dispatches
+    that merely outran a tiny deadline_ms never open the shared
+    breaker for everyone else."""
+    model, x = iris_zip
+    srv = KerasServer(breaker_failures=1)  # hair trigger
+    try:
+        cli = KerasClient(srv.host, srv.port)
+        cli.predict(x, model=model)  # warm
+        faultinject.set_schedule(FaultSchedule(
+            [Fault("hang_backend", at_call=1, duration=0.2)]))
+        with pytest.raises(RuntimeError, match="DEADLINE"):
+            cli.request(op="predict", features=x, model=model,
+                        deadline_ms=50)
+        # breaker untouched: the very next request is served, not
+        # BREAKER_OPEN (which breaker_failures=1 would otherwise give)
+        assert cli.predict(x, model=model).shape == (4, 3)
+        assert get_registry().get("serving_breaker_state").value == CLOSED
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_broker_dead_reader_subscriber_releases_slot():
+    """A subscriber that connects and never reads must not park its
+    handler in sendall forever: the send-side io_timeout requeues the
+    in-flight array at the HEAD and frees the admission slot."""
+    srv = NDArrayServer(max_connections=2, io_timeout=0.3)
+    try:
+        bad = socket.create_connection((srv.host, srv.port))
+        bad.sendall(b"SUB t\n")  # ...and never read a byte
+        pub = NDArrayPublisher(srv.host, srv.port, "t")
+        big = np.arange(2 << 20, dtype=np.float64)  # 16 MiB > buffers
+        pub.publish(big)
+        pub.close()  # frees pub's slot; bad SUB still holds one
+        # once the dead reader's sendall times out, its slot frees and
+        # a real consumer can connect (admission cap is 2) and must
+        # receive the requeued array IN FULL
+        _wait_until(lambda: srv._guard.inflight <= 0, timeout=10.0,
+                    msg="dead-reader handler reclaimed")
+        sub = NDArrayConsumer(srv.host, srv.port, "t", timeout=10.0,
+                              io_timeout=10.0)
+        np.testing.assert_array_equal(sub.get_array(), big)
+        sub.close(); bad.close()
+    finally:
+        srv.stop()
+
+
+def test_keras_drain_finishes_inflight_rejects_new(iris_zip):
+    model, x = iris_zip
+    srv = KerasServer()
+    try:
+        cli = KerasClient(srv.host, srv.port)
+        cli.predict(x, model=model)  # warm
+        faultinject.set_schedule(FaultSchedule(
+            [Fault("hang_backend", at_call=1, duration=0.6)]))
+        slow = {}
+
+        def slow_predict():
+            c = KerasClient(srv.host, srv.port)
+            slow["resp"] = c.request(op="predict", features=x,
+                                     model=model)
+            c.close()
+
+        t = threading.Thread(target=slow_predict, daemon=True)
+        t.start()
+        _wait_until(lambda: srv._guard.inflight == 1,
+                    msg="slow predict admitted")
+        drained = {}
+        d = threading.Thread(
+            target=lambda: drained.update(ok=srv.drain(grace_s=5.0)),
+            daemon=True)
+        d.start()
+        _wait_until(lambda: srv.draining, msg="drain mode")
+        with pytest.raises(RuntimeError, match="DRAINING"):
+            cli.request(op="predict", features=x, model=model)
+        t.join(10.0)
+        d.join(10.0)
+        assert slow["resp"]["ok"]  # in-flight work finished during grace
+        assert drained["ok"] is True
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_keras_model_cache_lru_and_per_model_lock(tmp_path, iris_zip):
+    model, x = iris_zip
+    import shutil
+    paths = []
+    for i in range(3):
+        p = tmp_path / f"m{i}.zip"
+        shutil.copy(model, p)
+        paths.append(str(p))
+    srv = KerasServer(keep_models=2)
+    try:
+        cli = KerasClient(srv.host, srv.port)
+        for p in paths:
+            cli.predict(x, model=p)
+        assert len(srv._models) <= 2
+        assert _counter("serving_models_evicted_total") >= 1
+        # an evicted model transparently reloads
+        preds = cli.predict(x, model=paths[0])
+        assert preds.shape == (4, 3)
+        # per-model lock identity: same path -> same lock, distinct
+        # paths -> distinct locks (fit/predict on one model serialize)
+        _, l0a = srv._get_model(paths[0])
+        _, l0b = srv._get_model(paths[0])
+        _, l1 = srv._get_model(paths[1])
+        assert l0a is l0b and l0a is not l1
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_keras_slow_loris_client_reclaimed():
+    srv = KerasServer(io_timeout=0.3)
+    try:
+        s = socket.create_connection((srv.host, srv.port))
+        s.settimeout(5.0)
+        s.sendall(b'{"op": "pre')  # dribble and stall
+        assert s.recv(1) == b""  # server hung up
+        # counted as an idle/slow-loris timeout, NOT a deadline budget
+        # (no admitted request's deadline ran out)
+        assert _counter("serving_idle_timeouts_total") >= 1
+        assert _counter("serving_deadline_exceeded_total") == 0
+        s.close()
+    finally:
+        srv.stop()
+
+
+def test_keras_nonfinite_prediction_refused(iris_zip, tmp_path):
+    model, _ = iris_zip
+    x = tmp_path / "nan_x.npy"
+    np.save(x, np.full((2, 4), np.nan, np.float32))
+    srv = KerasServer()
+    try:
+        cli = KerasClient(srv.host, srv.port)
+        with pytest.raises(RuntimeError, match="NONFINITE"):
+            cli.request(op="predict", features=str(x), model=model)
+        assert _counter("serving_nonfinite_outputs_total") == 1
+        cli.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# ui server: /healthz, /readyz
+# ---------------------------------------------------------------------------
+
+def _http_get(port, path):
+    import urllib.request
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except Exception as e:
+        from urllib.error import HTTPError
+        if isinstance(e, HTTPError):
+            return e.code, json.loads(e.read() or b"{}")
+        raise
+
+
+def test_ui_healthz_readyz_flip_on_drain(iris_zip):
+    from deeplearning4j_tpu.ui.server import UIServer
+    model, x = iris_zip
+    ui = UIServer(port=0).start()
+    srv = KerasServer()
+    try:
+        assert _http_get(ui.port, "/healthz") == (200, {"live": True})
+        code, body = _http_get(ui.port, "/readyz")
+        assert code == 503  # keras guard registered, no model loaded
+        kname = srv._guard.name
+        assert "model_loaded" in body["guards"][kname]["reasons"]
+        cli = KerasClient(srv.host, srv.port)
+        cli.predict(x, model=model)
+        code, body = _http_get(ui.port, "/readyz")
+        assert code == 200 and body["ready"]
+        srv._guard.start_drain()
+        code, body = _http_get(ui.port, "/readyz")
+        assert code == 503
+        assert "draining" in body["guards"][kname]["reasons"]
+        cli.close()
+    finally:
+        srv.stop()
+        ui.stop()
+
+
+def test_ui_probes_bypass_auth_but_api_does_not():
+    from deeplearning4j_tpu.ui.server import UIServer
+    ui = UIServer(port=0, auth_token="sekrit").start()
+    try:
+        assert _http_get(ui.port, "/healthz")[0] == 200
+        assert _http_get(ui.port, "/readyz")[0] in (200, 503)
+        assert _http_get(ui.port, "/api/sessions")[0] == 401
+    finally:
+        ui.stop()

@@ -26,6 +26,9 @@ if [ "${1:-}" != "--fast" ]; then
     echo "== chaos smoke (NaN injection under skip_batch + resume) =="
     JAX_PLATFORMS=cpu python tools/chaos_smoke.py || fail=1
 
+    echo "== serve smoke (burst shed + /readyz drain flip + clean drain) =="
+    JAX_PLATFORMS=cpu python tools/serve_smoke.py || fail=1
+
     echo "== tier-1 tests (ROADMAP.md) =="
     rm -f /tmp/_t1.log
     timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

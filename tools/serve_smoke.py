@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Serving smoke stage (tools/run_checks.sh): a burst of concurrent
+predicts against a tiny model behind the PR 4 service-hardening kit
+must (1) resolve every request as either a prediction or a structured
+shed/deadline/breaker error — zero crashes, zero garbage; (2) actually
+shed under pressure (``serving_shed_total`` > 0); (3) flip the UI
+server's ``/readyz`` to 503 while the gateway drains; (4) finish the
+drain cleanly with in-flight work completed and handler threads
+reclaimed. Exit 0 = the serving edge's hardening is wired end to end.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from urllib.error import HTTPError
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _readyz(port):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/readyz", timeout=5) as r:
+            return r.status, json.loads(r.read())
+    except HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def main() -> int:
+    import numpy as np
+
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.datasets.iris import load_iris
+    from deeplearning4j_tpu.keras.server import KerasClient, KerasServer
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.profiling.metrics import (MetricsRegistry,
+                                                      set_registry)
+    from deeplearning4j_tpu.resilience import faultinject
+    from deeplearning4j_tpu.resilience.faultinject import (Fault,
+                                                           FaultSchedule)
+    from deeplearning4j_tpu.ui.server import UIServer
+    from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+    registry = MetricsRegistry()
+    prev = set_registry(registry)
+    n0 = threading.active_count()
+    try:
+        conf = (NeuralNetConfiguration.builder().updater("adam")
+                .learning_rate(0.05).seed(7).list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        with tempfile.TemporaryDirectory() as d:
+            model = os.path.join(d, "iris.zip")
+            ModelSerializer.write_model(net, model)
+            x = os.path.join(d, "x.npy")
+            np.save(x, load_iris().features[:4])
+
+            ui = UIServer(port=0).start()
+            srv = KerasServer(max_concurrency=1, queue_depth=2,
+                              default_deadline_ms=5000)
+            warm = KerasClient(srv.host, srv.port)
+            warm.predict(x, model=model)  # load + compile
+            code, _ = _readyz(ui.port)
+            if code != 200:
+                print(f"serve_smoke: FAIL /readyz {code} before burst")
+                return 1
+
+            # burst: 16 concurrent predicts, two dispatches hung by the
+            # chaos harness so the queue (depth 2) backs up and sheds
+            faultinject.set_schedule(FaultSchedule(
+                [Fault("hang_backend", at_call=k, duration=0.3)
+                 for k in (1, 2)] + [Fault("burst", count=16)]))
+            n_burst = faultinject.burst_size()
+            outcomes, lock = [], threading.Lock()
+
+            def one():
+                try:
+                    c = KerasClient(srv.host, srv.port)
+                    try:
+                        c.request(op="predict", features=x, model=model,
+                                  deadline_ms=400)
+                        r = "ok"
+                    finally:
+                        c.close()
+                except RuntimeError as e:
+                    r = str(e).split(":")[0]
+                except Exception as e:  # a crash, not a structured shed
+                    r = f"CRASH({type(e).__name__})"
+                with lock:
+                    outcomes.append(r)
+
+            threads = [threading.Thread(target=one, daemon=True)
+                       for _ in range(n_burst)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(30.0)
+            faultinject.clear()
+
+            allowed = {"ok", "SHED", "DEADLINE", "BREAKER_OPEN"}
+            bad = [r for r in outcomes if r not in allowed]
+            if bad or len(outcomes) != n_burst:
+                print(f"serve_smoke: FAIL outcomes {outcomes}")
+                return 1
+            shed = registry.snapshot("serving_").get(
+                "serving_shed_total", 0)
+            if shed < 1:
+                print(f"serve_smoke: FAIL no shedding under burst "
+                      f"(outcomes {outcomes})")
+                return 1
+
+            # drain with one request in flight: /readyz must flip to
+            # 503 while it runs, and the in-flight predict must finish
+            faultinject.set_schedule(FaultSchedule(
+                [Fault("hang_backend", at_call=1, duration=0.5)]))
+            slow = {}
+
+            def slow_predict():
+                c = KerasClient(srv.host, srv.port)
+                slow["resp"] = c.request(op="predict", features=x,
+                                         model=model)
+                c.close()
+
+            t = threading.Thread(target=slow_predict, daemon=True)
+            t.start()
+            t_end = time.monotonic() + 5.0
+            while srv._guard.inflight == 0:
+                if time.monotonic() > t_end:
+                    print("serve_smoke: FAIL slow predict never admitted")
+                    return 1
+                time.sleep(0.01)
+            drained = {}
+            dt = threading.Thread(
+                target=lambda: drained.update(ok=srv.drain(grace_s=5.0)),
+                daemon=True)
+            dt.start()
+            while not srv.draining:
+                time.sleep(0.01)
+            code, body = _readyz(ui.port)
+            if code != 503:
+                print(f"serve_smoke: FAIL /readyz {code} during drain "
+                      f"({body})")
+                return 1
+            t.join(10.0)
+            dt.join(10.0)
+            faultinject.clear()
+            if not slow.get("resp", {}).get("ok"):
+                print(f"serve_smoke: FAIL in-flight predict lost in "
+                      f"drain ({slow})")
+                return 1
+            if drained.get("ok") is not True:
+                print("serve_smoke: FAIL drain grace expired with work "
+                      "in flight")
+                return 1
+            warm.close()
+            ui.stop()
+            t_end = time.monotonic() + 10.0
+            while threading.active_count() > n0 + 2:
+                if time.monotonic() > t_end:
+                    print(f"serve_smoke: FAIL thread leak "
+                          f"({threading.active_count()} vs {n0})")
+                    return 1
+                time.sleep(0.05)
+        n_ok = sum(1 for r in outcomes if r == "ok")
+        print(f"serve_smoke: OK — burst of {n_burst}: {n_ok} served, "
+              f"{int(shed)} shed, zero crashes; /readyz flipped during "
+              f"drain; in-flight work finished; threads reclaimed")
+        return 0
+    finally:
+        set_registry(prev)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
