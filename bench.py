@@ -619,6 +619,25 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
             _stamp("cost analysis FAILED (headline number stands):\n"
                    + traceback.format_exc(limit=10))
 
+    # Weight-update layout cost (ISSUE 5): analytic per-update comm
+    # bytes + per-chip updater-state HBM at this device count, for the
+    # layout under test (BENCH_WUS=off|zero1, BENCH_ACCUM=k) — the
+    # fields a real-TPU ladder compares against the replicated baseline
+    # to attribute an MFU delta to ZeRO-1 weight-update sharding.
+    wus_mode = os.environ.get("BENCH_WUS", "off")
+    comm_bytes = updater_hbm = None
+    try:
+        from deeplearning4j_tpu.profiling.cost import weight_update_cost
+        wuc = weight_update_cost(
+            net, dp=jax.device_count(),
+            gradient_accumulation=int(os.environ.get("BENCH_ACCUM", "1")),
+            weight_update_sharding=wus_mode)
+        comm_bytes = wuc["comm_bytes_per_step"]
+        updater_hbm = wuc["updater_hbm_bytes"]
+    except Exception:  # noqa: BLE001 — telemetry must never cost it
+        _stamp("weight-update cost model FAILED (headline stands):\n"
+               + traceback.format_exc(limit=10))
+
     # MFU estimate: analytic fwd FLOPs x3 (fwd+bwd) over chip peak.
     # ResNet-50 @224 fwd ~= 4.09e9 FLOPs/image, scaled by area; LeNet is
     # too small for a meaningful MFU.
@@ -659,6 +678,9 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
         "flops_per_step": flops_per_step,
         "bytes_accessed_per_step": bytes_accessed,
         "analytic_mfu": analytic,
+        "weight_update_sharding": wus_mode,
+        "comm_bytes_per_step": comm_bytes,
+        "updater_hbm_bytes": updater_hbm,
         "phase_breakdown_s_per_step": phase_breakdown,
         "pallas_lstm_parity": parity,
     }

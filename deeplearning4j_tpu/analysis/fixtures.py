@@ -104,12 +104,49 @@ def bad_pp_unbalanced():
     return conf, {"mesh": {"pp": 4}, "batch_size": 32}
 
 
+def bad_zero1_no_dp():
+    """zero1 weight-update sharding over a mesh with a single data
+    replica: nothing to shard — the trainers reject this at
+    construction and graphcheck must reject it statically."""
+    conf, _ = good_mlp()
+    return conf, {"mesh": {"dp": 1}, "batch_size": 32,
+                  "weight_update_sharding": "zero1"}
+
+
+def bad_zero1_tp():
+    """zero1 over a tensor-parallel mesh: model-sharded kernels already
+    distribute their updater state — the trainers raise, and graphcheck
+    must reject the combination statically too."""
+    conf, _ = good_mlp()
+    return conf, {"mesh": {"dp": 2, "model": 4}, "batch_size": 32,
+                  "weight_update_sharding": "zero1"}
+
+
+def bad_zero1_padding():
+    """Tiny odd-sized layers over a wide dp axis: pad-to-divisible
+    flattened-leaf padding dominates the sharded updater state (every
+    (5,)/(4,3)-ish leaf rounds up to a multiple of 8)."""
+    conf = (NeuralNetConfiguration.builder()
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=3, activation="relu"))
+            .layer(DenseLayer(n_out=5, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return conf, {"mesh": {"dp": 8}, "batch_size": 32,
+                  "weight_update_sharding": "zero1"}
+
+
 KNOWN_BAD: List[Tuple[str, str, Callable]] = [
     ("shape-mismatch", "GC005", bad_shape_mismatch),
     ("graph-cycle", "GC002", bad_graph_cycle),
     ("dangling-vertex", "GC003", bad_dangling_vertex),
     ("dp-indivisible-batch", "GC008", bad_dp_indivisible),
     ("unbalanced-pp-split", "GC009", bad_pp_unbalanced),
+    ("zero1-without-dp", "GC011", bad_zero1_no_dp),
+    ("zero1-over-tp-mesh", "GC011", bad_zero1_tp),
+    ("zero1-padding-waste", "GC011", bad_zero1_padding),
 ]
 
 
@@ -182,9 +219,18 @@ def good_graph_merge():
     return conf, {"mesh": {"dp": 4}, "batch_size": 32}
 
 
+def good_mlp_zero1():
+    """The MLP under zero1 weight-update sharding on a healthy dp=8
+    mesh: large layers, negligible padding — must validate clean."""
+    conf, _ = good_mlp()
+    return conf, {"mesh": {"dp": 8}, "batch_size": 64,
+                  "weight_update_sharding": "zero1"}
+
+
 KNOWN_GOOD: List[Tuple[str, Callable]] = [
     ("mlp", good_mlp),
     ("cnn", good_cnn),
     ("rnn", good_rnn),
     ("graph-merge", good_graph_merge),
+    ("mlp-zero1", good_mlp_zero1),
 ]

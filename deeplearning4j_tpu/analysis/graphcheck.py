@@ -26,6 +26,11 @@ Rules (stable ids; severities in parentheses):
                                     skewed, or more pp stages than layers
 - GC010 ep-mismatch       (error)   MoE expert count not divisible by the
                                     expert-parallel mesh axis
+- GC011 zero1-mesh        (error)   zero1 weight-update sharding with no
+                                    data-parallel axis or dp < 2 (nothing
+                                    to shard); (warning) pad-to-divisible
+                                    flattened-leaf padding wastes > 5% of
+                                    the updater-state footprint
 - GC012 vertex-arity      (error)   vertex input count != n_inputs()
 
 Entry points: ``check_multilayer`` / ``check_graph`` /
@@ -195,6 +200,77 @@ def _walk_multilayer_shapes(conf, findings: List[Finding]
 # mesh-legality checks (shared by both config kinds)
 # ---------------------------------------------------------------------------
 
+#: flattened-leaf padding above this fraction of the updater state is a
+#: GC011 warning (tiny odd-sized leaves over a wide dp axis)
+ZERO1_PADDING_WASTE = 0.05
+
+
+def _wus_mode(weight_update_sharding) -> str:
+    """Normalize a weight_update_sharding spec (None / str /
+    parallel.mesh.WeightUpdateSharding) to its mode string without
+    importing the jax-heavy parallel layer."""
+    if weight_update_sharding is None:
+        return "off"
+    return str(getattr(weight_update_sharding, "mode",
+                       weight_update_sharding)).lower()
+
+
+def _check_zero1(findings: List[Finding],
+                 all_layers: List[Tuple[str, object]],
+                 axes: Dict[str, int],
+                 weight_update_sharding) -> None:
+    """GC011: zero1 weight-update sharding legality — needs dp >= 2, and
+    pad-to-divisible flattened leaves should not waste a meaningful
+    fraction of the sharded updater state."""
+    if _wus_mode(weight_update_sharding) != "zero1":
+        return
+    dp = _dp_size(axes)
+    if not dp or dp < 2:
+        findings.append(Finding(
+            "GC011", Severity.ERROR,
+            f"dp={dp if dp else '<none>'}",
+            "weight_update_sharding=zero1 needs a data-parallel axis of "
+            "at least 2 — with a single replica there is no shard to "
+            "keep and the trainers reject the config at construction",
+            "grow the dp axis to >= 2 or drop to "
+            "weight_update_sharding='off'"))
+        return
+    tp = axes.get("model") or axes.get("tp")
+    if tp and tp > 1:
+        findings.append(Finding(
+            "GC011", Severity.ERROR, f"model={tp}",
+            "weight_update_sharding=zero1 composes with pure data "
+            "parallelism only — this mesh tensor-shards params over "
+            f"'model' ({tp} ways), whose updater state is already "
+            "distributed; the trainers reject the combination at "
+            "construction",
+            "drop the model axis or use weight_update_sharding='off'"))
+        return
+    from math import prod
+
+    from deeplearning4j_tpu.analysis.memory import param_shapes
+    sizes: List[int] = []
+    for label, layer in all_layers:
+        try:
+            shapes = param_shapes(layer)
+        except Exception:
+            continue  # inference failure already reported as GC005
+        sizes.extend(int(prod(s)) if s else 1 for s in shapes.values())
+    total = sum(sizes)
+    if total <= 0:
+        return
+    padded = sum(-(-s // dp) * dp for s in sizes)
+    waste = (padded - total) / total
+    if waste > ZERO1_PADDING_WASTE:
+        findings.append(Finding(
+            "GC011", Severity.WARNING, f"dp={dp}",
+            f"zero1 flattened-leaf padding wastes {waste:.0%} of the "
+            f"updater state ({padded - total:,} of {total:,} elements "
+            f"are pad-to-divisible filler over the {dp}-way axis)",
+            "shrink the dp axis, widen the model's small layers, or "
+            "accept the overhead (it is per-leaf <= dp-1 elements)"))
+
+
 def _check_mesh(findings: List[Finding], body_layers: List[Tuple[str, object]],
                 mesh, batch_size: Optional[int],
                 counts: Optional[List[int]] = None) -> None:
@@ -279,14 +355,19 @@ def _optimal_max_stage(costs: List[int], n_stages: int) -> int:
                    if best[i] != INF))
 
 
-def _build_report(conf, batch_size: Optional[int], walk=None):
+def _build_report(conf, batch_size: Optional[int], walk=None,
+                  weight_update_sharding=None, mesh=None):
     """One MemoryReport per validation pass — _check_mesh reuses its
     param counts and _check_hbm its totals. ``walk`` hands over the
     (name, layer, out_type) triples the checker already inferred so the
     report never re-runs the shape walk."""
     from deeplearning4j_tpu.analysis.memory import memory_report
+    dp = _dp_size(_mesh_axes(mesh)) or 1
     try:
-        return memory_report(conf, batch_size=batch_size or 32, layers=walk)
+        return memory_report(
+            conf, batch_size=batch_size or 32, layers=walk,
+            weight_update_sharding=_wus_mode(weight_update_sharding),
+            dp=dp)
     except Exception:
         return None  # inference failures already reported as GC005
 
@@ -310,7 +391,8 @@ def _check_hbm(findings: List[Finding], rep, batch_size: Optional[int],
 # ---------------------------------------------------------------------------
 
 def check_multilayer(conf, *, mesh=None, batch_size: Optional[int] = None,
-                     hbm_bytes: Optional[int] = None) -> List[Finding]:
+                     hbm_bytes: Optional[int] = None,
+                     weight_update_sharding=None) -> List[Finding]:
     """Validate a MultiLayerConfiguration. Pure CPU metadata walk — no
     arrays are built."""
     from deeplearning4j_tpu.analysis.memory import DEFAULT_HBM_BYTES
@@ -350,12 +432,16 @@ def check_multilayer(conf, *, mesh=None, batch_size: Optional[int] = None,
     body = [(_layer_label(i, l), l) for i, l in enumerate(conf.layers[:-1])]
     walk = [(_layer_label(i, l), l, out_types[i])
             for i, l in enumerate(conf.layers)]
-    rep = (_build_report(conf, batch_size, walk)
+    rep = (_build_report(conf, batch_size, walk,
+                         weight_update_sharding=weight_update_sharding,
+                         mesh=mesh)
            if mesh is not None or batch_size is not None else None)
     counts = ([e.n_params for e in rep.entries[:-1]]
               if rep is not None and len(rep.entries) == len(conf.layers)
               else None)
     _check_mesh(findings, body, mesh, batch_size, counts=counts)
+    _check_zero1(findings, [(lbl, l) for lbl, l, _ in walk],
+                 _mesh_axes(mesh), weight_update_sharding)
     _check_hbm(findings, rep, batch_size, hbm_bytes or DEFAULT_HBM_BYTES)
     return findings
 
@@ -476,7 +562,8 @@ def _walk_graph_shapes(conf, order: List[str],
 
 
 def check_graph(conf, *, mesh=None, batch_size: Optional[int] = None,
-                hbm_bytes: Optional[int] = None) -> List[Finding]:
+                hbm_bytes: Optional[int] = None,
+                weight_update_sharding=None) -> List[Finding]:
     """Validate a ComputationGraphConfiguration — including configs the
     builder itself would refuse to construct (cycles, dangling refs),
     which is why this walk never calls ``_resolve_shapes``."""
@@ -562,7 +649,9 @@ def check_graph(conf, *, mesh=None, batch_size: Optional[int] = None,
             if nodes[n].kind == "layer" and n not in heads]
     walk = [(n, nodes[n].layer, types.get(n)) for n in order
             if nodes[n].kind == "layer"]
-    rep = (_build_report(conf, batch_size, walk)
+    rep = (_build_report(conf, batch_size, walk,
+                         weight_update_sharding=weight_update_sharding,
+                         mesh=mesh)
            if mesh is not None or batch_size is not None else None)
     counts = None
     if rep is not None:
@@ -570,6 +659,8 @@ def check_graph(conf, *, mesh=None, batch_size: Optional[int] = None,
         if all(n in by_name for n, _ in body):
             counts = [by_name[n] for n, _ in body]
     _check_mesh(findings, body, mesh, batch_size, counts=counts)
+    _check_zero1(findings, [(lbl, l) for lbl, l, _ in walk],
+                 _mesh_axes(mesh), weight_update_sharding)
     if not any(f.severity == Severity.ERROR for f in findings):
         _check_hbm(findings, rep, batch_size,
                    hbm_bytes or DEFAULT_HBM_BYTES)
@@ -581,13 +672,16 @@ def check_graph(conf, *, mesh=None, batch_size: Optional[int] = None,
 # ---------------------------------------------------------------------------
 
 def validate_config(conf, *, mesh=None, batch_size: Optional[int] = None,
-                    hbm_bytes: Optional[int] = None) -> List[Finding]:
+                    hbm_bytes: Optional[int] = None,
+                    weight_update_sharding=None) -> List[Finding]:
     """Dispatch on configuration type."""
     if hasattr(conf, "nodes"):
         return check_graph(conf, mesh=mesh, batch_size=batch_size,
-                           hbm_bytes=hbm_bytes)
+                           hbm_bytes=hbm_bytes,
+                           weight_update_sharding=weight_update_sharding)
     return check_multilayer(conf, mesh=mesh, batch_size=batch_size,
-                            hbm_bytes=hbm_bytes)
+                            hbm_bytes=hbm_bytes,
+                            weight_update_sharding=weight_update_sharding)
 
 
 def iter_config_layers(conf) -> Iterator[Tuple[str, object,

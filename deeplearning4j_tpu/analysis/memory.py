@@ -86,12 +86,21 @@ class LayerMemoryEntry:
 @dataclass
 class MemoryReport:
     """Aggregated estimate. ``to_text()`` renders the per-layer table plus
-    the standing/working HBM split."""
+    the standing/working HBM split.
+
+    ``weight_update_sharding="zero1"`` + ``dp``: the updater-state term
+    models the ZeRO-1 layout of the parallel trainers — each replica
+    holds ``replicated / dp`` of the optax state (flattened pad-to-
+    divisible shards; the <= dp-elements-per-leaf padding is below this
+    estimate's resolution and graphcheck flags pathological waste
+    separately)."""
     entries: List[LayerMemoryEntry] = field(default_factory=list)
     batch_size: int = 32
     dtype: str = "float32"
     updater: str = "sgd"
     remat: bool = False
+    weight_update_sharding: str = "off"
+    dp: int = 1
 
     # ------------------------------------------------------------ aggregates
     @property
@@ -103,9 +112,16 @@ class MemoryReport:
         return self.total_params * _dtype_bytes(self.dtype)
 
     @property
+    def updater_state_shards(self) -> int:
+        """How many ways the updater state is split (1 = replicated)."""
+        if self.weight_update_sharding == "zero1" and self.dp > 1:
+            return self.dp
+        return 1
+
+    @property
     def updater_state_bytes(self) -> int:
         slots = UPDATER_STATE_SLOTS.get(self.updater, 2)
-        return self.param_bytes * slots
+        return -(-self.param_bytes * slots // self.updater_state_shards)
 
     @property
     def gradient_bytes(self) -> int:
@@ -161,7 +177,9 @@ class MemoryReport:
             f"  params:              {mb(self.param_bytes)}",
             f"  gradients:           {mb(self.gradient_bytes)}",
             f"  updater state:       {mb(self.updater_state_bytes)} "
-            f"({UPDATER_STATE_SLOTS.get(self.updater, 2)} slot(s))",
+            f"({UPDATER_STATE_SLOTS.get(self.updater, 2)} slot(s)"
+            + (f", zero1: 1/{self.updater_state_shards} per replica"
+               if self.updater_state_shards > 1 else "") + ")",
             f"  activations:         {mb(self.activation_bytes)}"
             + (" (remat: boundary pair only)" if self.remat else ""),
             f"  est. HBM (train):    {mb(self.total_hbm_bytes)}",
@@ -171,18 +189,23 @@ class MemoryReport:
         return "\n".join(lines)
 
 
-def memory_report(conf, batch_size: int = 32, layers=None) -> MemoryReport:
+def memory_report(conf, batch_size: int = 32, layers=None,
+                  weight_update_sharding: str = "off",
+                  dp: int = 1) -> MemoryReport:
     """Build a MemoryReport for either configuration type. Requires a
     shape-resolved config (input types set); layers whose params cannot be
     abstract-evaluated contribute zero (graphcheck flags those
     separately). ``layers``: optional pre-inferred (name, layer_conf,
     out_type) triples from a validation pass already in flight — avoids
-    re-walking shapes."""
+    re-walking shapes. ``weight_update_sharding``/``dp``: model the
+    ZeRO-1 updater-state layout (see :class:`MemoryReport`)."""
     from deeplearning4j_tpu.analysis.graphcheck import iter_config_layers
     training = conf.training
     rep = MemoryReport(batch_size=batch_size, dtype=training.dtype,
                        updater=training.updater.name,
-                       remat=getattr(training, "remat", False))
+                       remat=getattr(training, "remat", False),
+                       weight_update_sharding=weight_update_sharding,
+                       dp=max(1, int(dp)))
     for name, layer, out_type in (layers if layers is not None
                                   else iter_config_layers(conf)):
         try:

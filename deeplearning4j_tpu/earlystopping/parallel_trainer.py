@@ -46,9 +46,11 @@ class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
                  train_data: DataSetIterator,
                  mesh: Optional[MeshContext] = None,
                  gradient_accumulation: int = 1,
-                 collect_training_stats: bool = False):
+                 collect_training_stats: bool = False,
+                 weight_update_sharding=None):
         trainer = ParallelTrainer(
             net, mesh, gradient_accumulation=gradient_accumulation,
-            collect_training_stats=collect_training_stats)
+            collect_training_stats=collect_training_stats,
+            weight_update_sharding=weight_update_sharding)
         super().__init__(config, _ParallelNetAdapter(trainer), train_data)
         self.trainer = trainer

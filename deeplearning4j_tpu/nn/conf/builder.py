@@ -180,14 +180,17 @@ class MultiLayerConfiguration:
 
     # ------------------------------------------------------- static analysis
     def validate(self, mesh=None, batch_size: Optional[int] = None,
-                 hbm_bytes: Optional[int] = None):
+                 hbm_bytes: Optional[int] = None,
+                 weight_update_sharding=None):
         """Run graphcheck over this config: shape/dtype walk, loss-head
-        and mesh-legality checks, HBM estimate. Returns a list of
-        ``analysis.Finding`` — empty when the config is clean. Pure
-        metadata; no arrays are built."""
+        and mesh-legality checks (incl. zero1 weight-update-sharding
+        legality), HBM estimate. Returns a list of ``analysis.Finding``
+        — empty when the config is clean. Pure metadata; no arrays are
+        built."""
         from deeplearning4j_tpu.analysis.graphcheck import check_multilayer
-        return check_multilayer(self, mesh=mesh, batch_size=batch_size,
-                                hbm_bytes=hbm_bytes)
+        return check_multilayer(
+            self, mesh=mesh, batch_size=batch_size, hbm_bytes=hbm_bytes,
+            weight_update_sharding=weight_update_sharding)
 
     def memory_report(self, batch_size: int = 32):
         """Parameter-count + HBM/VMEM estimate (``MemoryReport``
@@ -264,7 +267,8 @@ class ListBuilder:
         self._parent._training.pretrain = flag
         return self
 
-    def validate(self, mesh=None, batch_size: Optional[int] = None):
+    def validate(self, mesh=None, batch_size: Optional[int] = None,
+                 weight_update_sharding=None):
         """graphcheck without build(): collect findings even for stacks
         ``build()`` would throw on (its throw becomes a finding). Builds
         a deep COPY — build() materializes the current global defaults
@@ -277,7 +281,8 @@ class ListBuilder:
             return [Finding("GC005", Severity.ERROR, "<build>", str(e),
                             "fix the configuration; build() rejects it "
                             "outright")]
-        return conf.validate(mesh=mesh, batch_size=batch_size)
+        return conf.validate(mesh=mesh, batch_size=batch_size,
+                             weight_update_sharding=weight_update_sharding)
 
     def build(self) -> MultiLayerConfiguration:
         g = self._parent._global

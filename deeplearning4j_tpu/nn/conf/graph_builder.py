@@ -100,14 +100,17 @@ class ComputationGraphConfiguration:
 
     # ------------------------------------------------------- static analysis
     def validate(self, mesh=None, batch_size: Optional[int] = None,
-                 hbm_bytes: Optional[int] = None):
+                 hbm_bytes: Optional[int] = None,
+                 weight_update_sharding=None):
         """Run graphcheck over this DAG: cycle/dangling/dead-vertex
-        detection, shape walk, loss-head and mesh-legality checks.
-        Returns a list of ``analysis.Finding``; never raises on broken
-        graphs (unlike ``_resolve_shapes``)."""
+        detection, shape walk, loss-head and mesh-legality checks (incl.
+        zero1 weight-update-sharding legality). Returns a list of
+        ``analysis.Finding``; never raises on broken graphs (unlike
+        ``_resolve_shapes``)."""
         from deeplearning4j_tpu.analysis.graphcheck import check_graph
         return check_graph(self, mesh=mesh, batch_size=batch_size,
-                           hbm_bytes=hbm_bytes)
+                           hbm_bytes=hbm_bytes,
+                           weight_update_sharding=weight_update_sharding)
 
     def memory_report(self, batch_size: int = 32):
         """Parameter-count + HBM/VMEM estimate (``MemoryReport``
@@ -233,7 +236,8 @@ class GraphBuilder:
         self._parent._training.tbptt_bwd_length = bwd
         return self
 
-    def validate(self, mesh=None, batch_size: Optional[int] = None):
+    def validate(self, mesh=None, batch_size: Optional[int] = None,
+                 weight_update_sharding=None):
         """graphcheck without build(): assemble a THROWAWAY copy of the
         config WITHOUT the throwing shape-resolution pass, so cycles/
         dangling refs surface as findings rather than exceptions. The
@@ -253,7 +257,8 @@ class GraphBuilder:
             input_types=dict(self._input_types),
             training=self._parent._training,
         )
-        return conf.validate(mesh=mesh, batch_size=batch_size)
+        return conf.validate(mesh=mesh, batch_size=batch_size,
+                             weight_update_sharding=weight_update_sharding)
 
     def build(self) -> ComputationGraphConfiguration:
         if not self._inputs:

@@ -197,6 +197,108 @@ def compute_updates(tx, grads, opt_state, params, layers,
     return new_params, new_opt
 
 
+# ---------------------------------------------------------------------------
+# ZeRO-1 weight-update sharding (parallel trainers, mode="zero1")
+# ---------------------------------------------------------------------------
+
+def _is_shardable(x) -> bool:
+    """Leaves that carry per-parameter state (arrays with >= 1 dim) are
+    sharded; scalars (optax step counters) stay replicated."""
+    return getattr(x, "ndim", 0) >= 1
+
+
+def shard_updater_state(opt_state, mesh_ctx, axis: Optional[str] = None):
+    """Re-lay an optax state pytree into the ZeRO-1 layout: every array
+    leaf becomes its flattened pad-to-divisible ``(dp, chunk)`` view
+    placed with a ``NamedSharding`` over the mesh's data axis, so each
+    replica holds 1/dp of Adam's m+v instead of a full copy.
+
+    Returns ``(sharded_state, template)`` — the template records each
+    sharded leaf's original shape/dtype (as ``jax.ShapeDtypeStruct``) so
+    :func:`gather_updater_state` can restore the replicated layout for
+    the zip serializer or a non-zero1 trainer. Accumulated state is
+    PRESERVED through the flatten (wrapping a trained net mid-run keeps
+    its Adam moments, same as the replicated path).
+    """
+    from deeplearning4j_tpu.parallel.mesh import zero1_shard_leaf
+    dp = mesh_ctx.zero1_shards(axis)
+    sharding = mesh_ctx.zero1_sharding(axis)
+    rep = mesh_ctx.replicated()
+
+    def place(x):
+        if _is_shardable(x):
+            return jax.device_put(zero1_shard_leaf(x, dp), sharding)
+        return jax.device_put(x, rep) if hasattr(x, "shape") else x
+
+    def describe(x):
+        if _is_shardable(x):
+            return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+        return None
+
+    template = jax.tree.map(describe, opt_state,
+                            is_leaf=lambda x: x is None)
+    return jax.tree.map(place, opt_state), template
+
+
+def gather_updater_state(opt_state, template):
+    """Inverse of :func:`shard_updater_state`: slice away the padding
+    and restore every leaf's original shape (replicated values). Leaves
+    whose template entry is None were never sharded and pass through."""
+    from deeplearning4j_tpu.parallel.mesh import zero1_unshard_leaf
+
+    def restore(x, t):
+        if t is None:
+            return x
+        return zero1_unshard_leaf(x, t.shape)
+
+    return jax.tree.map(restore, opt_state, template,
+                        is_leaf=lambda x: x is None)
+
+
+def compute_updates_sharded(tx, fgrads, opt_state, params, layers,
+                            training: TrainingConfig, mesh_ctx,
+                            axis: Optional[str] = None):
+    """ZeRO-1 counterpart of :func:`compute_updates`, traced inside the
+    parallel train step. ``fgrads`` is the gradient pytree whose leaves
+    are already flattened ``(dp, chunk)`` views sharded over the data
+    axis (the reduce-scattered sum); ``opt_state`` leaves live in the
+    same layout persistently. The whole optimizer pipeline runs on the
+    local shard only — every supported update rule is elementwise, so
+    the shard-local math is bit-identical to the replicated layout's —
+    and the updated params are restored to full (replicated) shape,
+    which XLA realizes as the ZeRO-1 all-gather.
+
+    Per-layer gradient-norm clipping still sees per-layer subtrees (the
+    flatten preserves pytree structure; padding contributes zeros to
+    every norm), so ``normalize_gradients`` keeps its semantics.
+    """
+    from deeplearning4j_tpu.parallel.mesh import (zero1_shard_leaf,
+                                                  zero1_unshard_leaf)
+    dp = mesh_ctx.zero1_shards(axis)
+    sharding = mesh_ctx.zero1_sharding(axis)
+    rep = mesh_ctx.replicated()
+
+    fgrads = mask_frozen(fgrads, layers)
+    fgrads = normalize_gradients(fgrads, training)
+    fparams = jax.tree.map(
+        lambda p: jax.lax.with_sharding_constraint(
+            zero1_shard_leaf(p, dp), sharding), params)
+    updates, new_opt = tx.update(fgrads, opt_state, fparams)
+    # pin the outgoing state to the 1/dp layout — left to propagation,
+    # GSPMD may emit it replicated and the memory win evaporates after
+    # the first (donated) step
+    new_opt = jax.tree.map(
+        lambda x: (jax.lax.with_sharding_constraint(x, sharding)
+                   if getattr(x, "ndim", 0) >= 1 else x), new_opt)
+    updates = per_layer_lr_scale(updates, layers,
+                                 training.updater.learning_rate)
+    fnew = jax.tree.map(lambda p, u: p + u, fparams, updates)
+    new_params = jax.tree.map(
+        lambda y, like: jax.lax.with_sharding_constraint(
+            zero1_unshard_leaf(y, tuple(like.shape)), rep), fnew, params)
+    return new_params, new_opt
+
+
 def per_layer_lr_scale(updates, layers, base_lr: float):
     """Per-layer learning-rate override: scale each layer's update by
     layer.learning_rate / base_lr (the reference instead builds a separate

@@ -19,6 +19,8 @@ reference's average-every-k semantics for parity testing.
 from deeplearning4j_tpu.parallel import checkpoint  # noqa: F401
 from deeplearning4j_tpu.parallel import multihost  # noqa: F401
 from deeplearning4j_tpu.parallel.delayed import DelayedSyncTrainer  # noqa: F401
-from deeplearning4j_tpu.parallel.mesh import MeshContext  # noqa: F401
+from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
+    MeshContext, WeightUpdateSharding,
+)
 from deeplearning4j_tpu.parallel.trainer import ParallelTrainer  # noqa: F401
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: F401

@@ -11,11 +11,91 @@ every explicit parameter-blob exchange.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple, Union
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# ---------------------------------------------------------------------------
+# weight-update sharding (ZeRO-1) config + layout helpers
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class WeightUpdateSharding:
+    """How the data-parallel trainers lay out the *weight update*.
+
+    ``off``   — the classic replicated layout: every replica holds full
+    params AND the full optax updater state, gradients are all-reduced,
+    and every chip applies the identical update (Adam's m+v cost 2x
+    param HBM per replica for nothing).
+
+    ``zero1`` — ZeRO-1 / "Automatic Cross-Replica Sharding of Weight
+    Update in Data-Parallel Training" (arxiv 2004.13336): each optax
+    state leaf is kept as a flattened, pad-to-divisible ``(dp, chunk)``
+    view sharded 1/dp over ``axis``; the compiled step reduce-scatters
+    gradients into that layout, applies the update to the local shard
+    only, and all-gathers the updated params. Updater-state HBM drops by
+    ``dp``x and, under ``gradient_accumulation=k``, per-update cross-chip
+    traffic drops from ``2.P.k`` (an all-reduce per microbatch) to
+    ``~P.(k+1)`` (a reduce-scatter per microbatch + one param gather).
+    The transformation is an execution-layout change only — loss/param
+    trajectories are exactly those of the replicated layout.
+    """
+
+    mode: str = "off"    # "off" | "zero1"
+    axis: str = "data"
+
+    MODES = ("off", "zero1")
+
+    def __post_init__(self):
+        if self.mode not in self.MODES:
+            raise ValueError(
+                f"weight_update_sharding mode must be one of {self.MODES}, "
+                f"got {self.mode!r}")
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode == "zero1"
+
+    @staticmethod
+    def parse(value: Union["WeightUpdateSharding", str, None]
+              ) -> "WeightUpdateSharding":
+        """Accept None / "off" / "zero1" / an instance — the form every
+        trainer constructor takes."""
+        if value is None:
+            return WeightUpdateSharding()
+        if isinstance(value, WeightUpdateSharding):
+            return value
+        return WeightUpdateSharding(mode=str(value))
+
+
+def zero1_chunk(size: int, n: int) -> int:
+    """Per-shard element count for a flattened leaf of ``size`` split
+    ``n`` ways (pad-to-divisible)."""
+    return -(-int(size) // max(1, n))
+
+
+def zero1_shard_leaf(x, n: int):
+    """Flattened pad-to-divisible ``(n, chunk)`` view of one leaf — the
+    layout each optax state leaf (and the in-step gradient/param views)
+    live in under zero1. Works traced and untraced."""
+    flat = jnp.ravel(x)
+    chunk = zero1_chunk(flat.size, n)
+    pad = chunk * n - flat.size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat.reshape(n, chunk)
+
+
+def zero1_unshard_leaf(y, shape: Tuple[int, ...]):
+    """Inverse of :func:`zero1_shard_leaf`: drop the padding tail and
+    restore the original shape. (The padding-waste math lives in
+    graphcheck's GC011 rule, which must stay importable without jax.)"""
+    size = int(np.prod(shape)) if shape else 1
+    return y.reshape(-1)[:size].reshape(shape)
 
 
 @dataclass
@@ -62,6 +142,38 @@ class MeshContext:
     # ------------------------------------------------------------- shardings
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
+
+    def zero1_sharding(self, axis: Optional[str] = None) -> NamedSharding:
+        """Sharding of the flattened ``(dp, chunk)`` weight-update views:
+        row i (one chunk of every leaf) lives on data-replica i only."""
+        return NamedSharding(self.mesh, P(axis or self.data_axis, None))
+
+    def zero1_shards(self, axis: Optional[str] = None) -> int:
+        """Number of weight-update shards = size of the sharding axis."""
+        return int(self.mesh.shape[axis or self.data_axis])
+
+    def validate_weight_update_sharding(
+            self, wus: "WeightUpdateSharding") -> None:
+        """Raise early (trainer construction, not trace time) when the
+        mesh cannot carry the requested weight-update layout."""
+        if not wus.enabled:
+            return
+        if wus.axis not in self.mesh.axis_names:
+            raise ValueError(
+                f"weight_update_sharding axis {wus.axis!r} is not a mesh "
+                f"axis (have {tuple(self.mesh.axis_names)})")
+        if self.mesh.shape[wus.axis] < 2:
+            raise ValueError(
+                "zero1 weight-update sharding needs at least 2 replicas "
+                f"on axis {wus.axis!r} (mesh has "
+                f"{self.mesh.shape[wus.axis]}) — with dp=1 there is "
+                "nothing to shard; use mode='off'")
+        if self.n_model > 1:
+            raise ValueError(
+                "zero1 weight-update sharding composes with pure data "
+                "parallelism only; this mesh tensor-shards params over "
+                f"'model' ({self.n_model} ways) — the updater state of a "
+                "model-sharded kernel is already distributed")
 
     def batch_sharding(self, ndim: int,
                        shape: Optional[Tuple[int, ...]] = None

@@ -83,3 +83,28 @@ def global_array(local_data, sharding):
     the Spark tier did with broadcast/collect, done zero-copy per host."""
     return jax.make_array_from_process_local_data(
         sharding, np.asarray(local_data))
+
+
+def data_parallel_trainer(net, n_model: int = 1,
+                          gradient_accumulation: int = 1,
+                          weight_update_sharding=None, **kwargs):
+    """One-call multihost trainer: build the global mesh over every
+    process's devices and wrap ``net`` in a ``ParallelTrainer``.
+
+    ``weight_update_sharding="zero1"`` shards the weight update and the
+    optax state 1/dp across the WHOLE data axis (all chips of all
+    processes): each process's addressable shard of Adam's m+v is only
+    ``local_devices/global_devices`` of the replicated footprint, and
+    the sharded checkpoint format persists exactly those addressable
+    shards per process — updater-state writes scale out with the pod
+    instead of funneling through one host.
+
+    Call ``initialize()`` first (TPU pods: with no args). Every process
+    then feeds process-LOCAL batch shards to ``fit_batch`` as usual.
+    """
+    from deeplearning4j_tpu.parallel.mesh import MeshContext
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+    ctx = MeshContext.create(n_model=n_model)
+    return ParallelTrainer(
+        net, ctx, gradient_accumulation=gradient_accumulation,
+        weight_update_sharding=weight_update_sharding, **kwargs)

@@ -27,12 +27,16 @@ from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator, DataSetIt
 from deeplearning4j_tpu.nn.netcommon import (
     ScanFitMixin, emit_scan_burst, make_scan_fit,
 )
-from deeplearning4j_tpu.nn.updater import compute_updates
+from deeplearning4j_tpu.nn.updater import (
+    compute_updates, compute_updates_sharded, gather_updater_state,
+    shard_updater_state,
+)
 from deeplearning4j_tpu.optimize.training_stats import (
     TrainingStats, maybe_phase,
 )
 from deeplearning4j_tpu.parallel.mesh import (
-    MeshContext, sequence_parallel_scope,
+    MeshContext, WeightUpdateSharding, sequence_parallel_scope,
+    zero1_shard_leaf,
 )
 from deeplearning4j_tpu.profiling import get_tracer
 
@@ -44,15 +48,35 @@ class ParallelTrainer:
     The model's params are resharded onto the mesh; each ``fit`` step feeds a
     global batch (sharded over 'data') through ONE jitted step compiled for
     the mesh. Collectives ride ICI automatically.
+
+    ``weight_update_sharding="zero1"`` (see
+    :class:`~deeplearning4j_tpu.parallel.mesh.WeightUpdateSharding`)
+    shards the weight update ZeRO-1 style: optax state leaves live as
+    flattened ``(dp, chunk)`` views 1/dp per replica, gradients are
+    reduce-scattered into that layout (under ``gradient_accumulation``
+    the inner scan accumulates directly into the sharded view — each
+    microbatch ships a reduce-scatter instead of a full all-reduce, and
+    only ONE param-sized gather rides the update), the update is
+    applied to the local shard only, and the updated params are
+    all-gathered. The loss/param trajectory is exactly the replicated
+    layout's — only the execution layout changes. While the trainer is
+    attached, ``net.opt_state`` holds the SHARDED views (sharded
+    checkpoints round-trip them natively); call :meth:`gather_opt_state`
+    before handing the net to the zip serializer or a non-zero1 trainer.
     """
 
     def __init__(self, net, mesh: Optional[MeshContext] = None,
                  gradient_accumulation: int = 1,
                  donate_params: bool = True,
-                 collect_training_stats: bool = False):
+                 collect_training_stats: bool = False,
+                 weight_update_sharding=None):
         self.net = net
         self.mesh = mesh or MeshContext.create()
         self.gradient_accumulation = max(1, gradient_accumulation)
+        self.weight_update_sharding = WeightUpdateSharding.parse(
+            weight_update_sharding)
+        self.mesh.validate_weight_update_sharding(
+            self.weight_update_sharding)
         self._step = None
         self._donate = donate_params
         # per-phase telemetry, ref ParameterAveragingTrainingMasterStats
@@ -71,12 +95,20 @@ class ParallelTrainer:
             lambda x: jax.device_put(x, self.mesh.replicated()), net.states)
         # PRESERVE accumulated optimizer state (Adam moments etc.) when
         # wrapping an already-trained net — re-initializing would spike
-        # the loss on resume. Leaves land replicated; the first donated
-        # step re-lays them out to whatever XLA computes.
-        rep = self.mesh.replicated()
-        net.opt_state = jax.tree.map(
-            lambda x: jax.device_put(x, rep) if hasattr(x, "shape") else x,
-            net.opt_state)
+        # the loss on resume. Replicated mode: leaves land replicated and
+        # the first donated step re-lays them out to whatever XLA
+        # computes. zero1: leaves are flattened+padded and placed 1/dp
+        # over the data axis — the layout they keep for the whole run.
+        self._opt_template = None
+        if self.weight_update_sharding.enabled:
+            net.opt_state, self._opt_template = shard_updater_state(
+                net.opt_state, self.mesh,
+                self.weight_update_sharding.axis)
+        else:
+            rep = self.mesh.replicated()
+            net.opt_state = jax.tree.map(
+                lambda x: jax.device_put(x, rep) if hasattr(x, "shape")
+                else x, net.opt_state)
 
     # ------------------------------------------------------------- the step
     def _build_step(self):
@@ -89,6 +121,28 @@ class ParallelTrainer:
             from deeplearning4j_tpu.resilience.sentinel import guard_update
 
         layers = self._layers
+        zero1 = self.weight_update_sharding.enabled
+        mesh_ctx = self.mesh
+        z_axis = self.weight_update_sharding.axis
+        if zero1:
+            dp = mesh_ctx.zero1_shards(z_axis)
+            z_sharding = mesh_ctx.zero1_sharding(z_axis)
+            rep_sharding = mesh_ctx.replicated()
+
+            def to_shards(g):
+                """Full-shape gradient tree -> flattened (dp, chunk)
+                views sharded over the data axis. The replicated anchor
+                first pins the forward/backward partitioning to the
+                exact replicated-mode program (loss parity stays
+                bitwise); the shard constraint then lets XLA fold the
+                gradient all-reduce + shard slice into a reduce-scatter.
+                """
+                g = jax.tree.map(
+                    lambda t: jax.lax.with_sharding_constraint(
+                        t, rep_sharding), g)
+                return jax.tree.map(
+                    lambda t: jax.lax.with_sharding_constraint(
+                        zero1_shard_leaf(t, dp), z_sharding), g)
 
         # both containers' _loss_fn share the positional signature
         # (params, states, inputs, labels, masks, label_masks) — inputs/
@@ -102,6 +156,8 @@ class ParallelTrainer:
                 (loss, new_states), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(params, states, feats, labels,
                                            fmask, lmask, rng)
+                if zero1:
+                    grads = to_shards(grads)
             else:
                 # microbatch split along the batch axis inside the step:
                 # local accumulation between synchronizations = the
@@ -112,6 +168,13 @@ class ParallelTrainer:
                     f, l, fm, lm, r = mb
                     (loss, st2), g = jax.value_and_grad(
                         loss_fn, has_aux=True)(params, st, f, l, fm, lm, r)
+                    if zero1:
+                        # accumulate straight into the sharded layout:
+                        # cross-chip traffic per microbatch becomes one
+                        # reduce-scatter of g instead of a full
+                        # all-reduce, and the accumulator itself holds
+                        # only 1/dp per chip
+                        g = to_shards(g)
                     g_acc = jax.tree.map(lambda a, b: a + b, g_acc, g)
                     return (g_acc, l_acc + loss, st2), None
 
@@ -130,18 +193,28 @@ class ParallelTrainer:
 
                 rngs = jax.random.split(rng, accum)
                 zero_g = jax.tree.map(jnp.zeros_like, params)
+                if zero1:
+                    zero_g = to_shards(zero_g)
                 (grads, loss, new_states), _ = jax.lax.scan(
                     micro, (zero_g, jnp.zeros(()), states),
                     (split(feats), split(labels), split(fmask),
                      split(lmask), rngs))
                 grads = jax.tree.map(lambda g: g / accum, grads)
                 loss = loss / accum
-            new_params, new_opt = compute_updates(
-                tx, grads, opt_state, params, layers, training)
+            if zero1:
+                new_params, new_opt = compute_updates_sharded(
+                    tx, grads, opt_state, params, layers, training,
+                    mesh_ctx, z_axis)
+            else:
+                new_params, new_opt = compute_updates(
+                    tx, grads, opt_state, params, layers, training)
             if sentinel is None:
                 return new_params, new_opt, new_states, loss
-            # non-finite guard: a diverged all-reduce'd update never
-            # lands (old state selected in-program — no host sync)
+            # non-finite guard: a diverged update never lands (old state
+            # selected in-program — no host sync). Under zero1 `grads`
+            # are the sharded (dp, chunk) views, so the guard's
+            # grad-norm reduction is a psum of local-shard norms — same
+            # flag value, no extra gather.
             sel, bad = guard_update(
                 loss, grads, (params, opt_state, states),
                 (new_params, new_opt, new_states))
@@ -150,9 +223,28 @@ class ParallelTrainer:
         donate = (0, 1, 2) if self._donate else ()
         return jax.jit(step, donate_argnums=donate)
 
+    def gather_opt_state(self):
+        """Restore ``net.opt_state`` to its original (replicated) layout
+        and return it. Under zero1 the net holds the flattened sharded
+        views while this trainer is attached; gather before handing the
+        net to the zip serializer, a non-zero1 trainer, or single-device
+        inference-with-resume. A no-op in replicated mode."""
+        if self._opt_template is not None:
+            self.net.opt_state = gather_updater_state(
+                self.net.opt_state, self._opt_template)
+            self._opt_template = None
+        return self.net.opt_state
+
     # ------------------------------------------------------------------- fit
     def fit_batch(self, batch) -> float:
         net = self.net
+        if (self.weight_update_sharding.enabled
+                and self._opt_template is None):
+            # a gather_opt_state() between fits put the replicated
+            # layout back on the net — restore the sharded contract the
+            # compiled zero1 step runs on
+            net.opt_state, self._opt_template = shard_updater_state(
+                net.opt_state, self.mesh, self.weight_update_sharding.axis)
         if (self._step is None
                 or getattr(self, "_step_sentinel", None)
                 is not getattr(net, "_sentinel", None)):
@@ -269,6 +361,10 @@ class ParallelTrainer:
         if not scannable:
             return np.asarray([float(self.fit_batch(b))
                                for b in batches], np.float32)
+        if (self.weight_update_sharding.enabled
+                and self._opt_template is None):
+            net.opt_state, self._opt_template = shard_updater_state(
+                net.opt_state, self.mesh, self.weight_update_sharding.axis)
         if self._step is None:
             self._step = self._build_step()
         cached = getattr(self, "_scan_step", None)

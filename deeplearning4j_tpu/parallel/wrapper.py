@@ -29,16 +29,28 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator, DataSetIterator
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn.updater import compute_updates
-from deeplearning4j_tpu.parallel.mesh import MeshContext
+from deeplearning4j_tpu.parallel.mesh import (
+    MeshContext, WeightUpdateSharding,
+)
 from deeplearning4j_tpu.profiling import get_tracer
 
 
 class ParallelWrapper:
+    """``weight_update_sharding="zero1"``: the stacked per-worker
+    params/updater-state/model-state trees are explicitly placed with
+    the worker axis sharded over the mesh's 'data' axis, so each device
+    holds ONLY its own worker's replica (and in particular 1/N of the
+    stacked optax state) instead of leaving the N-way stacks' layout to
+    XLA — the wrapper-shaped analog of ZeRO-1, where the per-worker
+    updater state is the natural shard. Workers must divide evenly by
+    the data axis. Semantics are unchanged (placement only)."""
+
     def __init__(self, net: MultiLayerNetwork, workers: Optional[int] = None,
                  prefetch_buffer: int = 16, averaging_frequency: int = 1,
                  average_updaters: bool = True,
                  mesh: Optional[MeshContext] = None,
-                 report_score_after_averaging: bool = True):
+                 report_score_after_averaging: bool = True,
+                 weight_update_sharding=None):
         net._check_init()
         self.net = net
         self.mesh = mesh or MeshContext.create()
@@ -47,6 +59,17 @@ class ParallelWrapper:
         self.averaging_frequency = max(1, averaging_frequency)
         self.average_updaters = average_updaters
         self.report_score_after_averaging = report_score_after_averaging
+        self.weight_update_sharding = WeightUpdateSharding.parse(
+            weight_update_sharding)
+        if self.weight_update_sharding.enabled:
+            self.mesh.validate_weight_update_sharding(
+                self.weight_update_sharding)
+            dp = self.mesh.zero1_shards(self.weight_update_sharding.axis)
+            if self.workers % dp != 0:
+                raise ValueError(
+                    f"zero1: {self.workers} workers cannot shard evenly "
+                    f"over the {dp}-way "
+                    f"{self.weight_update_sharding.axis!r} axis")
         # stack per-worker replicas: worker axis sharded over 'data'
         n = self.workers
         self._stacked_params = jax.tree.map(
@@ -58,8 +81,22 @@ class ParallelWrapper:
             net.opt_state)
         self._stacked_states = jax.tree.map(
             lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), net.states)
+        if self.weight_update_sharding.enabled:
+            put = lambda t: jax.tree.map(self._worker_shard, t)
+            self._stacked_params = put(self._stacked_params)
+            self._stacked_opt = put(self._stacked_opt)
+            self._stacked_states = put(self._stacked_states)
         self._vstep = None
         self._iter_since_avg = 0
+
+    def _worker_shard(self, x):
+        """Place one stacked leaf with its worker axis over 'data'."""
+        if not hasattr(x, "ndim") or x.ndim < 1:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = P(self.weight_update_sharding.axis,
+                 *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(self.mesh.mesh, spec))
 
     # -------------------------------------------------------------- the step
     def _build_vmapped_step(self):
@@ -89,6 +126,24 @@ class ParallelWrapper:
             return sel[0], sel[1], sel[2], loss, bad
 
         vstep = jax.vmap(one_worker)
+        zero1 = self.weight_update_sharding.enabled
+        if zero1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            z_axis = self.weight_update_sharding.axis
+            mesh = self.mesh.mesh
+
+            def pin_workers(tree):
+                """Keep the worker axis 'data'-sharded through the
+                donated step — without the constraint XLA is free to
+                re-replicate the stacks on output and the 1/N updater
+                footprint evaporates after the first update."""
+                def pin(x):
+                    if not hasattr(x, "ndim") or x.ndim < 1:
+                        return x
+                    spec = P(z_axis, *([None] * (x.ndim - 1)))
+                    return jax.lax.with_sharding_constraint(
+                        x, NamedSharding(mesh, spec))
+                return jax.tree.map(pin, tree)
 
         def step(sp, so, ss, feats, labels, rngs, do_average):
             sp, so, ss, losses, bads = vstep(sp, so, ss, feats, labels,
@@ -113,6 +168,9 @@ class ParallelWrapper:
                 so2 = so
             ss2 = jax.lax.cond(do_average, lambda t: avg(t, False),
                                lambda t: t, ss)
+            if zero1:
+                sp2, so2, ss2 = (pin_workers(sp2), pin_workers(so2),
+                                 pin_workers(ss2))
             return sp2, so2, ss2, losses, bads
 
         # _parallel_iteration overwrites the three stacked-state args with

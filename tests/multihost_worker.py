@@ -86,3 +86,28 @@ dlosses = []
 for _ in range(4):
     dlosses.append(float(dtrainer.fit_batch(DataSet(x[sl], y[sl]))))
 print("DLOSSES", " ".join(f"{l:.8f}" for l in dlosses), flush=True)
+
+# ---- phase 3: zero1 weight-update sharding over the global mesh ----------
+# Same seed/net/data as phase 1, dp = every chip of every process, optax
+# state sharded 1/dp globally; the loss sequence must be BITWISE the
+# replicated phase-1 sequence (the exact-parity guarantee, ISSUE 5).
+net3 = MultiLayerNetwork(
+    NeuralNetConfiguration.builder().seed(99)
+    .updater("sgd").learning_rate(0.1)
+    .list()
+    .layer(DenseLayer(n_out=16, activation="relu"))
+    .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+    .set_input_type(InputType.feed_forward(10)).build()).init()
+ztrainer = multihost.data_parallel_trainer(net3,
+                                           weight_update_sharding="zero1")
+zlosses = []
+for _ in range(3):
+    zlosses.append(ztrainer.fit_batch(DataSet(x[sl], y[sl])))
+np.testing.assert_array_equal(np.float32(zlosses), np.float32(losses))
+# each process addresses only its slice of the sharded updater state
+opt_leaves = [l for l in jax.tree_util.tree_leaves(net3.opt_state)
+              if getattr(l, "ndim", 0) >= 1]
+for leaf in opt_leaves:
+    local = sum(s.data.size for s in leaf.addressable_shards)
+    assert local * num_procs == leaf.size, (local, leaf.size)
+print("ZLOSSES", " ".join(f"{float(l):.8f}" for l in zlosses), flush=True)

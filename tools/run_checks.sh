@@ -29,9 +29,12 @@ if [ "${1:-}" != "--fast" ]; then
     echo "== serve smoke (burst shed + /readyz drain flip + clean drain) =="
     JAX_PLATFORMS=cpu python tools/serve_smoke.py || fail=1
 
+    echo "== zero1 smoke (dp=2 bitwise loss parity + sharded updater state) =="
+    JAX_PLATFORMS=cpu python tools/zero1_smoke.py || fail=1
+
     echo "== tier-1 tests (ROADMAP.md) =="
     rm -f /tmp/_t1.log
-    timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
         -m 'not slow' --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
         | tee /tmp/_t1.log
