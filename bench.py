@@ -84,6 +84,9 @@ BENCH_HISTORY = {
     "resnet50_b128_bf16_samples_per_sec_per_chip": None,
     "charlstm_b32_t64_samples_per_sec_per_chip": None,
     "vgg16_cifar10_b128_bf16_samples_per_sec_per_chip": None,
+    # serving rung (ISSUE 6): requests/sec inside the latency SLO
+    # through the continuous-batching KerasServer
+    "keras_serve_requests_per_sec": None,
 }
 
 # Peak FLOP/s per chip: ONE table for both MFU fields (the hand-model
@@ -252,7 +255,7 @@ class _RungWatchdog:
 # rung configurations
 # ---------------------------------------------------------------------------
 
-_RUNGS = ("lenet", "small", "full", "vgg", "lstm", "xl")
+_RUNGS = ("lenet", "small", "full", "vgg", "lstm", "xl", "serve")
 
 
 def _rung_config(rung: str, smoke: bool):
@@ -302,7 +305,18 @@ def _rung_config(rung: str, smoke: bool):
                     batch=4 if smoke else 32, steps=2 if smoke else 20,
                     warmup=2, dtype="float32",
                     metric="charlstm_b32_t64_samples_per_sec_per_chip")
-    raise ValueError(f"unknown rung {rung!r}; valid: {_RUNGS} + ('lstm',)")
+    if rung == "serve":
+        # serving throughput: C concurrent clients firing N predicts at
+        # the continuous-batching gateway; the headline is requests/sec
+        # INSIDE the latency SLO (a number that only improves when
+        # batching actually works — raw rps would reward queue-and-stall)
+        return dict(model="serve_mlp", clients=4 if smoke else 12,
+                    requests=48 if smoke else 240,
+                    slo_ms=2000 if smoke else 250,
+                    max_batch=8 if smoke else 16,
+                    max_wait_ms=5.0, features=32, classes=8,
+                    metric="keras_serve_requests_per_sec")
+    raise ValueError(f"unknown rung {rung!r}; valid: {_RUNGS}")
 
 
 # ---------------------------------------------------------------------------
@@ -686,6 +700,142 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
     }
 
 
+def _run_serve_rung(jax, smoke: bool, on_accel: bool, device_kind: str,
+                    platform: str) -> dict:
+    """The `serve` rung (ISSUE 6): requests/sec at a latency SLO through
+    the continuous-batching KerasServer. C concurrent clients fire N
+    predicts (mixed row counts) at an in-process gateway; warmup
+    AOT-compiles every power-of-two bucket first, so the timed storm
+    runs with zero recompiles. The record carries p50/p99 latency, the
+    achieved batch-size mix, and the scheduler's `compile_s` — the
+    fields every future serving PR reports against."""
+    import tempfile
+    import threading as _threading
+
+    cfg = _rung_config("serve", smoke)
+    _stamp(f"rung 'serve': {cfg}")
+    tracer = get_tracer()
+
+    from deeplearning4j_tpu import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.keras.server import KerasClient, KerasServer
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+    F, K = cfg["features"], cfg["classes"]
+    t = time.perf_counter()
+    with tracer.span("serve_build_model"):
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.builder().updater("adam")
+            .learning_rate(0.01).seed(7).list()
+            .layer(DenseLayer(n_out=64, activation="relu"))
+            .layer(OutputLayer(n_out=K, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(F)).build()).init()
+    _stamp(f"serve model built in {time.perf_counter() - t:.1f}s")
+
+    rng = np.random.default_rng(3)
+    clients, n_requests = cfg["clients"], cfg["requests"]
+    slo_s = cfg["slo_ms"] / 1000.0
+    with tempfile.TemporaryDirectory() as d:
+        model = os.path.join(d, "serve.zip")
+        ModelSerializer.write_model(net, model)
+        # mixed request sizes: every power-of-two bucket the storm can
+        # hit gets a feature file (and a warmup predict below)
+        row_choices = [r for r in (1, 2, 4, 8, 16)
+                       if r <= cfg["max_batch"]]
+        files = []
+        for rows in row_choices:
+            p = os.path.join(d, f"x{rows}.npy")
+            np.save(p, rng.normal(size=(rows, F)).astype(np.float32))
+            files.append(p)
+        srv = KerasServer(max_concurrency=clients,
+                          queue_depth=2 * clients,
+                          max_batch=cfg["max_batch"],
+                          max_wait_ms=cfg["max_wait_ms"])
+        try:
+            t = time.perf_counter()
+            with tracer.span("serve_warmup"):
+                warm = KerasClient(srv.host, srv.port)
+                for p in files:  # one AOT compile per bucket
+                    warm.predict(p, model=model)
+                warm.close()
+            _stamp(f"serve warmup ({len(files)} buckets) in "
+                   f"{time.perf_counter() - t:.1f}s")
+
+            latencies, errors = [], []
+            lock = _threading.Lock()
+            start = _threading.Barrier(clients + 1)
+            per_client = n_requests // clients
+
+            def client(idx: int) -> None:
+                cli = KerasClient(srv.host, srv.port)
+                start.wait(30.0)
+                for k in range(per_client):
+                    p = files[(idx + k) % len(files)]
+                    t0 = time.perf_counter()
+                    try:
+                        cli.request(op="predict", features=p,
+                                    model=model)
+                        with lock:
+                            latencies.append(time.perf_counter() - t0)
+                    except Exception as e:  # noqa: BLE001 — recorded
+                        with lock:
+                            errors.append(f"{type(e).__name__}: {e}")
+                cli.close()
+
+            threads = [_threading.Thread(target=client, args=(i,),
+                                         daemon=True)
+                       for i in range(clients)]
+            for th in threads:
+                th.start()
+            with tracer.span("serve_storm", clients=clients,
+                             requests=per_client * clients):
+                start.wait(30.0)
+                t0 = time.perf_counter()
+                for th in threads:
+                    th.join(300.0)
+                wall = time.perf_counter() - t0
+            stats = srv._batcher.stats()
+        finally:
+            srv.drain(grace_s=5.0)
+
+    from deeplearning4j_tpu.keras.batching import quantile
+    n_done = len(latencies)
+    n_slo = sum(1 for s in latencies if s <= slo_s)
+    rps_slo = n_slo / wall if wall > 0 else 0.0
+    ordered = sorted(latencies) or [0.0]
+    p50, p99 = quantile(ordered, 0.5), quantile(ordered, 0.99)
+    _stamp(f"serve storm: {n_done}/{per_client * clients} served in "
+           f"{wall:.2f}s -> {n_done / wall:.1f} rps "
+           f"({rps_slo:.1f} inside {cfg['slo_ms']}ms SLO), "
+           f"p50={p50 * 1e3:.1f}ms p99={p99 * 1e3:.1f}ms, "
+           f"mix={stats['batch_size_mix']}, {len(errors)} errors")
+    base = (_banked_baseline(cfg["metric"])
+            if on_accel and not smoke else None)
+    return {
+        "metric": cfg["metric"] + ("" if on_accel and not smoke
+                                   else "_SMOKE"),
+        "value": round(rps_slo, 2),
+        "unit": "requests/sec",
+        "vs_baseline": round(rps_slo / base, 3) if base else 1.0,
+        "device_kind": device_kind,
+        "platform": platform,
+        "rung": "serve",
+        "clients": clients,
+        "requests": n_done,
+        "request_errors": errors[:5],
+        "slo_ms": cfg["slo_ms"],
+        "slo_attained": round(n_slo / max(1, n_done), 4),
+        "p50_ms": round(p50 * 1e3, 2),
+        "p99_ms": round(p99 * 1e3, 2),
+        "max_batch": cfg["max_batch"],
+        "max_wait_ms": cfg["max_wait_ms"],
+        "batch_size_mix": stats["batch_size_mix"],
+        "compile_s": stats["compile_s"],
+    }
+
+
 def _run_child() -> int:
     smoke = os.environ.get("BENCH_SMOKE", os.environ.get("BENCH_SMALL",
                                                          "0")) == "1"
@@ -734,8 +884,12 @@ def _run_child() -> int:
                 "" if on_accel and not smoke else "_SMOKE")
             with _RungWatchdog(metric, rung_wall, tracer), \
                     tracer.span(f"rung:{rung}"):
-                rec = _run_rung(jax, rung, smoke, on_accel, device_kind,
-                                platform, parity)
+                if rung == "serve":
+                    rec = _run_serve_rung(jax, smoke, on_accel,
+                                          device_kind, platform)
+                else:
+                    rec = _run_rung(jax, rung, smoke, on_accel,
+                                    device_kind, platform, parity)
             print(json.dumps(rec), flush=True)  # banked — a later hang
             banked.append(rec)                  # cannot lose this
             if on_accel and not smoke:

@@ -1,5 +1,6 @@
 """Keras model import (ref: deeplearning4j-modelimport)."""
 
+from deeplearning4j_tpu.keras.batching import BatchScheduler  # noqa: F401
 from deeplearning4j_tpu.keras.hdf5 import Hdf5Archive  # noqa: F401
 from deeplearning4j_tpu.keras.keras_import import KerasModelImport  # noqa: F401
 from deeplearning4j_tpu.keras.server import (  # noqa: F401

@@ -25,7 +25,16 @@ unboundedly, blown budgets return ``{"error": "DEADLINE", ...}``, and a
 per-model circuit breaker fails fast with ``{"error": "BREAKER_OPEN",
 "retry_after_ms": ...}`` after consecutive failures/timeouts. A
 nonfinite prediction is refused (``{"error": "NONFINITE"}``) — the
-serving analog of the PR 3 divergence sentinel.
+serving analog of the PR 3 divergence sentinel, applied PER ROW under
+batching so one poisoned request never fails its batchmates.
+
+Predicts are served by a continuous-batching scheduler
+(``keras/batching.py``): admitted requests for the same model coalesce
+into padded power-of-two row buckets, each bucket executes one
+AOT-compiled step (compile once per (model, bucket) — no per-request
+recompiles), and batch formation is deadline-aware. ``max_batch`` /
+``max_wait_ms`` tune it; ``batching=False`` restores the one-request =
+one-dispatch path.
 
 Batch files: ``.npy`` or ``.h5`` (one array per file, sorted order), the
 HDF5MiniBatchDataSetIterator layout.
@@ -156,7 +165,14 @@ class KerasServer:
                  breaker_cooldown_base: float = 0.5,
                  breaker_cooldown_max: float = 30.0,
                  breaker_slow_call_s: float = 30.0,
-                 io_timeout: float = 60.0):
+                 io_timeout: float = 60.0, batching: bool = True,
+                 max_batch: int = 32, max_wait_ms: float = 5.0,
+                 batch_deadline_margin_ms: float = 50.0):
+        from deeplearning4j_tpu.keras.batching import BatchScheduler
+        self._batcher = (BatchScheduler(
+            max_batch=max_batch, max_wait_ms=max_wait_ms,
+            deadline_margin_ms=batch_deadline_margin_ms)
+            if batching and max_batch > 0 else None)
         self._models = collections.OrderedDict()  # path -> model (LRU)
         self._model_locks = {}  # path -> per-model op lock
         self._model_pins = {}  # path -> in-flight ops (pinned != evictable)
@@ -263,6 +279,8 @@ class KerasServer:
                     break  # everything older is mid-op; over-stay
                 del self._models[victim]
                 self._model_locks.pop(victim, None)
+                if self._batcher is not None:  # AOT cache dies with LRU
+                    self._batcher.evict_model(victim)
                 get_registry().counter(
                     "serving_models_evicted_total",
                     help="models evicted from the KerasServer LRU "
@@ -291,13 +309,24 @@ class KerasServer:
             return {"ok": True, "shutdown": True}
         if op not in ("fit", "predict", "evaluate"):
             raise ValueError(f"unknown op {op!r}")
+        # resolve the model name ONCE, at admission — a predict without
+        # 'model' must not re-read _last after queueing (an LRU swap or
+        # eviction mid-queue could silently retarget the request); the
+        # resolved key travels with the request from here on
+        key = self._resolve_key(req.get("model"))
         deadline = self._guard.deadline(req)
+        t_req = time.perf_counter()
         with self._guard.admit(deadline):
             with get_tracer().span(f"serve:{op}"):
-                return self._serve(op, req, deadline)
+                resp = self._serve(op, req, deadline, key)
+        if op == "predict" and self._batcher is not None:
+            # p50/p99 over served predictions (admission queue included
+            # — this is the latency a client actually observes)
+            self._batcher.latency.observe(time.perf_counter() - t_req)
+        return resp
 
-    def _serve(self, op: str, req: dict, deadline: Deadline) -> dict:
-        key = self._resolve_key(req.get("model"))
+    def _serve(self, op: str, req: dict, deadline: Deadline,
+               key: str) -> dict:
         # a budget already blown in the admission queue says nothing
         # about the backend — and checking BEFORE _prepare avoids
         # loading the whole input from disk for a doomed request
@@ -319,8 +348,19 @@ class KerasServer:
             model, lock = self._get_model(key)
             pinned = True
             faultinject.on_backend_dispatch(op)
-            with lock:
-                resp = self._run_op(op, req, payload, model, deadline)
+            if op == "predict" and self._batcher is not None:
+                # continuous batching: coalesce with concurrent
+                # predicts on this model; the scheduler runs one
+                # AOT-compiled step per bucket under the model lock
+                # and raises this request's OWN verdict (a batch-level
+                # failure is re-tried singleton first)
+                y = self._batcher.submit(key, model, lock, payload,
+                                         deadline)
+                resp = {"ok": True, "predictions": y.tolist()}
+            else:
+                with lock:
+                    resp = self._run_op(op, req, payload, model,
+                                        deadline)
             # post-hoc budget check: the op itself cannot be cancelled
             # mid-kernel, so a blown budget is detected at this seam
             # and the (late) result withheld
@@ -334,6 +374,11 @@ class KerasServer:
                     >= self._guard.breaker_slow_call_s):
                 breaker.record_failure()
             raise
+        except NonFiniteOutput:
+            # a NaN/Inf prediction is a CLIENT-INPUT failure (poisoned
+            # features on a healthy model): refuse the row, never open
+            # the shared circuit for its batchmates or anyone else
+            raise
         except Exception:
             breaker.record_failure()
             raise
@@ -346,7 +391,10 @@ class KerasServer:
     def _prepare(self, op: str, req: dict, deadline: Deadline):
         """Load/validate the request's inputs (not the model)."""
         if op == "predict":
-            return _load_array(Path(req["features"])).astype(np.float32)
+            x = _load_array(Path(req["features"])).astype(np.float32)
+            # poison_row chaos seam: NaN-poison ONE request's features
+            # so the per-row sentinel's batchmate isolation is provable
+            return faultinject.poison_predict(x)
         return _DeadlineGatedIterator(
             HDF5MiniBatchDataSetIterator(req["features_dir"],
                                          req["labels_dir"]),
@@ -386,6 +434,10 @@ class KerasServer:
         listener. Returns True when the server emptied in time."""
         self._guard.start_drain()
         drained = self._guard.wait_idle(grace_s)
+        if self._batcher is not None:
+            # after wait_idle no admitted predict is waiting on a
+            # future; fail any stragglers DRAINING and join dispatchers
+            self._batcher.stop(grace_s)
         self._server.shutdown()
         self._server.server_close()
         unregister_guard(self._guard)

@@ -166,10 +166,53 @@ class Histogram:
                 "sum": self._sum, "count": self._count}
 
 
+class LabeledCounter:
+    """A counter *family*: one metric name, one child ``Counter`` per
+    label set (``family.labels(reason="full").inc()``). Renders the
+    standard Prometheus labeled form — one ``# TYPE`` line, one sample
+    line per child. ``value`` is the sum over children, so prefix
+    ``snapshot()`` views keep working on families."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[Tuple[str, str], ...], Counter] = {}
+
+    def labels(self, **labels: str) -> Counter:
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = Counter(self.name + _fmt_labels(dict(key)),
+                                help=self.help)
+                self._children[key] = child
+            return child
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return sum(c.value for c in self._children.values())
+
+    def _render(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._children.items())
+        return [f"{self.name}{_fmt_labels(dict(key))} "
+                f"{_fmt_value(child.value)}" for key, child in items]
+
+    _prom_type = "counter"
+
+    def _json(self):
+        with self._lock:
+            items = sorted(self._children.items())
+        return {_fmt_labels(dict(key)): child.value
+                for key, child in items}
+
+
 class MetricsRegistry:
-    """Named instrument store. ``counter``/``gauge``/``histogram`` are
-    get-or-create (same name returns the same instrument; a kind clash
-    raises)."""
+    """Named instrument store. ``counter``/``gauge``/``histogram``/
+    ``labeled_counter`` are get-or-create (same name returns the same
+    instrument; a kind clash raises)."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -197,6 +240,9 @@ class MetricsRegistry:
                   buckets: Sequence[float] = DEFAULT_TIME_BUCKETS
                   ) -> Histogram:
         return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def labeled_counter(self, name: str, help: str = "") -> LabeledCounter:
+        return self._get_or_create(LabeledCounter, name, help)
 
     def get(self, name: str):
         return self._metrics.get(name)

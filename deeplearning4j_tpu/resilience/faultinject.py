@@ -40,6 +40,17 @@ Network fault kinds (PR 4, the serving edge's chaos seams):
   ``mode="truncate"`` halves the frame (receiver must see a clean
   truncation error, never a garbage array).
 
+Continuous-batching fault kinds (PR 6, the coalesced-batch seams):
+
+- ``poison_row``       — NaN-poison the Nth predict request's features
+  at the batching seam, so ONE request in a coalesced batch produces a
+  nonfinite row block; the per-row sentinel must fail it alone while
+  its batchmates are served.
+- ``slow_batch``       — the Nth *batched* dispatch stalls ``duration``
+  seconds before execution (a hung accelerator under a formed batch);
+  deadline-blown members must fail alone, the rest succeed late or on
+  their own budget.
+
 Faults are one-shot: each schedule entry fires once, is counted in the
 metrics registry (``resilience_faults_injected_total``) and stamped as a
 tracer instant event, then disarms. ``step`` indexing is 1-based and
@@ -61,7 +72,8 @@ from deeplearning4j_tpu.profiling.metrics import get_registry
 from deeplearning4j_tpu.profiling.tracer import get_tracer
 
 _KINDS = ("raise", "nan", "truncate_checkpoint", "drop_connection",
-          "slow_loris", "hang_backend", "burst", "corrupt_frame")
+          "slow_loris", "hang_backend", "burst", "corrupt_frame",
+          "poison_row", "slow_batch")
 _CORRUPT_MODES = ("length", "crc", "truncate")
 
 
@@ -118,6 +130,8 @@ _pub_calls = 0
 _dispatch_calls = 0
 _frame_sends = 0
 _loris_sends = 0
+_predict_loads = 0
+_batch_dispatches = 0
 
 
 def set_schedule(schedule: Optional[FaultSchedule]) -> None:
@@ -125,6 +139,7 @@ def set_schedule(schedule: Optional[FaultSchedule]) -> None:
     ``at_call`` indices are relative to arming time."""
     global _schedule, _commit_calls, _recv_calls, _pub_calls
     global _dispatch_calls, _frame_sends, _loris_sends
+    global _predict_loads, _batch_dispatches
     with _lock:
         _schedule = schedule
         _commit_calls = 0
@@ -133,6 +148,8 @@ def set_schedule(schedule: Optional[FaultSchedule]) -> None:
         _dispatch_calls = 0
         _frame_sends = 0
         _loris_sends = 0
+        _predict_loads = 0
+        _batch_dispatches = 0
 
 
 def clear() -> None:
@@ -279,6 +296,52 @@ def on_backend_dispatch(op: str = "") -> None:
                     break
             if hit is not None:
                 _fire(hit, op=op, dispatch=_dispatch_calls)
+    if hit is not None:
+        time.sleep(max(0.0, hit.duration))
+
+
+def poison_predict(features: np.ndarray) -> np.ndarray:
+    """Called by KerasServer per loaded predict payload (the batching
+    seam); a scheduled ``poison_row`` fault NaN-poisons the Nth
+    request's features — so one member of a coalesced batch turns
+    nonfinite while its batchmates stay clean. The input array is
+    never mutated."""
+    global _predict_loads
+    with _lock:
+        if _schedule is None:
+            return features
+        _predict_loads += 1
+        hit = None
+        for f in _schedule.pending():
+            if f.kind == "poison_row" and f.at_call == _predict_loads:
+                hit = f
+                break
+        if hit is None:
+            return features
+        _fire(hit, request=_predict_loads)
+    poisoned = np.array(features, copy=True)
+    if not np.issubdtype(poisoned.dtype, np.floating):
+        poisoned = poisoned.astype(np.float32)
+    poisoned.flat[0] = np.nan
+    return poisoned
+
+
+def on_batch_dispatch(key: str = "") -> None:
+    """Called by the batching scheduler immediately before executing a
+    coalesced batch; a scheduled ``slow_batch`` fault stalls this
+    dispatch for ``duration`` seconds (sleep OUTSIDE the harness lock —
+    a stalled batch must not freeze the chaos schedule)."""
+    global _batch_dispatches
+    with _lock:
+        hit = None
+        if _schedule is not None:
+            _batch_dispatches += 1
+            for f in _schedule.pending():
+                if f.kind == "slow_batch" and f.at_call == _batch_dispatches:
+                    hit = f
+                    break
+            if hit is not None:
+                _fire(hit, key=key, dispatch=_batch_dispatches)
     if hit is not None:
         time.sleep(max(0.0, hit.duration))
 

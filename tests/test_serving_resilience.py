@@ -801,6 +801,151 @@ def test_keras_nonfinite_prediction_refused(iris_zip, tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# continuous batching: batchmate isolation under chaos (PR 6)
+# ---------------------------------------------------------------------------
+
+def _flushes(reason: str) -> float:
+    fam = get_registry().get("serving_batch_flushes_total")
+    return 0.0 if fam is None else fam.labels(reason=reason).value
+
+
+def test_batch_poison_row_fails_alone(iris_zip):
+    """poison_row chaos: ONE request in a coalesced batch turns
+    nonfinite. The per-row sentinel must fail it alone — its batchmates
+    are served — and a client-input failure must never charge the
+    model's circuit breaker (hair-trigger breaker_failures=1 would
+    open on any charge)."""
+    model, x = iris_zip
+    srv = KerasServer(max_concurrency=8, queue_depth=16, max_batch=8,
+                      max_wait_ms=200.0, breaker_failures=1)
+    try:
+        warm = KerasClient(srv.host, srv.port)
+        warm.predict(x, model=model)  # load + compile outside the storm
+        warm.close()
+        faultinject.set_schedule(FaultSchedule(
+            [Fault("poison_row", at_call=2)]))
+        outcomes, lock = [], threading.Lock()
+        start = threading.Barrier(3)
+
+        def one():
+            try:
+                cli = KerasClient(srv.host, srv.port)
+                try:
+                    start.wait(10.0)
+                    cli.request(op="predict", features=x, model=model)
+                    r = "ok"
+                finally:
+                    cli.close()
+            except RuntimeError as e:
+                r = str(e).split(":")[0]
+            with lock:
+                outcomes.append(r)
+
+        threads = [threading.Thread(target=one, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        # exactly one poisoned failure, both batchmates served
+        assert sorted(outcomes) == ["NONFINITE", "ok", "ok"], outcomes
+        assert _counter("serving_nonfinite_outputs_total") == 1
+        assert _counter("resilience_faults_injected_total") == 1
+        # the breaker was NOT charged for the client-input failure
+        assert get_registry().get("serving_breaker_state").value == CLOSED
+        cli = KerasClient(srv.host, srv.port)
+        assert cli.predict(x, model=model).shape == (4, 3)
+        cli.close()
+    finally:
+        srv.drain(grace_s=5.0)
+
+
+def test_batch_deadline_blown_member_fails_alone(iris_zip):
+    """slow_batch chaos: a stalled batched dispatch blows ONE member's
+    tight budget. That member alone gets DEADLINE, its generous-budget
+    batchmate is served, the deadline-aware flush is counted
+    (reason=deadline), and the breaker is not charged (the dispatch ran
+    far below breaker_slow_call_s)."""
+    model, x = iris_zip
+    srv = KerasServer(max_concurrency=8, queue_depth=16,
+                      # two 4-row requests must NOT fill the bucket —
+                      # only the deadline-aware path may flush (the
+                      # idle window is far beyond the test horizon)
+                      max_batch=32, max_wait_ms=30_000.0,
+                      batch_deadline_margin_ms=50.0,
+                      breaker_failures=1)
+    try:
+        warm = KerasClient(srv.host, srv.port)
+        warm.predict(x, model=model)
+        warm.close()
+        flushes_before = _flushes("deadline")
+        faultinject.set_schedule(FaultSchedule(
+            [Fault("slow_batch", at_call=1, duration=0.6)]))
+        results = {}
+        lock = threading.Lock()
+        start = threading.Barrier(2)
+
+        def one(name, deadline_ms):
+            try:
+                cli = KerasClient(srv.host, srv.port)
+                try:
+                    start.wait(10.0)
+                    cli.request(op="predict", features=x, model=model,
+                                deadline_ms=deadline_ms)
+                    r = "ok"
+                finally:
+                    cli.close()
+            except RuntimeError as e:
+                r = str(e).split(":")[0]
+            with lock:
+                results[name] = r
+
+        threads = [
+            threading.Thread(target=one, args=("patient", 30_000),
+                             daemon=True),
+            threading.Thread(target=one, args=("tight", 300),
+                             daemon=True)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        # the tight budget blew during the 0.6s stall; the patient
+        # batchmate rode the same batch and was served
+        assert results == {"patient": "ok", "tight": "DEADLINE"}, results
+        # the tight member's margin flushed the batch early
+        assert _flushes("deadline") >= flushes_before + 1
+        assert _counter("serving_deadline_exceeded_total") >= 1
+        # dispatch (~0.6s) << breaker_slow_call_s (30s): not charged
+        assert get_registry().get("serving_breaker_state").value == CLOSED
+    finally:
+        srv.drain(grace_s=5.0)
+
+
+def test_batch_level_failure_falls_back_to_singletons(iris_zip, tmp_path):
+    """A batch-level execution failure re-runs each member ALONE before
+    anything surfaces: healthy members succeed via the singleton
+    fallback, and only requests that fail by themselves see an error."""
+    model, x = iris_zip
+    srv = KerasServer(max_batch=8, max_wait_ms=50.0)
+    try:
+        cli = KerasClient(srv.host, srv.port)
+        cli.predict(x, model=model)  # load + warm
+        # force the batch path itself to explode: poison the compiled-
+        # step cache with a callable that always raises
+        key, bucket = model, 4
+        shape_key = ((4,), "float32")
+        def boom(_x):
+            raise RuntimeError("injected batch-step failure")
+        srv._batcher._compiled[(key, bucket, shape_key)] = boom
+        got = cli.predict(x, model=model)  # singleton fallback serves it
+        assert got.shape == (4, 3)
+        assert _counter("serving_batch_fallbacks_total") == 1
+        cli.close()
+    finally:
+        srv.drain(grace_s=5.0)
+
+
+# ---------------------------------------------------------------------------
 # ui server: /healthz, /readyz
 # ---------------------------------------------------------------------------
 

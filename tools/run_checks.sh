@@ -26,8 +26,12 @@ if [ "${1:-}" != "--fast" ]; then
     echo "== chaos smoke (NaN injection under skip_batch + resume) =="
     JAX_PLATFORMS=cpu python tools/chaos_smoke.py || fail=1
 
-    echo "== serve smoke (burst shed + /readyz drain flip + clean drain) =="
+    echo "== serve smoke (burst shed + /readyz drain flip + clean drain + batching) =="
     JAX_PLATFORMS=cpu python tools/serve_smoke.py || fail=1
+
+    echo "== serve bench smoke (continuous-batching rung, tiny model, CPU) =="
+    JAX_PLATFORMS=cpu BENCH_SMOKE=1 BENCH_RUNGS=serve BENCH_CHILD=1 \
+        python bench.py || fail=1
 
     echo "== zero1 smoke (dp=2 bitwise loss parity + sharded updater state) =="
     JAX_PLATFORMS=cpu python tools/zero1_smoke.py || fail=1

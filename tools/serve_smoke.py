@@ -6,8 +6,14 @@ shed/deadline/breaker error — zero crashes, zero garbage; (2) actually
 shed under pressure (``serving_shed_total`` > 0); (3) flip the UI
 server's ``/readyz`` to 503 while the gateway drains; (4) finish the
 drain cleanly with in-flight work completed and handler threads
-reclaimed. Exit 0 = the serving edge's hardening is wired end to end.
-"""
+reclaimed. A second, continuous-batching phase (PR 6) then proves the
+scheduler end to end: concurrent clients must coalesce into
+multi-request batches (fewer batches than requests), batched
+predictions must be BITWISE equal to the singleton warmup predictions,
+the compile count must stay flat across a second wave of
+identical-bucket requests (zero per-request recompiles), and no
+request may blow its deadline. Exit 0 = the serving edge is wired end
+to end."""
 
 import json
 import os
@@ -170,13 +176,121 @@ def main() -> int:
                           f"({threading.active_count()} vs {n0})")
                     return 1
                 time.sleep(0.05)
+
+            # ---- continuous-batching phase (PR 6): fresh registry so
+            # the burst phase's deadline counts can't mask this one's
+            batch_registry = MetricsRegistry()
+            set_registry(batch_registry)
+            rc = _batching_phase(d, model, np)
+            if rc != 0:
+                return rc
         n_ok = sum(1 for r in outcomes if r == "ok")
         print(f"serve_smoke: OK — burst of {n_burst}: {n_ok} served, "
               f"{int(shed)} shed, zero crashes; /readyz flipped during "
-              f"drain; in-flight work finished; threads reclaimed")
+              f"drain; in-flight work finished; threads reclaimed; "
+              f"batching phase passed")
         return 0
     finally:
         set_registry(prev)
+
+
+def _batching_phase(d, model, np) -> int:
+    """Concurrent clients against the continuous-batching scheduler:
+    multi-request batches must form (batches < requests), batched
+    results must bitwise-match the singleton warmup results, the
+    compile count must stay flat across the second wave, and zero
+    deadlines may blow."""
+    import os
+    import threading
+
+    from deeplearning4j_tpu.keras.server import KerasClient, KerasServer
+    from deeplearning4j_tpu.profiling.metrics import get_registry
+
+    n_clients, n_waves = 12, 2
+    srv = KerasServer(max_concurrency=n_clients, queue_depth=2 * n_clients,
+                      max_batch=8, max_wait_ms=50.0,
+                      default_deadline_ms=30_000)
+    try:
+        # feature files for every power-of-two bucket the waves can hit
+        rng = np.random.default_rng(11)
+        files = {}
+        for rows in (1, 2, 4, 8):
+            p = os.path.join(d, f"bx{rows}.npy")
+            np.save(p, rng.normal(size=(rows, 4)).astype(np.float32))
+            files[rows] = p
+        warm = KerasClient(srv.host, srv.port)
+        singleton = {rows: warm.predict(p, model=model)
+                     for rows, p in files.items()}  # also warms buckets
+        warm.close()
+        net = next(iter(srv._models.values()))
+        traces_after_warm = net._infer_traces
+
+        results, failures = {}, []
+        res_lock = threading.Lock()
+
+        def one(wave, idx):
+            try:
+                cli = KerasClient(srv.host, srv.port)
+                try:
+                    got = cli.predict(files[1], model=model)
+                    with res_lock:
+                        results[(wave, idx)] = got
+                finally:
+                    cli.close()
+            except Exception as e:  # noqa: BLE001 — reported below
+                with res_lock:
+                    failures.append(f"{type(e).__name__}: {e}")
+
+        traces_per_wave = []
+        for wave in range(n_waves):
+            threads = [threading.Thread(target=one, args=(wave, i),
+                                        daemon=True)
+                       for i in range(n_clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(30.0)
+            traces_per_wave.append(net._infer_traces)
+        if failures:
+            print(f"serve_smoke: FAIL batching wave errors {failures}")
+            return 1
+        # zero per-request recompiles after warmup: compile count flat
+        # across BOTH waves of identical-bucket requests
+        if traces_per_wave != [traces_after_warm] * n_waves:
+            print(f"serve_smoke: FAIL recompiles under batching "
+                  f"(traces {traces_after_warm} -> {traces_per_wave})")
+            return 1
+        # batched == singleton, bitwise
+        for (wave, idx), got in results.items():
+            if not np.array_equal(got, singleton[1]):
+                print(f"serve_smoke: FAIL batched prediction diverged "
+                      f"from singleton (wave {wave}, client {idx})")
+                return 1
+        reg = get_registry()
+        batched = reg.get("serving_batched_requests_total")
+        hist = reg.get("serving_batch_size")
+        n_req = n_clients * n_waves
+        if batched is None or batched.value < n_req:
+            print(f"serve_smoke: FAIL batched path not taken "
+                  f"({batched and batched.value} < {n_req})")
+            return 1
+        if hist is None or hist.count >= batched.value:
+            print(f"serve_smoke: FAIL no multi-request batch formed "
+                  f"({hist and hist.count} batches for "
+                  f"{batched.value} requests)")
+            return 1
+        deadline = reg.get("serving_deadline_exceeded_total")
+        if deadline is not None and deadline.value > 0:
+            print(f"serve_smoke: FAIL {deadline.value} requests blew "
+                  "their deadline under batching")
+            return 1
+        print(f"serve_smoke: batching — {int(batched.value)} requests "
+              f"in {hist.count} batches, compile count flat at "
+              f"{traces_after_warm}, bitwise parity, zero blown "
+              f"deadlines")
+        return 0
+    finally:
+        srv.drain(grace_s=5.0)
 
 
 if __name__ == "__main__":
