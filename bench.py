@@ -87,6 +87,10 @@ BENCH_HISTORY = {
     # serving rung (ISSUE 6): requests/sec inside the latency SLO
     # through the continuous-batching KerasServer
     "keras_serve_requests_per_sec": None,
+    # input rung (ISSUE 7): samples/sec through the sharded streaming
+    # input pipeline ALONE (read+decode+h2d, no training step) —
+    # CPU-runnable, so input-pipeline PRs are measurable off-TPU too
+    "input_pipeline_samples_per_sec": None,
 }
 
 # Peak FLOP/s per chip: ONE table for both MFU fields (the hand-model
@@ -255,7 +259,7 @@ class _RungWatchdog:
 # rung configurations
 # ---------------------------------------------------------------------------
 
-_RUNGS = ("lenet", "small", "full", "vgg", "lstm", "xl", "serve")
+_RUNGS = ("lenet", "small", "full", "vgg", "lstm", "xl", "input", "serve")
 
 
 def _rung_config(rung: str, smoke: bool):
@@ -305,6 +309,18 @@ def _rung_config(rung: str, smoke: bool):
                     batch=4 if smoke else 32, steps=2 if smoke else 20,
                     warmup=2, dtype="float32",
                     metric="charlstm_b32_t64_samples_per_sec_per_chip")
+    if rung == "input":
+        # input-pipeline throughput, no training step: N sources decode
+        # into MNIST-shaped minibatches through the staged pipeline
+        # (parallel read/decode + ordered emission + device staging);
+        # the headline is samples/sec INTO device memory
+        return dict(model="input_pipeline",
+                    sources=3 if smoke else 8,
+                    batches_per_source=2 if smoke else 6,
+                    batch=8 if smoke else 128,
+                    height=28, width=28, channels=1, classes=10,
+                    reader_workers=2, decode_workers=2,
+                    metric="input_pipeline_samples_per_sec")
     if rung == "serve":
         # serving throughput: C concurrent clients firing N predicts at
         # the continuous-batching gateway; the headline is requests/sec
@@ -524,12 +540,22 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
 
     # timed region A (loop): pure async dispatch + ONE final sync — any
     # stamp or block_until_ready inside would serialize the pipeline (a
-    # device round-trip per step on a remote-TPU link) and bias low
+    # device round-trip per step on a remote-TPU link) and bias low.
+    # The per-step next()-wait is accumulated as input_stall_s (ISSUE 7:
+    # every rung record carries it) — two perf_counter calls per step,
+    # no device sync, so the headline stays unbiased; pre-staged batches
+    # should report ~0, and a nonzero value here means the harness
+    # itself went host-bound.
     _stamp(f"timing {steps} steps (loop)...")
     with tracer.span("timed_loop", steps=steps):
+        feed = iter([staged[i % len(staged)] for i in range(steps)])
+        input_stall = 0.0
         t0 = time.perf_counter()
         for i in range(steps):
-            net.fit_batch(staged[i % len(staged)])
+            t_next = time.perf_counter()
+            b = next(feed)
+            input_stall += time.perf_counter() - t_next
+            net.fit_batch(b)
         jax.block_until_ready(net.params)
         dt_loop = time.perf_counter() - t0
     sps_loop = batch * steps / dt_loop
@@ -685,6 +711,7 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
         "batch": batch,
         "steps": steps,
         "step_ms": round(1000 * dt / steps, 2),
+        "input_stall_s": round(input_stall, 4),
         "timing_mode": timing_mode,
         "loop_samples_per_sec": round(sps_loop, 2),
         "compile_s": round(compile_s, 1),
@@ -697,6 +724,82 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
         "updater_hbm_bytes": updater_hbm,
         "phase_breakdown_s_per_step": phase_breakdown,
         "pallas_lstm_parity": parity,
+    }
+
+
+def _run_input_rung(jax, smoke: bool, on_accel: bool, device_kind: str,
+                    platform: str) -> dict:
+    """The `input` rung (ISSUE 7): samples/sec through the sharded
+    streaming input pipeline ALONE — parallel source decode, ordered
+    emission, batches staged into device memory — with no training step
+    consuming them. CPU-runnable, so input-pipeline changes are
+    measurable even while the TPU tunnel is down. The record's
+    ``input_stall_s`` here is the consumer's total wait, i.e. ~the
+    whole wall (nothing hides the pipeline behind compute); the stage
+    seconds (read/decode/h2d) ride along from the metrics registry."""
+    cfg = _rung_config("input", smoke)
+    _stamp(f"rung 'input': {cfg}")
+    tracer = get_tracer()
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.pipeline import StreamingInputPipeline
+    from deeplearning4j_tpu.profiling.metrics import get_registry
+
+    batch, per_src = cfg["batch"], cfg["batches_per_source"]
+    H, W, C, K = cfg["height"], cfg["width"], cfg["channels"], cfg["classes"]
+
+    def make_source(seed):
+        def synth():
+            r = np.random.default_rng(seed)
+            out = []
+            for _ in range(per_src):
+                x = r.normal(size=(batch, H, W, C)).astype(np.float32)
+                y = np.eye(K, dtype=np.float32)[r.integers(0, K, batch)]
+                out.append(DataSet(x, y))
+            return out
+        return synth
+
+    sources = [make_source(s) for s in range(cfg["sources"])]
+    reg0 = dict(get_registry().snapshot("input_"))
+    with tracer.span("input_pipeline", sources=len(sources)):
+        pipe = StreamingInputPipeline(
+            sources, num_shards=1, shard_index=0,
+            reader_workers=cfg["reader_workers"],
+            decode_workers=cfg["decode_workers"])
+        t0 = time.perf_counter()
+        n_samples = n_batches = 0
+        for ds in pipe:
+            jax.block_until_ready(ds.features)  # count ARRIVED batches
+            n_batches += 1
+            n_samples += ds.num_examples()
+        wall = time.perf_counter() - t0
+    sps = n_samples / wall if wall > 0 else 0.0
+    reg1 = get_registry().snapshot("input_")
+    stages = {k: round(reg1.get(k, 0.0) - reg0.get(k, 0.0), 4)
+              for k in ("input_read_seconds_total",
+                        "input_decode_seconds_total",
+                        "input_h2d_seconds_total")}
+    _stamp(f"input pipeline: {n_batches} batches / {n_samples} samples "
+           f"in {wall:.2f}s -> {sps:.1f} samples/s "
+           f"(stall {pipe.stall_s:.2f}s, stages {stages})")
+    base = (_banked_baseline(cfg["metric"])
+            if on_accel and not smoke else None)
+    return {
+        "metric": cfg["metric"] + ("" if on_accel and not smoke
+                                   else "_SMOKE"),
+        "value": round(sps, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(sps / base, 3) if base else 1.0,
+        "device_kind": device_kind,
+        "platform": platform,
+        "rung": "input",
+        "batch": batch,
+        "sources": cfg["sources"],
+        "batches": n_batches,
+        "input_stall_s": round(pipe.stall_s, 4),
+        "input_stage_seconds": stages,
+        "reader_workers": cfg["reader_workers"],
+        "decode_workers": cfg["decode_workers"],
     }
 
 
@@ -826,6 +929,9 @@ def _run_serve_rung(jax, smoke: bool, on_accel: bool, device_kind: str,
         "requests": n_done,
         "request_errors": errors[:5],
         "slo_ms": cfg["slo_ms"],
+        # no training input feeds the serve rung; the field is carried
+        # so every rung record shares the same schema (ISSUE 7)
+        "input_stall_s": 0.0,
         "slo_attained": round(n_slo / max(1, n_done), 4),
         "p50_ms": round(p50 * 1e3, 2),
         "p99_ms": round(p99 * 1e3, 2),
@@ -886,6 +992,9 @@ def _run_child() -> int:
                     tracer.span(f"rung:{rung}"):
                 if rung == "serve":
                     rec = _run_serve_rung(jax, smoke, on_accel,
+                                          device_kind, platform)
+                elif rung == "input":
+                    rec = _run_input_rung(jax, smoke, on_accel,
                                           device_kind, platform)
                 else:
                     rec = _run_rung(jax, rung, smoke, on_accel,

@@ -138,6 +138,16 @@ def bad_zero1_padding():
                   "weight_update_sharding": "zero1"}
 
 
+def bad_dp_unsharded_iterator():
+    """A dp=8 mesh fed by a plain in-memory iterator: every batch lands
+    replicated on the default device and is resharded over 'data'
+    inside the step — graphcheck must flag the wasted H2D + reshard."""
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    conf, _ = good_mlp()
+    return conf, {"mesh": {"dp": 8}, "batch_size": 64,
+                  "input_iterator": ListDataSetIterator([])}
+
+
 KNOWN_BAD: List[Tuple[str, str, Callable]] = [
     ("shape-mismatch", "GC005", bad_shape_mismatch),
     ("graph-cycle", "GC002", bad_graph_cycle),
@@ -147,6 +157,7 @@ KNOWN_BAD: List[Tuple[str, str, Callable]] = [
     ("zero1-without-dp", "GC011", bad_zero1_no_dp),
     ("zero1-over-tp-mesh", "GC011", bad_zero1_tp),
     ("zero1-padding-waste", "GC011", bad_zero1_padding),
+    ("dp-unsharded-iterator", "GC013", bad_dp_unsharded_iterator),
 ]
 
 
@@ -227,10 +238,23 @@ def good_mlp_zero1():
                   "weight_update_sharding": "zero1"}
 
 
+def good_mlp_pipeline():
+    """The MLP on a dp=8 mesh fed by a StreamingInputPipeline: the
+    trainers attach its device stage to their mesh at fit time, so
+    batches land pre-placed in the step's NamedSharding layout — must
+    validate clean (no GC013)."""
+    from deeplearning4j_tpu.datasets.pipeline import StreamingInputPipeline
+    conf, _ = good_mlp()
+    return conf, {"mesh": {"dp": 8}, "batch_size": 64,
+                  "input_iterator": StreamingInputPipeline(
+                      [], num_shards=1, shard_index=0)}
+
+
 KNOWN_GOOD: List[Tuple[str, Callable]] = [
     ("mlp", good_mlp),
     ("cnn", good_cnn),
     ("rnn", good_rnn),
     ("graph-merge", good_graph_merge),
     ("mlp-zero1", good_mlp_zero1),
+    ("mlp-sharded-pipeline", good_mlp_pipeline),
 ]

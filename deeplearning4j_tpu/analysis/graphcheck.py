@@ -32,6 +32,12 @@ Rules (stable ids; severities in parentheses):
                                     flattened-leaf padding wastes > 5% of
                                     the updater-state footprint
 - GC012 vertex-arity      (error)   vertex input count != n_inputs()
+- GC013 input-unsharded   (warning) a dp >= 2 mesh is fed by an iterator
+                                    that neither shards its sources nor
+                                    places batches into the trainer's
+                                    NamedSharding layout — every batch
+                                    lands replicated and is resharded
+                                    inside the step
 
 Entry points: ``check_multilayer`` / ``check_graph`` /
 ``validate_config`` (dispatch), plus ``.validate()`` hooks installed on
@@ -326,6 +332,35 @@ def _check_mesh(findings: List[Finding], body_layers: List[Tuple[str, object]],
                     "axis"))
 
 
+def _check_input(findings: List[Finding], axes: Dict[str, int],
+                 input_iterator) -> None:
+    """GC013: a dp >= 2 mesh fed by a non-sharded iterator. Duck-typed
+    so the validator never imports the jax-heavy datasets/parallel
+    layers: an iterator is pipeline-shaped when it exposes ``attach``
+    (the trainers bind its device stage to their mesh at fit time) or
+    already reports ``places_sharded`` — anything else hands the step
+    host batches that land replicated on the default device and get
+    resharded over 'data' every step (an extra H2D + reshard per step
+    at exactly the batch sizes where input is the bottleneck)."""
+    if input_iterator is None:
+        return
+    dp = _dp_size(axes)
+    if not dp or dp < 2:
+        return
+    if getattr(input_iterator, "places_sharded", False) \
+            or hasattr(input_iterator, "attach"):
+        return
+    findings.append(Finding(
+        "GC013", Severity.WARNING, type(input_iterator).__name__,
+        f"a dp={dp} mesh is fed by a non-sharded iterator: every batch "
+        "lands replicated on the host's default device and is resharded "
+        "over 'data' inside the compiled step — an extra H2D hop and "
+        "reshard per step, serialized with the compute it starves",
+        "feed training through datasets/pipeline.StreamingInputPipeline "
+        "(per-host disjoint source shards + batches staged directly in "
+        "the trainer's NamedSharding layout)"))
+
+
 def _optimal_max_stage(costs: List[int], n_stages: int) -> int:
     """Heaviest stage of the OPTIMAL contiguous partition — the same
     minimize-the-max objective as parallel/pipeline.partition_stages with
@@ -392,7 +427,8 @@ def _check_hbm(findings: List[Finding], rep, batch_size: Optional[int],
 
 def check_multilayer(conf, *, mesh=None, batch_size: Optional[int] = None,
                      hbm_bytes: Optional[int] = None,
-                     weight_update_sharding=None) -> List[Finding]:
+                     weight_update_sharding=None,
+                     input_iterator=None) -> List[Finding]:
     """Validate a MultiLayerConfiguration. Pure CPU metadata walk — no
     arrays are built."""
     from deeplearning4j_tpu.analysis.memory import DEFAULT_HBM_BYTES
@@ -442,6 +478,7 @@ def check_multilayer(conf, *, mesh=None, batch_size: Optional[int] = None,
     _check_mesh(findings, body, mesh, batch_size, counts=counts)
     _check_zero1(findings, [(lbl, l) for lbl, l, _ in walk],
                  _mesh_axes(mesh), weight_update_sharding)
+    _check_input(findings, _mesh_axes(mesh), input_iterator)
     _check_hbm(findings, rep, batch_size, hbm_bytes or DEFAULT_HBM_BYTES)
     return findings
 
@@ -563,7 +600,8 @@ def _walk_graph_shapes(conf, order: List[str],
 
 def check_graph(conf, *, mesh=None, batch_size: Optional[int] = None,
                 hbm_bytes: Optional[int] = None,
-                weight_update_sharding=None) -> List[Finding]:
+                weight_update_sharding=None,
+                input_iterator=None) -> List[Finding]:
     """Validate a ComputationGraphConfiguration — including configs the
     builder itself would refuse to construct (cycles, dangling refs),
     which is why this walk never calls ``_resolve_shapes``."""
@@ -661,6 +699,7 @@ def check_graph(conf, *, mesh=None, batch_size: Optional[int] = None,
     _check_mesh(findings, body, mesh, batch_size, counts=counts)
     _check_zero1(findings, [(lbl, l) for lbl, l, _ in walk],
                  _mesh_axes(mesh), weight_update_sharding)
+    _check_input(findings, _mesh_axes(mesh), input_iterator)
     if not any(f.severity == Severity.ERROR for f in findings):
         _check_hbm(findings, rep, batch_size,
                    hbm_bytes or DEFAULT_HBM_BYTES)
@@ -673,15 +712,18 @@ def check_graph(conf, *, mesh=None, batch_size: Optional[int] = None,
 
 def validate_config(conf, *, mesh=None, batch_size: Optional[int] = None,
                     hbm_bytes: Optional[int] = None,
-                    weight_update_sharding=None) -> List[Finding]:
+                    weight_update_sharding=None,
+                    input_iterator=None) -> List[Finding]:
     """Dispatch on configuration type."""
     if hasattr(conf, "nodes"):
         return check_graph(conf, mesh=mesh, batch_size=batch_size,
                            hbm_bytes=hbm_bytes,
-                           weight_update_sharding=weight_update_sharding)
+                           weight_update_sharding=weight_update_sharding,
+                           input_iterator=input_iterator)
     return check_multilayer(conf, mesh=mesh, batch_size=batch_size,
                             hbm_bytes=hbm_bytes,
-                            weight_update_sharding=weight_update_sharding)
+                            weight_update_sharding=weight_update_sharding,
+                            input_iterator=input_iterator)
 
 
 def iter_config_layers(conf) -> Iterator[Tuple[str, object,

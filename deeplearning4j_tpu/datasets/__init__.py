@@ -11,6 +11,11 @@ from deeplearning4j_tpu.datasets.iterator import (  # noqa: F401
     MultipleEpochsIterator,
     ExistingDataSetIterator,
 )
+from deeplearning4j_tpu.datasets.pipeline import (  # noqa: F401
+    IdxPair,
+    StreamingInputPipeline,
+    shard_sources,
+)
 from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator  # noqa: F401
 from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator  # noqa: F401
 from deeplearning4j_tpu.datasets.fetchers import (  # noqa: F401

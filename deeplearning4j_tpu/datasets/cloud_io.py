@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import io
 import os
+import threading
 import urllib.parse
 import urllib.request
 from pathlib import Path
@@ -175,9 +176,28 @@ def list_url(url: str) -> List[str]:
     return client_for(url).list(url)
 
 
+# per-target-path download dedup locks (in-process reader threads)
+_fetch_locks_guard = threading.Lock()
+_fetch_locks: Dict[str, threading.Lock] = {}
+
+
 def fetch_to_cache(url: str, cache_dir: Optional[str] = None) -> Path:
     """Download once into the local dataset cache and return the path
-    (the S3Downloader role for fetchers that want a file on disk)."""
+    (the S3Downloader role for fetchers that want a file on disk).
+
+    The cache file commits through ``resilience/atomic.py`` (ROADMAP
+    standing rule: anything that persists state must): a crash or a
+    chaos-injected truncation mid-download can never leave a torn file
+    at the final path to be loaded as truth later — readers see either
+    no cache entry (refetch) or the complete object.
+
+    Concurrent fetches of the SAME url (the input pipeline runs
+    parallel reader threads; two sources may share a file) serialize on
+    a per-target lock so the object downloads once; racing writers the
+    lock cannot see (other processes sharing the cache dir) each commit
+    through their own ``unique=True`` tmp — last rename wins whole,
+    nobody renames a rival's half-written tmp.
+    """
     cache = Path(cache_dir or os.environ.get(
         "DL4J_TPU_CACHE", Path.home() / ".deeplearning4j_tpu" / "cache"))
     cache.mkdir(parents=True, exist_ok=True)
@@ -188,11 +208,24 @@ def fetch_to_cache(url: str, cache_dir: Optional[str] = None) -> Path:
     cache_root = cache.resolve()
     if not target.resolve().is_relative_to(cache_root):
         raise ValueError(f"Key {key!r} escapes the cache directory")
-    if not target.exists():
-        target.parent.mkdir(parents=True, exist_ok=True)
-        tmp = target.with_suffix(target.suffix + ".part")
-        tmp.write_bytes(read_url(url))
-        tmp.replace(target)
+    with _fetch_locks_guard:
+        lock = _fetch_locks.setdefault(str(target), threading.Lock())
+    try:
+        with lock:
+            if not target.exists():
+                target.parent.mkdir(parents=True, exist_ok=True)
+                from deeplearning4j_tpu.resilience.atomic import atomic_path
+                data = read_url(url)
+                with atomic_path(target, unique=True) as tmp:
+                    tmp.write_bytes(data)
+    finally:
+        # drop the entry — a per-file lock is only needed until the file
+        # exists, and a long-lived trainer streaming a large corpus
+        # would otherwise intern one lock per file for process lifetime.
+        # A waiter still holding the popped lock races a fresh one
+        # harmlessly: each commits whole via its own unique tmp.
+        with _fetch_locks_guard:
+            _fetch_locks.pop(str(target), None)
     return target
 
 

@@ -11,9 +11,7 @@ generated so training/tests run anywhere. ``is_synthetic`` reports which.
 
 from __future__ import annotations
 
-import gzip
 import os
-import struct
 from pathlib import Path
 from typing import Optional, Tuple
 
@@ -53,21 +51,9 @@ def _find(names) -> Optional[Path]:
 
 
 def _read_idx(path: Path) -> np.ndarray:
-    # native fast path (native/dataloader.cc via datasets/native_io.py);
-    # returns u8-valued float32 with scale=1 — cast back for callers that
-    # expect raw bytes. Python fallback covers gz and missing .so.
-    from deeplearning4j_tpu.datasets import native_io
-    native = native_io.idx_read(path, scale=1.0)
-    if native is not None:
-        return native.astype(np.uint8)
-    opener = gzip.open if path.suffix == ".gz" else open
-    with opener(path, "rb") as f:
-        data = f.read()
-    magic, = struct.unpack(">H", data[2:4])
-    dtype_code, ndim = data[2], data[3]
-    dims = struct.unpack(f">{ndim}I", data[4:4 + 4 * ndim])
-    arr = np.frombuffer(data, dtype=np.uint8, offset=4 + 4 * ndim)
-    return arr.reshape(dims)
+    # the shared validated IDX parser, raw-u8 mode (zero-copy view)
+    from deeplearning4j_tpu.datasets import pipeline
+    return pipeline.read_idx(path, scale=None)
 
 
 def _synthetic_mnist(n: int, seed: int = 123) -> Tuple[np.ndarray, np.ndarray]:

@@ -52,5 +52,13 @@ class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
             net, mesh, gradient_accumulation=gradient_accumulation,
             collect_training_stats=collect_training_stats,
             weight_update_sharding=weight_update_sharding)
+        if hasattr(train_data, "attach"):
+            # the early-stopping loop iterates train_data directly
+            # (never through ParallelTrainer.fit), so bind a streaming
+            # pipeline's device stage to the mesh here — same contract
+            # as ParallelTrainer.fit: batches arrive pre-placed in the
+            # step's NamedSharding layout instead of landing replicated
+            # and resharding every step
+            train_data.attach(mesh=trainer.mesh)
         super().__init__(config, _ParallelNetAdapter(trainer), train_data)
         self.trainer = trainer

@@ -271,10 +271,14 @@ class BatchScheduler:
                     self._cond.wait(left)
                     queue = self._queues.get(key)
                 if self._stopping:
-                    for p in queue:
+                    # queue may be None here: stop() can race the idle
+                    # retirement above (another pass popped the deque
+                    # between our wait and this re-fetch)
+                    for p in (queue or ()):
                         p.error = DrainingError("batch scheduler stopped")
                         p.event.set()
-                    queue.clear()
+                    if queue is not None:
+                        queue.clear()
                     return
                 batch, reason = self._form_batch(queue)
             try:

@@ -7,7 +7,11 @@ deeplearning4j/spark/impl/paramavg/stats/ParameterAveragingTrainingMasterStats.j
 ``collectTrainingStats`` flag, exportable as charts). Here the phases are the
 ones an MFU hunt on a chip actually needs:
 
-- ``data_wait``   host blocked on the iterator for the next batch
+- ``data_wait``   host blocked on the iterator for the next batch —
+                  the INPUT STALL: ``export()`` surfaces its total as
+                  the top-level ``input_stall_s`` field (the same
+                  number every bench rung record carries), so
+                  input-bound vs compute-bound time is one comparison
 - ``shard``       host->device placement (device_put / batch sharding)
 - ``step``        device step wall time (the flag forces a
                   ``block_until_ready`` sync per step, exactly like the
@@ -92,6 +96,15 @@ class TrainingStats:
         self._cost = cost
 
     # --------------------------------------------------------------- exports
+    def input_stall_s(self) -> float:
+        """Total host seconds blocked waiting on the iterator for the
+        next batch (the ``data_wait`` phase — ``fit`` records it around
+        every ``next()`` via ``timed_iter``). ~0 when the input
+        pipeline keeps ahead of the step; the chip-starvation measure
+        otherwise."""
+        p = self.phases.get("data_wait")
+        return p["total_s"] if p else 0.0
+
     def wall_s(self) -> float:
         if self._t0 is None:
             return 0.0
@@ -109,6 +122,7 @@ class TrainingStats:
                 fraction=(p["total_s"] / wall) if wall > 0 else 0.0)
         out["covered_fraction"] = (
             self.total_phase_s() / wall if wall > 0 else 0.0)
+        out["input_stall_s"] = self.input_stall_s()
         if self._cost:
             out["cost_analysis"] = dict(self._cost)
             step = self.phases.get("step")

@@ -226,6 +226,15 @@ class MeshContext:
         for a in arrays:
             if a is None:
                 out.append(None)
+            elif (isinstance(a, jax.Array) and a.sharding
+                    == self.batch_sharding(np.ndim(a), np.shape(a))):
+                # already placed in this mesh's batch layout (the input
+                # pipeline's device stage via attach(mesh=...)): pass
+                # through. Re-placing would be a wasted no-op
+                # single-process and a CRASH multi-process
+                # (np.asarray on a global array whose shards live on
+                # other hosts' devices).
+                out.append(a)
             elif multi:
                 # local T == global T (only the batch axis is split across
                 # processes), so the shape-based sp-divisibility check holds

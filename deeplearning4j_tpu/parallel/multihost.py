@@ -85,6 +85,32 @@ def global_array(local_data, sharding):
         sharding, np.asarray(local_data))
 
 
+def shard_sources(sources):
+    """THIS host's disjoint strided shard of a dataset source list —
+    shard ``process_index()`` of ``process_count()`` (the per-host input
+    contract: no two hosts ever read the same bytes). Single-process:
+    identity."""
+    from deeplearning4j_tpu.datasets.pipeline import (
+        shard_sources as _shard)
+    return _shard(sources, jax.process_count(), jax.process_index())
+
+
+def input_pipeline(sources, mesh=None, **kwargs):
+    """Per-host sharded :class:`~deeplearning4j_tpu.datasets.pipeline.
+    StreamingInputPipeline`: this process reads source shard
+    ``process_index()`` of ``process_count()`` and — when ``mesh`` is a
+    ``MeshContext`` (or left None and the pipeline is handed to
+    ``ParallelTrainer.fit``, which attaches its own) — stages each batch
+    as this host's slice of the GLOBAL sharded batch array
+    (``make_array_from_process_local_data``). Feed the result to
+    ``data_parallel_trainer(...).fit`` as-is; every host runs the same
+    call on the same source list."""
+    from deeplearning4j_tpu.datasets.pipeline import StreamingInputPipeline
+    kwargs.setdefault("num_shards", jax.process_count())
+    kwargs.setdefault("shard_index", jax.process_index())
+    return StreamingInputPipeline(sources, mesh=mesh, **kwargs)
+
+
 def data_parallel_trainer(net, n_model: int = 1,
                           gradient_accumulation: int = 1,
                           weight_update_sharding=None, **kwargs):

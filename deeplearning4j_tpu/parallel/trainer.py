@@ -321,6 +321,19 @@ class ParallelTrainer:
             for _ in range(epochs):
                 self.fit_batch(data)
             return self
+        if hasattr(data, "attach"):
+            # streaming input pipeline: bind its device stage to THIS
+            # mesh so batches arrive pre-placed in the step's
+            # NamedSharding batch layout (the in-step shard_batch then
+            # finds them already placed and moves nothing) — instead of
+            # landing replicated and resharding every step. The scan
+            # path stacks a window of batches HOST-side before placing
+            # the stack, so per-batch device staging would only force a
+            # D2H round trip (and crash multi-process, where pulling a
+            # global array back to one host is illegal) — keep those
+            # host-side.
+            data.attach(mesh=self.mesh,
+                        place=False if scan_window > 1 else None)
         it = (AsyncDataSetIterator(data)
               if use_async and data.async_supported() else data)
         stats = self.training_stats

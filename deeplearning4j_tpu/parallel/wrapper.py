@@ -214,6 +214,12 @@ class ParallelWrapper:
             from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
             iterator = ListDataSetIterator(
                 iterator.batch_by(max(1, iterator.num_examples() // self.workers)))
+        if hasattr(iterator, "attach"):
+            # streaming input pipeline: keep batches HOST-side — the
+            # wrapper stacks ``workers`` batches along a new leading
+            # axis before placement, so per-batch device staging would
+            # only force a gather-restack round trip
+            iterator.attach(place=False)
         it = (AsyncDataSetIterator(iterator, queue_size=self.prefetch_buffer)
               if iterator.async_supported() else iterator)
         net = self.net

@@ -27,6 +27,7 @@ commit point so chaos tests exercise the real code path.
 from __future__ import annotations
 
 import os
+import threading
 import zlib
 from contextlib import contextmanager
 from pathlib import Path
@@ -70,7 +71,7 @@ def fsync_dir(path: Union[str, Path]) -> None:
 
 
 @contextmanager
-def atomic_path(path: Union[str, Path]):
+def atomic_path(path: Union[str, Path], unique: bool = False):
     """Stream-friendly atomic commit: yields a tmp path for the caller
     to write (e.g. ``np.savez`` into an open handle, or a zipfile),
     then fsync + rename + dir-fsync on clean exit. Use this instead of
@@ -78,20 +79,40 @@ def atomic_path(path: Union[str, Path]):
     a second full copy in host RAM matters (pod-scale shard files);
     compute its CRC with ``crc32_file(tmp)`` before the block ends.
 
+    ``unique=True`` suffixes the tmp name with pid+thread so concurrent
+    UNCOORDINATED writers of the same final path (e.g. two processes
+    populating one shared dataset cache) each commit their own complete
+    bytes — last rename wins whole, nobody renames a rival's
+    half-written tmp. Checkpoint writers keep the deterministic name
+    (one writer per shard by construction; a stable name is what the
+    torn-write chaos + cleanup tooling key on).
+
     On an exception inside the block the tmp file is removed and the
     final path is untouched.
     """
     path = Path(path)
-    tmp = path.with_name(path.name + ".tmp")
+    suffix = (f".{os.getpid()}-{threading.get_ident()}.tmp"
+              if unique else ".tmp")
+    tmp = path.with_name(path.name + suffix)
     try:
         yield tmp
-    except BaseException:
-        tmp.unlink(missing_ok=True)
+        with open(tmp, "rb+") as f:
+            os.fsync(f.fileno())
+        _commit_hook(tmp, path)
+        os.replace(tmp, path)
+    except BaseException as e:
+        # an exception ANYWHERE before the rename lands — including the
+        # commit window itself (fsync ENOSPC) — must not strand the
+        # tmp: with unique=True every retrying thread gets a fresh
+        # suffix, so orphans would accumulate unboundedly in a shared
+        # cache. EXCEPT a simulated SIGKILL: a killed process runs no
+        # cleanup, so the chaos harness must see the torn stump a real
+        # mid-commit death leaves behind. Lazy import — this module
+        # stays importable with zero package dependencies.
+        from deeplearning4j_tpu.resilience.faultinject import KilledByFault
+        if not isinstance(e, KilledByFault):
+            tmp.unlink(missing_ok=True)
         raise
-    with open(tmp, "rb+") as f:
-        os.fsync(f.fileno())
-    _commit_hook(tmp, path)
-    os.replace(tmp, path)
     fsync_dir(path.parent)
 
 

@@ -51,6 +51,19 @@ Continuous-batching fault kinds (PR 6, the coalesced-batch seams):
   deadline-blown members must fail alone, the rest succeed late or on
   their own budget.
 
+Input-pipeline fault kinds (PR 7, the streaming-input seams):
+
+- ``slow_input``       — the Nth pipeline ``next()`` stalls ``duration``
+  seconds before the consumer dequeues; the stall must land in
+  ``input_stall_s`` (and ``input_stall_seconds_total``) with the
+  open-span stack naming ``input:wait`` — a starved trainer is a
+  measurement, never a mystery hang.
+- ``io_error``         — the Nth reader-worker read attempt raises (a
+  flaky object store / lost NFS mount); the pipeline's bounded-backoff
+  retry (the PR-3 policy) must absorb it, counted in
+  ``input_read_retries_total``, or surface a clean in-order error when
+  retries are exhausted.
+
 Faults are one-shot: each schedule entry fires once, is counted in the
 metrics registry (``resilience_faults_injected_total``) and stamped as a
 tracer instant event, then disarms. ``step`` indexing is 1-based and
@@ -73,7 +86,7 @@ from deeplearning4j_tpu.profiling.tracer import get_tracer
 
 _KINDS = ("raise", "nan", "truncate_checkpoint", "drop_connection",
           "slow_loris", "hang_backend", "burst", "corrupt_frame",
-          "poison_row", "slow_batch")
+          "poison_row", "slow_batch", "slow_input", "io_error")
 _CORRUPT_MODES = ("length", "crc", "truncate")
 
 
@@ -132,6 +145,8 @@ _frame_sends = 0
 _loris_sends = 0
 _predict_loads = 0
 _batch_dispatches = 0
+_input_nexts = 0
+_reader_reads = 0
 
 
 def set_schedule(schedule: Optional[FaultSchedule]) -> None:
@@ -139,7 +154,7 @@ def set_schedule(schedule: Optional[FaultSchedule]) -> None:
     ``at_call`` indices are relative to arming time."""
     global _schedule, _commit_calls, _recv_calls, _pub_calls
     global _dispatch_calls, _frame_sends, _loris_sends
-    global _predict_loads, _batch_dispatches
+    global _predict_loads, _batch_dispatches, _input_nexts, _reader_reads
     with _lock:
         _schedule = schedule
         _commit_calls = 0
@@ -150,6 +165,8 @@ def set_schedule(schedule: Optional[FaultSchedule]) -> None:
         _loris_sends = 0
         _predict_loads = 0
         _batch_dispatches = 0
+        _input_nexts = 0
+        _reader_reads = 0
 
 
 def clear() -> None:
@@ -389,6 +406,42 @@ def slow_loris_s() -> float:
                 _fire(f, duration=f.duration)
                 return max(0.0, f.duration)
         return 0.0
+
+
+def on_input_next() -> float:
+    """Called by the input pipeline's consumer per ``next()``; returns
+    the stall (seconds) a scheduled ``slow_input`` fault injects into
+    this (``at_call``-th) call — 0.0 = no stall. The caller sleeps
+    INSIDE its ``input:wait`` span so the injected stall is measured as
+    input stall and attributed by the open-span stack."""
+    global _input_nexts
+    with _lock:
+        if _schedule is None:
+            return 0.0
+        _input_nexts += 1
+        for f in _schedule.pending():
+            if f.kind == "slow_input" and f.at_call == _input_nexts:
+                _fire(f, next=_input_nexts, duration=f.duration)
+                return max(0.0, f.duration)
+        return 0.0
+
+
+def on_reader_read(source=None) -> None:
+    """Called by pipeline reader workers per read ATTEMPT; a scheduled
+    ``io_error`` fault raises ``FaultInjected`` on its Nth attempt (a
+    flaky object store). The pipeline's bounded-backoff retry loop sits
+    around this call, so consecutive scheduled faults exhaust retries
+    exactly like a persistent outage would."""
+    global _reader_reads
+    with _lock:
+        if _schedule is None:
+            return
+        _reader_reads += 1
+        for f in _schedule.pending():
+            if f.kind == "io_error" and f.at_call == _reader_reads:
+                _fire(f, read=_reader_reads, source=str(source)[:120])
+                raise FaultInjected(
+                    f"injected io_error at reader read {_reader_reads}")
 
 
 def burst_size() -> int:

@@ -137,3 +137,58 @@ def test_mnist_fetcher_from_cloud_url(monkeypatch, tmp_path):
     assert got_imgs.shape == (32, 28, 28)
     np.testing.assert_allclose(got_imgs, imgs.astype(np.float32) / 255.0)
     np.testing.assert_array_equal(got_labels, labels)
+
+
+def test_fetch_to_cache_truncate_mid_fetch_never_lands_torn(
+        store, tmp_path):
+    """Chaos (ISSUE 7): a crash mid-download — the faultinject harness
+    truncates + kills inside the atomic commit window — must leave NO
+    file at the final cache path. Before fetch_to_cache wrote through
+    ``resilience/atomic.py`` the torn prefix stayed behind and the next
+    reader loaded it as truth; now a retry refetches the full object."""
+    from deeplearning4j_tpu.resilience import faultinject
+    from deeplearning4j_tpu.resilience.faultinject import (
+        Fault, FaultSchedule, KilledByFault,
+    )
+    url = "gs://data/shard/a.bin"
+    faultinject.set_schedule(FaultSchedule(
+        [Fault("truncate_checkpoint", mode="crash")]))
+    try:
+        with pytest.raises(KilledByFault):
+            cloud_io.fetch_to_cache(url, cache_dir=tmp_path)
+    finally:
+        faultinject.clear()
+    finals = [p for p in tmp_path.rglob("*")
+              if p.is_file() and not p.name.endswith(".tmp")]
+    assert finals == []  # no torn file to be loaded as truth later
+    # the crashed process's retry (or the next run) gets the whole object
+    p = cloud_io.fetch_to_cache(url, cache_dir=tmp_path)
+    assert p.read_bytes() == b"AAAA"
+
+
+def test_concurrent_fetch_to_cache_downloads_once_and_whole(
+        store, tmp_path):
+    """The pipeline's parallel readers may fetch the same URL at once:
+    the per-target lock dedups the download and the unique-tmp atomic
+    commit means no racer can rename a rival's half-written file."""
+    import threading
+
+    results, errors = [], []
+
+    def fetch():
+        try:
+            results.append(
+                cloud_io.fetch_to_cache("gs://data/iris.csv",
+                                        cache_dir=tmp_path))
+        except Exception as e:  # pragma: no cover - diagnostic
+            errors.append(e)
+
+    threads = [threading.Thread(target=fetch) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(set(results)) == 1
+    assert results[0].read_bytes() == store.objects["gs://data/iris.csv"]
+    assert len(store.reads) == 1  # five losers found it cached

@@ -1,0 +1,184 @@
+"""Native C++ IDX/CSV fast path vs the pure-Python fallback: the two
+parsers must agree BYTE-FOR-BYTE on MNIST-shaped fixtures (the pipeline
+decode stage and fetchers pick whichever is available — a box without
+the shared library must train on bitwise-identical data), and the
+``available() == False`` seam must degrade gracefully everywhere it is
+consulted."""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import native_io
+from deeplearning4j_tpu.datasets.pipeline import _idx_read_python, read_idx
+from deeplearning4j_tpu.datasets.records import CSVRecordReader
+
+
+def _write_idx(path, arr):
+    arr = np.asarray(arr, dtype=np.uint8)
+    with open(path, "wb") as f:
+        f.write(struct.pack(">BBBB", 0, 0, 0x08, arr.ndim))
+        f.write(struct.pack(f">{arr.ndim}I", *arr.shape))
+        f.write(arr.tobytes())
+
+
+@pytest.fixture
+def mnist_shaped(tmp_path, rng):
+    """MNIST-shaped fixture pair: [N,28,28] u8 images + [N] u8 labels,
+    including the 0 and 255 extremes the scale multiply must round
+    identically."""
+    imgs = rng.integers(0, 256, (7, 28, 28)).astype(np.uint8)
+    imgs[0, 0, 0], imgs[0, 0, 1] = 0, 255
+    labels = rng.integers(0, 10, (7,)).astype(np.uint8)
+    _write_idx(tmp_path / "images.idx", imgs)
+    _write_idx(tmp_path / "labels.idx", labels)
+    return tmp_path, imgs, labels
+
+
+@pytest.fixture
+def no_native(monkeypatch):
+    """Simulate a box where libdataloader.so was never built."""
+    monkeypatch.setattr(native_io, "_lib", None)
+    monkeypatch.setattr(native_io, "_checked", True)
+    assert not native_io.available()
+
+
+needs_native = pytest.mark.skipif(not native_io.available(),
+                                  reason="libdataloader.so not built")
+
+
+# ------------------------------------------------------------------ parity
+
+@needs_native
+@pytest.mark.parametrize("scale", [1.0, 1.0 / 255.0])
+def test_idx_native_matches_python_bitwise(mnist_shaped, scale):
+    d, imgs, labels = mnist_shaped
+    for name in ("images.idx", "labels.idx"):
+        fast = native_io.idx_read(d / name, scale=scale)
+        slow = _idx_read_python(d / name, scale)
+        assert fast is not None
+        assert fast.dtype == slow.dtype == np.float32
+        assert fast.shape == slow.shape
+        assert fast.tobytes() == slow.tobytes()
+
+
+def test_idx_python_parses_the_fixture_faithfully(mnist_shaped):
+    d, imgs, labels = mnist_shaped
+    got = _idx_read_python(d / "images.idx", 1.0 / 255.0)
+    # double product then cast — the C parser's exact arithmetic
+    want = (imgs.astype(np.float64) * (1.0 / 255.0)).astype(np.float32)
+    assert got.tobytes() == want.tobytes()
+    np.testing.assert_array_equal(
+        _idx_read_python(d / "labels.idx", 1.0), labels.astype(np.float32))
+
+
+def test_read_idx_raw_u8_mode_returns_the_exact_bytes(mnist_shaped):
+    """``read_idx(path, scale=None)`` is the scale-free mode callers
+    like ``mnist._read_idx`` use: the validated header parse returning
+    the u8 payload as-is — no float64/float32 intermediates (~12x the
+    payload for MNIST-sized files) just to get the same bytes back."""
+    d, imgs, labels = mnist_shaped
+    got = read_idx(d / "images.idx", scale=None)
+    assert got.dtype == np.uint8
+    assert got.tobytes() == imgs.tobytes()
+    np.testing.assert_array_equal(read_idx(d / "labels.idx", scale=None),
+                                  labels)
+    # the header gate still applies in raw mode
+    bad = d / "bad.idx"
+    bad.write_bytes(b"\x00\x00\x0d\x01" + struct.pack(">1I", 2) + b"\x00" * 8)
+    with pytest.raises(ValueError, match="unsigned-byte"):
+        read_idx(bad, scale=None)
+
+
+def test_mnist_read_idx_delegates_to_the_shared_parser(mnist_shaped):
+    from deeplearning4j_tpu.datasets import mnist
+    d, imgs, labels = mnist_shaped
+    out = mnist._read_idx(d / "images.idx")
+    assert out.dtype == np.uint8 and out.tobytes() == imgs.tobytes()
+
+
+@needs_native
+def test_csv_native_matches_python_float_parse(tmp_path, rng):
+    rows = rng.normal(size=(12, 5))
+    lines = "\n".join(",".join(repr(float(v)) for v in row) for row in rows)
+    p = tmp_path / "data.csv"
+    p.write_text(lines + "\n")
+    parsed = native_io.csv_read(p)
+    assert parsed is not None
+    mat, ncols = parsed
+    assert (mat.shape, ncols) == ((12, 5), 5)
+    # strtod and Python's float() parse identically -> bitwise equal
+    want = np.array([[float(tok) for tok in line.split(",")]
+                     for line in lines.splitlines()], dtype=np.float64)
+    assert mat.tobytes() == want.tobytes()
+
+
+@needs_native
+def test_csv_record_reader_same_records_with_and_without_native(
+        tmp_path, rng, monkeypatch):
+    rows = rng.normal(size=(6, 4))
+    p = tmp_path / "r.csv"
+    p.write_text("\n".join(",".join(repr(float(v)) for v in row)
+                           for row in rows) + "\n")
+    fast = [r for r in iter_records(CSVRecordReader(str(p)))]
+    monkeypatch.setattr(native_io, "_lib", None)
+    monkeypatch.setattr(native_io, "_checked", True)
+    slow = [r for r in iter_records(CSVRecordReader(str(p)))]
+    assert len(fast) == len(slow) == 6
+    for a, b in zip(fast, slow):
+        assert np.asarray(a, dtype=np.float64).tobytes() \
+            == np.asarray(b, dtype=np.float64).tobytes()
+
+
+def iter_records(rr):
+    while rr.has_next():
+        yield rr.next_record()
+
+
+# ------------------------------------------------- graceful unavailability
+
+def test_idx_read_falls_back_when_native_unavailable(mnist_shaped,
+                                                     no_native):
+    d, imgs, _ = mnist_shaped
+    assert native_io.idx_read(d / "images.idx") is None  # the seam
+    got = read_idx(d / "images.idx", scale=1.0 / 255.0)  # the consumer
+    want = (imgs.astype(np.float64) * (1.0 / 255.0)).astype(np.float32)
+    assert got.tobytes() == want.tobytes()
+
+
+def test_csv_read_none_when_native_unavailable(tmp_path, no_native):
+    p = tmp_path / "x.csv"
+    p.write_text("1,2\n3,4\n")
+    assert native_io.csv_read(p) is None
+    # the consumer seam: CSVRecordReader still yields the rows
+    got = list(iter_records(CSVRecordReader(str(p))))
+    assert got == [[1.0, 2.0], [3.0, 4.0]]
+
+
+def test_gzip_idx_takes_the_python_path_everywhere(tmp_path, rng):
+    imgs = rng.integers(0, 256, (3, 4, 4)).astype(np.uint8)
+    plain = tmp_path / "g.idx"
+    _write_idx(plain, imgs)
+    gz = tmp_path / "g.idx.gz"
+    gz.write_bytes(gzip.compress(plain.read_bytes()))
+    assert native_io.idx_read(gz) is None  # native refuses gz: fallback
+    assert read_idx(gz, scale=1.0 / 255.0).tobytes() \
+        == read_idx(plain, scale=1.0 / 255.0).tobytes()
+
+
+def test_non_u8_idx_is_rejected_not_shredded(tmp_path):
+    """A legal-but-unsupported IDX dtype (0x0D = float32) must raise a
+    clean error on BOTH paths — the native parser refuses it (falls
+    back), and the Python fallback must not reinterpret the payload
+    byte-by-byte into garbage 'pixels' that train silently."""
+    from deeplearning4j_tpu.datasets.pipeline import read_idx
+    path = tmp_path / "f32.idx"
+    payload = np.arange(6, dtype=">f4")
+    with open(path, "wb") as f:
+        f.write(struct.pack(">BBBB", 0, 0, 0x0D, 1))
+        f.write(struct.pack(">I", payload.size))
+        f.write(payload.tobytes())
+    with pytest.raises(ValueError, match="unsigned-byte IDX"):
+        read_idx(path)

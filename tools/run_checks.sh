@@ -29,12 +29,15 @@ if [ "${1:-}" != "--fast" ]; then
     echo "== serve smoke (burst shed + /readyz drain flip + clean drain + batching) =="
     JAX_PLATFORMS=cpu python tools/serve_smoke.py || fail=1
 
-    echo "== serve bench smoke (continuous-batching rung, tiny model, CPU) =="
-    JAX_PLATFORMS=cpu BENCH_SMOKE=1 BENCH_RUNGS=serve BENCH_CHILD=1 \
+    echo "== serve+input bench smoke (batching + input-pipeline rungs, CPU) =="
+    JAX_PLATFORMS=cpu BENCH_SMOKE=1 BENCH_RUNGS=input,serve BENCH_CHILD=1 \
         python bench.py || fail=1
 
     echo "== zero1 smoke (dp=2 bitwise loss parity + sharded updater state) =="
     JAX_PLATFORMS=cpu python tools/zero1_smoke.py || fail=1
+
+    echo "== input smoke (pipeline vs sync: loss parity + lower stall) =="
+    JAX_PLATFORMS=cpu python tools/input_smoke.py || fail=1
 
     echo "== tier-1 tests (ROADMAP.md) =="
     rm -f /tmp/_t1.log
