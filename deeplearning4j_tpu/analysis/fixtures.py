@@ -148,6 +148,24 @@ def bad_dp_unsharded_iterator():
                   "input_iterator": ListDataSetIterator([])}
 
 
+def bad_elastic_indivisible():
+    """A dp=4 fleet planning to survive down to 3 hosts: global batch 32
+    shards over 4 and over 2, but a resize to dp=3 cannot split it —
+    the host loss the plan claims to survive would kill the resume."""
+    conf, _ = good_mlp()
+    return conf, {"mesh": {"dp": 4}, "batch_size": 32,
+                  "elastic_resize_widths": [3, 2, 1]}
+
+
+def bad_elastic_grow():
+    """A planned 'surviving' width of 8 on a dp=4 mesh: an elastic
+    resize only shrinks (hosts are lost, not gained) — the plan is
+    nonsense and must be rejected statically."""
+    conf, _ = good_mlp()
+    return conf, {"mesh": {"dp": 4}, "batch_size": 32,
+                  "elastic_resize_widths": [8]}
+
+
 KNOWN_BAD: List[Tuple[str, str, Callable]] = [
     ("shape-mismatch", "GC005", bad_shape_mismatch),
     ("graph-cycle", "GC002", bad_graph_cycle),
@@ -158,6 +176,8 @@ KNOWN_BAD: List[Tuple[str, str, Callable]] = [
     ("zero1-over-tp-mesh", "GC011", bad_zero1_tp),
     ("zero1-padding-waste", "GC011", bad_zero1_padding),
     ("dp-unsharded-iterator", "GC013", bad_dp_unsharded_iterator),
+    ("elastic-resize-indivisible", "GC014", bad_elastic_indivisible),
+    ("elastic-resize-grows", "GC014", bad_elastic_grow),
 ]
 
 
@@ -250,6 +270,17 @@ def good_mlp_pipeline():
                       [], num_shards=1, shard_index=0)}
 
 
+def good_mlp_elastic():
+    """A dp=4 zero1 fleet with a legal survival plan: batch 64 divides
+    every planned surviving width (2 and the sole-survivor dp=1, where
+    zero1 degrades to the replicated layout) and the large layers keep
+    re-evaluated padding negligible — must validate clean."""
+    conf, _ = good_mlp()
+    return conf, {"mesh": {"dp": 4}, "batch_size": 64,
+                  "weight_update_sharding": "zero1",
+                  "elastic_resize_widths": [2, 1]}
+
+
 KNOWN_GOOD: List[Tuple[str, Callable]] = [
     ("mlp", good_mlp),
     ("cnn", good_cnn),
@@ -257,4 +288,5 @@ KNOWN_GOOD: List[Tuple[str, Callable]] = [
     ("graph-merge", good_graph_merge),
     ("mlp-zero1", good_mlp_zero1),
     ("mlp-sharded-pipeline", good_mlp_pipeline),
+    ("mlp-elastic-plan", good_mlp_elastic),
 ]

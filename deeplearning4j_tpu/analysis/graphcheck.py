@@ -38,6 +38,14 @@ Rules (stable ids; severities in parentheses):
                                     NamedSharding layout — every batch
                                     lands replicated and is resharded
                                     inside the step
+- GC014 elastic-resize    (error)   a planned surviving dp width (the
+                                    mesh an elastic resize would leave
+                                    after host loss) cannot split the
+                                    global batch, or is not a possible
+                                    surviving width; (warning) zero1
+                                    pad-to-divisible waste re-evaluated
+                                    at the surviving width exceeds the
+                                    GC011 threshold
 
 Entry points: ``check_multilayer`` / ``check_graph`` /
 ``validate_config`` (dispatch), plus ``.validate()`` hooks installed on
@@ -221,6 +229,29 @@ def _wus_mode(weight_update_sharding) -> str:
                        weight_update_sharding)).lower()
 
 
+def _zero1_pad_waste(all_layers: List[Tuple[str, object]],
+                     width: int) -> Optional[float]:
+    """Fraction of the zero1-sharded updater state that is
+    pad-to-divisible filler at a ``width``-way data axis (each flattened
+    leaf rounds up to a multiple of ``width``). None when no param
+    shapes could be inferred."""
+    from math import prod
+
+    from deeplearning4j_tpu.analysis.memory import param_shapes
+    sizes: List[int] = []
+    for label, layer in all_layers:
+        try:
+            shapes = param_shapes(layer)
+        except Exception:
+            continue  # inference failure already reported as GC005
+        sizes.extend(int(prod(s)) if s else 1 for s in shapes.values())
+    total = sum(sizes)
+    if total <= 0:
+        return None
+    padded = sum(-(-s // width) * width for s in sizes)
+    return (padded - total) / total
+
+
 def _check_zero1(findings: List[Finding],
                  all_layers: List[Tuple[str, object]],
                  axes: Dict[str, int],
@@ -252,27 +283,13 @@ def _check_zero1(findings: List[Finding],
             "construction",
             "drop the model axis or use weight_update_sharding='off'"))
         return
-    from math import prod
-
-    from deeplearning4j_tpu.analysis.memory import param_shapes
-    sizes: List[int] = []
-    for label, layer in all_layers:
-        try:
-            shapes = param_shapes(layer)
-        except Exception:
-            continue  # inference failure already reported as GC005
-        sizes.extend(int(prod(s)) if s else 1 for s in shapes.values())
-    total = sum(sizes)
-    if total <= 0:
-        return
-    padded = sum(-(-s // dp) * dp for s in sizes)
-    waste = (padded - total) / total
-    if waste > ZERO1_PADDING_WASTE:
+    waste = _zero1_pad_waste(all_layers, dp)
+    if waste is not None and waste > ZERO1_PADDING_WASTE:
         findings.append(Finding(
             "GC011", Severity.WARNING, f"dp={dp}",
             f"zero1 flattened-leaf padding wastes {waste:.0%} of the "
-            f"updater state ({padded - total:,} of {total:,} elements "
-            f"are pad-to-divisible filler over the {dp}-way axis)",
+            f"updater state (pad-to-divisible filler over the {dp}-way "
+            "axis)",
             "shrink the dp axis, widen the model's small layers, or "
             "accept the overhead (it is per-leaf <= dp-1 elements)"))
 
@@ -361,6 +378,54 @@ def _check_input(findings: List[Finding], axes: Dict[str, int],
         "the trainer's NamedSharding layout)"))
 
 
+def _check_elastic(findings: List[Finding],
+                   all_layers: List[Tuple[str, object]],
+                   axes: Dict[str, int], batch_size: Optional[int],
+                   weight_update_sharding,
+                   elastic_resize_widths) -> None:
+    """GC014: post-resize mesh legality. ``elastic_resize_widths`` lists
+    the surviving dp widths an elastic resize could leave (e.g. [2, 1]
+    for a 4-host fleet planning for up to 3 preemptions). Each width
+    must still divide the global batch — ``ElasticTrainer`` splits the
+    SAME global batch among the survivors, so an indivisible width
+    turns a survivable host loss into a hard ``ElasticError`` at resume
+    — and under zero1 the pad-to-divisible waste is re-evaluated at the
+    new width (the GC011 economics change with the axis size)."""
+    if not elastic_resize_widths:
+        return
+    dp = _dp_size(axes)
+    zero1 = _wus_mode(weight_update_sharding) == "zero1"
+    for w in elastic_resize_widths:
+        w = int(w)
+        if w < 1 or (dp and w >= dp):
+            findings.append(Finding(
+                "GC014", Severity.ERROR, f"resize dp={w}",
+                f"{w} is not a possible surviving width of a dp="
+                f"{dp if dp else '<none>'} mesh — an elastic resize only "
+                "shrinks the data axis (hosts are lost, not gained)",
+                f"plan widths in [1, {dp - 1 if dp else '?'}]"))
+            continue
+        if batch_size is not None and batch_size % w != 0:
+            findings.append(Finding(
+                "GC014", Severity.ERROR, f"resize dp={w}",
+                f"global batch {batch_size} is not divisible by planned "
+                f"surviving width dp={w} — after that resize "
+                "ElasticTrainer cannot split the batch and resume "
+                "raises instead of continuing",
+                "pick a global batch divisible by every planned "
+                "surviving width (or drop that width from the plan)"))
+        if zero1 and w >= 2:
+            waste = _zero1_pad_waste(all_layers, w)
+            if waste is not None and waste > ZERO1_PADDING_WASTE:
+                findings.append(Finding(
+                    "GC014", Severity.WARNING, f"resize dp={w}",
+                    f"at surviving width dp={w} the zero1 flattened-leaf "
+                    f"padding would waste {waste:.0%} of the updater "
+                    "state (re-evaluated for the post-resize axis)",
+                    "accept the transient overhead or plan a narrower "
+                    "surviving width"))
+
+
 def _optimal_max_stage(costs: List[int], n_stages: int) -> int:
     """Heaviest stage of the OPTIMAL contiguous partition — the same
     minimize-the-max objective as parallel/pipeline.partition_stages with
@@ -428,7 +493,8 @@ def _check_hbm(findings: List[Finding], rep, batch_size: Optional[int],
 def check_multilayer(conf, *, mesh=None, batch_size: Optional[int] = None,
                      hbm_bytes: Optional[int] = None,
                      weight_update_sharding=None,
-                     input_iterator=None) -> List[Finding]:
+                     input_iterator=None,
+                     elastic_resize_widths=None) -> List[Finding]:
     """Validate a MultiLayerConfiguration. Pure CPU metadata walk — no
     arrays are built."""
     from deeplearning4j_tpu.analysis.memory import DEFAULT_HBM_BYTES
@@ -479,6 +545,9 @@ def check_multilayer(conf, *, mesh=None, batch_size: Optional[int] = None,
     _check_zero1(findings, [(lbl, l) for lbl, l, _ in walk],
                  _mesh_axes(mesh), weight_update_sharding)
     _check_input(findings, _mesh_axes(mesh), input_iterator)
+    _check_elastic(findings, [(lbl, l) for lbl, l, _ in walk],
+                   _mesh_axes(mesh), batch_size, weight_update_sharding,
+                   elastic_resize_widths)
     _check_hbm(findings, rep, batch_size, hbm_bytes or DEFAULT_HBM_BYTES)
     return findings
 
@@ -601,7 +670,8 @@ def _walk_graph_shapes(conf, order: List[str],
 def check_graph(conf, *, mesh=None, batch_size: Optional[int] = None,
                 hbm_bytes: Optional[int] = None,
                 weight_update_sharding=None,
-                input_iterator=None) -> List[Finding]:
+                input_iterator=None,
+                elastic_resize_widths=None) -> List[Finding]:
     """Validate a ComputationGraphConfiguration — including configs the
     builder itself would refuse to construct (cycles, dangling refs),
     which is why this walk never calls ``_resolve_shapes``."""
@@ -700,6 +770,9 @@ def check_graph(conf, *, mesh=None, batch_size: Optional[int] = None,
     _check_zero1(findings, [(lbl, l) for lbl, l, _ in walk],
                  _mesh_axes(mesh), weight_update_sharding)
     _check_input(findings, _mesh_axes(mesh), input_iterator)
+    _check_elastic(findings, [(lbl, l) for lbl, l, _ in walk],
+                   _mesh_axes(mesh), batch_size, weight_update_sharding,
+                   elastic_resize_widths)
     if not any(f.severity == Severity.ERROR for f in findings):
         _check_hbm(findings, rep, batch_size,
                    hbm_bytes or DEFAULT_HBM_BYTES)
@@ -713,17 +786,20 @@ def check_graph(conf, *, mesh=None, batch_size: Optional[int] = None,
 def validate_config(conf, *, mesh=None, batch_size: Optional[int] = None,
                     hbm_bytes: Optional[int] = None,
                     weight_update_sharding=None,
-                    input_iterator=None) -> List[Finding]:
+                    input_iterator=None,
+                    elastic_resize_widths=None) -> List[Finding]:
     """Dispatch on configuration type."""
     if hasattr(conf, "nodes"):
         return check_graph(conf, mesh=mesh, batch_size=batch_size,
                            hbm_bytes=hbm_bytes,
                            weight_update_sharding=weight_update_sharding,
-                           input_iterator=input_iterator)
+                           input_iterator=input_iterator,
+                           elastic_resize_widths=elastic_resize_widths)
     return check_multilayer(conf, mesh=mesh, batch_size=batch_size,
                             hbm_bytes=hbm_bytes,
                             weight_update_sharding=weight_update_sharding,
-                            input_iterator=input_iterator)
+                            input_iterator=input_iterator,
+                            elastic_resize_widths=elastic_resize_widths)
 
 
 def iter_config_layers(conf) -> Iterator[Tuple[str, object,

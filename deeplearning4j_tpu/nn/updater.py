@@ -255,6 +255,41 @@ def gather_updater_state(opt_state, template):
                         is_leaf=lambda x: x is None)
 
 
+def reshard_updater_state(opt_state, template, mesh_ctx,
+                          axis: Optional[str] = None):
+    """Re-lay a zero1-sharded optax state onto a DIFFERENT-width mesh:
+    ``(dp_old, chunk)`` flattened views (host or device) are un-padded
+    back to their original shapes via ``template`` (the record
+    :func:`shard_updater_state` returned when the state was first
+    sharded) and re-flattened to ``(dp_new, chunk')`` over ``mesh_ctx``'s
+    data axis. Returns ``(sharded_state, new_template)`` like
+    :func:`shard_updater_state`.
+
+    The transformation is exact: un-padding recovers bitwise the values
+    a replicated :func:`gather_updater_state` would, and the new padding
+    is zeros the shard-local update never reads — so a trainer resumed
+    at the new width computes the same updates it would have at the old
+    one (the elastic resize guarantee).
+    """
+    return shard_updater_state(gather_updater_state(opt_state, template),
+                               mesh_ctx, axis)
+
+
+def updater_state_template(opt_state):
+    """The gather/reshard template for an optax state already in the
+    REPLICATED (full-shape) layout — what :func:`shard_updater_state`
+    would have recorded. Lets a cross-width restore path that only has
+    the gathered state (e.g. a checkpoint un-padded by
+    ``restore_sharded_into(reshard_zero1=True)``) build the record the
+    reshard helpers need."""
+    def describe(x):
+        if _is_shardable(x):
+            return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+        return None
+
+    return jax.tree.map(describe, opt_state, is_leaf=lambda x: x is None)
+
+
 def compute_updates_sharded(tx, fgrads, opt_state, params, layers,
                             training: TrainingConfig, mesh_ctx,
                             axis: Optional[str] = None):

@@ -74,7 +74,8 @@ def _index_to_slices(index, shape):
 
 def save_sharded(ckpt_dir: Union[str, Path], pytree: Any,
                  mesh_ctx: Optional[MeshContext] = None,
-                 commit_timeout: float = 120.0) -> None:
+                 commit_timeout: float = 120.0,
+                 topology: Optional[dict] = None) -> None:
     """Write this process's addressable shards + (on process 0) the
     manifest and, once every process's shards have landed, the COMMIT
     marker. A reader polling the directory sees the checkpoint appear
@@ -82,11 +83,20 @@ def save_sharded(ckpt_dir: Union[str, Path], pytree: Any,
 
     Works for host numpy / single-device arrays too (one "shard" covering
     the full array), so the same call site serves laptop and pod.
+
+    The process count/rank come from ``multihost.effective_*`` so an
+    elastic resize (fewer survivors than ``jax.process_count()``) writes
+    a checkpoint in the surviving world's format. ``topology`` (dp
+    width, weight-update-sharding mode, process count — what
+    CheckpointManager records) is stored in the manifest so a restore at
+    a different width can be detected up front, not as a shape mismatch
+    mid-assembly.
     """
+    from deeplearning4j_tpu.parallel import multihost
     ckpt_dir = Path(ckpt_dir)
     ckpt_dir.mkdir(parents=True, exist_ok=True)
-    proc = jax.process_index()
-    nproc = jax.process_count()
+    proc = multihost.effective_process_index()
+    nproc = multihost.effective_process_count()
     # stale artifacts from a previous save into this directory would
     # corrupt the commit protocol: a stale COMMIT marks the half-written
     # new step valid, and a stale done_p<K> vote lets process 0 commit
@@ -99,6 +109,18 @@ def save_sharded(ckpt_dir: Union[str, Path], pytree: Any,
     (ckpt_dir / f"manifest_p{proc}.json").unlink(missing_ok=True)
     if proc == 0:
         (ckpt_dir / COMMIT).unlink(missing_ok=True)
+        # votes/manifests of ranks beyond the CURRENT world are stale
+        # remnants of a wider pre-resize world reusing this directory —
+        # their owners are gone and will never refresh them, so they
+        # must not feed the commit protocol or the manifest merge
+        for stale in list(ckpt_dir.glob("done_p*.json")) + \
+                list(ckpt_dir.glob("manifest_p*.json")):
+            try:
+                k = int(stale.name.split("_p")[1].split(".")[0])
+            except (IndexError, ValueError):
+                continue
+            if k >= nproc:
+                stale.unlink(missing_ok=True)
 
     leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(pytree)
     manifest: Dict[str, Any] = {
@@ -108,6 +130,8 @@ def save_sharded(ckpt_dir: Union[str, Path], pytree: Any,
         "treedef": None,  # reconstructed from leaf paths on restore
         "leaves": {},
     }
+    if topology is not None:
+        manifest["topology"] = dict(topology)
     shard_arrays: Dict[str, np.ndarray] = {}
     for path, leaf in leaves_with_paths:
         key = _leaf_key(path)
@@ -328,13 +352,55 @@ def restore_sharded(ckpt_dir: Union[str, Path],
     return tree
 
 
+def read_topology(ckpt_dir: Union[str, Path]) -> Optional[dict]:
+    """The mesh topology recorded at save time ({"dp", "
+    weight_update_sharding", "process_count"} — see
+    CheckpointManager.save), or None for checkpoints that predate the
+    record. Cheap (manifest parse only, no verification) — the
+    up-front width-change check reads this before any assembly."""
+    try:
+        with open(Path(ckpt_dir) / MANIFEST) as f:
+            return json.load(f).get("topology")
+    except (OSError, ValueError):
+        return None
+
+
+def _reshard_flat_leaf(key: str, arr: np.ndarray, shape, dtype) -> np.ndarray:
+    """Target-mesh reshard of one zero1 weight-update leaf: the saved
+    leaf is the flattened pad-to-divisible ``(dp_old, chunk)`` view
+    (``mesh.zero1_shard_leaf``); the template wants the ORIGINAL
+    ``shape``. Dropping the padding tail and reshaping is exact — the
+    values are bitwise those of a replicated ``gather_updater_state``
+    of the original, so a restore at any new width (the new trainer
+    re-flattens to ``(dp_new, chunk')``) changes layout only."""
+    size = int(np.prod(shape)) if shape else 1
+    if arr.ndim != 2 or arr.size < size or arr.size - size >= arr.shape[0] \
+            or np.dtype(arr.dtype) != np.dtype(dtype):
+        raise CheckpointError(
+            f"leaf {key!r}: checkpoint shape {tuple(arr.shape)} is not a "
+            f"zero1 (dp, chunk) view of template shape {tuple(shape)} — "
+            "cannot reshard across this width change")
+    return arr.reshape(-1)[:size].reshape(shape)
+
+
 def restore_sharded_into(ckpt_dir: Union[str, Path], template: Any,
                          mesh_ctx: Optional[MeshContext] = None,
-                         verify: bool = True) -> Any:
+                         verify: bool = True,
+                         reshard_zero1: bool = False) -> Any:
     """Restore into the exact structure of ``template`` (lists stay lists,
     custom pytree nodes stay themselves) — leaf lookup by flattened path.
     Shapes must match the saved checkpoint. ``verify=False``: see
-    ``restore_sharded``."""
+    ``restore_sharded``.
+
+    ``reshard_zero1=True`` is the target-mesh reshard path for restores
+    across a data-parallel width change: a leaf whose checkpoint shape
+    is a zero1 ``(dp_old, chunk)`` flattened view of the template's
+    (full) shape is un-padded back to that shape and placed REPLICATED
+    on ``mesh_ctx`` (not with its saved 1/dp spec — the old axis extent
+    no longer exists); the new-width trainer re-flattens it to
+    ``(dp_new, chunk')`` when it attaches. Any other shape mismatch
+    still raises.
+    """
     ckpt_dir = Path(ckpt_dir)
     manifest = _merge_manifests(ckpt_dir, verify=verify)
     npz_cache: Dict[str, Any] = {}
@@ -346,9 +412,20 @@ def restore_sharded_into(ckpt_dir: Union[str, Path], template: Any,
             raise KeyError(f"Checkpoint has no leaf {key!r}")
         meta = manifest["leaves"][key]
         if tuple(meta["shape"]) != tuple(np.shape(leaf)):
-            raise ValueError(
-                f"Leaf {key!r}: checkpoint shape {tuple(meta['shape'])} != "
-                f"template shape {tuple(np.shape(leaf))}")
+            if not reshard_zero1:
+                raise ValueError(
+                    f"Leaf {key!r}: checkpoint shape {tuple(meta['shape'])} "
+                    f"!= template shape {tuple(np.shape(leaf))}")
+            arr = _reshard_flat_leaf(
+                key, _assemble(ckpt_dir, meta, npz_cache),
+                tuple(np.shape(leaf)),
+                np.asarray(leaf).dtype if not hasattr(leaf, "dtype")
+                else leaf.dtype)
+            if mesh_ctx is not None:
+                arr = jax.device_put(
+                    arr, NamedSharding(mesh_ctx.mesh, P()))
+            new_leaves.append(arr)
+            continue
         arr = _assemble(ckpt_dir, meta, npz_cache)
         if mesh_ctx is not None:
             axes = set(mesh_ctx.mesh.axis_names)

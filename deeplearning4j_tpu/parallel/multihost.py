@@ -22,27 +22,106 @@ with no args is correct there too.
 
 from __future__ import annotations
 
+import logging
 import os
-from typing import Optional
+import threading
+from typing import List, Optional, Tuple
 
 import jax
 import numpy as np
 
+logger = logging.getLogger(__name__)
+
 _initialized = False
+
+#: runtime liveness windows used in ELASTIC mode. The coordination
+#: service's own health checking is all-or-nothing: a missed heartbeat
+#: propagates a fatal error to every task (jax's default callback
+#: terminates the process — the opposite of surviving a preemption).
+#: Elastic mode therefore dials the runtime's windows up to "never"
+#: and supplies its own liveness layer (resilience/elastic.py heartbeat
+#: files + step-barrier timeouts), which can tell a slow host from a
+#: dead one and react without killing the fleet.
+_ELASTIC_HEARTBEAT_INTERVAL_S = 3600
+_ELASTIC_MAX_MISSING_HEARTBEATS = 1000
+
+#: statuses delivered to the benign missed-heartbeat callback (elastic
+#: mode); resilience/elastic.py reads these as one more failure signal
+_runtime_faults: List[str] = []
+_runtime_faults_lock = threading.Lock()
+
+
+def _on_runtime_fault(status) -> None:
+    # replaces jax's default callback (which LOG(FATAL)s the process)
+    with _runtime_faults_lock:
+        _runtime_faults.append(str(status))
+    logger.warning("distributed runtime fault (benign in elastic mode): %s",
+                   status)
+
+
+def runtime_fault_count() -> int:
+    """Distributed-runtime faults seen by the elastic client's benign
+    missed-heartbeat callback (0 outside elastic mode)."""
+    with _runtime_faults_lock:
+        return len(_runtime_faults)
+
+
+def _ensure_cpu_collectives() -> None:
+    """On the CPU platform, cross-process computations need a real
+    collectives backend — without one XLA rejects every multi-process
+    program ("Multiprocess computations aren't implemented on the CPU
+    backend"). Select gloo before the backend initializes; harmless on
+    TPU/GPU (flag only consulted by the CPU client factory)."""
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") or \
+            str(jax.config.jax_platforms or "").startswith("cpu"):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # older jaxlib without gloo: keep prior behavior
+            logger.warning("gloo CPU collectives unavailable; multi-process "
+                           "CPU computations will not run")
 
 
 def initialize(coordinator: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None,
-               local_device_ids=None) -> None:
+               local_device_ids=None,
+               elastic: bool = False) -> None:
     """Bring this process into the global runtime
     (wraps jax.distributed.initialize; safe to call once per process).
 
     The Spark-era analog is the driver/executor bootstrap; here every
     process is a peer and process 0 hosts the coordination service.
+
+    ``elastic=True`` builds the distributed runtime for preemption
+    tolerance (the contract ``resilience/elastic.py`` needs): the
+    coordination client is constructed with a benign missed-heartbeat
+    callback instead of jax's default process-terminating one, with
+    ``shutdown_on_destruction`` off (a survivor must not run the
+    shutdown barrier against dead peers at exit), and with liveness
+    windows long enough that the runtime never declares a peer dead on
+    its own — host-failure detection belongs to the elastic layer's
+    heartbeat files + step-barrier timeouts, which can actually react.
+    Elastic mode requires explicit coordinator/num_processes/process_id
+    (no TPU-pod auto-detection yet).
     """
     global _initialized
     if _initialized:
+        return
+    _ensure_cpu_collectives()
+    if elastic:
+        if coordinator is None or num_processes is None or process_id is None:
+            raise ValueError(
+                "elastic initialize needs explicit coordinator, "
+                "num_processes and process_id (auto-detection would hand "
+                "the runtime back its fatal health checking)")
+        if local_device_ids is not None:
+            raise ValueError(
+                "local_device_ids is not supported with elastic=True "
+                "(the direct client bootstrap does not thread device "
+                "visibility); pin devices via CUDA_VISIBLE_DEVICES / "
+                "JAX flags instead")
+        _initialize_elastic(coordinator, num_processes, process_id)
+        _initialized = True
         return
     kwargs = {}
     if coordinator is not None:
@@ -57,6 +136,86 @@ def initialize(coordinator: Optional[str] = None,
     _initialized = True
 
 
+def _initialize_elastic(coordinator: str, num_processes: int,
+                        process_id: int) -> None:
+    """The preemption-tolerant bootstrap: same wiring as
+    jax.distributed.initialize, but the client is built directly so the
+    failure-handling knobs jax does not expose can be set. Process 0
+    hosts the coordination service (its loss is NOT survivable in
+    process — see ElasticTrainer docs; survivors restart at the new
+    width and resume through the cross-width checkpoint restore)."""
+    from jax._src import distributed as jdist
+    from jax._src import xla_bridge
+    from jax._src.lib import xla_extension
+
+    if xla_bridge.backends_are_initialized():
+        raise RuntimeError("multihost.initialize(elastic=True) must be "
+                           "called before any JAX computation")
+    gs = jdist.global_state
+    if gs.client is not None:
+        raise RuntimeError("distributed runtime already initialized")
+    if process_id == 0:
+        port = coordinator.rsplit(":", 1)[1]
+        gs.service = xla_extension.get_distributed_runtime_service(
+            f"[::]:{port}", num_processes,
+            heartbeat_interval=_ELASTIC_HEARTBEAT_INTERVAL_S,
+            max_missing_heartbeats=_ELASTIC_MAX_MISSING_HEARTBEATS)
+    gs.client = xla_extension.get_distributed_runtime_client(
+        coordinator, process_id, init_timeout=300,
+        heartbeat_interval=_ELASTIC_HEARTBEAT_INTERVAL_S,
+        max_missing_heartbeats=_ELASTIC_MAX_MISSING_HEARTBEATS,
+        missed_heartbeat_callback=_on_runtime_fault,
+        shutdown_on_destruction=False, use_compression=True)
+    gs.client.connect()
+    gs.process_id = process_id
+    gs.num_processes = num_processes
+    gs.coordinator_address = coordinator
+
+
+# ---------------------------------------------------------------------------
+# effective topology — the resize seam
+# ---------------------------------------------------------------------------
+# After an elastic resize the surviving world is smaller than what
+# jax.process_count() reports (the runtime's view is frozen at
+# initialize time). Everything that reasons about the per-host data/
+# checkpoint contract — local_batch_slice, shard_sources, the sharded
+# checkpoint writer — goes through these accessors so the elastic layer
+# can install the post-resize world without re-initializing jax.
+
+_topology_override: Optional[Tuple[int, int]] = None  # (count, index)
+
+
+def set_topology_override(count: int, index: int) -> None:
+    """Install the post-resize world: ``count`` surviving processes,
+    this one at rank ``index``. Called by ElasticTrainer after a host
+    loss; also useful for tests. ``clear_topology_override`` restores
+    the runtime's own view."""
+    global _topology_override
+    if not 0 <= index < count:
+        raise ValueError(f"rank {index} outside world of {count}")
+    _topology_override = (int(count), int(index))
+
+
+def clear_topology_override() -> None:
+    global _topology_override
+    _topology_override = None
+
+
+def effective_process_count() -> int:
+    """Surviving-world process count (== jax.process_count() until an
+    elastic resize installs an override)."""
+    if _topology_override is not None:
+        return _topology_override[0]
+    return jax.process_count()
+
+
+def effective_process_index() -> int:
+    """This process's rank in the surviving world."""
+    if _topology_override is not None:
+        return _topology_override[1]
+    return jax.process_index()
+
+
 def process_count() -> int:
     return jax.process_count()
 
@@ -67,13 +226,15 @@ def process_index() -> int:
 
 def local_batch_slice(global_batch: int) -> slice:
     """This host's slice of a [0, global_batch) range — the per-host input
-    shard (the reference's RDD split -> executor partition mapping)."""
-    n = jax.process_count()
+    shard (the reference's RDD split -> executor partition mapping).
+    Honors the elastic topology override: after a resize the survivors
+    split the same global batch among themselves."""
+    n = effective_process_count()
     if global_batch % n != 0:
         raise ValueError(
             f"global batch {global_batch} not divisible by process count {n}")
     per = global_batch // n
-    k = jax.process_index()
+    k = effective_process_index()
     return slice(k * per, (k + 1) * per)
 
 
@@ -87,12 +248,14 @@ def global_array(local_data, sharding):
 
 def shard_sources(sources):
     """THIS host's disjoint strided shard of a dataset source list —
-    shard ``process_index()`` of ``process_count()`` (the per-host input
-    contract: no two hosts ever read the same bytes). Single-process:
-    identity."""
+    shard ``effective_process_index()`` of ``effective_process_count()``
+    (the per-host input contract: no two hosts ever read the same
+    bytes; after an elastic resize the survivors re-partition the same
+    source list). Single-process: identity."""
     from deeplearning4j_tpu.datasets.pipeline import (
         shard_sources as _shard)
-    return _shard(sources, jax.process_count(), jax.process_index())
+    return _shard(sources, effective_process_count(),
+                  effective_process_index())
 
 
 def input_pipeline(sources, mesh=None, **kwargs):
@@ -106,8 +269,8 @@ def input_pipeline(sources, mesh=None, **kwargs):
     ``data_parallel_trainer(...).fit`` as-is; every host runs the same
     call on the same source list."""
     from deeplearning4j_tpu.datasets.pipeline import StreamingInputPipeline
-    kwargs.setdefault("num_shards", jax.process_count())
-    kwargs.setdefault("shard_index", jax.process_index())
+    kwargs.setdefault("num_shards", effective_process_count())
+    kwargs.setdefault("shard_index", effective_process_index())
     return StreamingInputPipeline(sources, mesh=mesh, **kwargs)
 
 

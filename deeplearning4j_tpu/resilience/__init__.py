@@ -18,6 +18,12 @@ preemptions, flaky hosts, and numeric blow-ups itself. Four legs:
 - ``faultinject`` — deterministic fault schedules driving the chaos
   test suite; every injected fault / retry / rollback / skipped batch
   is counted in the metrics registry and visible as tracer events.
+- ``elastic``     — preemption-tolerant multi-host training (PR 8):
+  ``ElasticTrainer`` detects a lost host (heartbeat files + bounded
+  step-barrier waits), resizes the mesh to the surviving dp width,
+  reshard-restores the latest valid sharded checkpoint (zero1 updater
+  shards re-flattened across the width change), and resumes the
+  training cursor's unconsumed tail exactly.
 - ``service``     — the serving edge's hardening kit (PR 4):
   ``ServiceGuard`` composes admission control (bounded queue + load
   shedding), per-request deadline budgets, per-backend circuit
@@ -28,6 +34,10 @@ preemptions, flaky hosts, and numeric blow-ups itself. Four legs:
 
 from deeplearning4j_tpu.resilience.atomic import (  # noqa: F401
     CheckpointError, atomic_write_bytes, crc32_bytes, crc32_file,
+)
+from deeplearning4j_tpu.resilience.elastic import (  # noqa: F401
+    ElasticError, ElasticRestartRequired, ElasticTrainer, HostHeartbeat,
+    read_heartbeat_ages,
 )
 from deeplearning4j_tpu.resilience.faultinject import (  # noqa: F401
     Fault, FaultInjected, FaultSchedule, KilledByFault,

@@ -1,0 +1,629 @@
+"""Elastic, preemption-tolerant multi-host training.
+
+The PR-3/5 resilience stack survives crashes of the WHOLE fleet (atomic
+checkpoints + cursor resume) and numeric divergence (sentinel +
+rollback), but a single preempted host still killed every other one:
+the SPMD step's collectives wait on the dead peer forever, and jax's
+own health checking terminates survivors rather than letting them
+adapt. ``ElasticTrainer`` closes that gap — the missing step from
+single-process to fleet-grade resilience (ROADMAP item 5):
+
+- **Detect**: every process writes a heartbeat file (sub-second cadence,
+  atomic rename) into a shared directory, and every training step's
+  device sync runs under a BOUNDED barrier wait. A stuck step with a
+  stale peer heartbeat = lost host; a stuck step with fresh peer
+  heartbeats = a straggler (counted ``elastic_barrier_timeouts_total``,
+  waited out — ``slow_host`` chaos proves the distinction); a stuck
+  step with everyone alive past the wait budget raises — detection is
+  never a silent hang, and while it runs the open-span stack names
+  ``elastic:step_barrier`` at the stuck step.
+- **Resize**: the surviving world re-ranks itself
+  (``multihost.set_topology_override``) and rebuilds the
+  ``MeshContext`` at the surviving data-parallel width. In-process
+  continuation is supported when a single host survives (it computes on
+  its local devices; the quarantined old runtime is simply never used
+  again — ``multihost.initialize(elastic=True)`` disarms the runtime's
+  own fatal health checking so this is safe). A multi-host surviving
+  world cannot re-rendezvous collectives inside the old runtime
+  (probe-verified gloo limitation), so it raises
+  ``ElasticRestartRequired`` carrying the surviving ranks: the outer
+  scheduler restarts those processes at the new width and the SAME
+  code path resumes them — restart-resume and live-resize share the
+  reshard-restore below.
+- **Reshard-restore**: the latest VALID sharded checkpoint is restored
+  across the new topology. Params/states re-place by their saved specs;
+  zero1 updater shards — ``(dp_old, chunk)`` flattened views — are
+  un-padded to full shape (``restore_sharded_into(reshard_zero1=True)``,
+  routed by the ``CheckpointManager`` topology record) and re-flattened
+  to ``(dp_new, chunk')`` when the new-width trainer attaches; the
+  round trip is bitwise a replicated ``gather_updater_state`` of the
+  original. At ``dp_new == 1`` zero1 degrades to the replicated layout
+  (nothing left to shard).
+- **Resume exactly**: the ``TrainingCursor``'s epoch/step/RNG/order are
+  applied and consumption restarts at the cursor's data position — the
+  unconsumed tail of the epoch is consumed exactly once, no batch
+  dropped or doubled (steps after the last checkpoint are replayed;
+  their pre-failure effects died with the old mesh). The replayed
+  order is the cursor's recorded order VERBATIM — unlike a divergence
+  rollback (which re-randomizes the tail because the data sequence is
+  implicated), a topology change keeps the trajectory bitwise
+  reproducible: a clean run restarted from the same checkpoint + cursor
+  at the same width produces identical losses, which is exactly what
+  ``tools/elastic_smoke.py`` gates.
+
+Invariants kept: every persistent write goes through
+``resilience/atomic.py`` (heartbeats use plain atomic rename without
+fsync — they are liveness signals, not state, and a per-beat fsync
+would hammer both the disk and the checkpoint-commit chaos seam); the
+divergence sentinel stays inside the compiled step across rebuilds;
+every detection/resize lands in ``elastic_*`` /
+``resilience_host_failures_total`` counters and tracer events.
+
+Limitations (documented, enforced with clear errors): data-parallel
+meshes only; the coordination service lives on original rank 0, whose
+loss is not survivable in process (jaxlib's polled-error path aborts
+the client) — survivors take the restart-resume path instead.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Union
+
+from deeplearning4j_tpu.profiling.metrics import get_registry
+from deeplearning4j_tpu.profiling.tracer import get_tracer
+from deeplearning4j_tpu.resilience import faultinject
+from deeplearning4j_tpu.resilience.atomic import CheckpointError
+from deeplearning4j_tpu.resilience.faultinject import (FaultInjected,
+                                                       KilledByFault)
+from deeplearning4j_tpu.resilience.manager import (CheckpointManager,
+                                                   TrainingCursor)
+from deeplearning4j_tpu.resilience.sentinel import (DivergenceError,
+                                                    RollbackRequested)
+
+logger = logging.getLogger(__name__)
+
+
+class ElasticError(RuntimeError):
+    """Elastic-layer failure that is NOT a survivable host loss."""
+
+
+class ElasticRestartRequired(ElasticError):
+    """More than one host survived a loss: the old runtime cannot
+    re-rendezvous their collectives in process. The outer scheduler
+    restarts the surviving ranks at the new width; on restart the same
+    ``ElasticTrainer`` resumes them through the cross-width
+    reshard-restore."""
+
+    def __init__(self, survivors: List[int], dead: List[int]):
+        self.survivors = list(survivors)
+        self.dead = list(dead)
+        super().__init__(
+            f"hosts {sorted(dead)} lost; surviving world {sorted(survivors)} "
+            f"must restart at dp-width of {len(survivors)} process(es) and "
+            "resume from the latest checkpoint (in-process continuation is "
+            "only possible for a sole survivor)")
+
+
+class _HostsLost(Exception):
+    """Internal control flow: detection verdict naming the dead ranks."""
+
+    def __init__(self, dead: List[int], where: str):
+        self.dead = list(dead)
+        self.where = where
+        super().__init__(f"hosts {sorted(dead)} lost ({where})")
+
+
+#: exceptions a step may raise that are NOT host-failure symptoms — they
+#: pass straight through to the caller (sentinel policies, scheduled
+#: chaos, checkpoint integrity, operator interrupt)
+_PASSTHROUGH = (RollbackRequested, DivergenceError, KilledByFault,
+                FaultInjected, KeyboardInterrupt)
+
+
+# ---------------------------------------------------------------------------
+# heartbeats
+# ---------------------------------------------------------------------------
+
+def _heartbeat_path(directory: Path, rank: int) -> Path:
+    return directory / f"hb_p{rank}.json"
+
+
+class HostHeartbeat:
+    """Per-process liveness beacon: a daemon thread rewrites this host's
+    heartbeat file every ``interval_s``. Atomic rename (no fsync — a
+    torn or unflushed beat just reads as one beat older, and beats are
+    sub-second), so readers never see partial JSON."""
+
+    def __init__(self, directory: Union[str, Path], rank: int,
+                 interval_s: float = 0.5):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.rank = int(rank)
+        self.interval_s = float(interval_s)
+        self.step = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._warned = False
+        self._last_written = time.monotonic()
+
+    def start(self) -> "HostHeartbeat":
+        if self._thread is None:
+            self.beat()
+            self._thread = threading.Thread(
+                target=self._run, name=f"heartbeat-p{self.rank}", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.beat()
+
+    def beat(self) -> None:
+        path = _heartbeat_path(self.directory, self.rank)
+        tmp = path.with_name(path.name + ".tmp")
+        try:
+            tmp.write_text(json.dumps({"rank": self.rank,
+                                       "time": time.time(),
+                                       "step": self.step}))
+            os.replace(tmp, path)
+            self._last_written = time.monotonic()
+            self._warned = False
+        except OSError as e:  # a transient disk blip must not kill training
+            if not self._warned:
+                self._warned = True
+                logger.warning("heartbeat write failed (will keep trying "
+                               "quietly): %s", e)
+
+    def write_stale_s(self) -> float:
+        """Seconds since this host's heartbeat last LANDED on disk. A
+        value past the fleet's heartbeat timeout means peers are about
+        to declare this host dead even though it is alive — the trainer
+        treats that as its own failure rather than training into a
+        split brain."""
+        return time.monotonic() - self._last_written
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval_s + 1.0)
+            self._thread = None
+
+
+def read_heartbeat_ages(directory: Union[str, Path]) -> Dict[int, float]:
+    """{rank: seconds since last beat} for every heartbeat file in
+    ``directory``. Unreadable/partial files are skipped (the next beat
+    replaces them)."""
+    ages: Dict[int, float] = {}
+    now = time.time()
+    for p in Path(directory).glob("hb_p*.json"):
+        try:
+            d = json.loads(p.read_text())
+            ages[int(d["rank"])] = max(0.0, now - float(d["time"]))
+        except (OSError, ValueError, KeyError):
+            continue
+    return ages
+
+
+# ---------------------------------------------------------------------------
+# the trainer
+# ---------------------------------------------------------------------------
+
+class ElasticTrainer:
+    """Preemption-tolerant wrapper around
+    ``multihost.data_parallel_trainer``: detect a lost host, resize the
+    mesh to the survivors, reshard-restore the latest valid sharded
+    checkpoint, resume the cursor's unconsumed tail exactly. See the
+    module docstring for the lifecycle.
+
+    ``net_factory`` must return a FRESH initialized container (same
+    configuration every call) — after a resize the old net's arrays may
+    be futures of a collective that never completed, so recovery never
+    touches them: everything is rebuilt from the factory + checkpoint.
+
+    Every process of the job runs the same ``ElasticTrainer.fit`` on the
+    same GLOBAL batch list; each host feeds its ``local_batch_slice`` of
+    every batch, recomputed from the surviving topology after a resize
+    (a sole survivor feeds the full global batch — the trajectory a
+    clean run at the new width would compute).
+    """
+
+    def __init__(self, net_factory, checkpoint_dir: Union[str, Path], *,
+                 heartbeat_dir: Optional[Union[str, Path]] = None,
+                 weight_update_sharding=None,
+                 gradient_accumulation: int = 1,
+                 checkpoint_every: int = 1,
+                 keep_last: int = 5,
+                 step_timeout_s: float = 60.0,
+                 max_barrier_waits: int = 10,
+                 heartbeat_interval_s: float = 0.5,
+                 heartbeat_timeout_s: float = 10.0,
+                 commit_timeout_s: float = 120.0,
+                 sentinel=None,
+                 resume: bool = True,
+                 collect_consumption: bool = True):
+        import jax
+
+        from deeplearning4j_tpu.parallel import multihost
+        from deeplearning4j_tpu.parallel.mesh import WeightUpdateSharding
+        self._factory = net_factory
+        self.checkpoint_dir = Path(checkpoint_dir)
+        self.heartbeat_dir = Path(heartbeat_dir
+                                  if heartbeat_dir is not None
+                                  else self.checkpoint_dir / "heartbeats")
+        self._wus = WeightUpdateSharding.parse(weight_update_sharding)
+        self.gradient_accumulation = max(1, int(gradient_accumulation))
+        self.checkpoint_every = max(0, int(checkpoint_every))
+        self.keep_last = keep_last
+        self.step_timeout_s = float(step_timeout_s)
+        self.max_barrier_waits = max(1, int(max_barrier_waits))
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.commit_timeout_s = float(commit_timeout_s)
+        self.sentinel = sentinel
+        self.resume = resume
+        self.collect_consumption = collect_consumption
+
+        self._rank = multihost.process_index()       # original rank
+        self._world = list(range(multihost.process_count()))
+        self._multihost = multihost
+        self._jax = jax
+        self.net = None
+        self.trainer = None
+        self.manager: Optional[CheckpointManager] = None
+        self.mesh = None
+        self._cursor: Optional[TrainingCursor] = None
+        #: committed (post-restore-truncated) step log:
+        #: [{"step", "epoch", "index", "loss"}] — the exactly-once
+        #: evidence the chaos tests assert over
+        self.trajectory: List[Dict[str, Any]] = []
+
+        reg = get_registry()
+        self._c_host_failures = reg.counter(
+            "resilience_host_failures_total",
+            help="lost/preempted hosts detected by ElasticTrainer")
+        self._c_resizes = reg.counter(
+            "elastic_resizes_total",
+            help="in-process mesh resizes after a host loss")
+        self._c_barrier_timeouts = reg.counter(
+            "elastic_barrier_timeouts_total",
+            help="step-barrier waits that timed out with all hosts alive "
+                 "(straggler detections)")
+        self._c_reshard_restores = reg.counter(
+            "elastic_reshard_restores_total",
+            help="checkpoint restores across a dp-width change")
+        self._g_dp = reg.gauge(
+            "elastic_dp_width", help="current data-parallel width")
+
+        self._hb = HostHeartbeat(self.heartbeat_dir, self._rank,
+                                 heartbeat_interval_s).start()
+        self._bootstrap(initial=True)
+
+    # --------------------------------------------------------------- topology
+    def _surviving_devices(self):
+        if len(self._world) == self._jax.process_count():
+            return list(self._jax.devices())
+        # sole survivor: local devices only — the dead peers' devices
+        # are unreachable and the old runtime is quarantined
+        return list(self._jax.local_devices())
+
+    def _bootstrap(self, initial: bool = False) -> None:
+        """(Re)build net + mesh + manager + trainer for the CURRENT
+        world and reshard-restore the latest valid checkpoint. Shared by
+        startup (including restart-at-new-width resume) and live
+        resize."""
+        from deeplearning4j_tpu.parallel.mesh import MeshContext
+        from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+        if len(self._world) != self._jax.process_count():
+            self._multihost.set_topology_override(
+                len(self._world), self._world.index(self._rank))
+        devices = self._surviving_devices()
+        dp = len(devices)
+        wus = self._wus if (self._wus.enabled and dp >= 2) else None
+        if self._wus.enabled and dp < 2:
+            logger.warning("dp width %d cannot carry zero1 weight-update "
+                           "sharding; continuing with the replicated "
+                           "layout", dp)
+        with get_tracer().span("elastic:bootstrap", dp=dp,
+                               world=len(self._world)):
+            self.mesh = MeshContext.create(n_data=dp, n_model=1,
+                                           devices=devices)
+            net = self._factory()
+            if self.sentinel is not None:
+                if hasattr(net, "set_divergence_sentinel"):
+                    net.set_divergence_sentinel(self.sentinel)
+                else:
+                    net._sentinel = self.sentinel
+            self.manager = CheckpointManager(
+                self.checkpoint_dir, keep_last=self.keep_last,
+                sharded=True, mesh_ctx=self.mesh,
+                weight_update_sharding="zero1" if wus else "off",
+                commit_timeout=self.commit_timeout_s)
+            cursor = None
+            if self.resume or not initial:
+                info = self.manager.latest_valid()
+                if info is not None:
+                    saved = info.cursor.topology if info.cursor else None
+                    resharding = bool(
+                        saved
+                        and saved.get("weight_update_sharding") == "zero1"
+                        and int(saved.get("dp", dp)) != dp)
+                    # restore BEFORE the trainer attaches: the reshard
+                    # path un-pads zero1 views into the fresh net's
+                    # full-shape updater state; wrapping afterwards
+                    # re-flattens to (dp_new, chunk')
+                    cursor = self.manager.restore(net, info, reshard=True)
+                    if resharding:
+                        self._c_reshard_restores.inc()
+                        get_tracer().instant(
+                            "reshard_restore",
+                            saved_dp=int(saved.get("dp", 0)), dp=dp)
+            self.net = net
+            self.trainer = ParallelTrainer(
+                net, self.mesh,
+                gradient_accumulation=self.gradient_accumulation,
+                weight_update_sharding=wus)
+        self._cursor = cursor
+        self._g_dp.set(dp)
+        # entries past the restore point were rolled back with the old
+        # mesh — the committed trajectory ends at the cursor (and is
+        # empty when recovery found no checkpoint at all: the restarted
+        # epoch replays every step, so stale entries would double-count)
+        self.trajectory = [e for e in self.trajectory
+                           if cursor is not None
+                           and e["step"] <= cursor.step]
+        if cursor is not None:
+            logger.info("resumed at dp=%d from step %d (epoch %d, "
+                        "batch %d)", dp, cursor.step, cursor.epoch,
+                        cursor.data_position)
+
+    # -------------------------------------------------------------- detection
+    def _peer_ages(self) -> Dict[int, float]:
+        ages = read_heartbeat_ages(self.heartbeat_dir)
+        return {r: ages.get(r, float("inf"))
+                for r in self._world if r != self._rank}
+
+    def _dead_hosts(self) -> List[int]:
+        return [r for r, age in self._peer_ages().items()
+                if age > self.heartbeat_timeout_s]
+
+    def _await_staleness(self) -> List[int]:
+        """After a step raised: wait out the heartbeat window to decide
+        whether a peer died (its file goes stale) or the error is
+        genuine (peers keep beating). Bounded by the window + slack."""
+        deadline = time.monotonic() + self.heartbeat_timeout_s + 2.0
+        while time.monotonic() < deadline:
+            dead = self._dead_hosts()
+            if dead:
+                return dead
+            time.sleep(min(0.2, self.heartbeat_timeout_s / 4))
+        return []
+
+    # ------------------------------------------------------------------ steps
+    @staticmethod
+    def _slice_batch(batch, sl: slice):
+        take = lambda a: None if a is None else a[sl]
+        if hasattr(batch, "features_masks"):  # MultiDataSet
+            import copy
+            out = copy.copy(batch)
+            out.features = [f[sl] for f in batch.features]
+            out.labels = [l[sl] for l in batch.labels]
+            if batch.features_masks is not None:
+                out.features_masks = [take(m) for m in batch.features_masks]
+            if batch.labels_masks is not None:
+                out.labels_masks = [take(m) for m in batch.labels_masks]
+            return out
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        return DataSet(batch.features[sl], batch.labels[sl],
+                       take(batch.features_mask), take(batch.labels_mask))
+
+    def _local_view(self, batch):
+        B = batch.num_examples()
+        dp = self.mesh.n_data
+        if B % dp != 0:
+            raise ElasticError(
+                f"global batch {B} is not divisible by the surviving "
+                f"dp width {dp} (graphcheck GC014 flags this statically "
+                "for planned resize widths)")
+        return self._slice_batch(batch,
+                                 self._multihost.local_batch_slice(B))
+
+    def _guarded_step(self, batch, step_id: int) -> float:
+        """One training step under the elastic contract: chaos hooks,
+        dispatch in a worker thread, BOUNDED barrier wait consulting
+        peer heartbeats — raises ``_HostsLost`` on a detected death,
+        ``ElasticError`` when the wait budget is exhausted with
+        everyone alive; never hangs silently."""
+        tracer = get_tracer()
+        stall = faultinject.host_step_stall(step_id)
+        if stall:
+            with tracer.span("elastic:straggle", step=step_id,
+                             duration=stall):
+                time.sleep(stall)
+        faultinject.check_kill(step_id)
+        if (len(self._world) > 1
+                and self._hb.write_stale_s() > self.heartbeat_timeout_s):
+            # our own beacon has not landed for a full timeout window:
+            # the peers are (correctly, from their view) about to
+            # declare this host dead and resize without it — stop
+            # contributing steps instead of splitting the brain
+            raise ElasticError(
+                f"this host's heartbeat has not been written for "
+                f"{self._hb.write_stale_s():.1f}s (> "
+                f"{self.heartbeat_timeout_s}s): peers will declare it "
+                "dead; refusing to keep training into a split brain "
+                "(is the heartbeat directory writable?)")
+        self._hb.step = step_id
+        local = self._local_view(batch)
+        box: Dict[str, Any] = {}
+        done = threading.Event()
+
+        def run():
+            try:
+                # float() forces the device sync INSIDE the abandonable
+                # thread: a collective stuck on a dead peer hangs here,
+                # not on the main thread
+                box["loss"] = float(self.trainer.fit_batch(local))
+            except BaseException as e:  # noqa: BLE001 — relayed below
+                box["exc"] = e
+            finally:
+                done.set()
+
+        worker = threading.Thread(target=run, daemon=True,
+                                  name=f"elastic-step-{step_id}")
+        with tracer.span("elastic:step_barrier", step=step_id):
+            worker.start()
+            waits = 0
+            while not done.wait(self.step_timeout_s):
+                dead = self._dead_hosts()
+                if dead:
+                    raise _HostsLost(dead, f"step {step_id} barrier")
+                waits += 1
+                self._c_barrier_timeouts.inc()
+                tracer.instant("barrier_timeout", step=step_id,
+                               waits=waits)
+                logger.warning(
+                    "step %d barrier timed out (%.0fs, wait %d/%d) with "
+                    "all hosts alive — straggler; continuing to wait",
+                    step_id, self.step_timeout_s, waits,
+                    self.max_barrier_waits)
+                if waits >= self.max_barrier_waits:
+                    raise ElasticError(
+                        f"step {step_id} still stuck after "
+                        f"{waits * self.step_timeout_s:.0f}s with every "
+                        "host's heartbeat fresh — not a host failure; "
+                        "giving up instead of hanging")
+        if "exc" in box:
+            e = box["exc"]
+            if isinstance(e, _PASSTHROUGH):
+                raise e
+            dead = self._await_staleness()
+            if dead:
+                logger.warning("step %d failed (%s) and hosts %s went "
+                               "stale — treating as host loss", step_id,
+                               type(e).__name__, sorted(dead))
+                raise _HostsLost(dead, f"step {step_id}: "
+                                       f"{type(e).__name__}") from e
+            raise e
+        return box["loss"]
+
+    # ----------------------------------------------------------------- resize
+    def _on_hosts_lost(self, lost: _HostsLost) -> None:
+        tracer = get_tracer()
+        for r in sorted(set(lost.dead)):
+            self._c_host_failures.inc()
+            tracer.instant("host_failure", rank=r, where=lost.where)
+        logger.warning("host(s) %s lost at %s; surviving world %s",
+                       sorted(set(lost.dead)), lost.where,
+                       [r for r in self._world if r not in lost.dead])
+        self._world = [r for r in self._world if r not in lost.dead]
+        if self._rank not in self._world:
+            raise ElasticError("this process was declared dead by its own "
+                               "detector — heartbeat directory clock skew?")
+        if len(self._world) > 1:
+            raise ElasticRestartRequired(self._world, lost.dead)
+        old_dp = self.mesh.n_data if self.mesh else 0
+        with tracer.span("elastic:resize", old_dp=old_dp):
+            self._c_resizes.inc()
+            self._bootstrap()
+        tracer.instant("elastic_resize", old_dp=old_dp,
+                       new_dp=self.mesh.n_data)
+
+    # -------------------------------------------------------------------- fit
+    def fit(self, data, epochs: int = 1) -> "ElasticTrainer":
+        """Train ``epochs`` over the GLOBAL batches in ``data`` under the
+        elastic contract. Identical call on every process; survives any
+        non-coordinator host loss mid-epoch."""
+        from deeplearning4j_tpu.resilience.trainer import \
+            FaultTolerantTrainer
+        batches = FaultTolerantTrainer._materialize(data)
+        if not batches:
+            return self
+        n = len(batches)
+        cursor = self._cursor
+        epoch, pos = (cursor.epoch, cursor.data_position) if cursor \
+            else (0, 0)
+        order = FaultTolerantTrainer._cursor_order(cursor, n)
+        anchored = cursor is not None or not self.checkpoint_every
+        while epoch < epochs:
+            try:
+                if not anchored:
+                    # anchor: a host lost on step 1 must have a state
+                    # to resume from
+                    self._save(epoch=epoch, next_pos=pos, order=order)
+                    anchored = True
+                if pos >= n:
+                    if self.sentinel is not None:
+                        self.sentinel.flush()
+                    self._save(epoch=epoch + 1, next_pos=0)
+                    epoch, pos, order = epoch + 1, 0, list(range(n))
+                    continue
+                step_id = self.net.iteration_count + 1
+                loss = self._guarded_step(batches[order[pos]], step_id)
+                if self.collect_consumption:
+                    self.trajectory.append(
+                        {"step": step_id, "epoch": epoch,
+                         "index": order[pos], "loss": loss})
+                pos += 1
+                if (self.checkpoint_every
+                        and self.net.iteration_count
+                        % self.checkpoint_every == 0):
+                    if self.sentinel is not None:
+                        self.sentinel.flush()
+                    self._save(epoch=epoch, next_pos=pos, order=order)
+            except _HostsLost as lost:
+                self._on_hosts_lost(lost)     # may raise RestartRequired
+                cursor = self._cursor
+                anchored = True
+                if cursor is None:
+                    epoch, pos, order = 0, 0, list(range(n))
+                else:
+                    epoch, pos = cursor.epoch, cursor.data_position
+                    order = FaultTolerantTrainer._cursor_order(cursor, n)
+        return self
+
+    def _save(self, epoch: int, next_pos: int,
+              order: Optional[List[int]] = None) -> None:
+        cursor = TrainingCursor.of(self.net, epoch=epoch,
+                                   data_position=next_pos)
+        if order is not None and order != list(range(len(order))):
+            cursor.extra["order"] = list(order)
+        try:
+            self.manager.save(self.net, cursor=cursor)
+        except CheckpointError:
+            # a peer that dies mid-save surfaces as a commit timeout;
+            # classify before giving up (same verdict logic as a step)
+            dead = self._await_staleness()
+            if dead:
+                raise _HostsLost(dead, "checkpoint commit") from None
+            raise
+
+    # ---------------------------------------------------------------- cleanup
+    def close(self) -> None:
+        self._hb.stop()
+
+    def __enter__(self) -> "ElasticTrainer":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # ------------------------------------------------------------ inspection
+    @property
+    def dp_width(self) -> int:
+        return self.mesh.n_data if self.mesh else 0
+
+    @property
+    def world(self) -> List[int]:
+        return list(self._world)
+
+    def consumed_indices(self, epoch: int) -> List[int]:
+        """Batch indices the COMMITTED trajectory consumed in ``epoch``
+        (post-restore entries only) — the exactly-once evidence."""
+        return [e["index"] for e in self.trajectory
+                if e["epoch"] == epoch]

@@ -64,6 +64,23 @@ Input-pipeline fault kinds (PR 7, the streaming-input seams):
   ``input_read_retries_total``, or surface a clean in-order error when
   retries are exhausted.
 
+Elastic / multi-host fault kinds (PR 8, the topology-change seams):
+
+- ``kill_host``        — hard ``os._exit`` of THIS process at training
+  step N (arm the schedule on the victim only): a preempted/lost host.
+  Nothing is flushed or cleaned up — that is the point. The SURVIVING
+  hosts' ElasticTrainer must detect the loss (heartbeat staleness +
+  step-barrier timeout), resize the mesh, reshard-restore, and resume;
+  detection lands in ``resilience_host_failures_total`` /
+  ``elastic_resizes_total`` on the survivors.
+- ``slow_host``        — stall THIS host's step N by ``duration``
+  seconds before it dispatches (a straggling-but-alive host). The other
+  hosts must surface it as barrier-timeout DETECTION
+  (``elastic_barrier_timeouts_total`` + a ``barrier_timeout`` tracer
+  instant while the wait's open span names the stalled step), never a
+  silent hang — and then complete the step when the straggler catches
+  up, because its heartbeats stayed fresh.
+
 Faults are one-shot: each schedule entry fires once, is counted in the
 metrics registry (``resilience_faults_injected_total``) and stamped as a
 tracer instant event, then disarms. ``step`` indexing is 1-based and
@@ -86,7 +103,12 @@ from deeplearning4j_tpu.profiling.tracer import get_tracer
 
 _KINDS = ("raise", "nan", "truncate_checkpoint", "drop_connection",
           "slow_loris", "hang_backend", "burst", "corrupt_frame",
-          "poison_row", "slow_batch", "slow_input", "io_error")
+          "poison_row", "slow_batch", "slow_input", "io_error",
+          "kill_host", "slow_host")
+
+#: exit code of a ``kill_host`` hard exit — distinct so test drivers can
+#: assert the victim died BY the fault, not by a bug
+KILL_HOST_EXIT_CODE = 117
 _CORRUPT_MODES = ("length", "crc", "truncate")
 
 
@@ -228,6 +250,48 @@ def poison_batch(batch, step: int):
     else:
         poisoned.features = _poison(feats)
     return poisoned
+
+
+def check_kill(step: int) -> None:
+    """Called by ElasticTrainer per training step (before dispatch); a
+    ``kill_host`` fault scheduled for ``step`` hard-exits THIS process
+    with ``KILL_HOST_EXIT_CODE`` — no flushing, no cleanup, no exception
+    a handler could catch: exactly what a preemption leaves behind. The
+    ``fault_injected`` instant and counter land in-process first (they
+    die with it; the surviving hosts' detection counters are the
+    observable record)."""
+    with _lock:
+        hit = None
+        if _schedule is not None:
+            for f in _schedule.pending():
+                if f.kind == "kill_host" and f.step == step:
+                    hit = f
+                    break
+            if hit is not None:
+                _fire(hit, step=step)
+    if hit is not None:
+        import os
+        import sys
+        print(f"faultinject: kill_host at step {step} — os._exit",
+              file=sys.stderr, flush=True)
+        os._exit(KILL_HOST_EXIT_CODE)
+
+
+def host_step_stall(step: int) -> float:
+    """Called by ElasticTrainer per training step (before dispatch);
+    returns the stall a scheduled ``slow_host`` fault injects into THIS
+    host's ``step`` — 0.0 = run normally. The caller sleeps inside its
+    own tracer span (heartbeats keep beating from their thread), so the
+    straggle is visible on the victim AND detectable as barrier timeout
+    on its peers."""
+    with _lock:
+        if _schedule is None:
+            return 0.0
+        for f in _schedule.pending():
+            if f.kind == "slow_host" and f.step == step:
+                _fire(f, step=step, duration=f.duration)
+                return max(0.0, f.duration)
+        return 0.0
 
 
 def on_checkpoint_commit(tmp: Path, final: Path) -> None:

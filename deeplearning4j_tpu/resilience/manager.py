@@ -46,19 +46,26 @@ class TrainingCursor:
     container's ``iteration_count``; ``data_position`` counts batches
     already consumed in the current epoch (resume skips that many);
     ``rng_key`` is the container's raw PRNG key words so the resumed
-    run draws the same dropout/shuffle randomness it would have."""
+    run draws the same dropout/shuffle randomness it would have.
+    ``topology`` records the mesh the checkpoint was cut on
+    ({"dp", "weight_update_sharding", "process_count"}) so a restore at
+    a different data-parallel width is detected up front and routed
+    through the reshard path instead of dying on a shape mismatch deep
+    inside ``restore_sharded``."""
 
     epoch: int = 0
     step: int = 0
     data_position: int = 0
     rng_key: Optional[List[int]] = None
+    topology: Optional[Dict[str, Any]] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def to_json(self) -> str:
         return json.dumps({"version": 1, "epoch": self.epoch,
                            "step": self.step,
                            "data_position": self.data_position,
-                           "rng_key": self.rng_key, "extra": self.extra})
+                           "rng_key": self.rng_key,
+                           "topology": self.topology, "extra": self.extra})
 
     @staticmethod
     def from_json(text: str) -> "TrainingCursor":
@@ -67,6 +74,7 @@ class TrainingCursor:
                               step=int(d.get("step", 0)),
                               data_position=int(d.get("data_position", 0)),
                               rng_key=d.get("rng_key"),
+                              topology=d.get("topology"),
                               extra=d.get("extra", {}))
 
     @staticmethod
@@ -111,7 +119,9 @@ class CheckpointManager:
 
     def __init__(self, directory: Union[str, Path], keep_last: int = 3,
                  prefix: str = "ckpt", sharded: bool = False,
-                 mesh_ctx=None, save_updater: bool = True):
+                 mesh_ctx=None, save_updater: bool = True,
+                 weight_update_sharding: Optional[str] = None,
+                 commit_timeout: float = 120.0):
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
         self.keep_last = max(1, int(keep_last))
@@ -119,6 +129,12 @@ class CheckpointManager:
         self.sharded = sharded
         self.mesh_ctx = mesh_ctx
         self.save_updater = save_updater
+        # recorded in every cursor/manifest so cross-width restores are
+        # detected up front (mode string, e.g. "off"/"zero1")
+        self.weight_update_sharding = str(
+            getattr(weight_update_sharding, "mode",
+                    weight_update_sharding or "off")).lower()
+        self.commit_timeout = float(commit_timeout)
         reg = get_registry()
         self._c_saved = reg.counter("resilience_checkpoints_saved_total",
                                     help="checkpoints committed")
@@ -159,6 +175,69 @@ class CheckpointManager:
         except (OSError, ValueError, KeyError):
             return None
 
+    # --------------------------------------------------------------- topology
+    def topology(self) -> Dict[str, Any]:
+        """The mesh topology checkpoints cut by this manager run on:
+        data-parallel width, weight-update-sharding mode, and surviving
+        process count (elastic-aware via ``multihost.effective_*``)."""
+        dp = 1
+        if self.mesh_ctx is not None:
+            try:
+                dp = int(self.mesh_ctx.n_data)
+            except (KeyError, TypeError):
+                dp = 1
+        try:
+            from deeplearning4j_tpu.parallel import multihost
+            nproc = multihost.effective_process_count()
+        except Exception:
+            nproc = 1
+        return {"dp": dp,
+                "weight_update_sharding": self.weight_update_sharding,
+                "process_count": nproc}
+
+    def _check_topology(self, info: "CheckpointInfo",
+                        reshard: bool) -> bool:
+        """Up-front width-change detection. Returns True when the
+        restore must go through the zero1 reshard path; raises
+        ``CheckpointError`` when the widths differ and the caller did
+        not ask for resharding (the clear error the deep shape mismatch
+        used to be)."""
+        if not self.sharded:
+            # the zip format stores the GATHERED (replicated) updater
+            # state — width-agnostic, restorable on any mesh
+            return False
+        saved = (info.cursor.topology if info.cursor is not None
+                 else None)
+        if saved is None:
+            from deeplearning4j_tpu.parallel.checkpoint import read_topology
+            saved = read_topology(info.path)
+        if not saved:
+            # pre-topology checkpoint: no up-front check possible; honor
+            # the caller's reshard request (the path only engages on a
+            # template shape mismatch)
+            return bool(reshard)
+        if str(saved.get("weight_update_sharding", "off")) != "zero1":
+            return False  # replicated layouts restore at any width
+        if reshard:
+            # un-pad (dp_old, chunk) views into full-shape templates —
+            # needed even at the same width, because the elastic restore
+            # targets a FRESH net (full shapes) before the new trainer
+            # re-flattens; a template already holding same-width sharded
+            # views matches shapes and bypasses the path leaf-by-leaf
+            return True
+        cur = self.topology()
+        if int(saved.get("dp", 1)) == cur["dp"]:
+            return False
+        raise CheckpointError(
+            f"checkpoint {info.path} was cut at dp={saved.get('dp')} "
+            f"(weight_update_sharding=zero1, "
+            f"{saved.get('process_count')} processes) but is being "
+            f"restored at dp={cur['dp']} — the sharded updater state "
+            "is laid out for the old width. Restore with "
+            "reshard=True (ElasticTrainer's cross-width path) into a "
+            "net holding the full-shape updater state, then attach "
+            "the new-width trainer.")
+
     # ------------------------------------------------------------------- save
     def save(self, net, step: Optional[int] = None,
              cursor: Optional[TrainingCursor] = None) -> Path:
@@ -171,6 +250,8 @@ class CheckpointManager:
         """
         step = net.iteration_count if step is None else int(step)
         cursor = TrainingCursor.of(net) if cursor is None else cursor
+        if cursor.topology is None:
+            cursor.topology = self.topology()
         name = self._name(step)
         with get_tracer().span("checkpoint_save", step=step):
             if self.sharded:
@@ -180,7 +261,9 @@ class CheckpointManager:
                 save_sharded(path, {"params": net.params,
                                     "opt_state": net.opt_state,
                                     "states": net.states},
-                             self.mesh_ctx)
+                             self.mesh_ctx,
+                             commit_timeout=self.commit_timeout,
+                             topology=cursor.topology)
             else:
                 from deeplearning4j_tpu.util.serializer import \
                     ModelSerializer
@@ -239,15 +322,28 @@ class CheckpointManager:
 
     # ---------------------------------------------------------------- restore
     def restore(self, net, info: Optional[CheckpointInfo] = None,
-                load_updater: bool = True) -> Optional[TrainingCursor]:
+                load_updater: bool = True,
+                reshard: bool = False) -> Optional[TrainingCursor]:
         """Load ``info`` (default: latest valid) into an initialized
         ``net`` and apply its cursor. Returns the cursor (None when no
-        valid checkpoint exists — the caller starts fresh)."""
+        valid checkpoint exists — the caller starts fresh).
+
+        ``reshard=True`` allows restoring a zero1 checkpoint cut at a
+        DIFFERENT data-parallel width: ``net`` must hold the full-shape
+        (replicated-layout) updater state — a freshly initialized net,
+        NOT one already attached to a zero1 trainer — and each saved
+        ``(dp_old, chunk)`` view is un-padded into it; wrapping the net
+        in the new-width trainer afterwards re-flattens to
+        ``(dp_new, chunk')``. Without the flag a width change raises
+        ``CheckpointError`` up front.
+        """
         if info is None:
             info = self.latest_valid()
             if info is None:
                 return None
-        with get_tracer().span("checkpoint_restore", step=info.step):
+        needs_reshard = self._check_topology(info, reshard)
+        with get_tracer().span("checkpoint_restore", step=info.step,
+                               reshard=needs_reshard):
             if self.sharded:
                 from deeplearning4j_tpu.parallel.checkpoint import \
                     restore_sharded_into
@@ -255,7 +351,8 @@ class CheckpointManager:
                 if load_updater and net.opt_state is not None:
                     tpl["opt_state"] = net.opt_state
                 out = restore_sharded_into(info.path, tpl, self.mesh_ctx,
-                                           verify=not info.verified)
+                                           verify=not info.verified,
+                                           reshard_zero1=needs_reshard)
                 net.params = out["params"]
                 net.states = out["states"]
                 if "opt_state" in out:

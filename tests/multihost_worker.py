@@ -14,10 +14,17 @@ import sys
 proc_id = int(sys.argv[1])
 num_procs = int(sys.argv[2])
 port = sys.argv[3]
+#: "spmd" (default) = the synchronous-parity phases below;
+#: "elastic" = ElasticTrainer chaos run (1 device/process, kill_host /
+#: slow_host armed via env, prints TRAJ/METRICS);
+#: "elastic_ref" = single-process clean dp=1 restart from a specific
+#: checkpoint of a previous elastic run (the bitwise reference)
+mode = sys.argv[4] if len(sys.argv) > 4 else "spmd"
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+_DEVS = 1 if mode.startswith("elastic") else 4
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                           + " --xla_force_host_platform_device_count=4")
+                           + f" --xla_force_host_platform_device_count={_DEVS}")
 
 import numpy as np  # noqa: E402
 
@@ -33,6 +40,96 @@ from deeplearning4j_tpu.parallel import multihost  # noqa: E402
 import faulthandler  # noqa: E402
 
 faulthandler.dump_traceback_later(120, exit=False)
+
+
+def _elastic_factory():
+    """Same seeded net on every process / every (re)build — Adam state
+    so the zero1 cross-width reshard has real (m, v) leaves to move."""
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder().seed(99)
+        .updater("adam").learning_rate(0.05)
+        .list()
+        .layer(DenseLayer(n_out=8, activation="relu"))
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(6)).build()).init()
+
+
+def _elastic_batches():
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    rng = np.random.default_rng(0)  # same GLOBAL data on every process
+    return [DataSet(rng.normal(size=(8, 6)).astype(np.float32),
+                    np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)])
+            for _ in range(6)]
+
+
+def _run_elastic() -> None:
+    """The preemption chaos phase: both processes train under
+    ElasticTrainer; env arms a kill_host/slow_host fault on rank 1. The
+    survivor must finish the epoch and print the exactly-once record."""
+    import json
+
+    from deeplearning4j_tpu.profiling.metrics import get_registry
+    from deeplearning4j_tpu.resilience import faultinject
+    from deeplearning4j_tpu.resilience.elastic import ElasticTrainer
+    from deeplearning4j_tpu.resilience.faultinject import (Fault,
+                                                           FaultSchedule)
+
+    print(f"worker {proc_id}: initializing elastic runtime", flush=True)
+    multihost.initialize(coordinator=f"localhost:{port}",
+                         num_processes=num_procs, process_id=proc_id,
+                         elastic=True)
+    fault_step = int(os.environ.get("ELASTIC_FAULT_STEP", "0"))
+    if fault_step and proc_id == 1:
+        faultinject.set_schedule(FaultSchedule([Fault(
+            kind=os.environ.get("ELASTIC_FAULT_KIND", "kill_host"),
+            step=fault_step,
+            duration=float(os.environ.get("ELASTIC_FAULT_S", "6.0")))]))
+    trainer = ElasticTrainer(
+        _elastic_factory, os.environ["ELASTIC_CKPT"],
+        weight_update_sharding="zero1", checkpoint_every=1, keep_last=50,
+        step_timeout_s=2.0, heartbeat_timeout_s=3.0, commit_timeout_s=30.0)
+    trainer.fit(_elastic_batches(), epochs=1)
+    print("TRAJ " + json.dumps(trainer.trajectory), flush=True)
+    print("WORLD " + json.dumps(trainer.world), flush=True)
+    reg = get_registry()
+    print("METRICS " + json.dumps(
+        reg.snapshot("elastic_") | reg.snapshot("resilience_host")),
+        flush=True)
+    trainer.close()
+
+
+def _run_elastic_ref() -> None:
+    """Clean dp=1 restart from checkpoint ELASTIC_RESUME_STEP of a
+    finished chaos run: restore (cross-width reshard), fit the
+    unconsumed tail, print the losses the survivor must have matched
+    bitwise."""
+    from deeplearning4j_tpu.parallel import MeshContext, ParallelTrainer
+    from deeplearning4j_tpu.resilience.manager import CheckpointManager
+
+    net = _elastic_factory()
+    mesh = MeshContext.create(n_data=1)
+    mgr = CheckpointManager(os.environ["ELASTIC_CKPT"], sharded=True,
+                            mesh_ctx=mesh)
+    step = int(os.environ["ELASTIC_RESUME_STEP"])
+    info = next(i for i in mgr.checkpoints() if i.step == step)
+    cursor = mgr.restore(net, info, reshard=True)
+    trainer = ParallelTrainer(net, mesh)
+    batches = _elastic_batches()
+    losses = [float(trainer.fit_batch(batches[i]))
+              for i in range(cursor.data_position, len(batches))]
+    print("REFLOSSES " + " ".join(f"{l:.17g}" for l in losses), flush=True)
+
+
+if mode == "elastic":
+    _run_elastic()
+    sys.exit(0)
+if mode == "elastic_ref":
+    _run_elastic_ref()
+    sys.exit(0)
+
 print(f"worker {proc_id}: initializing distributed", flush=True)
 multihost.initialize(coordinator=f"localhost:{port}",
                      num_processes=num_procs, process_id=proc_id)

@@ -1,0 +1,309 @@
+"""Elastic preemption-tolerance unit tests (ISSUE 8) — everything that
+does NOT need two real processes (those live in test_multihost.py's
+elastic chaos cases): cross-width zero1 checkpoint reshard bitwise vs a
+replicated gather, the up-front topology mismatch error, heartbeat
+liveness, the topology override seam, and single-process ElasticTrainer
+resume semantics."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn import updater as updater_mod
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel import MeshContext, ParallelTrainer
+from deeplearning4j_tpu.parallel import multihost
+from deeplearning4j_tpu.parallel.checkpoint import read_topology
+from deeplearning4j_tpu.resilience.atomic import CheckpointError
+from deeplearning4j_tpu.resilience.elastic import (ElasticError,
+                                                   ElasticTrainer,
+                                                   HostHeartbeat,
+                                                   read_heartbeat_ages)
+from deeplearning4j_tpu.resilience.manager import CheckpointManager
+
+
+def _net(seed=7):
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder().seed(seed)
+        .updater("adam").learning_rate(0.05)
+        .list()
+        .layer(DenseLayer(n_out=8, activation="relu"))
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(6)).build()).init()
+
+
+def _batch(rng, n=8):
+    return DataSet(rng.normal(size=(n, 6)).astype(np.float32),
+                   np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)])
+
+
+def _train_and_save_zero1(tmp_path, dp=4, steps=3):
+    """A dp-wide zero1 run checkpointed into tmp_path; returns the
+    replicated reference of its updater state + params."""
+    rng = np.random.default_rng(0)
+    net = _net()
+    mesh = MeshContext.create(n_data=dp, n_model=1,
+                              devices=jax.devices()[:dp])
+    trainer = ParallelTrainer(net, mesh, weight_update_sharding="zero1")
+    ds = _batch(rng)
+    for _ in range(steps):
+        trainer.fit_batch(ds)
+    mgr = CheckpointManager(tmp_path, sharded=True, mesh_ctx=mesh,
+                            weight_update_sharding="zero1")
+    mgr.save(net)
+    ref_opt = jax.tree_util.tree_leaves(updater_mod.gather_updater_state(
+        net.opt_state, trainer._opt_template))
+    ref_params = jax.tree_util.tree_leaves(net.params)
+    return [np.asarray(x) for x in ref_opt], \
+        [np.asarray(x) for x in ref_params]
+
+
+# ---------------------------------------------------------------------------
+# cross-width reshard restore
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dp_new", [2, 1])
+def test_cross_width_restore_bitwise_vs_replicated_gather(tmp_path, dp_new):
+    """save at dp=4 -> restore at dp=2 / dp=1: every zero1 (4, chunk)
+    updater view un-pads BITWISE to the replicated gather of the
+    original, params restore exactly, and a new-width trainer attaches
+    and trains."""
+    ref_opt, ref_params = _train_and_save_zero1(tmp_path, dp=4)
+    net = _net()
+    mesh = MeshContext.create(n_data=dp_new, n_model=1,
+                              devices=jax.devices()[:dp_new])
+    mgr = CheckpointManager(tmp_path, sharded=True, mesh_ctx=mesh,
+                            weight_update_sharding="zero1")
+    cursor = mgr.restore(net, reshard=True)
+    assert cursor.step == 3
+    got = jax.tree_util.tree_leaves(net.opt_state)
+    assert len(got) == len(ref_opt)
+    for a, b in zip(ref_opt, got):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    for a, b in zip(ref_params, jax.tree_util.tree_leaves(net.params)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    # the restored net trains at the new width (zero1 needs dp >= 2)
+    wus = "zero1" if dp_new >= 2 else None
+    trainer = ParallelTrainer(net, mesh, weight_update_sharding=wus)
+    loss = float(trainer.fit_batch(_batch(np.random.default_rng(0))))
+    assert np.isfinite(loss)
+
+
+def test_width_change_without_reshard_is_upfront_checkpoint_error(tmp_path):
+    """Restoring a zero1 dp=4 checkpoint at dp=2 WITHOUT the reshard
+    flag must raise the clear CheckpointError up front (topology check),
+    not a shape mismatch deep inside restore_sharded."""
+    _train_and_save_zero1(tmp_path, dp=4)
+    net = _net()
+    mesh = MeshContext.create(n_data=2, n_model=1,
+                              devices=jax.devices()[:2])
+    mgr = CheckpointManager(tmp_path, sharded=True, mesh_ctx=mesh,
+                            weight_update_sharding="zero1")
+    with pytest.raises(CheckpointError, match="dp=4.*dp=2"):
+        mgr.restore(net)
+
+
+def test_topology_recorded_in_cursor_and_manifest(tmp_path):
+    _train_and_save_zero1(tmp_path, dp=4)
+    mesh = MeshContext.create(n_data=4, n_model=1,
+                              devices=jax.devices()[:4])
+    mgr = CheckpointManager(tmp_path, sharded=True, mesh_ctx=mesh)
+    info = mgr.latest_valid()
+    topo = info.cursor.topology
+    assert topo == {"dp": 4, "weight_update_sharding": "zero1",
+                    "process_count": 1}
+    # and independently in the sharded manifest (cursor-less readers)
+    assert read_topology(info.path) == topo
+
+
+def test_non_zero1_shape_mismatch_still_raises_under_reshard(tmp_path):
+    """reshard=True only legalizes zero1 (dp, chunk) views — a genuine
+    template mismatch (different architecture) must still fail."""
+    _train_and_save_zero1(tmp_path, dp=4)
+    wrong = MultiLayerNetwork(
+        NeuralNetConfiguration.builder().seed(7)
+        .updater("adam").learning_rate(0.05)
+        .list()
+        .layer(DenseLayer(n_out=12, activation="relu"))  # 8 -> 12
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(6)).build()).init()
+    mesh = MeshContext.create(n_data=2, n_model=1,
+                              devices=jax.devices()[:2])
+    mgr = CheckpointManager(tmp_path, sharded=True, mesh_ctx=mesh,
+                            weight_update_sharding="zero1")
+    with pytest.raises((CheckpointError, ValueError, KeyError)):
+        mgr.restore(wrong, reshard=True)
+
+
+def test_reshard_updater_state_roundtrip():
+    """nn/updater reshard helpers: (4, chunk) views re-flatten to
+    (2, chunk') with values bitwise those of the replicated gather."""
+    rng = np.random.default_rng(0)
+    net = _net()
+    mesh4 = MeshContext.create(n_data=4, n_model=1,
+                               devices=jax.devices()[:4])
+    trainer = ParallelTrainer(net, mesh4, weight_update_sharding="zero1")
+    trainer.fit_batch(_batch(rng))
+    ref = updater_mod.gather_updater_state(net.opt_state,
+                                           trainer._opt_template)
+    mesh2 = MeshContext.create(n_data=2, n_model=1,
+                               devices=jax.devices()[:2])
+    resharded, tpl = updater_mod.reshard_updater_state(
+        net.opt_state, trainer._opt_template, mesh2)
+    for leaf in jax.tree_util.tree_leaves(resharded):
+        if getattr(leaf, "ndim", 0) == 2:
+            assert leaf.shape[0] == 2  # (dp_new, chunk') view
+    back = updater_mod.gather_updater_state(resharded, tpl)
+    for a, b in zip(jax.tree_util.tree_leaves(ref),
+                    jax.tree_util.tree_leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_updater_state_template_describes_replicated_state():
+    net = _net()
+    tpl = updater_mod.updater_state_template(net.opt_state)
+    descs = jax.tree_util.tree_leaves(tpl, is_leaf=lambda x: x is None)
+    leaves = jax.tree_util.tree_leaves(net.opt_state)
+    assert len(descs) == len(leaves)
+    described = 0
+    for desc, leaf in zip(descs, leaves):
+        if desc is not None:  # non-shardable leaves stay unrecorded
+            assert tuple(desc.shape) == tuple(np.shape(leaf))
+            described += 1
+    assert described > 0
+
+
+# ---------------------------------------------------------------------------
+# heartbeats + topology override
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_beats_and_goes_stale(tmp_path):
+    hb = HostHeartbeat(tmp_path, rank=3, interval_s=0.05).start()
+    try:
+        import time
+        time.sleep(0.2)
+        ages = read_heartbeat_ages(tmp_path)
+        assert 3 in ages and ages[3] < 1.0
+    finally:
+        hb.stop()
+    import time
+    time.sleep(0.3)
+    assert read_heartbeat_ages(tmp_path)[3] >= 0.3  # no thread, no beats
+
+
+def test_topology_override_changes_batch_slice_and_save_world():
+    assert multihost.effective_process_count() == jax.process_count()
+    multihost.set_topology_override(1, 0)
+    try:
+        assert multihost.effective_process_count() == 1
+        assert multihost.local_batch_slice(16) == slice(0, 16)
+    finally:
+        multihost.clear_topology_override()
+    with pytest.raises(ValueError):
+        multihost.set_topology_override(2, 5)  # rank outside world
+
+
+# ---------------------------------------------------------------------------
+# single-process ElasticTrainer semantics
+# ---------------------------------------------------------------------------
+
+def test_elastic_trainer_fit_and_exact_cursor_resume(tmp_path):
+    """A second ElasticTrainer over the same checkpoint dir resumes at
+    the cursor: asking for the SAME epoch count replays nothing (the
+    epoch is complete), asking for one more consumes exactly the new
+    epoch — no index dropped or doubled."""
+    rng = np.random.default_rng(0)
+    batches = [_batch(rng) for _ in range(4)]
+    first = ElasticTrainer(_net, tmp_path, checkpoint_every=1,
+                           step_timeout_s=30.0)
+    try:
+        first.fit(batches, epochs=1)
+        assert first.consumed_indices(0) == [0, 1, 2, 3]
+    finally:
+        first.close()
+
+    second = ElasticTrainer(_net, tmp_path, checkpoint_every=1,
+                            step_timeout_s=30.0)
+    try:
+        second.fit(batches, epochs=1)
+        assert second.trajectory == []  # nothing left of epoch 0
+        second.fit(batches, epochs=2)
+        assert second.consumed_indices(1) == [0, 1, 2, 3]
+        assert second.net.iteration_count == 8
+    finally:
+        second.close()
+
+
+def test_elastic_trainer_indivisible_batch_is_clear_error(tmp_path):
+    trainer = ElasticTrainer(_net, tmp_path, checkpoint_every=0,
+                             step_timeout_s=30.0)
+    try:
+        with pytest.raises(ElasticError, match="not divisible"):
+            trainer.fit([_batch(np.random.default_rng(0), n=9)], epochs=1)
+    finally:
+        trainer.close()
+
+
+def test_elastic_trainer_losses_match_plain_trainer(tmp_path):
+    """No faults, dp = all local devices: ElasticTrainer is just
+    ParallelTrainer + checkpoints — the trajectory must be bitwise the
+    plain trainer's on the same data."""
+    rng = np.random.default_rng(0)
+    batches = [_batch(rng) for _ in range(3)]
+    elastic = ElasticTrainer(_net, tmp_path, checkpoint_every=1,
+                             step_timeout_s=30.0)
+    try:
+        elastic.fit(batches, epochs=1)
+        got = [e["loss"] for e in elastic.trajectory]
+    finally:
+        elastic.close()
+    net = _net()
+    plain = ParallelTrainer(net, MeshContext.create(n_data=8, n_model=1))
+    want = [float(plain.fit_batch(b)) for b in batches]
+    np.testing.assert_array_equal(np.float64(got), np.float64(want))
+
+
+def test_zip_checkpoint_restores_across_widths_without_reshard(tmp_path):
+    """The zip (non-sharded) format stores the GATHERED updater state —
+    width-agnostic: a zero1 dp=4 run handed off via gather_opt_state
+    restores under a dp=2 manager with no topology error and no
+    reshard flag."""
+    rng = np.random.default_rng(0)
+    net = _net()
+    mesh4 = MeshContext.create(n_data=4, n_model=1,
+                               devices=jax.devices()[:4])
+    trainer = ParallelTrainer(net, mesh4, weight_update_sharding="zero1")
+    trainer.fit_batch(_batch(rng))
+    trainer.gather_opt_state()  # zip-serializer handoff (PR 5)
+    mgr4 = CheckpointManager(tmp_path, sharded=False,
+                             mesh_ctx=mesh4, weight_update_sharding="zero1")
+    mgr4.save(net)
+    net2 = _net()
+    mesh2 = MeshContext.create(n_data=2, n_model=1,
+                               devices=jax.devices()[:2])
+    mgr2 = CheckpointManager(tmp_path, sharded=False, mesh_ctx=mesh2,
+                             weight_update_sharding="zero1")
+    cursor = mgr2.restore(net2)  # no reshard flag, no CheckpointError
+    assert cursor is not None
+    for a, b in zip(jax.tree_util.tree_leaves(net.params),
+                    jax.tree_util.tree_leaves(net2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_recovery_without_checkpoint_clears_trajectory(tmp_path):
+    """A rebuild that finds NO checkpoint replays the epoch from
+    scratch — stale pre-loss trajectory entries must not survive to
+    double-count consumed indices."""
+    trainer = ElasticTrainer(_net, tmp_path, checkpoint_every=0,
+                             step_timeout_s=30.0, resume=True)
+    try:
+        trainer.trajectory = [{"step": 1, "epoch": 0, "index": 0,
+                               "loss": 1.0}]
+        trainer._bootstrap()  # empty dir: cursor is None
+        assert trainer.trajectory == []
+    finally:
+        trainer.close()

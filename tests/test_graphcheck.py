@@ -270,3 +270,53 @@ def test_hbm_overflow_warning():
     findings = check_multilayer(conf, batch_size=64,
                                 hbm_bytes=1024 * 1024)  # absurd 1 MiB chip
     assert any(f.rule == "GC007" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# GC014: post-resize mesh legality (ISSUE 8, elastic training)
+# ---------------------------------------------------------------------------
+
+def test_gc014_indivisible_surviving_width():
+    """batch 32 over dp=4 is legal, but the planned resize to dp=3
+    cannot split it — GC014 error naming the width."""
+    conf, _ = fixtures.good_mlp()
+    findings = check_multilayer(conf, mesh={"dp": 4}, batch_size=32,
+                                elastic_resize_widths=[3, 2, 1])
+    bad = [f for f in findings if f.rule == "GC014"]
+    assert len(bad) == 1 and "dp=3" in bad[0].location
+    assert bad[0].severity == Severity.ERROR
+
+
+def test_gc014_growing_width_rejected():
+    conf, _ = fixtures.good_mlp()
+    findings = check_multilayer(conf, mesh={"dp": 4}, batch_size=32,
+                                elastic_resize_widths=[8])
+    assert any(f.rule == "GC014" and "8" in f.location for f in findings)
+
+
+def test_gc014_zero1_pad_waste_reevaluated():
+    """Tiny layers: waste is over threshold at a surviving width of 7
+    even though the planned batch divides — warning, not error."""
+    conf, kw = fixtures.bad_zero1_padding()
+    findings = check_multilayer(conf, mesh={"dp": 8}, batch_size=56,
+                                weight_update_sharding="zero1",
+                                elastic_resize_widths=[7])
+    ours = [f for f in findings if f.rule == "GC014"]
+    assert len(ours) == 1 and ours[0].severity == Severity.WARNING
+    assert "dp=7" in ours[0].location
+
+
+def test_gc014_clean_plan_and_sole_survivor():
+    """A legal plan — every width divides, dp=1 skips the zero1 waste
+    re-evaluation (the layout degrades to replicated) — is clean."""
+    conf, _ = fixtures.good_mlp()
+    findings = check_multilayer(conf, mesh={"dp": 4}, batch_size=64,
+                                weight_update_sharding="zero1",
+                                elastic_resize_widths=[2, 1])
+    assert not [f for f in findings if f.rule == "GC014"]
+
+
+def test_gc014_silent_without_plan():
+    conf, _ = fixtures.good_mlp()
+    findings = check_multilayer(conf, mesh={"dp": 4}, batch_size=32)
+    assert not [f for f in findings if f.rule == "GC014"]

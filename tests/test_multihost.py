@@ -92,3 +92,115 @@ def test_two_process_training_matches_single():
                 if ln.startswith("DLOSSES"))
     single_dl = [float(v) for v in line.split()[1:]]
     np.testing.assert_allclose(dl[0], single_dl, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# elastic chaos (ISSUE 8): kill_host mid-epoch, survivor resizes + resumes
+# ---------------------------------------------------------------------------
+
+KILL_HOST_EXIT_CODE = 117  # faultinject.KILL_HOST_EXIT_CODE
+
+
+def _spawn_elastic(tmp_path, fault_kind, fault_step, fault_s=6.0,
+                   timeout=420):
+    """Run the 2-process elastic worker phase; returns (returncodes,
+    outputs)."""
+    import json
+    import tempfile
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (os.path.dirname(HERE)
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    env["ELASTIC_CKPT"] = str(tmp_path)
+    env["ELASTIC_FAULT_KIND"] = fault_kind
+    env["ELASTIC_FAULT_STEP"] = str(fault_step)
+    env["ELASTIC_FAULT_S"] = str(fault_s)
+    logdir = tempfile.mkdtemp(prefix="elastic")
+    logs = [open(os.path.join(logdir, f"w{i}.log"), "w+") for i in range(2)]
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(i), "2", str(port), "elastic"],
+        stdout=logs[i], stderr=subprocess.STDOUT, env=env,
+        cwd=os.path.dirname(HERE)) for i in range(2)]
+    rcs, outs = [], []
+    for i, p in enumerate(procs):
+        try:
+            p.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            logs[i].seek(0)
+            pytest.fail("elastic worker hung — detection must be bounded:\n"
+                        + logs[i].read()[-3000:])
+        logs[i].seek(0)
+        rcs.append(p.returncode)
+        outs.append(logs[i].read())
+    return rcs, outs
+
+
+def _parse_tagged(out, tag):
+    import json
+    line = next(ln for ln in out.splitlines() if ln.startswith(tag + " "))
+    return json.loads(line[len(tag) + 1:])
+
+
+def test_kill_host_survivor_resizes_and_resumes_exactly(tmp_path):
+    """A 2-process elastic run loses rank 1 to a hard kill at step 4:
+    rank 0 must detect the loss, resize to dp=1, reshard-restore the
+    latest valid checkpoint (zero1 (2,chunk) views -> full shape), and
+    consume exactly the unconsumed tail — and its post-resume losses
+    must BITWISE match a clean dp=1 restart from the same checkpoint +
+    cursor."""
+    rcs, outs = _spawn_elastic(tmp_path, "kill_host", fault_step=4)
+    assert rcs[1] == KILL_HOST_EXIT_CODE, outs[1][-2000:]  # died BY the fault
+    assert rcs[0] == 0, outs[0][-3000:]
+
+    traj = _parse_tagged(outs[0], "TRAJ")
+    # exactly-once: every batch index consumed once, none dropped/doubled
+    assert [e["index"] for e in traj if e["epoch"] == 0] == list(range(6))
+    assert _parse_tagged(outs[0], "WORLD") == [0]
+    metrics = _parse_tagged(outs[0], "METRICS")
+    assert metrics["elastic_resizes_total"] == 1.0
+    assert metrics["resilience_host_failures_total"] == 1.0
+    assert metrics["elastic_reshard_restores_total"] == 1.0
+    assert metrics["elastic_dp_width"] == 1.0
+
+    # bitwise gate: clean dp=1 restart from the resume checkpoint (the
+    # last one committed before the kill: step 3) reproduces the
+    # survivor's post-resume losses exactly
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (os.path.dirname(HERE)
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    env["ELASTIC_CKPT"] = str(tmp_path)
+    env["ELASTIC_RESUME_STEP"] = "3"
+    ref = subprocess.run(
+        [sys.executable, WORKER, "0", "1", str(_free_port()), "elastic_ref"],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=os.path.dirname(HERE))
+    assert ref.returncode == 0, ref.stdout[-2000:] + ref.stderr[-2000:]
+    line = next(ln for ln in ref.stdout.splitlines()
+                if ln.startswith("REFLOSSES"))
+    ref_losses = [float(v) for v in line.split()[1:]]
+    survivor_tail = [e["loss"] for e in traj if e["step"] > 3]
+    np.testing.assert_array_equal(np.float64(survivor_tail),
+                                  np.float64(ref_losses))
+
+
+def test_slow_host_surfaces_as_barrier_timeout_not_hang(tmp_path):
+    """A straggling-but-alive host (6s stall at step 3 vs a 2s barrier
+    budget) must surface on its peer as counted barrier-timeout
+    DETECTION — and then the step completes: no resize, no hang, both
+    processes finish the epoch with identical trajectories."""
+    rcs, outs = _spawn_elastic(tmp_path, "slow_host", fault_step=3,
+                               fault_s=6.0)
+    assert rcs == [0, 0], outs[0][-2000:] + outs[1][-2000:]
+    t0, t1 = (_parse_tagged(o, "TRAJ") for o in outs)
+    assert t0 == t1  # synchronous SPMD: same losses, same order
+    assert [e["index"] for e in t0] == list(range(6))
+    m0 = _parse_tagged(outs[0], "METRICS")
+    assert m0["elastic_barrier_timeouts_total"] >= 1.0
+    assert m0["elastic_resizes_total"] == 0.0
+    assert m0["resilience_host_failures_total"] == 0.0

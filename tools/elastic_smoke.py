@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Elastic-training smoke stage (tools/run_checks.sh): a 2-process CPU
+run (1 device per process, dp=2, zero1) loses rank 1 to a hard
+``kill_host`` at step 4. The surviving rank 0 must
+
+1. detect the loss within its bounded step-barrier/heartbeat windows
+   (never a silent hang — the driver enforces a wall clock),
+2. resize the mesh to dp=1 and reshard-restore the latest valid
+   sharded checkpoint (zero1 ``(2, chunk)`` updater views un-padded to
+   full shape),
+3. finish the epoch consuming exactly the unconsumed tail — every
+   batch index once, none dropped or doubled,
+4. produce a post-resume loss trajectory that is BITWISE identical to
+   a clean dp=1 run restarted from the same checkpoint + cursor, and
+5. serve ``/api/metrics`` showing exactly one ``elastic_resizes_total``
+   (fetched over a real HTTP socket, the PR-2 wiring).
+
+Exit 0 = the detect -> resize -> reshard-restore -> tail-resume
+lifecycle is wired end to end.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+KILL_STEP = 4
+KILL_HOST_EXIT_CODE = 117  # faultinject.KILL_HOST_EXIT_CODE
+N_BATCHES = 6
+
+
+# ---------------------------------------------------------------------------
+# worker halves (re-exec'd subprocesses; the driver never imports jax)
+# ---------------------------------------------------------------------------
+
+def _factory():
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder().seed(2024)
+        .updater("adam").learning_rate(0.05)
+        .list()
+        .layer(DenseLayer(n_out=16, activation="relu"))
+        .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(10)).build()).init()
+
+
+def _batches():
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    rng = np.random.default_rng(7)
+    return [DataSet(rng.normal(size=(8, 10)).astype(np.float32),
+                    np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)])
+            for _ in range(N_BATCHES)]
+
+
+def _worker(rank: int, port: str, ckpt: str) -> int:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=1")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from deeplearning4j_tpu.parallel import multihost
+    from deeplearning4j_tpu.resilience import faultinject
+    from deeplearning4j_tpu.resilience.elastic import ElasticTrainer
+    from deeplearning4j_tpu.resilience.faultinject import (Fault,
+                                                           FaultSchedule)
+    multihost.initialize(coordinator=f"localhost:{port}", num_processes=2,
+                         process_id=rank, elastic=True)
+    if rank == 1:
+        faultinject.set_schedule(FaultSchedule(
+            [Fault(kind="kill_host", step=KILL_STEP)]))
+    trainer = ElasticTrainer(
+        _factory, ckpt, weight_update_sharding="zero1",
+        checkpoint_every=1, keep_last=50,
+        step_timeout_s=2.0, heartbeat_timeout_s=3.0, commit_timeout_s=30.0)
+    trainer.fit(_batches(), epochs=1)
+    print("TRAJ " + json.dumps(trainer.trajectory), flush=True)
+
+    # the /api/metrics gate: serve the registry on an ephemeral port and
+    # read elastic_resizes_total back over a real HTTP socket
+    import urllib.request
+
+    from deeplearning4j_tpu.ui.server import UIServer
+    server = UIServer(port=0).start()
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/api/metrics", timeout=10
+    ).read().decode()
+    resizes = [ln.split()[-1] for ln in text.splitlines()
+               if ln.startswith("elastic_resizes_total")]
+    print("HTTP_RESIZES " + (resizes[0] if resizes else "absent"),
+          flush=True)
+    server.stop()
+    trainer.close()
+    return 0
+
+
+def _ref(ckpt: str, resume_step: int) -> int:
+    """Clean dp=1 restart from the resume checkpoint: the bitwise
+    reference trajectory."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=1")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from deeplearning4j_tpu.parallel import MeshContext, ParallelTrainer
+    from deeplearning4j_tpu.resilience.manager import CheckpointManager
+    net = _factory()
+    mesh = MeshContext.create(n_data=1)
+    mgr = CheckpointManager(ckpt, sharded=True, mesh_ctx=mesh)
+    info = next(i for i in mgr.checkpoints() if i.step == resume_step)
+    cursor = mgr.restore(net, info, reshard=True)
+    trainer = ParallelTrainer(net, mesh)
+    batches = _batches()
+    losses = [float(trainer.fit_batch(batches[i]))
+              for i in range(cursor.data_position, len(batches))]
+    print("REFLOSSES " + " ".join(f"{l:.17g}" for l in losses), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _tagged(out: str, tag: str) -> str:
+    return next(ln for ln in out.splitlines()
+                if ln.startswith(tag + " "))[len(tag) + 1:]
+
+
+def main() -> int:
+    port = _free_port()
+    ckpt = tempfile.mkdtemp(prefix="elastic_smoke_ckpt")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    me = os.path.abspath(__file__)
+    logs = [tempfile.NamedTemporaryFile("w+", suffix=f"_w{i}.log",
+                                        delete=False) for i in range(2)]
+    procs = [subprocess.Popen(
+        [sys.executable, me, "--worker", str(i), str(port), ckpt],
+        stdout=logs[i], stderr=subprocess.STDOUT, env=env)
+        for i in range(2)]
+    outs = []
+    for i, p in enumerate(procs):
+        try:
+            # the wall clock IS the no-silent-hang gate: detection +
+            # resume must complete well inside it
+            p.wait(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            logs[i].seek(0)
+            print("elastic_smoke: FAIL worker hung (detection must be "
+                  "bounded)\n" + logs[i].read()[-3000:])
+            return 1
+        logs[i].seek(0)
+        outs.append(logs[i].read())
+    if procs[1].returncode != KILL_HOST_EXIT_CODE:
+        print(f"elastic_smoke: FAIL rank 1 exited {procs[1].returncode}, "
+              f"wanted kill_host's {KILL_HOST_EXIT_CODE}\n" + outs[1][-3000:])
+        return 1
+    if procs[0].returncode != 0:
+        print("elastic_smoke: FAIL survivor crashed\n" + outs[0][-3000:])
+        return 1
+
+    traj = json.loads(_tagged(outs[0], "TRAJ"))
+    indices = [e["index"] for e in traj if e["epoch"] == 0]
+    if indices != list(range(N_BATCHES)):
+        print(f"elastic_smoke: FAIL batch indices {indices} != exactly-once "
+              f"{list(range(N_BATCHES))}")
+        return 1
+
+    resizes = _tagged(outs[0], "HTTP_RESIZES")
+    try:
+        resizes = float(resizes)
+    except ValueError:
+        resizes = None
+    if resizes != 1.0:
+        print(f"elastic_smoke: FAIL /api/metrics elastic_resizes_total = "
+              f"{resizes!r}, wanted exactly one")
+        return 1
+
+    ref = subprocess.run(
+        [sys.executable, me, "--ref", ckpt, str(KILL_STEP - 1)],
+        capture_output=True, text=True, timeout=300, env=env)
+    if ref.returncode != 0:
+        print("elastic_smoke: FAIL reference run\n"
+              + ref.stdout[-2000:] + ref.stderr[-2000:])
+        return 1
+    ref_losses = [float(v) for v in
+                  _tagged(ref.stdout, "REFLOSSES").split()]
+    tail = [e["loss"] for e in traj if e["step"] > KILL_STEP - 1]
+    if tail != ref_losses:
+        print(f"elastic_smoke: FAIL post-resume trajectory {tail} is not "
+              f"bitwise the clean dp=1 restart's {ref_losses}")
+        return 1
+
+    print(f"elastic_smoke: PASS kill_host@{KILL_STEP} -> dp=1 resume, "
+          f"{len(tail)} post-resume steps bitwise-matched, exactly one "
+          "resize on /api/metrics")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        sys.exit(_worker(int(sys.argv[2]), sys.argv[3], sys.argv[4]))
+    if len(sys.argv) > 1 and sys.argv[1] == "--ref":
+        sys.exit(_ref(sys.argv[2], int(sys.argv[3])))
+    sys.exit(main())

@@ -39,9 +39,12 @@ if [ "${1:-}" != "--fast" ]; then
     echo "== input smoke (pipeline vs sync: loss parity + lower stall) =="
     JAX_PLATFORMS=cpu python tools/input_smoke.py || fail=1
 
+    echo "== elastic smoke (kill_host -> dp=1 resume, bitwise + /api/metrics) =="
+    JAX_PLATFORMS=cpu python tools/elastic_smoke.py || fail=1
+
     echo "== tier-1 tests (ROADMAP.md) =="
     rm -f /tmp/_t1.log
-    timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    timeout -k 10 1500 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
         -m 'not slow' --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
         | tee /tmp/_t1.log
