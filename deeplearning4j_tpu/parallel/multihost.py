@@ -79,6 +79,13 @@ def _ensure_cpu_collectives() -> None:
         except Exception:  # older jaxlib without gloo: keep prior behavior
             logger.warning("gloo CPU collectives unavailable; multi-process "
                            "CPU computations will not run")
+        # NOTE: do NOT disable XLA's thunk runtime here to dodge the
+        # gloo slot race (see gloo_collectives_active): the legacy CPU
+        # runtime turns a gloo all-reduce failing on a dead peer into a
+        # FATAL check — the SURVIVOR aborts with its killed peer, which
+        # breaks elastic recovery. The thunk runtime leaves that
+        # collective hanging, which the elastic layer's abandonable
+        # step thread + bounded barrier waits are built to detect.
 
 
 def initialize(coordinator: Optional[str] = None,
@@ -207,6 +214,25 @@ def effective_process_count() -> int:
     if _topology_override is not None:
         return _topology_override[0]
     return jax.process_count()
+
+
+def gloo_collectives_active() -> bool:
+    """True when cross-process collectives run over the gloo CPU
+    backend (the path ``_ensure_cpu_collectives`` selects).
+
+    Gloo reuses one set of per-executable collective tags, so two
+    async in-flight runs of the SAME compiled step — jax dispatch
+    returns before the param-update all-reduce lands — can collide on
+    a TCP pair and abort the whole process
+    (``gloo::EnforceNotMet: op.preamble.length <= op.nbytes``).
+    Callers stepping in a loop on this path must drain each step
+    (``jax.block_until_ready`` on params + updater state) before
+    dispatching the next; on TPU/GPU this is unnecessary and the
+    helper returns False so pipelining is preserved."""
+    if effective_process_count() <= 1:
+        return False
+    return (os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+            or str(jax.config.jax_platforms or "").startswith("cpu"))
 
 
 def effective_process_index() -> int:

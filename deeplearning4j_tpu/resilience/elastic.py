@@ -468,6 +468,14 @@ class ElasticTrainer:
                 # thread: a collective stuck on a dead peer hangs here,
                 # not on the main thread
                 box["loss"] = float(self.trainer.fit_batch(local))
+                if self._multihost.gloo_collectives_active():
+                    # forcing the loss does NOT force the param-update
+                    # all-reduce; on the gloo CPU path an in-flight
+                    # step overlapping the next one aborts the process
+                    # (tag collision — see multihost helper), which
+                    # peers would misread as a host failure
+                    self._jax.block_until_ready(
+                        (self.net.params, self.net.opt_state))
             except BaseException as e:  # noqa: BLE001 — relayed below
                 box["exc"] = e
             finally:
@@ -559,7 +567,10 @@ class ElasticTrainer:
                 if pos >= n:
                     if self.sentinel is not None:
                         self.sentinel.flush()
-                    self._save(epoch=epoch + 1, next_pos=0)
+                    if self.checkpoint_every:
+                        # checkpoint_every=0 disables ALL saves (e.g. a
+                        # read-only checkpoint dir), not just in-epoch
+                        self._save(epoch=epoch + 1, next_pos=0)
                     epoch, pos, order = epoch + 1, 0, list(range(n))
                     continue
                 step_id = self.net.iteration_count + 1

@@ -165,6 +165,13 @@ losses = []
 for _ in range(3):
     # each process feeds only ITS slice of the global batch
     losses.append(trainer.fit_batch(DataSet(x[sl], y[sl])))
+    # Serialize steps on the gloo CPU-collectives path: async dispatch
+    # lets step N+1's collectives launch while step N's are still in
+    # flight, and consecutive runs of one executable reuse the same
+    # collective tags — two same-tag ops of different byte sizes then
+    # collide on one TCP pair and gloo aborts the whole process
+    # (EnforceNotMet: op.preamble.length <= op.nbytes).
+    jax.block_until_ready((net.params, net.opt_state))
 print("LOSSES", " ".join(f"{l:.8f}" for l in losses), flush=True)
 
 # ---- phase 2: delayed-sync DP (the DP-2/DCN tier) over the same mesh ----
@@ -182,6 +189,7 @@ dtrainer = DelayedSyncTrainer(net2, ctx2, sync_frequency=2)
 dlosses = []
 for _ in range(4):
     dlosses.append(float(dtrainer.fit_batch(DataSet(x[sl], y[sl]))))
+    jax.block_until_ready((net2.params, net2.opt_state))  # see phase 1
 print("DLOSSES", " ".join(f"{l:.8f}" for l in dlosses), flush=True)
 
 # ---- phase 3: zero1 weight-update sharding over the global mesh ----------
@@ -200,6 +208,7 @@ ztrainer = multihost.data_parallel_trainer(net3,
 zlosses = []
 for _ in range(3):
     zlosses.append(ztrainer.fit_batch(DataSet(x[sl], y[sl])))
+    jax.block_until_ready((net3.params, net3.opt_state))  # see phase 1
 np.testing.assert_array_equal(np.float32(zlosses), np.float32(losses))
 # each process addresses only its slice of the sharded updater state
 opt_leaves = [l for l in jax.tree_util.tree_leaves(net3.opt_state)

@@ -307,3 +307,20 @@ def test_recovery_without_checkpoint_clears_trajectory(tmp_path):
         assert trainer.trajectory == []
     finally:
         trainer.close()
+
+
+def test_kill_host_exit_code_pinned_in_smoke_driver():
+    """tools/elastic_smoke.py hand-copies KILL_HOST_EXIT_CODE (its
+    driver process must stay jax-free, and importing the package pulls
+    in jax) — pin the copy to the faultinject constant."""
+    import importlib.util
+    import os
+
+    from deeplearning4j_tpu.resilience import faultinject
+    spec = importlib.util.spec_from_file_location(
+        "elastic_smoke", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "elastic_smoke.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.KILL_HOST_EXIT_CODE == faultinject.KILL_HOST_EXIT_CODE

@@ -28,13 +28,38 @@ def _free_port():
     return port
 
 
-def test_two_process_training_matches_single():
-    port = _free_port()
+#: Signature of the KNOWN upstream race in XLA's CPU gloo collectives:
+#: with several virtual devices per process, the per-device execution
+#: threads walk a program's independent (different-sized) all-reduces at
+#: different rates and gloo's slot assignment lets two collide on one
+#: TCP pair — the victim aborts printing this C++ terminate message
+#: (``op.preamble.length <= op.nbytes``), and its peer then cascades
+#: (connection reset / shutdown-barrier heartbeat timeout). Not a repo
+#: bug; the pair is retried a bounded number of times — but ONLY on the
+#: victim's own signature: peer-side cascade symptoms alone also follow
+#: any genuine worker failure and must surface that worker's log, not a
+#: retry.
+_GLOO_RACE_MARKER = "gloo::EnforceNotMet"
+
+
+def _worker_env():
+    """The ONE environment every worker spawn (multi-process attempts
+    AND the single-process reference) must share — a divergence here
+    would invalidate the bitwise loss comparisons."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = (os.path.dirname(HERE)
                          + os.pathsep + env.get("PYTHONPATH", ""))
+    return env
+
+
+def _spawn_spmd_pair():
+    """One attempt at the 2-process SPMD phase. Returns the two worker
+    outputs, or None when a worker died of the upstream gloo race (the
+    caller retries); any other failure fails the test."""
+    port = _free_port()
+    env = _worker_env()
     import tempfile
     logdir = tempfile.mkdtemp(prefix="multihost")
     logs = [open(os.path.join(logdir, f"w{i}.log"), "w+") for i in range(2)]
@@ -46,18 +71,50 @@ def test_two_process_training_matches_single():
         for i in range(2)
     ]
     outs = []
-    for i, p in enumerate(procs):
-        try:
-            p.wait(timeout=420)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
+    try:
+        for i, p in enumerate(procs):
+            try:
+                # healthy pair ~25s; a gloo-race abort cascade resolves
+                # within ~110s (peer's shutdown-barrier heartbeat
+                # timeout). Kept tight so retried attempts cannot eat
+                # the tier-1 suite's `timeout 1500` headroom; a genuine
+                # hang fails HERE on the first attempt — no retry.
+                p.wait(timeout=180)
+            except subprocess.TimeoutExpired:
+                logs[i].seek(0)
+                pytest.fail("multihost worker timed out:\n"
+                            + logs[i].read()[-3000:])
             logs[i].seek(0)
-            pytest.fail("multihost worker timed out:\n" + logs[i].read()[-3000:])
-        logs[i].seek(0)
-        out = logs[i].read()
-        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
-        outs.append(out)
+            outs.append(logs[i].read())
+    finally:
+        # never orphan a worker: a live orphan (4 spinning XLA device
+        # threads + its half of the gloo mesh) degrades every
+        # subsequent run on the box
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+                q.wait(timeout=30)
+    if all(p.returncode == 0 for p in procs):
+        return outs
+    if any(p.returncode != 0 and _GLOO_RACE_MARKER in out
+           for p, out in zip(procs, outs)):
+        return None
+    bad = next(i for i, p in enumerate(procs) if p.returncode != 0)
+    assert False, (f"worker {bad} failed (rc={procs[bad].returncode}):\n"
+                   + outs[bad][-3000:])
+
+
+def test_two_process_training_matches_single():
+    for _ in range(3):
+        outs = _spawn_spmd_pair()
+        if outs is not None:
+            break
+    else:
+        pytest.fail("upstream gloo CPU-collective race (gloo::EnforceNotMet "
+                    "slot collision) aborted the worker pair 3 times in a "
+                    "row — see the /tmp/multihost* worker logs")
+
+    env = _worker_env()
 
     losses = []
     for out in outs:
@@ -98,7 +155,8 @@ def test_two_process_training_matches_single():
 # elastic chaos (ISSUE 8): kill_host mid-epoch, survivor resizes + resumes
 # ---------------------------------------------------------------------------
 
-KILL_HOST_EXIT_CODE = 117  # faultinject.KILL_HOST_EXIT_CODE
+from deeplearning4j_tpu.resilience.faultinject import (  # noqa: E402
+    KILL_HOST_EXIT_CODE)
 
 
 def _spawn_elastic(tmp_path, fault_kind, fault_step, fault_s=6.0,
@@ -108,11 +166,7 @@ def _spawn_elastic(tmp_path, fault_kind, fault_step, fault_s=6.0,
     import json
     import tempfile
     port = _free_port()
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = (os.path.dirname(HERE)
-                         + os.pathsep + env.get("PYTHONPATH", ""))
+    env = _worker_env()
     env["ELASTIC_CKPT"] = str(tmp_path)
     env["ELASTIC_FAULT_KIND"] = fault_kind
     env["ELASTIC_FAULT_STEP"] = str(fault_step)
@@ -169,11 +223,7 @@ def test_kill_host_survivor_resizes_and_resumes_exactly(tmp_path):
     # bitwise gate: clean dp=1 restart from the resume checkpoint (the
     # last one committed before the kill: step 3) reproduces the
     # survivor's post-resume losses exactly
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = (os.path.dirname(HERE)
-                         + os.pathsep + env.get("PYTHONPATH", ""))
+    env = _worker_env()
     env["ELASTIC_CKPT"] = str(tmp_path)
     env["ELASTIC_RESUME_STEP"] = "3"
     ref = subprocess.run(

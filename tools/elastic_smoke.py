@@ -30,7 +30,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 KILL_STEP = 4
-KILL_HOST_EXIT_CODE = 117  # faultinject.KILL_HOST_EXIT_CODE
+# Must equal faultinject.KILL_HOST_EXIT_CODE (tested in
+# tests/test_elastic.py); hand-copied because importing the package
+# pulls in jax, and this driver process must stay jax-free.
+KILL_HOST_EXIT_CODE = 117
 N_BATCHES = 6
 
 
