@@ -197,6 +197,19 @@ def _stamp(msg: str) -> None:
           file=sys.stderr, flush=True)
 
 
+def _precision_fields(default: str = "float32") -> dict:
+    """``compute_dtype`` / ``params_dtype`` — fields EVERY rung record
+    carries (ISSUE 10) so a ladder entry names the matmul precision it
+    ran at next to its throughput. ``BENCH_PRECISION`` (fp32|bf16|fp16
+    or a dtype name, the ``nn.updater.PrecisionPolicy`` presets)
+    overrides; ``default`` is the rung's own dtype choice."""
+    from deeplearning4j_tpu.nn.updater import PrecisionPolicy
+    pol = PrecisionPolicy.parse(
+        os.environ.get("BENCH_PRECISION") or default)
+    return {"compute_dtype": pol.compute_dtype,
+            "params_dtype": pol.params_dtype}
+
+
 def _failure_record(metric: str, detail: str, open_spans, kind: str
                     ) -> dict:
     """A rung failure as a first-class JSON record: value 0, marked
@@ -659,13 +672,13 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
             _stamp("cost analysis FAILED (headline number stands):\n"
                    + traceback.format_exc(limit=10))
 
-    # Weight-update layout cost (ISSUE 5): analytic per-update comm
-    # bytes + per-chip updater-state HBM at this device count, for the
-    # layout under test (BENCH_WUS=off|zero1, BENCH_ACCUM=k) — the
-    # fields a real-TPU ladder compares against the replicated baseline
-    # to attribute an MFU delta to ZeRO-1 weight-update sharding.
+    # Weight-update layout cost (ISSUE 5 + 10): analytic per-update
+    # comm bytes + per-chip updater-state/gradient HBM at this device
+    # count, for the layout under test (BENCH_WUS=off|zero1|zero2,
+    # BENCH_ACCUM=k) — the fields a real-TPU ladder compares against
+    # the replicated baseline to attribute an MFU delta to the layout.
     wus_mode = os.environ.get("BENCH_WUS", "off")
-    comm_bytes = updater_hbm = None
+    comm_bytes = updater_hbm = gradient_hbm = None
     try:
         from deeplearning4j_tpu.profiling.cost import weight_update_cost
         wuc = weight_update_cost(
@@ -674,6 +687,7 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
             weight_update_sharding=wus_mode)
         comm_bytes = wuc["comm_bytes_per_step"]
         updater_hbm = wuc["updater_hbm_bytes"]
+        gradient_hbm = wuc["gradient_hbm_bytes"]
     except Exception:  # noqa: BLE001 — telemetry must never cost it
         _stamp("weight-update cost model FAILED (headline stands):\n"
                + traceback.format_exc(limit=10))
@@ -722,8 +736,12 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
         "weight_update_sharding": wus_mode,
         "comm_bytes_per_step": comm_bytes,
         "updater_hbm_bytes": updater_hbm,
+        "gradient_hbm_bytes": gradient_hbm,
         "phase_breakdown_s_per_step": phase_breakdown,
         "pallas_lstm_parity": parity,
+        **_precision_fields("bfloat16" if on_accel
+                            and cfg["dtype"] == "bfloat16"
+                            else "float32"),
     }
 
 
@@ -800,6 +818,7 @@ def _run_input_rung(jax, smoke: bool, on_accel: bool, device_kind: str,
         "input_stage_seconds": stages,
         "reader_workers": cfg["reader_workers"],
         "decode_workers": cfg["decode_workers"],
+        **_precision_fields(),
     }
 
 
@@ -939,6 +958,7 @@ def _run_serve_rung(jax, smoke: bool, on_accel: bool, device_kind: str,
         "max_wait_ms": cfg["max_wait_ms"],
         "batch_size_mix": stats["batch_size_mix"],
         "compile_s": stats["compile_s"],
+        **_precision_fields(),
     }
 
 

@@ -138,6 +138,43 @@ def bad_zero1_padding():
                   "weight_update_sharding": "zero1"}
 
 
+def bad_zero2_no_dp():
+    """zero2 weight-update sharding over a single data replica: same
+    static illegality as zero1 (GC011 covers both sharded modes — the
+    (dp, chunk) layout is shared; zero2 only changes the gradient
+    anchoring)."""
+    conf, _ = good_mlp()
+    return conf, {"mesh": {"dp": 1}, "batch_size": 32,
+                  "weight_update_sharding": "zero2"}
+
+
+def bad_zero2_padding():
+    """Tiny odd-sized layers under zero2 over a wide dp axis: the
+    pad-to-divisible waste warning must fire for zero2 exactly as for
+    zero1 (same flattened-leaf layout)."""
+    conf, kw = bad_zero1_padding()
+    kw = dict(kw, weight_update_sharding="zero2")
+    return conf, kw
+
+
+def bad_bf16_no_loss_scale():
+    """bf16 compute policy with no fp32 loss scale configured: GC015
+    warns — half-precision backward gradients that underflow are
+    silently zero (benign-ish for bf16's fp32 exponent range, a real
+    hazard for fp16; the rule points at the knob either way)."""
+    conf, _ = good_mlp()
+    conf.training.precision = "bf16"
+    return conf, {"mesh": {"dp": 2}, "batch_size": 32}
+
+
+def bad_fp16_bad_dtype():
+    """A precision policy naming a non-float compute dtype: GC015
+    errors before the step-boundary casts would die at trace time."""
+    conf, _ = good_mlp()
+    conf.training.precision = "int8"
+    return conf, {"batch_size": 32}
+
+
 def bad_dp_unsharded_iterator():
     """A dp=8 mesh fed by a plain in-memory iterator: every batch lands
     replicated on the default device and is resharded over 'data'
@@ -175,6 +212,10 @@ KNOWN_BAD: List[Tuple[str, str, Callable]] = [
     ("zero1-without-dp", "GC011", bad_zero1_no_dp),
     ("zero1-over-tp-mesh", "GC011", bad_zero1_tp),
     ("zero1-padding-waste", "GC011", bad_zero1_padding),
+    ("zero2-without-dp", "GC011", bad_zero2_no_dp),
+    ("zero2-padding-waste", "GC011", bad_zero2_padding),
+    ("bf16-without-loss-scale", "GC015", bad_bf16_no_loss_scale),
+    ("precision-non-float", "GC015", bad_fp16_bad_dtype),
     ("dp-unsharded-iterator", "GC013", bad_dp_unsharded_iterator),
     ("elastic-resize-indivisible", "GC014", bad_elastic_indivisible),
     ("elastic-resize-grows", "GC014", bad_elastic_grow),
@@ -258,6 +299,27 @@ def good_mlp_zero1():
                   "weight_update_sharding": "zero1"}
 
 
+def good_mlp_zero2():
+    """The MLP under zero2 on a healthy dp=8 mesh: large layers,
+    negligible padding — must validate clean (GC011 legality is the
+    same for both sharded modes)."""
+    conf, _ = good_mlp()
+    return conf, {"mesh": {"dp": 8}, "batch_size": 64,
+                  "weight_update_sharding": "zero2"}
+
+
+def good_mlp_bf16_zero2():
+    """bf16 compute / fp32 masters with an explicit loss scale, under
+    zero2 on a dp=8 mesh: the mixed policy composes with the sharded
+    weight update and must validate clean (the GC015 loss-scale warning
+    is satisfied by the configured scale)."""
+    conf, _ = good_mlp()
+    conf.training.precision = "bf16"
+    conf.training.loss_scale = 1024.0
+    return conf, {"mesh": {"dp": 8}, "batch_size": 64,
+                  "weight_update_sharding": "zero2"}
+
+
 def good_mlp_pipeline():
     """The MLP on a dp=8 mesh fed by a StreamingInputPipeline: the
     trainers attach its device stage to their mesh at fit time, so
@@ -287,6 +349,8 @@ KNOWN_GOOD: List[Tuple[str, Callable]] = [
     ("rnn", good_rnn),
     ("graph-merge", good_graph_merge),
     ("mlp-zero1", good_mlp_zero1),
+    ("mlp-zero2", good_mlp_zero2),
+    ("mlp-bf16-zero2", good_mlp_bf16_zero2),
     ("mlp-sharded-pipeline", good_mlp_pipeline),
     ("mlp-elastic-plan", good_mlp_elastic),
 ]

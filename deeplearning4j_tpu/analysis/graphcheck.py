@@ -26,11 +26,12 @@ Rules (stable ids; severities in parentheses):
                                     skewed, or more pp stages than layers
 - GC010 ep-mismatch       (error)   MoE expert count not divisible by the
                                     expert-parallel mesh axis
-- GC011 zero1-mesh        (error)   zero1 weight-update sharding with no
-                                    data-parallel axis or dp < 2 (nothing
-                                    to shard); (warning) pad-to-divisible
-                                    flattened-leaf padding wastes > 5% of
-                                    the updater-state footprint
+- GC011 wus-mesh          (error)   zero1/zero2 weight-update sharding
+                                    with no data-parallel axis or dp < 2
+                                    (nothing to shard); (warning)
+                                    pad-to-divisible flattened-leaf
+                                    padding wastes > 5% of the
+                                    updater-state footprint
 - GC012 vertex-arity      (error)   vertex input count != n_inputs()
 - GC013 input-unsharded   (warning) a dp >= 2 mesh is fed by an iterator
                                     that neither shards its sources nor
@@ -46,6 +47,15 @@ Rules (stable ids; severities in parentheses):
                                     pad-to-divisible waste re-evaluated
                                     at the surviving width exceeds the
                                     GC011 threshold
+- GC015 precision-policy  (error)   the policy's compute dtype is not a
+                                    float dtype; (warning) half-precision
+                                    compute (bf16/fp16) with no fp32
+                                    loss scale configured — gradients
+                                    that underflow in the half backward
+                                    are silently zero (bf16 shares
+                                    fp32's exponent range, so this is a
+                                    footgun warning there and a real
+                                    hazard for fp16)
 
 Entry points: ``check_multilayer`` / ``check_graph`` /
 ``validate_config`` (dispatch), plus ``.validate()`` hooks installed on
@@ -229,6 +239,71 @@ def _wus_mode(weight_update_sharding) -> str:
                        weight_update_sharding)).lower()
 
 
+#: weight-update-sharding modes that lay state out as (dp, chunk)
+#: shards — the ONE jax-light definition every mode-string consumer
+#: (analysis/memory, profiling/cost, resilience/manager + elastic)
+#: imports; keep in sync with parallel.mesh.WeightUpdateSharding.MODES
+#: (the jax-side runtime authority) when a new rung (zero3) lands
+SHARDED_WUS_MODES = ("zero1", "zero2")
+
+#: compute dtypes whose mantissa/exponent lose information vs fp32 —
+#: the GC015 loss-scale warning territory
+HALF_PRECISION_DTYPES = ("bfloat16", "bf16", "float16", "fp16", "half")
+
+#: dtype names GC015 accepts as a float compute/params dtype
+FLOAT_DTYPES = ("float64", "fp64", "double", "float32", "fp32", "float",
+                ) + HALF_PRECISION_DTYPES
+
+
+def _precision_fields(precision):
+    """Normalize a precision spec (None / preset str / dtype str /
+    nn.updater.PrecisionPolicy / dict) to (compute_dtype, loss_scale)
+    WITHOUT importing the jax-heavy nn layer. Mirrors
+    ``PrecisionPolicy.parse``'s presets."""
+    if precision is None:
+        return None, None
+    if isinstance(precision, dict):
+        return (str(precision.get("compute_dtype", "float32")).lower(),
+                precision.get("loss_scale"))
+    compute = getattr(precision, "compute_dtype", None)
+    if compute is not None:
+        return str(compute).lower(), getattr(precision, "loss_scale", None)
+    key = str(precision).lower()
+    presets = {"fp32": "float32", "float32": "float32",
+               "bf16": "bfloat16", "bfloat16": "bfloat16",
+               "fp16": "float16", "float16": "float16"}
+    return presets.get(key, key), None
+
+
+def _check_precision(findings: List[Finding], precision,
+                     loss_scale=None) -> None:
+    """GC015: precision-policy legality. ``precision`` is whatever the
+    config/trainer carries (preset string, PrecisionPolicy, dict);
+    ``loss_scale`` overrides the spec's own when the config stores the
+    two knobs separately (TrainingConfig.precision/.loss_scale)."""
+    compute, spec_scale = _precision_fields(precision)
+    if compute is None or compute in ("fp32", "float32"):
+        return
+    scale = loss_scale if loss_scale is not None else spec_scale
+    if compute not in FLOAT_DTYPES:
+        findings.append(Finding(
+            "GC015", Severity.ERROR, f"compute={compute}",
+            f"precision policy names {compute!r} as the compute dtype, "
+            "which is not a float dtype — the step-boundary casts would "
+            "reject it at trace time",
+            "use 'bf16'/'fp16' (half compute, fp32 masters) or 'fp32'"))
+        return
+    if compute in HALF_PRECISION_DTYPES and scale is None:
+        findings.append(Finding(
+            "GC015", Severity.WARNING, f"compute={compute}",
+            f"half-precision compute ({compute}) with no fp32 loss "
+            "scale configured — gradients that underflow in the half "
+            "backward are silently zero (bf16 keeps fp32's exponent "
+            "range, so this is usually benign there; fp16 is not)",
+            "set loss_scale (builder: .precision('bf16', "
+            "loss_scale=...)) or accept the unscaled backward"))
+
+
 def _zero1_pad_waste(all_layers: List[Tuple[str, object]],
                      width: int) -> Optional[float]:
     """Fraction of the zero1-sharded updater state that is
@@ -256,18 +331,20 @@ def _check_zero1(findings: List[Finding],
                  all_layers: List[Tuple[str, object]],
                  axes: Dict[str, int],
                  weight_update_sharding) -> None:
-    """GC011: zero1 weight-update sharding legality — needs dp >= 2, and
-    pad-to-divisible flattened leaves should not waste a meaningful
-    fraction of the sharded updater state."""
-    if _wus_mode(weight_update_sharding) != "zero1":
+    """GC011: zero1/zero2 weight-update sharding legality — needs
+    dp >= 2, and pad-to-divisible flattened leaves should not waste a
+    meaningful fraction of the sharded updater state (both modes share
+    the flattened ``(dp, chunk)`` layout, so one rule covers them)."""
+    mode = _wus_mode(weight_update_sharding)
+    if mode not in SHARDED_WUS_MODES:
         return
     dp = _dp_size(axes)
     if not dp or dp < 2:
         findings.append(Finding(
             "GC011", Severity.ERROR,
             f"dp={dp if dp else '<none>'}",
-            "weight_update_sharding=zero1 needs a data-parallel axis of "
-            "at least 2 — with a single replica there is no shard to "
+            f"weight_update_sharding={mode} needs a data-parallel axis "
+            "of at least 2 — with a single replica there is no shard to "
             "keep and the trainers reject the config at construction",
             "grow the dp axis to >= 2 or drop to "
             "weight_update_sharding='off'"))
@@ -276,7 +353,7 @@ def _check_zero1(findings: List[Finding],
     if tp and tp > 1:
         findings.append(Finding(
             "GC011", Severity.ERROR, f"model={tp}",
-            "weight_update_sharding=zero1 composes with pure data "
+            f"weight_update_sharding={mode} composes with pure data "
             "parallelism only — this mesh tensor-shards params over "
             f"'model' ({tp} ways), whose updater state is already "
             "distributed; the trainers reject the combination at "
@@ -287,7 +364,7 @@ def _check_zero1(findings: List[Finding],
     if waste is not None and waste > ZERO1_PADDING_WASTE:
         findings.append(Finding(
             "GC011", Severity.WARNING, f"dp={dp}",
-            f"zero1 flattened-leaf padding wastes {waste:.0%} of the "
+            f"{mode} flattened-leaf padding wastes {waste:.0%} of the "
             f"updater state (pad-to-divisible filler over the {dp}-way "
             "axis)",
             "shrink the dp axis, widen the model's small layers, or "
@@ -394,7 +471,7 @@ def _check_elastic(findings: List[Finding],
     if not elastic_resize_widths:
         return
     dp = _dp_size(axes)
-    zero1 = _wus_mode(weight_update_sharding) == "zero1"
+    zero1 = _wus_mode(weight_update_sharding) in SHARDED_WUS_MODES
     for w in elastic_resize_widths:
         w = int(w)
         if w < 1 or (dp and w >= dp):
@@ -490,11 +567,30 @@ def _check_hbm(findings: List[Finding], rep, batch_size: Optional[int],
 # MultiLayerConfiguration
 # ---------------------------------------------------------------------------
 
+def _conf_precision(conf, precision):
+    """The (precision, loss_scale) pair to validate: an explicit kwarg
+    wins; otherwise the config's own TrainingConfig.precision/.loss_scale
+    (older serialized configs lack the fields — treated as fp32).
+    Mirrors the trainers' ``PrecisionPolicy.parse(precision,
+    loss_scale=conf.loss_scale)`` semantics: a policy INSTANCE carries
+    its own loss_scale, but a preset/dtype STRING inherits the config's
+    — so the validator never warns about a hazard the runtime does not
+    have."""
+    training = getattr(conf, "training", None)
+    conf_scale = getattr(training, "loss_scale", None)
+    if precision is not None:
+        if getattr(precision, "compute_dtype", None) is not None:
+            return precision, None  # instance: its own loss_scale rules
+        return precision, conf_scale
+    return getattr(training, "precision", None), conf_scale
+
+
 def check_multilayer(conf, *, mesh=None, batch_size: Optional[int] = None,
                      hbm_bytes: Optional[int] = None,
                      weight_update_sharding=None,
                      input_iterator=None,
-                     elastic_resize_widths=None) -> List[Finding]:
+                     elastic_resize_widths=None,
+                     precision=None) -> List[Finding]:
     """Validate a MultiLayerConfiguration. Pure CPU metadata walk — no
     arrays are built."""
     from deeplearning4j_tpu.analysis.memory import DEFAULT_HBM_BYTES
@@ -548,6 +644,7 @@ def check_multilayer(conf, *, mesh=None, batch_size: Optional[int] = None,
     _check_elastic(findings, [(lbl, l) for lbl, l, _ in walk],
                    _mesh_axes(mesh), batch_size, weight_update_sharding,
                    elastic_resize_widths)
+    _check_precision(findings, *_conf_precision(conf, precision))
     _check_hbm(findings, rep, batch_size, hbm_bytes or DEFAULT_HBM_BYTES)
     return findings
 
@@ -671,7 +768,8 @@ def check_graph(conf, *, mesh=None, batch_size: Optional[int] = None,
                 hbm_bytes: Optional[int] = None,
                 weight_update_sharding=None,
                 input_iterator=None,
-                elastic_resize_widths=None) -> List[Finding]:
+                elastic_resize_widths=None,
+                precision=None) -> List[Finding]:
     """Validate a ComputationGraphConfiguration — including configs the
     builder itself would refuse to construct (cycles, dangling refs),
     which is why this walk never calls ``_resolve_shapes``."""
@@ -773,6 +871,7 @@ def check_graph(conf, *, mesh=None, batch_size: Optional[int] = None,
     _check_elastic(findings, [(lbl, l) for lbl, l, _ in walk],
                    _mesh_axes(mesh), batch_size, weight_update_sharding,
                    elastic_resize_widths)
+    _check_precision(findings, *_conf_precision(conf, precision))
     if not any(f.severity == Severity.ERROR for f in findings):
         _check_hbm(findings, rep, batch_size,
                    hbm_bytes or DEFAULT_HBM_BYTES)
@@ -787,19 +886,22 @@ def validate_config(conf, *, mesh=None, batch_size: Optional[int] = None,
                     hbm_bytes: Optional[int] = None,
                     weight_update_sharding=None,
                     input_iterator=None,
-                    elastic_resize_widths=None) -> List[Finding]:
+                    elastic_resize_widths=None,
+                    precision=None) -> List[Finding]:
     """Dispatch on configuration type."""
     if hasattr(conf, "nodes"):
         return check_graph(conf, mesh=mesh, batch_size=batch_size,
                            hbm_bytes=hbm_bytes,
                            weight_update_sharding=weight_update_sharding,
                            input_iterator=input_iterator,
-                           elastic_resize_widths=elastic_resize_widths)
+                           elastic_resize_widths=elastic_resize_widths,
+                           precision=precision)
     return check_multilayer(conf, mesh=mesh, batch_size=batch_size,
                             hbm_bytes=hbm_bytes,
                             weight_update_sharding=weight_update_sharding,
                             input_iterator=input_iterator,
-                            elastic_resize_widths=elastic_resize_widths)
+                            elastic_resize_widths=elastic_resize_widths,
+                            precision=precision)
 
 
 def iter_config_layers(conf) -> Iterator[Tuple[str, object,

@@ -93,7 +93,10 @@ class MemoryReport:
     holds ``replicated / dp`` of the optax state (flattened pad-to-
     divisible shards; the <= dp-elements-per-leaf padding is below this
     estimate's resolution and graphcheck flags pathological waste
-    separately)."""
+    separately). ``"zero2"`` additionally divides the GRADIENT term by
+    ``dp``: the reduced gradient lives only as its ``(dp, chunk)``
+    shard — zero1 still anchors a full replicated copy before
+    slicing."""
     entries: List[LayerMemoryEntry] = field(default_factory=list)
     batch_size: int = 32
     dtype: str = "float32"
@@ -114,7 +117,8 @@ class MemoryReport:
     @property
     def updater_state_shards(self) -> int:
         """How many ways the updater state is split (1 = replicated)."""
-        if self.weight_update_sharding == "zero1" and self.dp > 1:
+        from deeplearning4j_tpu.analysis.graphcheck import SHARDED_WUS_MODES
+        if self.weight_update_sharding in SHARDED_WUS_MODES and self.dp > 1:
             return self.dp
         return 1
 
@@ -124,8 +128,17 @@ class MemoryReport:
         return -(-self.param_bytes * slots // self.updater_state_shards)
 
     @property
+    def gradient_shards(self) -> int:
+        """How many ways the reduced gradient is split — ``dp`` under
+        zero2 only (zero1 still anchors a full replicated gradient
+        before slicing it into the sharded accumulator)."""
+        if self.weight_update_sharding == "zero2" and self.dp > 1:
+            return self.dp
+        return 1
+
+    @property
     def gradient_bytes(self) -> int:
-        return self.param_bytes
+        return -(-self.param_bytes // self.gradient_shards)
 
     @property
     def activation_bytes(self) -> int:
@@ -175,10 +188,13 @@ class MemoryReport:
         lines += [
             f"  total params:        {self.total_params:,}",
             f"  params:              {mb(self.param_bytes)}",
-            f"  gradients:           {mb(self.gradient_bytes)}",
+            f"  gradients:           {mb(self.gradient_bytes)}"
+            + (f" (zero2: 1/{self.gradient_shards} per replica)"
+               if self.gradient_shards > 1 else ""),
             f"  updater state:       {mb(self.updater_state_bytes)} "
             f"({UPDATER_STATE_SLOTS.get(self.updater, 2)} slot(s)"
-            + (f", zero1: 1/{self.updater_state_shards} per replica"
+            + (f", {self.weight_update_sharding}: "
+               f"1/{self.updater_state_shards} per replica"
                if self.updater_state_shards > 1 else "") + ")",
             f"  activations:         {mb(self.activation_bytes)}"
             + (" (remat: boundary pair only)" if self.remat else ""),

@@ -47,11 +47,13 @@ class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
                  mesh: Optional[MeshContext] = None,
                  gradient_accumulation: int = 1,
                  collect_training_stats: bool = False,
-                 weight_update_sharding=None):
+                 weight_update_sharding=None,
+                 precision=None):
         trainer = ParallelTrainer(
             net, mesh, gradient_accumulation=gradient_accumulation,
             collect_training_stats=collect_training_stats,
-            weight_update_sharding=weight_update_sharding)
+            weight_update_sharding=weight_update_sharding,
+            precision=precision)
         if hasattr(train_data, "attach"):
             # the early-stopping loop iterates train_data directly
             # (never through ParallelTrainer.fit), so bind a streaming

@@ -120,6 +120,14 @@ class TrainingConfig:
     tbptt_fwd_length: int = 20
     tbptt_bwd_length: int = 20
     dtype: str = "float32"
+    # mixed-precision policy (nn/updater.PrecisionPolicy presets):
+    # "fp32" (default — every cast gated out, bitwise-parity territory)
+    # or "bf16"/"fp16" (half-precision compute, fp32 master weights,
+    # explicit cast seams in every compiled step). ``loss_scale``
+    # statically scales the loss before differentiation and unscales
+    # the fp32 gradients after (the fp16 seam; optional for bf16).
+    precision: str = "fp32"
+    loss_scale: Optional[float] = None
     # rematerialization: recompute per-layer activations in the backward
     # pass instead of storing them (jax.checkpoint). Trades FLOPs for HBM
     # — the standard TPU lever for batch sizes that don't otherwise fit.
@@ -181,16 +189,19 @@ class MultiLayerConfiguration:
     # ------------------------------------------------------- static analysis
     def validate(self, mesh=None, batch_size: Optional[int] = None,
                  hbm_bytes: Optional[int] = None,
-                 weight_update_sharding=None):
+                 weight_update_sharding=None, precision=None):
         """Run graphcheck over this config: shape/dtype walk, loss-head
-        and mesh-legality checks (incl. zero1 weight-update-sharding
-        legality), HBM estimate. Returns a list of ``analysis.Finding``
-        — empty when the config is clean. Pure metadata; no arrays are
-        built."""
+        and mesh-legality checks (incl. zero1/zero2
+        weight-update-sharding legality and GC015 precision-policy
+        legality — the config's own ``training.precision`` is validated
+        when ``precision`` is not given), HBM estimate. Returns a list
+        of ``analysis.Finding`` — empty when the config is clean. Pure
+        metadata; no arrays are built."""
         from deeplearning4j_tpu.analysis.graphcheck import check_multilayer
         return check_multilayer(
             self, mesh=mesh, batch_size=batch_size, hbm_bytes=hbm_bytes,
-            weight_update_sharding=weight_update_sharding)
+            weight_update_sharding=weight_update_sharding,
+            precision=precision)
 
     def memory_report(self, batch_size: int = 32):
         """Parameter-count + HBM/VMEM estimate (``MemoryReport``
@@ -436,6 +447,20 @@ class NeuralNetConfiguration:
 
     def dtype(self, dt: str) -> "NeuralNetConfiguration":
         self._training.dtype = dt
+        return self
+
+    def precision(self, policy: str,
+                  loss_scale: Optional[float] = None
+                  ) -> "NeuralNetConfiguration":
+        """Mixed-precision policy for every compiled train step:
+        ``"bf16"`` runs forward/backward in bfloat16 against fp32
+        master weights (cast seams at the step boundary; loss,
+        gradients, optax, and the divergence sentinel stay fp32).
+        ``"fp32"`` (default) gates every cast out. ``loss_scale``
+        statically scales the loss before differentiation (the fp16
+        seam; optional for bf16)."""
+        self._training.precision = str(policy).lower()
+        self._training.loss_scale = loss_scale
         return self
 
     def gradient_checkpointing(self, flag: bool = True) -> "NeuralNetConfiguration":

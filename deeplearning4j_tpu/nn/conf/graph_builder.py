@@ -101,16 +101,19 @@ class ComputationGraphConfiguration:
     # ------------------------------------------------------- static analysis
     def validate(self, mesh=None, batch_size: Optional[int] = None,
                  hbm_bytes: Optional[int] = None,
-                 weight_update_sharding=None):
+                 weight_update_sharding=None, precision=None):
         """Run graphcheck over this DAG: cycle/dangling/dead-vertex
         detection, shape walk, loss-head and mesh-legality checks (incl.
-        zero1 weight-update-sharding legality). Returns a list of
-        ``analysis.Finding``; never raises on broken graphs (unlike
-        ``_resolve_shapes``)."""
+        zero1/zero2 weight-update-sharding legality and GC015
+        precision-policy legality — the config's own
+        ``training.precision`` is validated when ``precision`` is not
+        given). Returns a list of ``analysis.Finding``; never raises on
+        broken graphs (unlike ``_resolve_shapes``)."""
         from deeplearning4j_tpu.analysis.graphcheck import check_graph
         return check_graph(self, mesh=mesh, batch_size=batch_size,
                            hbm_bytes=hbm_bytes,
-                           weight_update_sharding=weight_update_sharding)
+                           weight_update_sharding=weight_update_sharding,
+                           precision=precision)
 
     def memory_report(self, batch_size: int = 32):
         """Parameter-count + HBM/VMEM estimate (``MemoryReport``

@@ -341,15 +341,28 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
         sentinel = self._sentinel
         if sentinel is not None:
             from deeplearning4j_tpu.resilience.sentinel import guard_update
+        from deeplearning4j_tpu.nn.updater import (
+            PrecisionPolicy, cast_floats, precision_value_and_grad,
+        )
+        policy = PrecisionPolicy.parse(
+            getattr(training, "precision", None),
+            loss_scale=getattr(training, "loss_scale", None))
+        mixed = policy.mixed
 
         def train_step(params, opt_state, states, inputs, labels, masks,
                        lmasks, rng):
+            if mixed:
+                # step-boundary cast seams: forward/backward in the
+                # compute dtype, fp32 master params stay the update's
+                inputs = cast_floats(inputs, policy.compute_dtype)
+                masks = cast_floats(masks, policy.compute_dtype)
+
             def loss_for_grad(p):
                 return self._loss_fn(p, states, inputs, labels, masks,
                                      lmasks, rng)
 
-            (loss, new_states), grads = jax.value_and_grad(
-                loss_for_grad, has_aux=True)(params)
+            (loss, new_states), grads = precision_value_and_grad(
+                loss_for_grad, policy)(params)
             layer_list = [self.conf.nodes[n].layer for n in self._layer_nodes]
             new_params, new_opt = compute_updates(
                 tx, grads, opt_state, params, layer_list, training)
@@ -483,9 +496,19 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
         sentinel = self._sentinel
         if sentinel is not None:
             from deeplearning4j_tpu.resilience.sentinel import guard_update
+        from deeplearning4j_tpu.nn.updater import (
+            PrecisionPolicy, cast_floats, precision_value_and_grad,
+        )
+        policy = PrecisionPolicy.parse(
+            getattr(training, "precision", None),
+            loss_scale=getattr(training, "loss_scale", None))
+        mixed = policy.mixed
 
         def step(params, opt_state, states, inputs, labels, masks, lmasks,
                  carries, rng):
+            if mixed:
+                inputs = cast_floats(inputs, policy.compute_dtype)
+                masks = cast_floats(masks, policy.compute_dtype)
             # bwd < fwd: run the slice head forward-only (stop-gradded
             # activations + carries), backprop through the last bwd steps
             # only — same semantics as MultiLayerNetwork._build_tbptt_step
@@ -535,8 +558,8 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
                         + _sum_aux_losses(new_states),
                         (new_states, new_carries))
 
-            (loss, (new_states, new_carries)), grads = jax.value_and_grad(
-                loss_for_grad, has_aux=True)(params)
+            (loss, (new_states, new_carries)), grads = \
+                precision_value_and_grad(loss_for_grad, policy)(params)
             layer_list = [self.conf.nodes[n].layer for n in self._layer_nodes]
             new_params, new_opt = compute_updates(
                 tx, grads, opt_state, params, layer_list, training)

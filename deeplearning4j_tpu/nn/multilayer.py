@@ -283,10 +283,23 @@ class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin,
         if sentinel is not None:
             from deeplearning4j_tpu.resilience.sentinel import guard_update
         from deeplearning4j_tpu.nn.layers.core import CenterLossOutputLayer
+        from deeplearning4j_tpu.nn.updater import (
+            PrecisionPolicy, cast_floats, precision_value_and_grad,
+        )
         center_loss_head = isinstance(self.layers[-1], CenterLossOutputLayer)
+        policy = PrecisionPolicy.parse(
+            getattr(training, "precision", None),
+            loss_scale=getattr(training, "loss_scale", None))
+        mixed = policy.mixed
 
         def train_step(params, opt_state, states, features, labels, fmask,
                        lmask, rng):
+            if mixed:
+                # step-boundary cast seams: forward/backward in the
+                # compute dtype, fp32 master params stay the update's
+                features = cast_floats(features, policy.compute_dtype)
+                fmask = cast_floats(fmask, policy.compute_dtype)
+
             def loss_for_grad(p):
                 h, _, new_states, _, cur_mask = self._forward(
                     p, states, features, train=True, rng=rng, mask=fmask)
@@ -298,8 +311,8 @@ class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin,
                 return (data_loss + reg + _sum_aux_losses(new_states),
                         (new_states, h))
 
-            (loss, (new_states, h_last)), grads = jax.value_and_grad(
-                loss_for_grad, has_aux=True)(params)
+            (loss, (new_states, h_last)), grads = precision_value_and_grad(
+                loss_for_grad, policy)(params)
             new_params, new_opt = compute_updates(
                 tx, grads, opt_state, params, self.layers, training)
             if center_loss_head:
@@ -384,9 +397,19 @@ class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin,
         sentinel = self._sentinel
         if sentinel is not None:
             from deeplearning4j_tpu.resilience.sentinel import guard_update
+        from deeplearning4j_tpu.nn.updater import (
+            PrecisionPolicy, cast_floats, precision_value_and_grad,
+        )
+        policy = PrecisionPolicy.parse(
+            getattr(training, "precision", None),
+            loss_scale=getattr(training, "loss_scale", None))
+        mixed = policy.mixed
 
         def step(params, opt_state, states, features, labels, fmask, lmask,
                  carries, rng):
+            if mixed:
+                features = cast_floats(features, policy.compute_dtype)
+                fmask = cast_floats(fmask, policy.compute_dtype)
             # When bwd < fwd the reference's backward time-loop only visits
             # the LAST bwd steps of each fwd slice
             # (MultiLayerNetwork.java:1119 + LSTMHelpers.java:333
@@ -439,8 +462,8 @@ class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin,
                 return (data_loss + reg + _sum_aux_losses(new_states),
                         (new_states, new_carries))
 
-            (loss, (new_states, new_carries)), grads = jax.value_and_grad(
-                loss_for_grad, has_aux=True)(params)
+            (loss, (new_states, new_carries)), grads = \
+                precision_value_and_grad(loss_for_grad, policy)(params)
             new_params, new_opt = compute_updates(
                 tx, grads, opt_state, params, self.layers, training)
             # stop gradients across tBPTT boundaries

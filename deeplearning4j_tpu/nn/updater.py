@@ -23,13 +23,148 @@ boundary by util/serializer.py.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Union
 
 import jax
 import jax.numpy as jnp
 import optax
 
 from deeplearning4j_tpu.nn.conf.builder import TrainingConfig, UpdaterConfig
+
+
+# ---------------------------------------------------------------------------
+# mixed-precision policy (bf16 compute / fp32 master weights)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class PrecisionPolicy:
+    """First-class matmul/update precision policy with explicit cast
+    seams — replaces the previous per-model ad-hoc bf16 handling.
+
+    ``compute_dtype`` is what the forward/backward runs in: params (and
+    float batch features) are cast to it at the step boundary, so every
+    matmul sees half-precision operands while ``params_dtype`` master
+    weights — owned by the updater, never donated away — stay full
+    precision. The loss is cast back to ``params_dtype`` before it
+    leaves the loss function, gradients are cast to ``params_dtype``
+    the moment autodiff returns them, and every post-gradient op
+    (normalization/clipping, optax, the divergence sentinel's grad-norm)
+    therefore runs in fp32. ``loss_scale`` (static) multiplies the loss
+    before differentiation and divides the fp32 gradients after — bf16
+    shares fp32's exponent range so it rarely needs one, but the knob is
+    the seam fp16 (and graphcheck's precision rule) expects.
+
+    The default policy is pure fp32: every cast is gated out and the
+    compiled step is the exact program it was before this policy
+    existed — the bitwise-parity guarantees of the weight-update
+    sharding modes only apply there.
+    """
+
+    compute_dtype: str = "float32"
+    params_dtype: str = "float32"
+    loss_scale: Optional[float] = None
+
+    #: accepted shorthand -> (compute_dtype, params_dtype)
+    PRESETS = {
+        "fp32": ("float32", "float32"),
+        "float32": ("float32", "float32"),
+        "bf16": ("bfloat16", "float32"),
+        "bfloat16": ("bfloat16", "float32"),
+        "fp16": ("float16", "float32"),
+        "float16": ("float16", "float32"),
+    }
+
+    def __post_init__(self):
+        for field_name in ("compute_dtype", "params_dtype"):
+            dt = getattr(self, field_name)
+            try:
+                ok = jnp.issubdtype(jnp.dtype(dt), jnp.floating)
+            except TypeError:
+                ok = False
+            if not ok:
+                raise ValueError(
+                    f"precision {field_name} must be a float dtype, "
+                    f"got {dt!r}")
+        if self.loss_scale is not None and not self.loss_scale > 0:
+            raise ValueError(
+                f"loss_scale must be positive, got {self.loss_scale!r}")
+
+    @property
+    def mixed(self) -> bool:
+        """True when the step needs cast seams (compute != master)."""
+        return (self.compute_dtype != self.params_dtype
+                or self.compute_dtype != "float32")
+
+    @staticmethod
+    def parse(value: Union["PrecisionPolicy", str, None],
+              loss_scale: Optional[float] = None) -> "PrecisionPolicy":
+        """None / "fp32" / "bf16" / a dtype name / an instance — the
+        form every trainer constructor (and TrainingConfig.precision)
+        takes. ``loss_scale`` applies to the string forms only."""
+        if value is None:
+            return PrecisionPolicy(loss_scale=loss_scale)
+        if isinstance(value, PrecisionPolicy):
+            return value
+        key = str(value).lower()
+        compute, params = PrecisionPolicy.PRESETS.get(key, (key, "float32"))
+        return PrecisionPolicy(compute_dtype=compute, params_dtype=params,
+                               loss_scale=loss_scale)
+
+
+def cast_floats(tree, dtype):
+    """Cast every inexact (float/complex) array leaf of ``tree`` to
+    ``dtype``; integer/bool leaves (labels-as-ids, step counters) and
+    None subtrees pass through. Works traced and untraced."""
+    dtype = jnp.dtype(dtype)
+
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(cast, tree)
+
+
+def precision_value_and_grad(loss_fn, policy: "PrecisionPolicy"):
+    """``jax.value_and_grad(loss_fn, has_aux=True)`` with the policy's
+    cast seams folded in. ``loss_fn(params, *args) -> (loss, aux)`` is
+    differentiated w.r.t. ``params``; under a mixed policy the params
+    are cast to the compute dtype at the boundary, the loss is cast
+    back to the master dtype (and optionally loss-scaled around the
+    differentiation), and the returned gradients are master-dtype.
+
+    Pure-fp32 policies return the plain ``jax.value_and_grad`` — the
+    compiled step stays the exact pre-policy program, which is what the
+    weight-update-sharding bitwise parity gates run on.
+    """
+    if not policy.mixed:
+        return jax.value_and_grad(loss_fn, has_aux=True)
+    cdt = jnp.dtype(policy.compute_dtype)
+    pdt = jnp.dtype(policy.params_dtype)
+    scale = policy.loss_scale
+
+    def vag(params, *args):
+        cparams = cast_floats(params, cdt)
+
+        def seamed(p, *a):
+            loss, aux = loss_fn(p, *a)
+            # the loss seam: everything downstream (reporting, the
+            # sentinel, the backward's seed cotangent) sees fp32
+            loss = loss.astype(pdt)
+            scaled = loss * scale if scale else loss
+            return scaled, (loss, aux)
+
+        (_, (loss, aux)), grads = jax.value_and_grad(
+            seamed, has_aux=True)(cparams, *args)
+        # the gradient seam: master-dtype the instant autodiff returns,
+        # so clip/optax/sentinel math never runs in half precision
+        grads = cast_floats(grads, pdt)
+        if scale:
+            grads = jax.tree.map(lambda g: g / scale, grads)
+        return (loss, aux), grads
+
+    return vag
 
 
 def make_lr_schedule(u: UpdaterConfig) -> Callable:
@@ -198,7 +333,9 @@ def compute_updates(tx, grads, opt_state, params, layers,
 
 
 # ---------------------------------------------------------------------------
-# ZeRO-1 weight-update sharding (parallel trainers, mode="zero1")
+# ZeRO-1/2 weight-update sharding (parallel trainers, mode="zero1"/"zero2")
+# — zero2 shares every helper here; it differs only in the trainer-side
+# gradient layout (no replicated anchor: grads arrive already sharded)
 # ---------------------------------------------------------------------------
 
 def _is_shardable(x) -> bool:

@@ -16,6 +16,7 @@ meshes). A parameter-averaging compatibility mode reproduces the
 reference's average-every-k semantics for parity testing.
 """
 
+from deeplearning4j_tpu.nn.updater import PrecisionPolicy  # noqa: F401
 from deeplearning4j_tpu.parallel import checkpoint  # noqa: F401
 from deeplearning4j_tpu.parallel import multihost  # noqa: F401
 from deeplearning4j_tpu.parallel.delayed import DelayedSyncTrainer  # noqa: F401

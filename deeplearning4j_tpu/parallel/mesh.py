@@ -43,12 +43,29 @@ class WeightUpdateSharding:
     ``~P.(k+1)`` (a reduce-scatter per microbatch + one param gather).
     The transformation is an execution-layout change only — loss/param
     trajectories are exactly those of the replicated layout.
+
+    ``zero2`` — ZeRO-2, the paper's next rung: same updater-state layout
+    as ``zero1``, but the GRADIENTS also live only as the flattened
+    ``(dp, chunk)`` shards from the reduce-scatter onward. ``zero1``
+    anchors the reduced gradient replicated first (the exact
+    replicated-mode program) before constraining the sharded view;
+    ``zero2`` drops that anchor on the per-update path, so the compiled
+    program never requires a full-size reduced gradient per replica —
+    the accumulation buffer, mask/clip/optax math, and the divergence
+    sentinel's grad-norm (a psum of shard norms) all run on the 1/dp
+    views, gradient HBM drops by ``dp``x, and the only full-size
+    collective left per update is the param all-gather. (Inside a
+    ``gradient_accumulation`` scan the per-microbatch anchor is kept —
+    GSPMD otherwise repartitions the scan body and parity dies; the
+    sharded accumulator carries that path's 1/dp gradient memory.)
+    Still an execution-layout change only: fp32 trajectories stay
+    bitwise those of the replicated layout.
     """
 
-    mode: str = "off"    # "off" | "zero1"
+    mode: str = "off"    # "off" | "zero1" | "zero2"
     axis: str = "data"
 
-    MODES = ("off", "zero1")
+    MODES = ("off", "zero1", "zero2")
 
     def __post_init__(self):
         if self.mode not in self.MODES:
@@ -58,13 +75,21 @@ class WeightUpdateSharding:
 
     @property
     def enabled(self) -> bool:
-        return self.mode == "zero1"
+        """True when the weight update runs on the sharded ``(dp, chunk)``
+        layout (zero1 and zero2 share all of that machinery)."""
+        return self.mode in ("zero1", "zero2")
+
+    @property
+    def zero2(self) -> bool:
+        """True when gradients live ONLY as shards (no replicated
+        anchor) — the zero2 refinement on top of the shared layout."""
+        return self.mode == "zero2"
 
     @staticmethod
     def parse(value: Union["WeightUpdateSharding", str, None]
               ) -> "WeightUpdateSharding":
-        """Accept None / "off" / "zero1" / an instance — the form every
-        trainer constructor takes."""
+        """Accept None / "off" / "zero1" / "zero2" / an instance — the
+        form every trainer constructor takes."""
         if value is None:
             return WeightUpdateSharding()
         if isinstance(value, WeightUpdateSharding):
@@ -164,16 +189,16 @@ class MeshContext:
                 f"axis (have {tuple(self.mesh.axis_names)})")
         if self.mesh.shape[wus.axis] < 2:
             raise ValueError(
-                "zero1 weight-update sharding needs at least 2 replicas "
-                f"on axis {wus.axis!r} (mesh has "
+                f"{wus.mode} weight-update sharding needs at least 2 "
+                f"replicas on axis {wus.axis!r} (mesh has "
                 f"{self.mesh.shape[wus.axis]}) — with dp=1 there is "
                 "nothing to shard; use mode='off'")
         if self.n_model > 1:
             raise ValueError(
-                "zero1 weight-update sharding composes with pure data "
-                "parallelism only; this mesh tensor-shards params over "
-                f"'model' ({self.n_model} ways) — the updater state of a "
-                "model-sharded kernel is already distributed")
+                f"{wus.mode} weight-update sharding composes with pure "
+                "data parallelism only; this mesh tensor-shards params "
+                f"over 'model' ({self.n_model} ways) — the updater state "
+                "of a model-sharded kernel is already distributed")
 
     def batch_sharding(self, ndim: int,
                        shape: Optional[Tuple[int, ...]] = None

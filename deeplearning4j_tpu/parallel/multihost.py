@@ -302,7 +302,8 @@ def input_pipeline(sources, mesh=None, **kwargs):
 
 def data_parallel_trainer(net, n_model: int = 1,
                           gradient_accumulation: int = 1,
-                          weight_update_sharding=None, **kwargs):
+                          weight_update_sharding=None,
+                          precision=None, **kwargs):
     """One-call multihost trainer: build the global mesh over every
     process's devices and wrap ``net`` in a ``ParallelTrainer``.
 
@@ -312,7 +313,15 @@ def data_parallel_trainer(net, n_model: int = 1,
     ``local_devices/global_devices`` of the replicated footprint, and
     the sharded checkpoint format persists exactly those addressable
     shards per process — updater-state writes scale out with the pod
-    instead of funneling through one host.
+    instead of funneling through one host. ``"zero2"`` additionally
+    keeps the GRADIENTS in that 1/dp layout from the reduce-scatter
+    onward (no full-size reduced gradient per replica), so gradient
+    HBM scales out with the pod too.
+
+    ``precision="bf16"`` (or a ``PrecisionPolicy``) runs every
+    process's forward/backward in bfloat16 against fp32 master weights
+    — same cast seams as ``ParallelTrainer``; composes with every
+    weight-update-sharding mode.
 
     Call ``initialize()`` first (TPU pods: with no args). Every process
     then feeds process-LOCAL batch shards to ``fit_batch`` as usual.
@@ -322,4 +331,5 @@ def data_parallel_trainer(net, n_model: int = 1,
     ctx = MeshContext.create(n_model=n_model)
     return ParallelTrainer(
         net, ctx, gradient_accumulation=gradient_accumulation,
-        weight_update_sharding=weight_update_sharding, **kwargs)
+        weight_update_sharding=weight_update_sharding,
+        precision=precision, **kwargs)

@@ -28,8 +28,8 @@ from deeplearning4j_tpu.nn.netcommon import (
     ScanFitMixin, emit_scan_burst, make_scan_fit,
 )
 from deeplearning4j_tpu.nn.updater import (
-    compute_updates, compute_updates_sharded, gather_updater_state,
-    shard_updater_state,
+    PrecisionPolicy, cast_floats, compute_updates, compute_updates_sharded,
+    gather_updater_state, precision_value_and_grad, shard_updater_state,
 )
 from deeplearning4j_tpu.optimize.training_stats import (
     TrainingStats, maybe_phase,
@@ -63,13 +63,36 @@ class ParallelTrainer:
     attached, ``net.opt_state`` holds the SHARDED views (sharded
     checkpoints round-trip them natively); call :meth:`gather_opt_state`
     before handing the net to the zip serializer or a non-zero1 trainer.
+
+    ``weight_update_sharding="zero2"`` goes one rung further: on the
+    per-update path the reduced gradient exists ONLY as the flattened
+    ``(dp, chunk)`` shards — zero1's replicated gradient anchor is
+    dropped, so the program never requires a full-size reduced gradient
+    per replica, gradient HBM drops 1/dp alongside the updater state,
+    and the only full-size collective left per update is the param
+    all-gather. Inside the ``gradient_accumulation`` scan the
+    per-microbatch anchor is retained (GSPMD repartitions the scan body
+    without it and bitwise parity dies — see ``to_shards``); the
+    sharded ACCUMULATOR carries the scan path's 1/dp gradient memory.
+    Same fp32 bitwise-parity guarantee as zero1
+    (``tools/zero2_smoke.py``).
+
+    ``precision`` (a :class:`~deeplearning4j_tpu.nn.updater.
+    PrecisionPolicy`, a preset name like ``"bf16"``, or None to inherit
+    ``net.conf.training.precision``): under a mixed policy the step
+    casts params and float batch features to the compute dtype at its
+    boundary, runs forward/backward in half precision, and keeps the
+    fp32 master weights + every post-gradient op (loss, clip, optax,
+    divergence sentinel) in fp32 — composing with every
+    weight-update-sharding mode. The fp32 default gates all casts out.
     """
 
     def __init__(self, net, mesh: Optional[MeshContext] = None,
                  gradient_accumulation: int = 1,
                  donate_params: bool = True,
                  collect_training_stats: bool = False,
-                 weight_update_sharding=None):
+                 weight_update_sharding=None,
+                 precision=None):
         self.net = net
         self.mesh = mesh or MeshContext.create()
         self.gradient_accumulation = max(1, gradient_accumulation)
@@ -77,6 +100,11 @@ class ParallelTrainer:
             weight_update_sharding)
         self.mesh.validate_weight_update_sharding(
             self.weight_update_sharding)
+        training_conf = net.conf.training
+        self.precision = PrecisionPolicy.parse(
+            precision if precision is not None
+            else getattr(training_conf, "precision", None),
+            loss_scale=getattr(training_conf, "loss_scale", None))
         self._step = None
         self._donate = donate_params
         # per-phase telemetry, ref ParameterAveragingTrainingMasterStats
@@ -121,25 +149,46 @@ class ParallelTrainer:
             from deeplearning4j_tpu.resilience.sentinel import guard_update
 
         layers = self._layers
-        zero1 = self.weight_update_sharding.enabled
+        sharded = self.weight_update_sharding.enabled
+        zero2 = self.weight_update_sharding.zero2
         mesh_ctx = self.mesh
         z_axis = self.weight_update_sharding.axis
-        if zero1:
+        policy = self.precision
+        mixed = policy.mixed
+        if sharded:
             dp = mesh_ctx.zero1_shards(z_axis)
             z_sharding = mesh_ctx.zero1_sharding(z_axis)
             rep_sharding = mesh_ctx.replicated()
 
-            def to_shards(g):
-                """Full-shape gradient tree -> flattened (dp, chunk)
-                views sharded over the data axis. The replicated anchor
-                first pins the forward/backward partitioning to the
-                exact replicated-mode program (loss parity stays
-                bitwise); the shard constraint then lets XLA fold the
-                gradient all-reduce + shard slice into a reduce-scatter.
-                """
-                g = jax.tree.map(
+            def pin_replicated(tree):
+                return jax.tree.map(
                     lambda t: jax.lax.with_sharding_constraint(
-                        t, rep_sharding), g)
+                        t, rep_sharding), tree)
+
+            def to_shards(g, in_scan: bool = False):
+                """Full-shape gradient tree -> flattened (dp, chunk)
+                views sharded over the data axis. Under zero1 a
+                replicated anchor first pins the forward/backward
+                partitioning to the exact replicated-mode program (loss
+                parity stays bitwise); the shard constraint then lets
+                XLA fold the gradient all-reduce + shard slice into a
+                reduce-scatter. Under zero2 the anchor is DROPPED from
+                the per-update path: the sharded view is the
+                gradients' only constraint, so the reduce-scatter is
+                their native layout and the program never requires a
+                full-size reduced gradient per replica — gradient HBM
+                drops with the axis. INSIDE the ga scan the anchor is
+                kept for every mode: without it GSPMD repartitions the
+                scan body itself (measured on CPU dp=2 — the local
+                forward/loss reductions reassociate, and in one
+                observed layout the forward matmuls all-gather sharded
+                weights), which breaks the bitwise gate; the sharded
+                ACCUMULATOR already holds the scan path's 1/dp
+                gradient-memory win, and the anchored per-microbatch
+                sum stays transient.
+                """
+                if in_scan or not zero2:
+                    g = pin_replicated(g)
                 return jax.tree.map(
                     lambda t: jax.lax.with_sharding_constraint(
                         zero1_shard_leaf(t, dp), z_sharding), g)
@@ -151,12 +200,19 @@ class ParallelTrainer:
             return net._loss_fn(p, states, feats, labels, fmask, lmask,
                                 rng=rng, train=True)
 
+        # fp32 policy: the plain jax.value_and_grad — the exact
+        # pre-policy program. Mixed: params/features cast to the compute
+        # dtype at the step boundary, loss + grads handed back in fp32.
+        vag = precision_value_and_grad(loss_fn, policy)
+
         def step(params, opt_state, states, feats, labels, fmask, lmask, rng):
+            if mixed:
+                feats = cast_floats(feats, policy.compute_dtype)
+                fmask = cast_floats(fmask, policy.compute_dtype)
             if accum == 1:
-                (loss, new_states), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params, states, feats, labels,
-                                           fmask, lmask, rng)
-                if zero1:
+                (loss, new_states), grads = vag(params, states, feats,
+                                                labels, fmask, lmask, rng)
+                if sharded:
                     grads = to_shards(grads)
             else:
                 # microbatch split along the batch axis inside the step:
@@ -166,15 +222,14 @@ class ParallelTrainer:
                 def micro(carry, mb):
                     g_acc, l_acc, st = carry
                     f, l, fm, lm, r = mb
-                    (loss, st2), g = jax.value_and_grad(
-                        loss_fn, has_aux=True)(params, st, f, l, fm, lm, r)
-                    if zero1:
+                    (loss, st2), g = vag(params, st, f, l, fm, lm, r)
+                    if sharded:
                         # accumulate straight into the sharded layout:
                         # cross-chip traffic per microbatch becomes one
                         # reduce-scatter of g instead of a full
                         # all-reduce, and the accumulator itself holds
                         # only 1/dp per chip
-                        g = to_shards(g)
+                        g = to_shards(g, in_scan=True)
                     g_acc = jax.tree.map(lambda a, b: a + b, g_acc, g)
                     return (g_acc, l_acc + loss, st2), None
 
@@ -193,15 +248,15 @@ class ParallelTrainer:
 
                 rngs = jax.random.split(rng, accum)
                 zero_g = jax.tree.map(jnp.zeros_like, params)
-                if zero1:
-                    zero_g = to_shards(zero_g)
+                if sharded:
+                    zero_g = to_shards(zero_g, in_scan=True)
                 (grads, loss, new_states), _ = jax.lax.scan(
                     micro, (zero_g, jnp.zeros(()), states),
                     (split(feats), split(labels), split(fmask),
                      split(lmask), rngs))
                 grads = jax.tree.map(lambda g: g / accum, grads)
                 loss = loss / accum
-            if zero1:
+            if sharded:
                 new_params, new_opt = compute_updates_sharded(
                     tx, grads, opt_state, params, layers, training,
                     mesh_ctx, z_axis)
@@ -211,10 +266,11 @@ class ParallelTrainer:
             if sentinel is None:
                 return new_params, new_opt, new_states, loss
             # non-finite guard: a diverged update never lands (old state
-            # selected in-program — no host sync). Under zero1 `grads`
-            # are the sharded (dp, chunk) views, so the guard's
+            # selected in-program — no host sync). Under zero1/zero2
+            # `grads` are the sharded (dp, chunk) views, so the guard's
             # grad-norm reduction is a psum of local-shard norms — same
-            # flag value, no extra gather.
+            # flag value, no extra gather. Under a mixed policy both
+            # loss and grads crossed the fp32 seam before reaching it.
             sel, bad = guard_update(
                 loss, grads, (params, opt_state, states),
                 (new_params, new_opt, new_states))

@@ -17,10 +17,11 @@ feed three consumers: ``bench.py`` rung records (``flops_per_step``,
 and direct calls from perf work.
 
 ``weight_update_cost(net, dp, ...)`` models the data-parallel trainers'
-weight-update traffic and updater-state HBM per chip for both layouts
-(replicated vs ZeRO-1 ``weight_update_sharding="zero1"``) — the
-``comm_bytes_per_step`` / ``updater_hbm_bytes`` fields BENCH records
-carry so a real-TPU ladder can attribute an MFU delta to the layout.
+weight-update traffic and updater-state/gradient HBM per chip for all
+three layouts (replicated, ``weight_update_sharding="zero1"``,
+``"zero2"``) — the ``comm_bytes_per_step`` / ``updater_hbm_bytes`` /
+``gradient_hbm_bytes`` fields BENCH records carry so a real-TPU ladder
+can attribute an MFU delta to the layout.
 
 NOTE: the AOT ``lower().compile()`` pays one real XLA compile and its
 executable is NOT reused by later ``net.fit_batch`` calls (jax's jit
@@ -99,18 +100,29 @@ def dp_comm_bytes_per_update(param_count: int, dp: int,
                (the layout-sharded update lets XLA fold the per-
                microbatch all-reduce + shard slice into a reduce-
                scatter, and only the final params travel back).
+    ``zero2``: same wire traffic as zero1 — the reduce-scatter is
+               already the minimum that preserves the per-microbatch
+               reduction order (the bitwise-parity contract rules out
+               the textbook accumulate-unreduced-then-reduce-once
+               floor) — so ``comm(zero2) == comm(zero1) <= comm(off)``
+               for ``k >= 1``; what zero2 sheds is the full-size
+               REDUCED-gradient buffer (see
+               :func:`dp_gradient_hbm_bytes`), because the shards are
+               the gradients' native layout rather than a slice of an
+               anchored replicated copy.
 
     At ``gradient_accumulation=4`` that is 8x vs 5x the reduce-scatter
     unit — the win BENCH records quantify against the replicated
     baseline. dp=1 is 0 either way (no cross-chip axis).
     """
+    from deeplearning4j_tpu.analysis.graphcheck import SHARDED_WUS_MODES
     dp = max(1, int(dp))
     if dp == 1:
         return 0
     k = max(1, int(gradient_accumulation))
     payload = int(param_count) * int(dtype_bytes)
     unit = payload * (dp - 1) // dp
-    if weight_update_sharding == "zero1":
+    if weight_update_sharding in SHARDED_WUS_MODES:
         return (k + 1) * unit
     return 2 * k * unit
 
@@ -119,13 +131,32 @@ def dp_updater_hbm_bytes(param_count: int, updater: str, dp: int,
                          dtype_bytes: int = 4,
                          weight_update_sharding: str = "off") -> int:
     """Per-chip standing HBM of the optax updater state: ``slots . P.b``
-    replicated, divided by ``dp`` under zero1 (flattened pad-to-divisible
-    shards; per-leaf padding is < dp elements and below this model's
-    resolution)."""
+    replicated, divided by ``dp`` under zero1/zero2 (flattened
+    pad-to-divisible shards; per-leaf padding is < dp elements and
+    below this model's resolution)."""
+    from deeplearning4j_tpu.analysis.graphcheck import SHARDED_WUS_MODES
     from deeplearning4j_tpu.analysis.memory import UPDATER_STATE_SLOTS
     slots = UPDATER_STATE_SLOTS.get((updater or "").lower(), 2)
     total = int(param_count) * int(dtype_bytes) * slots
-    if weight_update_sharding == "zero1" and dp > 1:
+    if weight_update_sharding in SHARDED_WUS_MODES and dp > 1:
+        return -(-total // int(dp))
+    return total
+
+
+def dp_gradient_hbm_bytes(param_count: int, dp: int,
+                          dtype_bytes: int = 4,
+                          weight_update_sharding: str = "off") -> int:
+    """Per-chip HBM of the REDUCED gradient the update consumes.
+
+    ``off`` keeps a full replicated gradient (``P.b``); ``zero1``
+    anchors the reduced gradient replicated before slicing it, so its
+    peak is still ``P.b``; ``zero2`` holds only the ``(dp, chunk)``
+    shard — ``P.b / dp`` — because the sharded view is the gradients'
+    only layout from the reduce-scatter onward (the per-microbatch
+    pre-reduction partial is transient on every mode and not modeled
+    here)."""
+    total = int(param_count) * int(dtype_bytes)
+    if weight_update_sharding == "zero2" and dp > 1:
         return -(-total // int(dp))
     return total
 
@@ -156,6 +187,8 @@ def weight_update_cost(net, dp: int,
         "updater_hbm_bytes": dp_updater_hbm_bytes(
             param_count, updater, dp, dtype_bytes,
             weight_update_sharding),
+        "gradient_hbm_bytes": dp_gradient_hbm_bytes(
+            param_count, dp, dtype_bytes, weight_update_sharding),
     }
 
 

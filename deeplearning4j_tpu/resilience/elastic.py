@@ -325,9 +325,9 @@ class ElasticTrainer:
         dp = len(devices)
         wus = self._wus if (self._wus.enabled and dp >= 2) else None
         if self._wus.enabled and dp < 2:
-            logger.warning("dp width %d cannot carry zero1 weight-update "
+            logger.warning("dp width %d cannot carry %s weight-update "
                            "sharding; continuing with the replicated "
-                           "layout", dp)
+                           "layout", dp, self._wus.mode)
         with get_tracer().span("elastic:bootstrap", dp=dp,
                                world=len(self._world)):
             self.mesh = MeshContext.create(n_data=dp, n_model=1,
@@ -341,16 +341,19 @@ class ElasticTrainer:
             self.manager = CheckpointManager(
                 self.checkpoint_dir, keep_last=self.keep_last,
                 sharded=True, mesh_ctx=self.mesh,
-                weight_update_sharding="zero1" if wus else "off",
+                weight_update_sharding=wus.mode if wus else "off",
                 commit_timeout=self.commit_timeout_s)
             cursor = None
             if self.resume or not initial:
                 info = self.manager.latest_valid()
                 if info is not None:
+                    from deeplearning4j_tpu.analysis.graphcheck import \
+                        SHARDED_WUS_MODES
                     saved = info.cursor.topology if info.cursor else None
                     resharding = bool(
                         saved
-                        and saved.get("weight_update_sharding") == "zero1"
+                        and saved.get("weight_update_sharding")
+                        in SHARDED_WUS_MODES
                         and int(saved.get("dp", dp)) != dp)
                     # restore BEFORE the trainer attaches: the reshard
                     # path un-pads zero1 views into the fresh net's

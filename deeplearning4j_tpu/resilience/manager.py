@@ -216,27 +216,33 @@ class CheckpointManager:
             # the caller's reshard request (the path only engages on a
             # template shape mismatch)
             return bool(reshard)
-        if str(saved.get("weight_update_sharding", "off")) != "zero1":
+        from deeplearning4j_tpu.analysis.graphcheck import SHARDED_WUS_MODES
+        saved_mode = str(saved.get("weight_update_sharding", "off"))
+        if saved_mode not in SHARDED_WUS_MODES:
             return False  # replicated layouts restore at any width
         if reshard:
             # un-pad (dp_old, chunk) views into full-shape templates —
             # needed even at the same width, because the elastic restore
             # targets a FRESH net (full shapes) before the new trainer
             # re-flattens; a template already holding same-width sharded
-            # views matches shapes and bypasses the path leaf-by-leaf
+            # views matches shapes and bypasses the path leaf-by-leaf.
+            # zero1 and zero2 persist the SAME (dp, chunk) layout, so
+            # one reshard path serves both (and restores across a
+            # zero1 <-> zero2 mode change bitwise).
             return True
         cur = self.topology()
         if int(saved.get("dp", 1)) == cur["dp"]:
             return False
         raise CheckpointError(
             f"checkpoint {info.path} was cut at dp={saved.get('dp')} "
-            f"(weight_update_sharding=zero1, "
+            f"(weight_update_sharding={saved_mode}, "
             f"{saved.get('process_count')} processes) but is being "
-            f"restored at dp={cur['dp']} — the sharded updater state "
-            "is laid out for the old width. Restore with "
-            "reshard=True (ElasticTrainer's cross-width path) into a "
-            "net holding the full-shape updater state, then attach "
-            "the new-width trainer.")
+            f"restored at dp={cur['dp']} "
+            f"(weight_update_sharding={cur['weight_update_sharding']}) "
+            "— the sharded updater state is laid out for the old "
+            "width. Restore with reshard=True (ElasticTrainer's "
+            "cross-width path) into a net holding the full-shape "
+            "updater state, then attach the new-width trainer.")
 
     # ------------------------------------------------------------------- save
     def save(self, net, step: Optional[int] = None,

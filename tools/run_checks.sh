@@ -30,11 +30,32 @@ if [ "${1:-}" != "--fast" ]; then
     JAX_PLATFORMS=cpu python tools/serve_smoke.py || fail=1
 
     echo "== serve+input bench smoke (batching + input-pipeline rungs, CPU) =="
+    rm -f /tmp/_bench_smoke.jsonl
     JAX_PLATFORMS=cpu BENCH_SMOKE=1 BENCH_RUNGS=input,serve BENCH_CHILD=1 \
-        python bench.py || fail=1
+        python bench.py | tee /tmp/_bench_smoke.jsonl || fail=1
+    # every rung record must carry the ISSUE-10 precision fields
+    python - <<'PY' || fail=1
+import json
+recs = []
+for line in open("/tmp/_bench_smoke.jsonl"):
+    line = line.strip()
+    if line.startswith("{"):
+        recs.append(json.loads(line))
+# failure/timeout records (_failure_record / _RungWatchdog) carry no
+# precision fields by design — only successful rung records must
+recs = [r for r in recs if not r.get("failed")]
+assert recs, "bench smoke emitted no successful records"
+missing = [r.get("metric") for r in recs
+           if "compute_dtype" not in r or "params_dtype" not in r]
+assert not missing, f"records missing compute_dtype/params_dtype: {missing}"
+print(f"bench precision fields: {len(recs)} records OK")
+PY
 
     echo "== zero1 smoke (dp=2 bitwise loss parity + sharded updater state) =="
     JAX_PLATFORMS=cpu python tools/zero1_smoke.py || fail=1
+
+    echo "== zero2 smoke (dp=2 bitwise parity + gradient sharding + bf16 masters) =="
+    JAX_PLATFORMS=cpu python tools/zero2_smoke.py || fail=1
 
     echo "== input smoke (pipeline vs sync: loss parity + lower stall) =="
     JAX_PLATFORMS=cpu python tools/input_smoke.py || fail=1
