@@ -652,7 +652,7 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
     # compile-time numbers (the same fields a CPU smoke run reports).
     # Runs AFTER the timed regions (it pays one AOT recompile) and can
     # never cost the rung. BENCH_COST=0 skips.
-    flops_per_step = bytes_accessed = analytic = None
+    flops_per_step = bytes_accessed = analytic = comm_bytes_hlo = None
     if os.environ.get("BENCH_COST", "1") == "1":
         t = time.perf_counter()
         try:
@@ -660,6 +660,12 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
                 cost = net.cost_analysis(staged[0])
             flops_per_step = cost.get("flops_per_step")
             bytes_accessed = cost.get("bytes_accessed")
+            # shardcheck's SC007 surface: the MEASURED program's actual
+            # per-chip collective bytes (ring model over the compiled
+            # HLO) — 0 for a single-device step; on a sharded run the
+            # number `comm_bytes_per_step` (the analytic model) is
+            # calibrated against
+            comm_bytes_hlo = cost.get("comm_bytes_hlo")
             peak = cost.get("peak_flops_per_chip")
             if flops_per_step and peak and sps > 0:
                 from deeplearning4j_tpu.profiling.cost import analytic_mfu
@@ -735,6 +741,7 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
         "analytic_mfu": analytic,
         "weight_update_sharding": wus_mode,
         "comm_bytes_per_step": comm_bytes,
+        "comm_bytes_hlo": comm_bytes_hlo,
         "updater_hbm_bytes": updater_hbm,
         "gradient_hbm_bytes": gradient_hbm,
         "phase_breakdown_s_per_step": phase_breakdown,
@@ -812,6 +819,9 @@ def _run_input_rung(jax, smoke: bool, on_accel: bool, device_kind: str,
         "platform": platform,
         "rung": "input",
         "batch": batch,
+        # schema uniformity: the pipeline-alone rung compiles no step,
+        # so there is no program to derive collective bytes from
+        "comm_bytes_hlo": None,
         "sources": cfg["sources"],
         "batches": n_batches,
         "input_stall_s": round(pipe.stall_s, 4),
@@ -944,6 +954,9 @@ def _run_serve_rung(jax, smoke: bool, on_accel: bool, device_kind: str,
         "device_kind": device_kind,
         "platform": platform,
         "rung": "serve",
+        # schema uniformity: the serve rung's AOT infer buckets are not
+        # collective-analyzed (inference ships no gradient collectives)
+        "comm_bytes_hlo": None,
         "clients": clients,
         "requests": n_done,
         "request_errors": errors[:5],

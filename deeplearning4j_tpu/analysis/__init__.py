@@ -1,23 +1,33 @@
-"""Static analysis: pre-execution model validation + JAX anti-pattern lint.
-
-Two tools, both CPU-only and array-free, meant to run in milliseconds
-before any TPU time is spent (the pre-execution planning tradition of
+"""Static analysis: three layers, one per representation a model passes
+through on its way to the chip — all CPU-only, all wired into
+``tools/run_checks.sh`` (the pre-execution planning tradition of
 cuDNN-style primitive selection and the sharding-legality checks of
 automatic cross-replica sharding — PAPERS.md):
 
-- ``graphcheck``: walks a ``MultiLayerConfiguration`` /
-  ``ComputationGraphConfiguration`` without building arrays — per-layer
-  shape+dtype inference, cycle / dangling / dead-vertex / duplicate-name
-  detection, parameter-count + HBM/VMEM footprint estimation
-  (``MemoryReport``), and mesh-legality checks (dp divisibility, pp stage
-  balance, MoE expert counts).
-- ``jaxlint``: an AST linter over the source tree flagging JAX
-  anti-patterns inside jitted/scanned/vmapped code (tracer leaks, traced
-  branches, host syncs, Python-loop compute, impure calls in jit, jitted
-  train steps missing ``donate_argnums``).
+- ``graphcheck`` — the CONFIG layer: walks a ``MultiLayerConfiguration``
+  / ``ComputationGraphConfiguration`` without building arrays —
+  per-layer shape+dtype inference, cycle / dangling / dead-vertex /
+  duplicate-name detection, parameter-count + HBM/VMEM footprint
+  estimation (``MemoryReport``), mesh-legality (dp divisibility, pp
+  balance, MoE expert counts, zero1/zero2 legality, elastic resize
+  plans, precision policy). Rules GC001–GC015.
+- ``jaxlint`` — the SOURCE layer: an AST linter over the tree flagging
+  JAX anti-patterns inside jitted/scanned/vmapped code (tracer leaks,
+  traced branches, host syncs, Python-loop compute, impure calls,
+  missing donation, host timers, stale suppressions). Rules
+  JL001–JL008.
+- ``shardcheck`` — the COMPILED-PROGRAM layer: parses the StableHLO +
+  post-SPMD optimized HLO of a ``jit(step).lower(...).compile()`` and
+  statically re-proves the invariants the bitwise smoke gates verify at
+  runtime — reduce-scatter layout under zero1/zero2, the ga-scan
+  replicated anchor, precision boundaries, donation aliasing, no host
+  transfers, and the comm-bytes calibration the cost-model autotuner
+  consumes. Rules SC001–SC007.
 
-CLIs: ``tools/graphcheck.py`` and ``tools/jaxlint.py``; both are wired
-into ``tools/run_checks.sh``.
+CLIs: ``tools/graphcheck.py``, ``tools/jaxlint.py``,
+``tools/shardcheck.py``. Per-rule KNOWN_BAD/KNOWN_GOOD fixtures for all
+three live in ``analysis/fixtures.py``, with coverage enforced by
+``tests/test_fixture_coverage.py``.
 """
 
 from deeplearning4j_tpu.analysis.findings import Finding, Severity, max_severity
@@ -25,9 +35,13 @@ from deeplearning4j_tpu.analysis.graphcheck import (
     check_graph, check_multilayer, validate_config,
 )
 from deeplearning4j_tpu.analysis.memory import MemoryReport, memory_report
+from deeplearning4j_tpu.analysis.shardcheck import (
+    StepProgram, check_step_program, lower_step_program,
+)
 
 __all__ = [
     "Finding", "Severity", "max_severity",
     "check_multilayer", "check_graph", "validate_config",
     "MemoryReport", "memory_report",
+    "StepProgram", "check_step_program", "lower_step_program",
 ]

@@ -1,18 +1,37 @@
-"""Known-bad and known-good configs for graphcheck's self-check.
+"""Known-bad and known-good fixtures for ALL THREE analyzers' self-checks.
 
-Shared by ``tools/graphcheck.py --self-check`` (the CI gate) and
-``tests/test_graphcheck.py``. Each known-bad entry names the rule id its
-defect must produce; the known-good entries are the seed model families
-(MLP, CNN, RNN, ComputationGraph merge) and must validate clean.
+One file, three fixture families — the gate ``tools/run_checks.sh``
+drives and the fixture-coverage meta-test
+(``tests/test_fixture_coverage.py``) enforces (every registered GC/JL/SC
+rule id must have at least one KNOWN_BAD and one KNOWN_GOOD fixture
+here, so a new rule cannot land fixture-less):
 
-The broken configs are constructed directly (dataclass constructors, no
-``build()``): the builders throw on several of these defects by design,
-and graphcheck exists precisely for configs that arrive from JSON/YAML
-without ever passing through a builder.
+- **graphcheck** (``KNOWN_BAD`` / ``KNOWN_GOOD`` / ``KNOWN_GOOD_FOR``):
+  config objects. Each known-bad entry names the rule id its defect
+  must produce; known-good entries are the seed model families and must
+  validate clean; ``KNOWN_GOOD_FOR`` maps each rule to the clean
+  fixture that exercises its trigger surface.
+- **jaxlint** (``JL_FIXTURES``): per-rule (bad snippet, good twin)
+  source strings — consumed by ``tools/jaxlint.py --self-check``.
+- **shardcheck** (``SC_KNOWN_BAD`` / ``SC_KNOWN_GOOD`` /
+  ``SC_GOOD_FOR``): COMPILED step programs. Each maker lowers+compiles
+  a small program on a dp=2 CPU mesh (needs >= 2 devices —
+  ``tools/shardcheck.py`` forces ``--xla_force_host_platform_device_count``)
+  and returns ``(StepProgram, check_kwargs)``. Known-bad programs are
+  synthetic steps exhibiting exactly the defect; known-good programs
+  are the REAL ParallelTrainer steps (zero1/zero2 x fp32/bf16, ga
+  scan, fp32-preset identity), so the self-check doubles as a static
+  re-proof of the zero1/zero2/bf16 program contracts.
+
+The broken graphcheck configs are constructed directly (dataclass
+constructors, no ``build()``): the builders throw on several of these
+defects by design, and graphcheck exists precisely for configs that
+arrive from JSON/YAML without ever passing through a builder.
 """
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Callable, Dict, List, Optional, Tuple
 
 from deeplearning4j_tpu.nn.conf.builder import (
@@ -203,7 +222,97 @@ def bad_elastic_grow():
                   "elastic_resize_widths": [8]}
 
 
+def bad_duplicate_name():
+    """Two layers both named 'hidden' — the flat-view param contract
+    (and every by-name lookup) silently collapses them."""
+    conf = MultiLayerConfiguration(layers=[
+        DenseLayer(n_in=16, n_out=8, activation="relu", name="hidden"),
+        DenseLayer(n_in=8, n_out=8, activation="relu", name="hidden"),
+        OutputLayer(n_in=8, n_out=2, activation="softmax", loss="mcxent"),
+    ])
+    return conf, {}
+
+
+def bad_dead_vertex():
+    """A branch that feeds no network output: its params would train on
+    no gradient signal."""
+    nodes = {
+        "in": NodeConf(name="in", kind="input"),
+        "live": NodeConf(name="live", kind="layer", inputs=["in"],
+                         layer=DenseLayer(n_in=8, n_out=8,
+                                          activation="relu")),
+        "dead": NodeConf(name="dead", kind="layer", inputs=["in"],
+                         layer=DenseLayer(n_in=8, n_out=8,
+                                          activation="relu")),
+        "out": NodeConf(name="out", kind="layer", inputs=["live"],
+                        layer=OutputLayer(n_in=8, n_out=2,
+                                          activation="softmax")),
+    }
+    conf = ComputationGraphConfiguration(
+        nodes=nodes, network_inputs=["in"], network_outputs=["out"],
+        input_types={"in": InputType.feed_forward(8)})
+    return conf, {}
+
+
+def bad_missing_loss_head():
+    """Stack ending in a plain DenseLayer: fit() would be rejected at
+    runtime; graphcheck warns at config time."""
+    conf = MultiLayerConfiguration(layers=[
+        DenseLayer(n_in=16, n_out=8, activation="relu"),
+        DenseLayer(n_in=8, n_out=4, activation="relu"),
+    ])
+    return conf, {}
+
+
+def bad_hbm_overflow():
+    """The MLP against a deliberately tiny 1 MiB per-chip budget: the
+    estimated training footprint (~3.4 MiB) cannot fit."""
+    conf, _ = good_mlp()
+    return conf, {"batch_size": 64, "hbm_bytes": 1 << 20}
+
+
+def bad_ep_mismatch():
+    """MoE with 3 experts over an ep=2 mesh axis: the stacked expert
+    weights cannot shard evenly."""
+    from deeplearning4j_tpu.parallel.expert import MoELayer
+    conf = (NeuralNetConfiguration.builder()
+            .updater("adam", learning_rate=1e-3)
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(MoELayer(n_experts=3, hidden=32, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(16))
+            .build())
+    return conf, {"mesh": {"dp": 2, "ep": 2}, "batch_size": 32}
+
+
+def bad_vertex_arity():
+    """An L2Vertex (pairwise distance, exactly 2 inputs) wired with 1."""
+    from deeplearning4j_tpu.nn.conf.graph import L2Vertex
+    nodes = {
+        "in": NodeConf(name="in", kind="input"),
+        "h": NodeConf(name="h", kind="layer", inputs=["in"],
+                      layer=DenseLayer(n_in=8, n_out=8, activation="relu")),
+        "d": NodeConf(name="d", kind="vertex", inputs=["h"],
+                      vertex=L2Vertex()),
+        "out": NodeConf(name="out", kind="layer", inputs=["d"],
+                        layer=OutputLayer(n_in=1, n_out=2,
+                                          activation="softmax")),
+    }
+    conf = ComputationGraphConfiguration(
+        nodes=nodes, network_inputs=["in"], network_outputs=["out"],
+        input_types={"in": InputType.feed_forward(8)})
+    return conf, {}
+
+
 KNOWN_BAD: List[Tuple[str, str, Callable]] = [
+    ("duplicate-name", "GC001", bad_duplicate_name),
+    ("dead-vertex", "GC004", bad_dead_vertex),
+    ("missing-loss-head", "GC006", bad_missing_loss_head),
+    ("hbm-overflow", "GC007", bad_hbm_overflow),
+    ("ep-mismatch", "GC010", bad_ep_mismatch),
+    ("vertex-arity", "GC012", bad_vertex_arity),
     ("shape-mismatch", "GC005", bad_shape_mismatch),
     ("graph-cycle", "GC002", bad_graph_cycle),
     ("dangling-vertex", "GC003", bad_dangling_vertex),
@@ -343,14 +452,410 @@ def good_mlp_elastic():
                   "elastic_resize_widths": [2, 1]}
 
 
+def good_moe_ep():
+    """MoE with 4 experts over an ep=2 mesh: stacked expert weights
+    shard evenly — must validate clean (GC010's clean twin)."""
+    from deeplearning4j_tpu.parallel.expert import MoELayer
+    conf = (NeuralNetConfiguration.builder()
+            .updater("adam", learning_rate=1e-3)
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(MoELayer(n_experts=4, hidden=32, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(16))
+            .build())
+    return conf, {"mesh": {"dp": 2, "ep": 2}, "batch_size": 32}
+
+
+def good_mlp_pp():
+    """Equal-width body layers over a pp=2 mesh: the best contiguous
+    stage partition is balanced — must validate clean (GC009's clean
+    twin)."""
+    conf = (NeuralNetConfiguration.builder()
+            .updater("adam", learning_rate=1e-3)
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=128, activation="relu"))
+            .layer(DenseLayer(n_out=128, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(128))
+            .build())
+    return conf, {"mesh": {"dp": 2, "pp": 2}, "batch_size": 32}
+
+
 KNOWN_GOOD: List[Tuple[str, Callable]] = [
     ("mlp", good_mlp),
     ("cnn", good_cnn),
     ("rnn", good_rnn),
     ("graph-merge", good_graph_merge),
+    ("moe-ep", good_moe_ep),
+    ("mlp-pp-balanced", good_mlp_pp),
     ("mlp-zero1", good_mlp_zero1),
     ("mlp-zero2", good_mlp_zero2),
     ("mlp-bf16-zero2", good_mlp_bf16_zero2),
     ("mlp-sharded-pipeline", good_mlp_pipeline),
     ("mlp-elastic-plan", good_mlp_elastic),
 ]
+
+#: rule id -> the KNOWN_GOOD fixture that exercises that rule's trigger
+#: surface and stays clean (the meta-test's "one KNOWN_GOOD per rule").
+KNOWN_GOOD_FOR: Dict[str, str] = {
+    "GC001": "mlp",                  # multi-layer stack, unique names
+    "GC002": "graph-merge",          # real DAG, acyclic
+    "GC003": "graph-merge",          # all refs resolve
+    "GC004": "graph-merge",          # every node feeds an output
+    "GC005": "cnn",                  # deepest shape-inference walk
+    "GC006": "mlp",                  # loss head present
+    "GC007": "mlp",                  # memory walk under default budget
+    "GC008": "mlp",                  # batch 64 divides dp=8
+    "GC009": "mlp-pp-balanced",      # balanced pp=2 partition
+    "GC010": "moe-ep",               # 4 experts over ep=2
+    "GC011": "mlp-zero1",            # legal zero1 mesh, low padding
+    "GC012": "graph-merge",          # merge vertex wired at its arity
+    "GC013": "mlp-sharded-pipeline", # dp mesh fed by a sharded pipeline
+    "GC014": "mlp-elastic-plan",     # every planned width divides batch
+    "GC015": "mlp-bf16-zero2",       # bf16 with an explicit loss scale
+}
+
+
+# ---------------------------------------------------------------------------
+# jaxlint fixtures: rule -> (bad snippet firing exactly it, clean twin)
+# ---------------------------------------------------------------------------
+
+JL_FIXTURES: Dict[str, Tuple[str, str]] = {
+    "JL001": ("import jax\n@jax.jit\ndef f(x):\n    return float(x)\n",
+              "import jax\n@jax.jit\ndef f(x):\n"
+              "    return x.astype('float32')\n"),
+    "JL002": ("import jax, jax.numpy as jnp\n@jax.jit\ndef f(x):\n"
+              "    if jnp.any(x > 0):\n        return x\n    return -x\n",
+              "import jax, jax.numpy as jnp\n@jax.jit\ndef f(x):\n"
+              "    return jnp.where(x > 0, x, -x)\n"),
+    "JL003": ("import jax, numpy as np\n@jax.jit\ndef f(x):\n"
+              "    return np.asarray(x)\n",
+              "import jax, jax.numpy as jnp\n@jax.jit\ndef f(x):\n"
+              "    return jnp.asarray(x)\n"),
+    "JL004": ("import jax, jax.numpy as jnp\n@jax.jit\ndef f(h, W):\n"
+              "    for _ in range(64):\n        h = jnp.tanh(h @ W)\n"
+              "    return h\n",
+              "import jax, jax.numpy as jnp\n@jax.jit\ndef f(h, W):\n"
+              "    return jax.lax.fori_loop(\n"
+              "        0, 64, lambda i, a: jnp.tanh(a @ W), h)\n"),
+    "JL005": ("import jax, numpy as np\n@jax.jit\ndef f(x):\n"
+              "    return x + np.random.normal()\n",
+              "import jax\n@jax.jit\ndef f(x, key):\n"
+              "    return x + jax.random.normal(key, x.shape)\n"),
+    "JL006": ("import jax\ndef train_step(p, g):\n    return p - g\n"
+              "fn = jax.jit(train_step)\n",
+              "import jax\ndef train_step(p, g):\n    return p - g\n"
+              "fn = jax.jit(train_step, donate_argnums=(0,))\n"),
+    "JL007": ("import jax, time\n@jax.jit\ndef f(x):\n"
+              "    t0 = time.perf_counter()\n    return x * t0\n",
+              "import jax, time\ndef host_fit(step, x):\n"
+              "    t0 = time.perf_counter()\n"
+              "    jax.block_until_ready(step(x))\n"
+              "    return time.perf_counter() - t0\n"),
+    # JL008: the bad snippet's suppression suppresses nothing (there is
+    # no JL001 on that line); the good twin's suppression is live, so
+    # neither JL001 (suppressed) nor JL008 (used) fires
+    "JL008": ("import jax\n@jax.jit\ndef f(x):\n"
+              "    return x + 1  # jaxlint: disable=JL001 -- stale\n",
+              "import jax\n@jax.jit\ndef f(x):\n"
+              "    return float(x)  # jaxlint: disable=JL001 -- demo\n"),
+}
+
+
+# ---------------------------------------------------------------------------
+# shardcheck fixtures: compiled step programs on a dp=2 CPU mesh
+# ---------------------------------------------------------------------------
+#
+# Each maker returns (StepProgram, check_kwargs). Known-bad programs are
+# small synthetic steps exhibiting exactly one defect; known-good
+# programs are the REAL ParallelTrainer steps at each layout, so the
+# self-check statically re-proves the zero1/zero2/bf16 contracts the
+# bitwise smokes then verify at runtime. jax is imported lazily (>= 2
+# CPU devices required — tests/conftest.py and tools/shardcheck.py both
+# force the device count).
+
+def _sc_mesh():
+    import jax
+    from deeplearning4j_tpu.parallel.mesh import MeshContext
+    if len(jax.devices()) < 2:
+        raise RuntimeError(
+            "shardcheck fixtures need >= 2 devices "
+            "(set XLA_FLAGS=--xla_force_host_platform_device_count)")
+    return MeshContext.create(n_data=2, n_model=1,
+                              devices=jax.devices()[:2])
+
+
+def _sc_batch():
+    import numpy as np
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    rng = np.random.default_rng(0)
+    return DataSet(rng.normal(size=(8, 16)).astype(np.float32),
+                   np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)])
+
+
+def _sc_net(precision: Optional[str] = None, loss_scale=None):
+    from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater("adam", learning_rate=1e-3)
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(16))
+            .build())
+    if precision is not None:
+        conf.training.precision = precision
+        conf.training.loss_scale = loss_scale
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+@lru_cache(maxsize=None)
+def _sc_trainer_program(wus: str = "zero1", accum: int = 1,
+                        precision: Optional[str] = None,
+                        donate: bool = True):
+    """(program, ctx) of a REAL ParallelTrainer step at the given
+    layout — ONE compile per distinct config per process (cached: the
+    self-check, the contracts gate, and the tests all share these)."""
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+    trainer = ParallelTrainer(
+        _sc_net(precision), mesh=_sc_mesh(), gradient_accumulation=accum,
+        weight_update_sharding=wus, donate_params=donate,
+        precision=precision)
+    program = trainer.step_program(_sc_batch())
+    return program, trainer.shardcheck_context()
+
+
+def _sc_shardings():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = _sc_mesh().mesh
+    return (NamedSharding(mesh, P()),           # replicated
+            NamedSharding(mesh, P("data", None)))  # (dp, chunk) rows
+
+
+# -- known-bad makers -------------------------------------------------------
+
+def sc_bad_full_allreduce():
+    """Claims zero1, but the gradient all-reduce is consumed at full
+    size by a replicated update on every chip — the reduce-scatter
+    layout never formed (the defect SC001 exists for)."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.analysis.shardcheck import lower_step_program
+    rep, shard = _sc_shardings()
+
+    def step(w, x):
+        y = x @ w
+        g = jnp.einsum("bi,bo->io", x, y)          # batch-contracted:
+        g = jax.lax.with_sharding_constraint(g, rep)  # full all-reduce
+        return w - 0.1 * g, (y * y).sum()          # full-size consumer
+
+    w = jax.device_put(jnp.ones((16, 8)), rep)
+    x = jax.device_put(jnp.ones((4, 16)), shard)
+    program = lower_step_program(jax.jit(step, donate_argnums=(0,)), w, x)
+    return program, dict(weight_update_sharding="zero1", dp=2,
+                         expect_donation=True)
+
+
+def sc_bad_double_gather():
+    """Two full-size (dp, chunk) all-gathers of the one param leaf per
+    update — one more than the ZeRO contract's single param gather."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.analysis.shardcheck import lower_step_program
+    rep, shard = _sc_shardings()
+
+    def step(wsh, x):
+        full = jax.lax.with_sharding_constraint(
+            wsh, rep).reshape(128)[:128].reshape(16, 8)   # gather #1
+        y = x @ full
+        wsh2 = jax.lax.with_sharding_constraint(
+            (full * 0.999).reshape(2, 64), shard)
+        full2 = jax.lax.with_sharding_constraint(wsh2, rep)  # gather #2
+        return wsh2, full2, (y * y).sum()
+
+    wsh = jax.device_put(jnp.ones((2, 64)), shard)
+    x = jax.device_put(jnp.ones((4, 16)), rep)
+    program = lower_step_program(jax.jit(step, donate_argnums=(0,)), wsh, x)
+    return program, dict(weight_update_sharding="zero1", dp=2,
+                         param_leaf_sizes=[128], expect_donation=True)
+
+
+def sc_bad_scan_body_gather():
+    """A scan whose body re-gathers the sharded carry to full size every
+    microbatch — the GSPMD repartition hazard the ga-scan anchor
+    prevents (the ``to_shards`` comment in parallel/trainer.py)."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.analysis.shardcheck import lower_step_program
+    rep, shard = _sc_shardings()
+
+    def step(wsh, xs):
+        def body(c, x):
+            full = jax.lax.with_sharding_constraint(
+                c, shard).reshape(128)[:128].reshape(16, 8)
+            full = jax.lax.with_sharding_constraint(full, rep)
+            y = x @ full
+            c2 = jax.lax.with_sharding_constraint(
+                (full * (1.0 + 0.0 * y.sum())).reshape(2, 64), shard)
+            return c2, (y * y).sum()
+        c, losses = jax.lax.scan(body, wsh, xs)
+        return c, losses.sum()
+
+    wsh = jax.device_put(jnp.ones((2, 64)), shard)
+    xs = jax.device_put(jnp.ones((3, 4, 16)), rep)
+    program = lower_step_program(jax.jit(step, donate_argnums=(0,)), wsh, xs)
+    return program, dict(weight_update_sharding="zero1", dp=2,
+                         gradient_accumulation=3, expect_donation=True)
+
+
+def sc_bad_bf16_gated_out():
+    """Claims a bf16 policy, but the program computes every dot in f32 —
+    the step-boundary casts never reached the compiled step."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.analysis.shardcheck import lower_step_program
+
+    def step(w, x):
+        y = x @ w
+        return w - 0.1 * (y * y).sum(), (y * y).sum()
+
+    program = lower_step_program(
+        jax.jit(step, donate_argnums=(0,)),
+        jnp.ones((16, 8)), jnp.ones((4, 16)))
+    return program, dict(precision="bf16", expect_donation=True)
+
+
+def sc_bad_half_masters():
+    """Computes in bf16 (the policy's half) but hands the PARAMS back in
+    bf16 too — master weights crossed the step boundary half-precision."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.analysis.shardcheck import lower_step_program
+
+    def step(w, x):
+        wh = w.astype(jnp.bfloat16)
+        y = x.astype(jnp.bfloat16) @ wh
+        loss = (y.astype(jnp.float32) ** 2).sum()
+        return wh * jnp.bfloat16(0.9), loss        # bf16 result [0]
+
+    program = lower_step_program(
+        jax.jit(step, donate_argnums=(0,)),
+        jnp.ones((16, 8)), jnp.ones((4, 16)))
+    return program, dict(precision="bf16", expect_donation=True)
+
+
+def sc_bad_donation_missing():
+    """A step that overwrites its params but was jitted without
+    donate_argnums — 2x peak param HBM for nothing."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.analysis.shardcheck import lower_step_program
+
+    def step(w, x):
+        y = x @ w
+        return w - 0.1 * (y * y).sum(), (y * y).sum()
+
+    program = lower_step_program(jax.jit(step),  # jaxlint: disable=JL006 -- the KNOWN_BAD donation fixture: the missing donation IS the defect under test
+                                 jnp.ones((16, 8)), jnp.ones((4, 16)))
+    return program, dict(expect_donation=True)
+
+
+def sc_bad_host_callback():
+    """A debug print inside the compiled step: a host callback
+    custom-call serialized with every step."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.analysis.shardcheck import lower_step_program
+
+    def step(w, x):
+        y = x @ w
+        jax.debug.print("loss {}", (y * y).sum())
+        return w - 0.1 * (y * y).sum(), (y * y).sum()
+
+    program = lower_step_program(
+        jax.jit(step, donate_argnums=(0,)),
+        jnp.ones((16, 8)), jnp.ones((4, 16)))
+    return program, dict(expect_donation=True)
+
+
+def sc_bad_comm_model_mismatch():
+    """The real zero1 program checked against a 10x-inflated param
+    count: the HLO-vs-model delta blows the SC007 tolerance."""
+    program, ctx = _sc_trainer_program("zero1", 1)
+    ctx = dict(ctx)
+    ctx["param_count"] = sum(ctx.pop("param_leaf_sizes")) * 10
+    return program, ctx
+
+
+SC_KNOWN_BAD: List[Tuple[str, str, Callable]] = [
+    ("zero1-full-allreduce", "SC001", sc_bad_full_allreduce),
+    ("zero1-double-gather", "SC002", sc_bad_double_gather),
+    ("ga-scan-weight-gather", "SC003", sc_bad_scan_body_gather),
+    ("bf16-casts-gated-out", "SC004", sc_bad_bf16_gated_out),
+    ("bf16-half-masters", "SC004", sc_bad_half_masters),
+    ("donation-missing", "SC005", sc_bad_donation_missing),
+    ("host-callback-in-step", "SC006", sc_bad_host_callback),
+    ("comm-model-mismatch", "SC007", sc_bad_comm_model_mismatch),
+]
+
+
+# -- known-good makers ------------------------------------------------------
+
+def sc_good_zero1():
+    return _sc_trainer_program("zero1", 1)
+
+
+def sc_good_zero2():
+    return _sc_trainer_program("zero2", 1)
+
+
+def sc_good_zero2_ga_scan():
+    return _sc_trainer_program("zero2", 2)
+
+
+def sc_good_bf16_zero2():
+    return _sc_trainer_program("zero2", 1, "bf16")
+
+
+def sc_good_replicated():
+    return _sc_trainer_program("off", 1)
+
+
+def sc_good_fp32_preset_identity():
+    """The fp32 PRESET program checked against the pre-policy baseline:
+    SC004 must find them convert-op-identical (the bitwise-parity
+    surface every smoke gate runs on)."""
+    program, ctx = _sc_trainer_program("zero1", 1, "fp32")
+    baseline, _ = _sc_trainer_program("zero1", 1, None)
+    ctx = dict(ctx)
+    ctx["baseline"] = baseline
+    return program, ctx
+
+
+SC_KNOWN_GOOD: List[Tuple[str, Callable]] = [
+    ("zero1-step", sc_good_zero1),
+    ("zero2-step", sc_good_zero2),
+    ("zero2-ga-scan", sc_good_zero2_ga_scan),
+    ("bf16-zero2-step", sc_good_bf16_zero2),
+    ("fp32-preset-identity", sc_good_fp32_preset_identity),
+    ("replicated-step", sc_good_replicated),
+]
+
+#: rule id -> the SC_KNOWN_GOOD fixture exercising that rule's trigger
+#: surface cleanly (the meta-test's "one KNOWN_GOOD per rule").
+SC_GOOD_FOR: Dict[str, str] = {
+    "SC001": "zero1-step",            # rs-form all-reduces, no full use
+    "SC002": "zero2-step",            # param gathers == leaves
+    "SC003": "zero2-ga-scan",         # anchor held: empty scan body census
+    "SC004": "bf16-zero2-step",       # half dots, fp32 masters
+    "SC005": "zero1-step",            # donation requested AND landed
+    "SC006": "replicated-step",       # no host transfer in the step
+    "SC007": "zero1-step",            # HLO == model within tolerance
+}

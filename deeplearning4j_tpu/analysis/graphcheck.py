@@ -70,6 +70,37 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from deeplearning4j_tpu.analysis.findings import Finding, Severity
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 
+#: registered rule ids -> (slug, summary). The fixture-coverage
+#: meta-test (tests/test_fixture_coverage.py) asserts every id here has
+#: a KNOWN_BAD fixture and a KNOWN_GOOD_FOR mapping in
+#: ``analysis/fixtures.py`` — a new rule cannot land fixture-less.
+RULES: Dict[str, Tuple[str, str]] = {
+    "GC001": ("duplicate-name", "two layers/vertices share a name"),
+    "GC002": ("graph-cycle", "the DAG contains a cycle"),
+    "GC003": ("dangling-ref", "a node references an unknown input"),
+    "GC004": ("dead-vertex", "a node feeds no network output"),
+    "GC005": ("shape-mismatch", "declared n_in contradicts inference, "
+                                "or shape inference fails"),
+    "GC006": ("missing-loss-head", "final layer/output node has no loss"),
+    "GC007": ("hbm-overflow", "estimated training HBM exceeds the "
+                              "per-chip budget"),
+    "GC008": ("dp-indivisible", "batch size not divisible by the dp "
+                                "mesh axis"),
+    "GC009": ("pp-imbalance", "best contiguous stage partition skewed, "
+                              "or more pp stages than layers"),
+    "GC010": ("ep-mismatch", "MoE expert count not divisible by the ep "
+                             "mesh axis"),
+    "GC011": ("wus-mesh", "zero1/zero2 sharding on an illegal mesh, or "
+                          "excessive pad-to-divisible waste"),
+    "GC012": ("vertex-arity", "vertex input count != n_inputs()"),
+    "GC013": ("input-unsharded", "dp >= 2 mesh fed by a non-sharded "
+                                 "iterator"),
+    "GC014": ("elastic-resize", "planned surviving width cannot split "
+                                "the batch / is impossible"),
+    "GC015": ("precision-policy", "non-float compute dtype, or half "
+                                  "precision without a loss scale"),
+}
+
 # pp stage partitions whose heaviest stage exceeds the mean by this factor
 # waste the slice (the bubble amortizes, the skew does not)
 PP_IMBALANCE_RATIO = 1.5

@@ -32,6 +32,11 @@ Rules (stable ids):
         ``maybe_phase(...)``) — inside a traced function: a host timer
         there is a trace-time constant, not a measurement, and a span
         times the trace, not the run
+- JL008 stale-suppression (warning) a ``# jaxlint: disable=<rule>``
+        comment that suppresses nothing on its line — the finding it
+        once silenced is gone (code moved or was fixed), and the stale
+        comment would silently swallow any FUTURE finding of that rule
+        there
 
 Traced-context detection is lexical: a function counts as traced when it
 is (a) decorated with ``jax.jit``/``pmap``/``vmap``/``shard_map`` (bare
@@ -85,6 +90,9 @@ RULES: Dict[str, Tuple[str, str]] = {
               "host timer (time.time/perf_counter) or profiling span/"
               "phase inside a traced function is a trace-time constant, "
               "not a measurement"),
+    "JL008": ("stale-suppression",
+              "suppression comment that suppresses nothing on its line "
+              "(rots silently and would swallow future findings)"),
 }
 
 RULE_SEVERITY = {
@@ -96,6 +104,7 @@ RULE_SEVERITY = {
     "JL005": Severity.ERROR,
     "JL006": Severity.WARNING,
     "JL007": Severity.ERROR,
+    "JL008": Severity.WARNING,
 }
 
 # decorators / callables whose function argument is traced
@@ -281,11 +290,16 @@ class _Ctx:
     path: str
     suppressed: Dict[int, Set[str]]
     findings: List[Finding] = field(default_factory=list)
+    # line -> suppression ids that actually silenced a finding there;
+    # the JL008 post-pass reports the declared-but-unused remainder
+    used: Dict[int, Set[str]] = field(default_factory=dict)
 
     def emit(self, rule: str, node: ast.AST, message: str, hint: str = ""):
         line = getattr(node, "lineno", 0)
         dis = self.suppressed.get(line, set())
         if "all" in dis or rule in dis:
+            self.used.setdefault(line, set()).update(
+                dis & {"all", rule})
             return
         self.findings.append(Finding(
             rule, RULE_SEVERITY[rule], f"{self.path}:{line}", message, hint))
@@ -532,6 +546,25 @@ def lint_source(source: str, path: str = "<string>") -> List[Finding]:
         return findings
     ctx = _Ctx(path=path, suppressed=suppressed, findings=findings)
     _lint_module(tree, ctx)
+    # JL008: suppressions that silenced nothing on their line. A
+    # `disable=all` is live if ANY finding was swallowed there; explicit
+    # ids are checked one by one. `disable=JL008` on the line opts the
+    # line out (self-referential suppressions cannot be "used").
+    for line, ids in sorted(suppressed.items()):
+        if "JL008" in ids or "all" in ids and ctx.used.get(line):
+            continue
+        stale = sorted(
+            i for i in ids
+            if i not in ctx.used.get(line, set())
+            and (i != "all" or not ctx.used.get(line)))
+        if stale:
+            ctx.findings.append(Finding(
+                "JL008", RULE_SEVERITY["JL008"], f"{path}:{line}",
+                "suppression suppresses nothing on this line "
+                f"({', '.join('all' if s == 'all' else s for s in stale)}"
+                " never fired here)",
+                "delete the stale comment — it would silently swallow "
+                "a future finding of that rule"))
     ctx.findings.sort(key=lambda f: (f.location.rsplit(":", 1)[0],
                                      int(f.location.rsplit(":", 1)[1])))
     return ctx.findings

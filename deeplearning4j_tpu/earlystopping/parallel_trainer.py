@@ -64,3 +64,9 @@ class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
             train_data.attach(mesh=trainer.mesh)
         super().__init__(config, _ParallelNetAdapter(trainer), train_data)
         self.trainer = trainer
+
+    def shardcheck(self, batch, **overrides):
+        """Statically verify the underlying SPMD step's compiled-program
+        contracts (analysis/shardcheck) — the early-stopping loop drives
+        the same ParallelTrainer step per batch."""
+        return self.trainer.shardcheck(batch, **overrides)

@@ -30,6 +30,7 @@ from deeplearning4j_tpu.nn.conf.graph_builder import ComputationGraphConfigurati
 from deeplearning4j_tpu.nn.netcommon import (CostAnalysisMixin, EvalMixin,
                                               LazyScoreMixin, jit_init,
                                               ScanFitMixin, SentinelMixin,
+                                              ShardCheckMixin,
 )
 from deeplearning4j_tpu.nn.updater import build_optimizer, compute_updates
 from deeplearning4j_tpu.optimize.listeners import IterationListener, TrainingListener
@@ -59,7 +60,7 @@ def _time_slice(d: Optional[Dict[str, Array]], lo: int, hi: int,
 
 
 class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
-                       CostAnalysisMixin, SentinelMixin):
+                       CostAnalysisMixin, ShardCheckMixin, SentinelMixin):
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
         self.params: Optional[Dict[str, Dict[str, Array]]] = None

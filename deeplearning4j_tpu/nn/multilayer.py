@@ -38,6 +38,7 @@ from deeplearning4j_tpu.nn.layers.base import BaseLayerConf
 from deeplearning4j_tpu.nn.netcommon import (CostAnalysisMixin, EvalMixin,
                                               LazyScoreMixin, jit_init,
                                               ScanFitMixin, SentinelMixin,
+                                              ShardCheckMixin,
 )
 from deeplearning4j_tpu.nn.updater import (
     build_optimizer, compute_updates, l1_l2_penalty,
@@ -66,7 +67,7 @@ def _sum_aux_losses(states) -> Array:
 
 
 class MultiLayerNetwork(LazyScoreMixin, EvalMixin, ScanFitMixin,
-                        CostAnalysisMixin, SentinelMixin):
+                        CostAnalysisMixin, ShardCheckMixin, SentinelMixin):
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
         self.layers: List[BaseLayerConf] = conf.layers

@@ -131,6 +131,29 @@ class CostAnalysisMixin:
         return train_step_cost(self, batch, peak=peak)
 
 
+class ShardCheckMixin:
+    """``shardcheck(batch)`` for both containers: static analysis of
+    the container's own COMPILED train step (analysis/shardcheck) —
+    donation landed (SC005), no host transfers in the hot path (SC006),
+    precision boundaries honored (SC004), collective census (SC002).
+    The zero1/zero2 layout rules live on the data-parallel trainers'
+    ``shardcheck`` (the container's own step is the single-device
+    program). Same compile cost as ``cost_analysis``: one AOT lower per
+    (model, batch shape), no execution."""
+
+    def shardcheck(self, batch, **overrides):
+        from deeplearning4j_tpu.analysis.shardcheck import (
+            check_step_program, net_step_program, param_leaf_sizes,
+        )
+        training = self.conf.training
+        ctx = dict(weight_update_sharding="off", dp=1,
+                   precision=getattr(training, "precision", None),
+                   expect_donation=True,
+                   param_leaf_sizes=param_leaf_sizes(self.params))
+        ctx.update(overrides)
+        return check_step_program(net_step_program(self, batch), **ctx)
+
+
 def make_pretrain_step(layer, tx):
     """Jitted single-layer pretraining step for the greedy layerwise walk
     both containers run (ref: MultiLayerNetwork.pretrain /

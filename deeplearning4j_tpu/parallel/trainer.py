@@ -279,20 +279,11 @@ class ParallelTrainer:
         donate = (0, 1, 2) if self._donate else ()
         return jax.jit(step, donate_argnums=donate)
 
-    def gather_opt_state(self):
-        """Restore ``net.opt_state`` to its original (replicated) layout
-        and return it. Under zero1 the net holds the flattened sharded
-        views while this trainer is attached; gather before handing the
-        net to the zip serializer, a non-zero1 trainer, or single-device
-        inference-with-resume. A no-op in replicated mode."""
-        if self._opt_template is not None:
-            self.net.opt_state = gather_updater_state(
-                self.net.opt_state, self._opt_template)
-            self._opt_template = None
-        return self.net.opt_state
-
-    # ------------------------------------------------------------------- fit
-    def fit_batch(self, batch) -> float:
+    # ---------------------------------------------------- shared step prep
+    def _ensure_step(self) -> None:
+        """(Re)build the cached jitted step — shared by fit_batch and
+        step_program so the analyzed program is EXACTLY the one fit
+        runs, including the sentinel-change rebuild."""
         net = self.net
         if (self.weight_update_sharding.enabled
                 and self._opt_template is None):
@@ -308,6 +299,82 @@ class ParallelTrainer:
             # guarded step is a different program — rebuild
             self._step_sentinel = getattr(net, "_sentinel", None)
             self._step = self._build_step()
+
+    def _shard_batch_args(self, batch):
+        """Place one batch in the step's NamedSharding layout —
+        (feats, labels, fmask, lmask), the per-batch half of the step's
+        argument list. One copy, so fit and shardcheck cannot drift."""
+        net = self.net
+        if self._is_graph:
+            # name-keyed dicts (DataSet or MultiDataSet), every leaf
+            # sharded over the data axis
+            inputs, lbls, masks, lmasks_d = net._split(batch)
+            shard = lambda t: jax.tree.map(self.mesh.shard_batch, t)
+            return (shard(inputs), shard(lbls), shard(masks),
+                    shard(lmasks_d))
+        feats, labels = self.mesh.shard_batch(
+            jnp.asarray(batch.features), jnp.asarray(batch.labels))
+        fmask = lmask = None
+        if batch.features_mask is not None:
+            fmask = self.mesh.shard_batch(jnp.asarray(batch.features_mask))
+        if batch.labels_mask is not None:
+            lmask = self.mesh.shard_batch(jnp.asarray(batch.labels_mask))
+        return feats, labels, fmask, lmask
+
+    # ------------------------------------------------------- shardcheck
+    def step_program(self, batch):
+        """Capture THIS trainer's compiled per-batch step program for
+        ``batch`` (analysis/shardcheck) — one AOT compile, no
+        execution, donated buffers untouched."""
+        from deeplearning4j_tpu.analysis.shardcheck import lower_step_program
+        net = self.net
+        self._ensure_step()
+        feats, labels, fmask, lmask = self._shard_batch_args(batch)
+        with sequence_parallel_scope(self.mesh):
+            return lower_step_program(
+                self._step, net.params, net.opt_state, net.states, feats,
+                labels, fmask, lmask, jax.random.PRNGKey(0))
+
+    def shardcheck_context(self) -> dict:
+        """The layout context ``analysis/shardcheck`` validates this
+        trainer's program against — what the program CLAIMS to be."""
+        from deeplearning4j_tpu.analysis.shardcheck import param_leaf_sizes
+        return dict(
+            weight_update_sharding=self.weight_update_sharding.mode,
+            dp=self.mesh.n_data,
+            gradient_accumulation=self.gradient_accumulation,
+            precision=self.precision,
+            expect_donation=self._donate,
+            param_leaf_sizes=param_leaf_sizes(self.net.params))
+
+    def shardcheck(self, batch, **overrides):
+        """Statically verify the compiled step honors this trainer's
+        declared layout: reduce-scatter form under zero1/zero2 (SC001),
+        collective census (SC002), ga-scan anchor (SC003), precision
+        boundaries (SC004), donation (SC005), no host transfers
+        (SC006), comm-bytes calibration (SC007). Returns findings; runs
+        on CPU in seconds with no training step executed."""
+        from deeplearning4j_tpu.analysis.shardcheck import check_step_program
+        ctx = self.shardcheck_context()
+        ctx.update(overrides)
+        return check_step_program(self.step_program(batch), **ctx)
+
+    def gather_opt_state(self):
+        """Restore ``net.opt_state`` to its original (replicated) layout
+        and return it. Under zero1 the net holds the flattened sharded
+        views while this trainer is attached; gather before handing the
+        net to the zip serializer, a non-zero1 trainer, or single-device
+        inference-with-resume. A no-op in replicated mode."""
+        if self._opt_template is not None:
+            self.net.opt_state = gather_updater_state(
+                self.net.opt_state, self._opt_template)
+            self._opt_template = None
+        return self.net.opt_state
+
+    # ------------------------------------------------------------------- fit
+    def fit_batch(self, batch) -> float:
+        net = self.net
+        self._ensure_step()
         stats = self.training_stats
         # global-tracer spans (profiling/): host-side timeline of the
         # same phases the stats flag times — unconditional because the
@@ -318,24 +385,7 @@ class ParallelTrainer:
         tracer = get_tracer()
         with tracer.span("shard"):
             t_shard = time.perf_counter() if stats else 0.0
-            if self._is_graph:
-                # name-keyed dicts (DataSet or MultiDataSet), every leaf
-                # sharded over the data axis
-                inputs, lbls, masks, lmasks_d = net._split(batch)
-                shard = lambda t: jax.tree.map(self.mesh.shard_batch, t)
-                feats, labels = shard(inputs), shard(lbls)
-                fmask, lmask = shard(masks), shard(lmasks_d)
-            else:
-                feats = jnp.asarray(batch.features)
-                labels = jnp.asarray(batch.labels)
-                feats, labels = self.mesh.shard_batch(feats, labels)
-                fmask = lmask = None
-                if batch.features_mask is not None:
-                    fmask = self.mesh.shard_batch(
-                        jnp.asarray(batch.features_mask))
-                if batch.labels_mask is not None:
-                    lmask = self.mesh.shard_batch(
-                        jnp.asarray(batch.labels_mask))
+            feats, labels, fmask, lmask = self._shard_batch_args(batch)
             if stats:
                 # sync the async device_put so transfer time lands in
                 # 'shard', not 'step' — over a remote tunnel that

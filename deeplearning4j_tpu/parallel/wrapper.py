@@ -204,6 +204,45 @@ class ParallelWrapper:
         # the step's returns; donating them halves peak HBM per update
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
+    # ------------------------------------------------------- shardcheck
+    def step_program(self, batch: DataSet):
+        """Capture the compiled all-worker vmapped step program for one
+        global ``batch`` (analysis/shardcheck) — one AOT compile, no
+        execution."""
+        from deeplearning4j_tpu.analysis.shardcheck import lower_step_program
+        self._ensure_vstep()
+        n = batch.num_examples()
+        if n % self.workers:
+            raise ValueError(
+                f"global batch of {n} examples not divisible by "
+                f"workers={self.workers}")
+        batches = batch.batch_by(n // self.workers)
+        feats = jnp.stack([jnp.asarray(b.features) for b in batches])
+        labels = jnp.stack([jnp.asarray(b.labels) for b in batches])
+        rngs = jax.random.split(jax.random.PRNGKey(0), self.workers)
+        return lower_step_program(
+            self._vstep, self._stacked_params, self._stacked_opt,
+            self._stacked_states, feats, labels, rngs, jnp.asarray(True))
+
+    def shardcheck(self, batch: DataSet, **overrides):
+        """Statically verify the wrapper's compiled step: donation
+        (SC005), host transfers (SC006), precision boundaries (SC004),
+        collective census (SC002). The wrapper has no reduce-scatter
+        contract — its vmapped step never materializes a cross-worker
+        reduced gradient — so the zero-mode rules run as 'off'."""
+        from deeplearning4j_tpu.analysis.shardcheck import (
+            check_step_program, param_leaf_sizes,
+        )
+        ctx = dict(weight_update_sharding="off", dp=self.mesh.n_data,
+                   gradient_accumulation=1, precision=self.precision,
+                   expect_donation=True,
+                   # parameter averaging is not the dp gradient
+                   # exchange the SC007 ring model predicts — skip it
+                   check_cost=False,
+                   param_leaf_sizes=param_leaf_sizes(self._stacked_params))
+        ctx.update(overrides)
+        return check_step_program(self.step_program(batch), **ctx)
+
     # ------------------------------------------------------------------- fit
     def _ensure_vstep(self) -> None:
         if (self._vstep is None

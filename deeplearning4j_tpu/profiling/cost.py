@@ -211,11 +211,41 @@ def _normalize_cost(raw) -> dict:
     return out
 
 
+def lower_and_compile(jitted, *args, **kwargs):
+    """``(lowered, compiled)`` for a jitted function on example args —
+    ONE real XLA compile, shared by :func:`compiled_cost` and
+    ``analysis/shardcheck.lower_step_program`` (which also reads the
+    StableHLO/HLO texts off the same pair)."""
+    lowered = jitted.lower(*args, **kwargs)
+    return lowered, lowered.compile()
+
+
 def compiled_cost(jitted, *args, **kwargs) -> dict:
     """Lower + compile ``jitted`` for the given example args and return
     its normalized cost analysis (one real XLA compile)."""
-    lowered = jitted.lower(*args, **kwargs)
-    return _normalize_cost(lowered.compile().cost_analysis())
+    _, compiled = lower_and_compile(jitted, *args, **kwargs)
+    return _normalize_cost(compiled.cost_analysis())
+
+
+def step_example_args(net, batch):
+    """The positional argument tuple of a container's jitted train step
+    for one example ``batch`` — the arg-assembly both
+    :func:`train_step_cost` and ``net.shardcheck`` lower with."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = jax.random.PRNGKey(0)
+    if hasattr(net, "_split"):  # ComputationGraph: name-keyed dicts
+        inputs, labels, masks, lmasks = net._split(batch)
+        return (net.params, net.opt_state, net.states, inputs, labels,
+                masks, lmasks, rng)
+    fmask = (None if batch.features_mask is None
+             else jnp.asarray(batch.features_mask))
+    lmask = (None if batch.labels_mask is None
+             else jnp.asarray(batch.labels_mask))
+    return (net.params, net.opt_state, net.states,
+            jnp.asarray(batch.features), jnp.asarray(batch.labels),
+            fmask, lmask, rng)
 
 
 def train_step_cost(net, batch, peak: Optional[float] = None) -> dict:
@@ -223,32 +253,31 @@ def train_step_cost(net, batch, peak: Optional[float] = None) -> dict:
 
     ``net``: an initialized MultiLayerNetwork or ComputationGraph.
     Returns {flops_per_step, flops_per_example, bytes_accessed,
-    arithmetic_intensity, batch, device_kind, peak_flops_per_chip}, plus
-    ``mfu_at(step_seconds)`` left to the caller via ``analytic_mfu``.
-    Pure compile-time work — runs on CPU without a chip.
+    arithmetic_intensity, comm_bytes_hlo, batch, device_kind,
+    peak_flops_per_chip}, plus ``mfu_at(step_seconds)`` left to the
+    caller via ``analytic_mfu``. Pure compile-time work — runs on CPU
+    without a chip. ``comm_bytes_hlo`` is the compiled program's actual
+    per-chip collective bytes on the ring model (shardcheck's SC007
+    surface) — 0 for a single-device program, and the number a sharded
+    program's cost-model prediction is calibrated against.
     """
     import jax
-    import jax.numpy as jnp
 
     net._check_init()
     if net._train_step_fn is None:
         net._train_step_fn = net._build_train_step()
-    rng = jax.random.PRNGKey(0)
-    if hasattr(net, "_split"):  # ComputationGraph: name-keyed dicts
-        inputs, labels, masks, lmasks = net._split(batch)
-        args = (net.params, net.opt_state, net.states, inputs, labels,
-                masks, lmasks, rng)
-        n_examples = batch.num_examples()
-    else:
-        fmask = (None if batch.features_mask is None
-                 else jnp.asarray(batch.features_mask))
-        lmask = (None if batch.labels_mask is None
-                 else jnp.asarray(batch.labels_mask))
-        args = (net.params, net.opt_state, net.states,
-                jnp.asarray(batch.features), jnp.asarray(batch.labels),
-                fmask, lmask, rng)
-        n_examples = batch.num_examples()
-    cost = compiled_cost(net._train_step_fn, *args)
+    args = step_example_args(net, batch)
+    n_examples = batch.num_examples()
+    comm_bytes_hlo = None
+    try:
+        from deeplearning4j_tpu.analysis.shardcheck import (
+            hlo_comm_bytes, lower_step_program,
+        )
+        program = lower_step_program(net._train_step_fn, *args)
+        cost = dict(program.cost)
+        comm_bytes_hlo = hlo_comm_bytes(program)
+    except Exception:  # noqa: BLE001 — cost numbers stand without the parse
+        cost = compiled_cost(net._train_step_fn, *args)
     try:
         device_kind = str(getattr(jax.devices()[0], "device_kind",
                                   jax.devices()[0].platform))
@@ -261,6 +290,7 @@ def train_step_cost(net, batch, peak: Optional[float] = None) -> dict:
         "flops_per_example": (flops / n_examples
                               if flops and n_examples else None),
         "bytes_accessed": cost.get("bytes_accessed"),
+        "comm_bytes_hlo": comm_bytes_hlo,
         "arithmetic_intensity": (
             flops / cost["bytes_accessed"]
             if flops and cost.get("bytes_accessed") else None),

@@ -1,0 +1,92 @@
+"""Fixture-coverage meta-test (ISSUE 11 satellite): every registered
+analyzer rule — graphcheck GC*, jaxlint JL*, shardcheck SC* — must have
+at least one KNOWN_BAD fixture that produces it and one KNOWN_GOOD
+fixture that exercises its trigger surface cleanly, all registered in
+``analysis/fixtures.py``. The standing ROADMAP gate ("graphcheck
+findings must grow with each new layer type / parallel strategy,
+fixtures in analysis/fixtures.py"), enforced instead of remembered: a
+new rule that lands fixture-less fails here, before any reviewer has to
+notice.
+
+Pure registry introspection — no program is compiled and no config is
+validated here (the self-checks in tools/*.py run the fixtures; this
+test only proves they EXIST for every rule).
+"""
+
+from deeplearning4j_tpu.analysis import fixtures
+from deeplearning4j_tpu.analysis.graphcheck import RULES as GC_RULES
+from deeplearning4j_tpu.analysis.jaxlint import RULES as JL_RULES
+from deeplearning4j_tpu.analysis.shardcheck import RULES as SC_RULES
+
+
+def test_every_gc_rule_has_a_known_bad_fixture():
+    covered = {rule for _, rule, _ in fixtures.KNOWN_BAD}
+    missing = set(GC_RULES) - covered
+    assert not missing, (
+        f"graphcheck rules without a KNOWN_BAD fixture: {sorted(missing)} "
+        "— add one to analysis/fixtures.py KNOWN_BAD")
+
+
+def test_every_gc_rule_has_a_known_good_fixture():
+    good_names = {name for name, _ in fixtures.KNOWN_GOOD}
+    missing = set(GC_RULES) - set(fixtures.KNOWN_GOOD_FOR)
+    assert not missing, (
+        f"graphcheck rules without a KNOWN_GOOD_FOR mapping: "
+        f"{sorted(missing)}")
+    dangling = {rule: name for rule, name in fixtures.KNOWN_GOOD_FOR.items()
+                if name not in good_names}
+    assert not dangling, (
+        f"KNOWN_GOOD_FOR names fixtures that do not exist: {dangling}")
+
+
+def test_every_jl_rule_has_a_bad_good_pair():
+    # JL000 is the meta rule (reasonless suppression) — it fires FROM
+    # the suppression machinery, not on its own fixture
+    missing = set(JL_RULES) - set(fixtures.JL_FIXTURES) - {"JL000"}
+    assert not missing, (
+        f"jaxlint rules without a (bad, good) fixture pair: "
+        f"{sorted(missing)} — add one to analysis/fixtures.py JL_FIXTURES")
+    malformed = {r for r, pair in fixtures.JL_FIXTURES.items()
+                 if len(pair) != 2 or not all(
+                     isinstance(s, str) and s.strip() for s in pair)}
+    assert not malformed, f"malformed JL fixture pairs: {sorted(malformed)}"
+
+
+def test_every_sc_rule_has_a_known_bad_fixture():
+    covered = {rule for _, rule, _ in fixtures.SC_KNOWN_BAD}
+    missing = set(SC_RULES) - covered
+    assert not missing, (
+        f"shardcheck rules without a KNOWN_BAD fixture: {sorted(missing)} "
+        "— add one to analysis/fixtures.py SC_KNOWN_BAD")
+
+
+def test_every_sc_rule_has_a_known_good_fixture():
+    good_names = {name for name, _ in fixtures.SC_KNOWN_GOOD}
+    missing = set(SC_RULES) - set(fixtures.SC_GOOD_FOR)
+    assert not missing, (
+        f"shardcheck rules without an SC_GOOD_FOR mapping: "
+        f"{sorted(missing)}")
+    dangling = {rule: name for rule, name in fixtures.SC_GOOD_FOR.items()
+                if name not in good_names}
+    assert not dangling, (
+        f"SC_GOOD_FOR names fixtures that do not exist: {dangling}")
+
+
+def test_known_bad_rules_are_registered():
+    """A fixture naming an unregistered rule id is a typo that would
+    silently never gate anything."""
+    for name, rule, _ in fixtures.KNOWN_BAD:
+        assert rule in GC_RULES, f"KNOWN_BAD {name!r} names unknown {rule}"
+    for name, rule, _ in fixtures.SC_KNOWN_BAD:
+        assert rule in SC_RULES, f"SC_KNOWN_BAD {name!r} names unknown {rule}"
+    for rule in fixtures.JL_FIXTURES:
+        assert rule in JL_RULES, f"JL_FIXTURES names unknown {rule}"
+
+
+def test_fixture_names_are_unique():
+    for family in (fixtures.KNOWN_BAD, fixtures.SC_KNOWN_BAD):
+        names = [name for name, *_ in family]
+        assert len(names) == len(set(names)), f"duplicate names: {names}"
+    for family in (fixtures.KNOWN_GOOD, fixtures.SC_KNOWN_GOOD):
+        names = [name for name, _ in family]
+        assert len(names) == len(set(names)), f"duplicate names: {names}"

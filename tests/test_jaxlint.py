@@ -282,6 +282,59 @@ def test_suppression_only_covers_its_line():
     """) == ["JL001"]
 
 
+# ------------------------------------------------- JL008 stale suppressions
+
+def test_jl008_stale_suppression_fires():
+    # nothing on the line fires JL001 — the suppression rots silently
+    assert rules_of("""
+        import jax
+        @jax.jit
+        def f(x):
+            return x + 1  # jaxlint: disable=JL001 -- was a cast once
+    """) == ["JL008"]
+
+
+def test_jl008_live_suppression_is_silent():
+    assert rules_of("""
+        import jax
+        @jax.jit
+        def f(x):
+            return float(x)  # jaxlint: disable=JL001 -- known host scalar
+    """) == []
+
+
+def test_jl008_stale_disable_all_fires():
+    assert rules_of("""
+        import jax
+        @jax.jit
+        def f(x):
+            return x + 1  # jaxlint: disable=all -- nothing to silence
+    """) == ["JL008"]
+
+
+def test_jl008_partial_stale_names_only_the_dead_id():
+    # JL001 fires and is suppressed (live); JL004 never fires (stale)
+    findings = [
+        f for f in lint_source(textwrap.dedent("""
+            import jax
+            @jax.jit
+            def f(x):
+                return float(x)  # jaxlint: disable=JL001,JL004 -- mixed
+        """), "snippet.py")]
+    assert [f.rule for f in findings] == ["JL008"]
+    assert "JL004" in findings[0].message
+    assert "JL001" not in findings[0].message
+
+
+def test_jl008_reasonless_and_stale_both_fire():
+    assert sorted(rules_of("""
+        import jax
+        @jax.jit
+        def f(x):
+            return x + 1  # jaxlint: disable=JL001
+    """)) == ["JL000", "JL008"]
+
+
 # ---------------------------------------------------------------- the gate
 
 def test_repo_source_tree_is_lint_clean():
@@ -295,4 +348,4 @@ def test_repo_source_tree_is_lint_clean():
 
 def test_rule_table_is_complete():
     assert set(RULES) == {"JL000", "JL001", "JL002", "JL003", "JL004",
-                          "JL005", "JL006", "JL007"}
+                          "JL005", "JL006", "JL007", "JL008"}

@@ -1,0 +1,361 @@
+"""shardcheck: compiled-program static analysis (ISSUE 11).
+
+Each SC rule exercised on REAL compiled steps — the dp=2 CPU-mesh
+ParallelTrainer programs at off/zero1/zero2 x fp32/bf16, donation
+on/off, plus the synthetic KNOWN_BAD programs — and the CLI self-check.
+Programs are expensive (one XLA compile each), so everything routes
+through ``analysis/fixtures._sc_trainer_program``'s per-process cache.
+"""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis import fixtures
+from deeplearning4j_tpu.analysis.findings import Severity
+from deeplearning4j_tpu.analysis.shardcheck import (
+    RULES, check_step_program, hlo_comm_bytes, parse_hlo_module,
+)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def significant(findings):
+    return [f for f in findings if f.severity != Severity.INFO]
+
+
+def check_fixture(maker, **overrides):
+    program, ctx = maker()
+    ctx = dict(ctx)
+    ctx.update(overrides)
+    return program, check_step_program(program, **ctx)
+
+
+# ------------------------------------------------------------- the parser
+
+def test_parser_reads_real_zero1_program():
+    program, ctx = fixtures._sc_trainer_program("zero1", 1)
+    mod = program.module
+    assert mod.entry, "no ENTRY computation found"
+    assert mod.alias_pairs > 0, "donated step lost its aliasing"
+    kinds = {c.kind for c in mod.collectives}
+    assert "all-gather" in kinds and "all-reduce" in kinds
+    # one param all-gather per leaf, each the (dp, chunk) full view
+    ags = [c for c in mod.collectives if c.kind == "all-gather"]
+    assert len(ags) == len(ctx["param_leaf_sizes"])
+    for ag in ags:
+        assert len(ag.full_dims) == 2 and ag.full_dims[0] == 2
+        assert ag.group_size == 2
+
+
+def test_parser_finds_while_bodies_on_the_ga_scan():
+    program, _ = fixtures._sc_trainer_program("zero2", 2)
+    assert program.module.while_bodies, "ga scan did not lower as a loop"
+
+
+def test_ring_bytes_counts_unfolded_allreduce_as_reduce_scatter():
+    program, ctx = fixtures._sc_trainer_program("zero1", 1)
+    from deeplearning4j_tpu.profiling.cost import dp_comm_bytes_per_update
+    hlo = hlo_comm_bytes(program, dp=2)
+    predicted = dp_comm_bytes_per_update(
+        sum(ctx["param_leaf_sizes"]), 2, 4, 1, "zero1")
+    assert abs(hlo - predicted) / predicted < 0.05
+
+
+# ------------------------------------------------------------------ SC001
+
+def test_sc001_real_zero1_and_zero2_steps_are_clean():
+    for wus in ("zero1", "zero2"):
+        _, findings = check_fixture(
+            lambda w=wus: fixtures._sc_trainer_program(w, 1))
+        assert "SC001" not in rules_of(findings), findings
+
+
+def test_sc001_fires_on_full_size_allreduce_update():
+    _, findings = check_fixture(fixtures.sc_bad_full_allreduce)
+    assert "SC001" in rules_of(findings)
+    f = next(f for f in findings if f.rule == "SC001")
+    assert f.severity == Severity.ERROR
+    assert "full size" in f.message
+
+
+def test_sc001_does_not_apply_to_replicated_mode():
+    # off mode all-reduces at full size BY DESIGN — SC001 must not fire
+    _, findings = check_fixture(
+        lambda: fixtures._sc_trainer_program("off", 1))
+    assert "SC001" not in rules_of(findings)
+
+
+# ------------------------------------------------------------------ SC002
+
+def test_sc002_census_reports_the_collective_mix():
+    _, findings = check_fixture(
+        lambda: fixtures._sc_trainer_program("zero1", 1))
+    census = [f for f in findings if f.rule == "SC002"]
+    assert len(census) == 1 and census[0].severity == Severity.INFO
+    assert "all-gather" in census[0].message
+    assert "rs-form" in census[0].message
+
+
+def test_sc002_warns_on_extra_param_gathers():
+    _, findings = check_fixture(fixtures.sc_bad_double_gather)
+    warn = [f for f in findings
+            if f.rule == "SC002" and f.severity == Severity.WARNING]
+    assert warn, findings
+    assert "param leaves" in warn[0].message
+
+
+# ------------------------------------------------------------------ SC003
+
+def test_sc003_real_ga_scan_keeps_the_anchor():
+    program, findings = check_fixture(
+        lambda: fixtures._sc_trainer_program("zero2", 2))
+    assert "SC003" not in rules_of(findings), findings
+    # no WEIGHT re-gather in the body; per-microbatch all-reduces (the
+    # gradient/loss reductions of the (k+1) comm model) are legitimate
+    assert not any(c.in_loop_body and c.kind == "all-gather"
+                   for c in program.module.collectives)
+
+
+def test_sc003_fires_on_in_body_weight_gather():
+    _, findings = check_fixture(fixtures.sc_bad_scan_body_gather)
+    f = next(f for f in findings if f.rule == "SC003")
+    assert f.severity == Severity.ERROR
+    assert "MICROBATCH" in f.message
+
+
+def test_sc003_not_checked_outside_the_ga_scan_contract():
+    # same bad program, but declared accum=1: the in-body collective is
+    # not the ga-scan hazard (scan-of-steps windows legitimately
+    # collect per step) — default gating skips it
+    program, ctx = fixtures.sc_bad_scan_body_gather()
+    ctx = dict(ctx)
+    ctx["gradient_accumulation"] = 1
+    findings = check_step_program(program, **ctx)
+    assert "SC003" not in rules_of(findings)
+
+
+# ------------------------------------------------------------------ SC004
+
+def test_sc004_real_bf16_step_is_clean_and_actually_half():
+    program, findings = check_fixture(
+        lambda: fixtures._sc_trainer_program("zero2", 1, "bf16"))
+    assert "SC004" not in rules_of(findings), findings
+    assert any(dt == "bf16" for dt in program.dot_dtypes())
+    # masters cross the boundary fp32: no half dtype in params/opt results
+    for info, dt in program.result_dtypes():
+        if info.startswith("[0]") or info.startswith("[1]"):
+            assert dt not in ("bf16", "f16"), (info, dt)
+
+
+def test_sc004_fires_when_bf16_casts_gated_out():
+    _, findings = check_fixture(fixtures.sc_bad_bf16_gated_out)
+    f = next(f for f in findings if f.rule == "SC004")
+    assert "no" in f.message and "bf16" in f.message
+
+
+def test_sc004_fires_on_half_precision_masters():
+    _, findings = check_fixture(fixtures.sc_bad_half_masters)
+    msgs = [f.message for f in findings if f.rule == "SC004"]
+    assert any("master" in m for m in msgs), findings
+
+
+def test_sc004_fp32_preset_is_convert_op_identical():
+    _, findings = check_fixture(fixtures.sc_good_fp32_preset_identity)
+    assert significant(findings) == [], findings
+
+
+def test_sc004_fires_when_fp32_program_differs_from_baseline():
+    # fp32-claimed program compared against the bf16 program's baseline:
+    # the convert multiset differs and the identity check must fail
+    program, ctx = fixtures._sc_trainer_program("zero2", 1, "bf16")
+    baseline, _ = fixtures._sc_trainer_program("zero2", 1, None)
+    findings = check_step_program(
+        program, baseline=baseline, precision="fp32",
+        weight_update_sharding="zero2", dp=2,
+        expect_donation=True,
+        param_leaf_sizes=ctx["param_leaf_sizes"])
+    f = next(f for f in findings if f.rule == "SC004")
+    assert "convert-op-identical" in f.message.lower() \
+        or "NOT convert-op-identical" in f.message
+
+
+# ------------------------------------------------------------------ SC005
+
+def test_sc005_real_donated_steps_alias():
+    for wus in ("off", "zero1", "zero2"):
+        program, findings = check_fixture(
+            lambda w=wus: fixtures._sc_trainer_program(w, 1))
+        assert "SC005" not in rules_of(findings)
+        assert program.donation_requested and program.donation_landed
+
+
+def test_sc005_fires_without_donate_argnums():
+    _, findings = check_fixture(fixtures.sc_bad_donation_missing)
+    f = next(f for f in findings if f.rule == "SC005")
+    assert "donate_argnums" in f.message
+
+
+def test_sc005_trainer_donation_off_is_a_choice_not_a_defect():
+    # donate_params=False threads expect_donation=False through the
+    # context: the trainer declared no donation, so SC005 stays silent
+    program, ctx = fixtures._sc_trainer_program("zero1", 1, None, False)
+    assert ctx["expect_donation"] is False
+    findings = check_step_program(program, **ctx)
+    assert "SC005" not in rules_of(findings)
+    # but CLAIMING donation over the same program fires
+    ctx = dict(ctx)
+    ctx["expect_donation"] = True
+    assert "SC005" in rules_of(check_step_program(program, **ctx))
+
+
+# ------------------------------------------------------------------ SC006
+
+def test_sc006_fires_on_host_callback():
+    _, findings = check_fixture(fixtures.sc_bad_host_callback)
+    f = next(f for f in findings if f.rule == "SC006")
+    assert "host" in f.message.lower()
+
+
+def test_sc006_real_steps_have_no_host_transfer():
+    for wus, accum in (("off", 1), ("zero2", 2)):
+        _, findings = check_fixture(
+            lambda w=wus, k=accum: fixtures._sc_trainer_program(w, k))
+        assert "SC006" not in rules_of(findings)
+
+
+# ------------------------------------------------------------------ SC007
+
+def test_sc007_zero1_calibration_within_tolerance():
+    _, findings = check_fixture(
+        lambda: fixtures._sc_trainer_program("zero1", 1))
+    f = next(f for f in findings if f.rule == "SC007")
+    assert f.severity == Severity.INFO
+    assert "+0%" in f.message or "-0%" in f.message
+
+
+def test_sc007_fires_on_model_mismatch():
+    _, findings = check_fixture(fixtures.sc_bad_comm_model_mismatch)
+    f = next(f for f in findings if f.rule == "SC007")
+    assert f.severity == Severity.WARNING
+    assert "tolerance" in f.message
+
+
+def test_sc007_gate_skipped_on_the_ga_scan_path():
+    _, findings = check_fixture(
+        lambda: fixtures._sc_trainer_program("zero2", 2))
+    sc7 = [f for f in findings if f.rule == "SC007"]
+    assert sc7 and all(f.severity == Severity.INFO for f in sc7)
+    assert "gate skipped" in sc7[0].message
+
+
+# ------------------------------------------------- container/trainer hooks
+
+def _small_batch(rng_seed=0, n=8):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    rng = np.random.default_rng(rng_seed)
+    return DataSet(rng.normal(size=(n, 16)).astype(np.float32),
+                   np.eye(4, dtype=np.float32)[rng.integers(0, 4, n)])
+
+
+def test_net_shardcheck_multilayer_clean():
+    net = fixtures._sc_net()
+    findings = net.shardcheck(_small_batch())
+    assert significant(findings) == [], findings
+
+
+def test_net_shardcheck_computation_graph_clean():
+    from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater("adam", learning_rate=1e-3).weight_init("xavier")
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(16))
+            .add_layer("h", DenseLayer(n_out=8, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=4, activation="softmax",
+                                          loss="mcxent"), "h")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf)
+    net.init()
+    findings = net.shardcheck(_small_batch())
+    assert significant(findings) == [], findings
+
+
+def test_parallel_wrapper_shardcheck_clean():
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+    wrapper = ParallelWrapper(fixtures._sc_net(), workers=2,
+                              mesh=fixtures._sc_mesh())
+    findings = wrapper.shardcheck(_small_batch())
+    assert significant(findings) == [], findings
+
+
+def test_early_stopping_trainer_delegates_shardcheck():
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.earlystopping.config import (
+        EarlyStoppingConfiguration,
+    )
+    from deeplearning4j_tpu.earlystopping.parallel_trainer import (
+        EarlyStoppingParallelTrainer,
+    )
+    est = EarlyStoppingParallelTrainer(
+        EarlyStoppingConfiguration(),
+        fixtures._sc_net(), ListDataSetIterator([_small_batch()]),
+        mesh=fixtures._sc_mesh(), weight_update_sharding="zero1")
+    findings = est.shardcheck(_small_batch())
+    assert significant(findings) == [], findings
+    assert "SC002" in rules_of(findings)  # the census proves dp ran
+
+
+def test_cost_analysis_carries_comm_bytes_hlo():
+    net = fixtures._sc_net()
+    cost = net.cost_analysis(_small_batch())
+    # single-device program: no collectives, and the field says so
+    assert cost["comm_bytes_hlo"] == 0
+
+
+# ------------------------------------------------------------------- CLI
+
+def _cli():
+    import importlib.util
+    path = Path(__file__).resolve().parents[1] / "tools" / "shardcheck.py"
+    spec = importlib.util.spec_from_file_location("shardcheck_cli", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cli_self_check_passes():
+    assert _cli().self_check() == 0
+
+
+def test_cli_contracts_pass():
+    assert _cli().contracts() == 0
+
+
+def test_cli_file_mode(tmp_path):
+    program, _ = fixtures._sc_trainer_program("zero1", 1)
+    dump = tmp_path / "step.hlo"
+    dump.write_text(program.hlo)
+    # clean under the true claim...
+    assert _cli().main([str(dump), "--wus", "zero1", "--dp", "2"]) == 0
+    # ...and the zero-mode claim is refuted on an off-mode program
+    program_off, _ = fixtures._sc_trainer_program("off", 1)
+    dump.write_text(program_off.hlo)
+    assert _cli().main([str(dump), "--wus", "zero1", "--dp", "2"]) == 1
+
+
+def test_rule_table_is_complete():
+    assert set(RULES) == {"SC001", "SC002", "SC003", "SC004", "SC005",
+                          "SC006", "SC007"}
+
+
+def test_parse_hlo_module_tolerates_garbage():
+    mod = parse_hlo_module("not hlo at all\n\njust text")
+    assert mod.collectives == [] and mod.alias_pairs == 0

@@ -12,9 +12,10 @@ File mode loads a serialized ``MultiLayerConfiguration`` or
 1 when any ERROR finding is present. ``--memory`` additionally prints
 the MemoryReport (parameter counts + HBM/VMEM estimate).
 
-``--self-check`` validates the analyzer itself: the five known-bad
-fixture configs must each produce their named finding and the seed model
-families (MLP, CNN, RNN, graph merge) must validate clean — the CI gate
+``--self-check`` validates the analyzer itself: every known-bad fixture
+config (one or more per GC rule — coverage enforced by
+tests/test_fixture_coverage.py) must produce its named finding and the
+known-good model families must validate clean — the CI gate
 tools/run_checks.sh runs.
 """
 

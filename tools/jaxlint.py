@@ -29,48 +29,15 @@ from deeplearning4j_tpu.analysis.jaxlint import (  # noqa: E402
     RULES, RULE_SEVERITY, lint_paths, lint_source,
 )
 
-# --self-check fixtures: rule -> (bad snippet firing exactly it,
-#                                 good twin staying clean)
-_FIXTURES = {
-    "JL001": ("import jax\n@jax.jit\ndef f(x):\n    return float(x)\n",
-              "import jax\n@jax.jit\ndef f(x):\n"
-              "    return x.astype('float32')\n"),
-    "JL002": ("import jax, jax.numpy as jnp\n@jax.jit\ndef f(x):\n"
-              "    if jnp.any(x > 0):\n        return x\n    return -x\n",
-              "import jax, jax.numpy as jnp\n@jax.jit\ndef f(x):\n"
-              "    return jnp.where(x > 0, x, -x)\n"),
-    "JL003": ("import jax, numpy as np\n@jax.jit\ndef f(x):\n"
-              "    return np.asarray(x)\n",
-              "import jax, jax.numpy as jnp\n@jax.jit\ndef f(x):\n"
-              "    return jnp.asarray(x)\n"),
-    "JL004": ("import jax, jax.numpy as jnp\n@jax.jit\ndef f(h, W):\n"
-              "    for _ in range(64):\n        h = jnp.tanh(h @ W)\n"
-              "    return h\n",
-              "import jax, jax.numpy as jnp\n@jax.jit\ndef f(h, W):\n"
-              "    return jax.lax.fori_loop(\n"
-              "        0, 64, lambda i, a: jnp.tanh(a @ W), h)\n"),
-    "JL005": ("import jax, numpy as np\n@jax.jit\ndef f(x):\n"
-              "    return x + np.random.normal()\n",
-              "import jax\n@jax.jit\ndef f(x, key):\n"
-              "    return x + jax.random.normal(key, x.shape)\n"),
-    "JL006": ("import jax\ndef train_step(p, g):\n    return p - g\n"
-              "fn = jax.jit(train_step)\n",
-              "import jax\ndef train_step(p, g):\n    return p - g\n"
-              "fn = jax.jit(train_step, donate_argnums=(0,))\n"),
-    "JL007": ("import jax, time\n@jax.jit\ndef f(x):\n"
-              "    t0 = time.perf_counter()\n    return x * t0\n",
-              "import jax, time\ndef host_fit(step, x):\n"
-              "    t0 = time.perf_counter()\n"
-              "    jax.block_until_ready(step(x))\n"
-              "    return time.perf_counter() - t0\n"),
-}
-
 
 def self_check() -> int:
     """Every rule's bad fixture fires exactly that rule; every good
-    twin is clean. Nonzero exit on any drift."""
+    twin is clean. Nonzero exit on any drift. Fixtures live in
+    ``analysis/fixtures.py`` (``JL_FIXTURES``) next to the graphcheck
+    and shardcheck families, under the same coverage meta-test."""
+    from deeplearning4j_tpu.analysis.fixtures import JL_FIXTURES
     failures = []
-    for rule, (bad, good) in sorted(_FIXTURES.items()):
+    for rule, (bad, good) in sorted(JL_FIXTURES.items()):
         got = [f.rule for f in lint_source(bad, f"<{rule}-bad>")]
         if got != [rule]:
             failures.append(f"{rule}: bad fixture fired {got or 'nothing'}, "
@@ -78,7 +45,7 @@ def self_check() -> int:
         got = [f.rule for f in lint_source(good, f"<{rule}-good>")]
         if got:
             failures.append(f"{rule}: good fixture fired {got}")
-    missing = set(RULES) - set(_FIXTURES) - {"JL000"}  # JL000 = meta rule
+    missing = set(RULES) - set(JL_FIXTURES) - {"JL000"}  # JL000 = meta rule
     if missing:
         failures.append(f"rules without fixtures: {sorted(missing)}")
     if failures:
@@ -86,7 +53,7 @@ def self_check() -> int:
         for f in failures:
             print("  " + f)
         return 1
-    print(f"jaxlint --self-check: {len(_FIXTURES)} rule fixtures OK")
+    print(f"jaxlint --self-check: {len(JL_FIXTURES)} rule fixtures OK")
     return 0
 
 

@@ -1,40 +1,39 @@
 #!/usr/bin/env bash
-# The repo's check entrypoint: lint gate + analyzer self-check + tier-1
-# tests. Exits nonzero on ANY failure. This is what a PR must pass.
+# The repo's check entrypoint: lint gates + analyzer self-checks + the
+# shardcheck compiled-program contracts + smoke gates + tier-1 tests.
+# Exits nonzero on ANY failure. This is what a PR must pass.
 #
-#   tools/run_checks.sh            # everything (tests take ~10 min)
+#   tools/run_checks.sh            # everything (tests take ~20 min)
 #   tools/run_checks.sh --fast     # static checks only (seconds)
+#
+# Every stage is timed and the run ends with a summary table
+# (stage -> pass/fail -> seconds) so the slowest gates stay visible and
+# check-time regressions get noticed.
 
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 fail=0
+declare -a ST_NAME=() ST_RC=() ST_SEC=()
 
-echo "== jaxlint (deeplearning4j_tpu) =="
-python tools/jaxlint.py deeplearning4j_tpu || fail=1
+stage() {
+    local name="$1"; shift
+    echo "== $name =="
+    local t0=$SECONDS
+    "$@"
+    local rc=$?
+    ST_NAME+=("$name"); ST_RC+=("$rc"); ST_SEC+=($((SECONDS - t0)))
+    [ "$rc" -ne 0 ] && fail=1
+    return 0
+}
 
-echo "== jaxlint --self-check =="
-python tools/jaxlint.py --self-check || fail=1
-
-echo "== graphcheck --self-check =="
-JAX_PLATFORMS=cpu python tools/graphcheck.py --self-check || fail=1
-
-if [ "${1:-}" != "--fast" ]; then
-    echo "== profiling smoke (trace export + metrics + cost analysis) =="
-    JAX_PLATFORMS=cpu python tools/profiling_smoke.py || fail=1
-
-    echo "== chaos smoke (NaN injection under skip_batch + resume) =="
-    JAX_PLATFORMS=cpu python tools/chaos_smoke.py || fail=1
-
-    echo "== serve smoke (burst shed + /readyz drain flip + clean drain + batching) =="
-    JAX_PLATFORMS=cpu python tools/serve_smoke.py || fail=1
-
-    echo "== serve+input bench smoke (batching + input-pipeline rungs, CPU) =="
+bench_smoke() {
     rm -f /tmp/_bench_smoke.jsonl
     JAX_PLATFORMS=cpu BENCH_SMOKE=1 BENCH_RUNGS=input,serve BENCH_CHILD=1 \
-        python bench.py | tee /tmp/_bench_smoke.jsonl || fail=1
-    # every rung record must carry the ISSUE-10 precision fields
-    python - <<'PY' || fail=1
+        python bench.py | tee /tmp/_bench_smoke.jsonl || return 1
+    # every successful rung record must carry the ISSUE-10 precision
+    # fields and the ISSUE-11 comm_bytes_hlo calibration field
+    python - <<'PY'
 import json
 recs = []
 for line in open("/tmp/_bench_smoke.jsonl"):
@@ -42,38 +41,65 @@ for line in open("/tmp/_bench_smoke.jsonl"):
     if line.startswith("{"):
         recs.append(json.loads(line))
 # failure/timeout records (_failure_record / _RungWatchdog) carry no
-# precision fields by design — only successful rung records must
+# schema fields by design — only successful rung records must
 recs = [r for r in recs if not r.get("failed")]
 assert recs, "bench smoke emitted no successful records"
 missing = [r.get("metric") for r in recs
            if "compute_dtype" not in r or "params_dtype" not in r]
 assert not missing, f"records missing compute_dtype/params_dtype: {missing}"
-print(f"bench precision fields: {len(recs)} records OK")
+missing = [r.get("metric") for r in recs if "comm_bytes_hlo" not in r]
+assert not missing, f"records missing comm_bytes_hlo: {missing}"
+print(f"bench record schema: {len(recs)} records OK")
 PY
+}
 
-    echo "== zero1 smoke (dp=2 bitwise loss parity + sharded updater state) =="
-    JAX_PLATFORMS=cpu python tools/zero1_smoke.py || fail=1
-
-    echo "== zero2 smoke (dp=2 bitwise parity + gradient sharding + bf16 masters) =="
-    JAX_PLATFORMS=cpu python tools/zero2_smoke.py || fail=1
-
-    echo "== input smoke (pipeline vs sync: loss parity + lower stall) =="
-    JAX_PLATFORMS=cpu python tools/input_smoke.py || fail=1
-
-    echo "== elastic smoke (kill_host -> dp=1 resume, bitwise + /api/metrics) =="
-    JAX_PLATFORMS=cpu python tools/elastic_smoke.py || fail=1
-
-    echo "== tier-1 tests (ROADMAP.md) =="
+tier1() {
     rm -f /tmp/_t1.log
     timeout -k 10 1500 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
         -m 'not slow' --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
         | tee /tmp/_t1.log
-    rc=${PIPESTATUS[0]}
+    local rc=${PIPESTATUS[0]}
     echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
         | tr -cd . | wc -c)
-    [ "$rc" -ne 0 ] && fail=1
+    return "$rc"
+}
+
+stage "jaxlint (tree)"          python tools/jaxlint.py deeplearning4j_tpu
+stage "jaxlint --self-check"    python tools/jaxlint.py --self-check
+stage "graphcheck --self-check" env JAX_PLATFORMS=cpu \
+    python tools/graphcheck.py --self-check
+
+if [ "${1:-}" != "--fast" ]; then
+    # shardcheck FIRST: the compiled-program contracts (reduce-scatter
+    # layout, ga-scan anchor, bf16 boundary, fp32 identity, donation)
+    # fail in seconds here instead of minutes in the bitwise smokes
+    stage "shardcheck --self-check" env JAX_PLATFORMS=cpu \
+        python tools/shardcheck.py --self-check
+    stage "shardcheck --contracts"  env JAX_PLATFORMS=cpu \
+        python tools/shardcheck.py --contracts
+
+    stage "profiling smoke"  env JAX_PLATFORMS=cpu python tools/profiling_smoke.py
+    stage "chaos smoke"      env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+    stage "serve smoke"      env JAX_PLATFORMS=cpu python tools/serve_smoke.py
+    stage "bench smoke (input+serve rungs)" bench_smoke
+    stage "zero1 smoke"      env JAX_PLATFORMS=cpu python tools/zero1_smoke.py
+    stage "zero2 smoke"      env JAX_PLATFORMS=cpu python tools/zero2_smoke.py
+    stage "input smoke"      env JAX_PLATFORMS=cpu python tools/input_smoke.py
+    stage "elastic smoke"    env JAX_PLATFORMS=cpu python tools/elastic_smoke.py
+    stage "tier-1 tests"     tier1
 fi
+
+echo
+echo "== run_checks summary =="
+printf '%-40s %-6s %8s\n' "stage" "result" "seconds"
+total=0
+for i in "${!ST_NAME[@]}"; do
+    res=PASS; [ "${ST_RC[$i]}" -ne 0 ] && res=FAIL
+    printf '%-40s %-6s %8s\n' "${ST_NAME[$i]}" "$res" "${ST_SEC[$i]}"
+    total=$((total + ST_SEC[i]))
+done
+printf '%-40s %-6s %8s\n' "total" "" "$total"
 
 if [ "$fail" -eq 0 ]; then
     echo "run_checks: ALL CHECKS PASSED"

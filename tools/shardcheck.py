@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""shardcheck CLI: static analysis of COMPILED step programs.
+
+Usage:
+    python tools/shardcheck.py --self-check    # fixture gate (CI)
+    python tools/shardcheck.py --contracts     # zero1/zero2/bf16 gate
+    python tools/shardcheck.py step.hlo --wus zero1 --dp 2 \
+        [--accum K] [--param-count N] [--precision bf16]
+    python tools/shardcheck.py --list-rules
+
+``--self-check`` validates the analyzer itself against the
+compiled-program fixtures in ``analysis/fixtures.py``: every SC rule
+must fire on its KNOWN_BAD program and stay silent (nothing above INFO)
+on every KNOWN_GOOD program.
+
+``--contracts`` statically re-proves the compiled-program contracts the
+bitwise smoke gates (zero1_smoke / zero2_smoke) then verify at runtime
+— on CPU, in seconds, with no training step executed:
+
+  1. zero1 and zero2 accum=1 steps carry a reduce-scatter(-form)
+     gradient reduction + one param all-gather per leaf and NO
+     full-size gradient all-reduce on the update path (SC001/SC002);
+  2. the gradient-accumulation scan body keeps its per-microbatch
+     replicated anchor — no collective inside the while body (SC003);
+  3. the bf16 policy computes dots in bf16 while masters/loss cross the
+     step boundary in fp32 (SC004);
+  4. the fp32 preset is convert-op-identical to the pre-policy program
+     (SC004);
+  5. donation aliases are present in every compiled step (SC005);
+  6. the HLO-vs-cost-model comm-bytes delta is within tolerance
+     (SC007).
+
+File mode parses a saved ``compiled.as_text()`` dump (no jax needed for
+the parse; the declared layout comes from the flags) — useful for
+analyzing a program captured on a TPU host from a dev box.
+
+Wired into ``tools/run_checks.sh`` BEFORE the bitwise smokes: a
+contract violation fails in seconds instead of minutes.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the fixture/contract programs lower on a dp=2 CPU mesh
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
+from deeplearning4j_tpu.analysis.findings import (  # noqa: E402
+    Severity, format_findings, has_errors,
+)
+from deeplearning4j_tpu.analysis.shardcheck import (  # noqa: E402
+    RULES, RULE_SEVERITY, StepProgram, check_step_program,
+)
+
+
+def _significant(findings):
+    """Findings above INFO — the self-check/contract 'dirty' bar."""
+    return [f for f in findings if f.severity != Severity.INFO]
+
+
+def self_check() -> int:
+    from deeplearning4j_tpu.analysis.fixtures import (
+        SC_KNOWN_BAD, SC_KNOWN_GOOD,
+    )
+    ok = True
+    for name, rule, make in SC_KNOWN_BAD:
+        t0 = time.perf_counter()
+        program, kwargs = make()
+        rules = {f.rule for f in check_step_program(program, **kwargs)}
+        dt = time.perf_counter() - t0
+        if rule in rules:
+            print(f"  known-bad  {name:<24} fired {rule} ({dt:.1f}s, ok)")
+        else:
+            ok = False
+            print(f"  known-bad  {name:<24} FAILED: wanted {rule}, "
+                  f"got {sorted(rules) or 'no findings'}")
+    for name, make in SC_KNOWN_GOOD:
+        t0 = time.perf_counter()
+        program, kwargs = make()
+        bad = _significant(check_step_program(program, **kwargs))
+        dt = time.perf_counter() - t0
+        if bad:
+            ok = False
+            print(f"  known-good {name:<24} FAILED: unexpected findings")
+            for f in bad:
+                print(f"    {f}")
+        else:
+            print(f"  known-good {name:<24} clean ({dt:.1f}s, ok)")
+    print("shardcheck self-check:", "PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+def contracts() -> int:
+    """Statically re-prove the zero1/zero2/bf16/donation program
+    contracts on the REAL ParallelTrainer steps (dp=2 CPU mesh)."""
+    from deeplearning4j_tpu.analysis.fixtures import _sc_trainer_program
+    t_total = time.perf_counter()
+    failures = []
+
+    def gate(label, check):
+        t0 = time.perf_counter()
+        try:
+            problems = check()
+        except Exception as e:  # noqa: BLE001 — a crash IS a gate failure
+            problems = [f"crashed: {e!r}"]
+        dt = time.perf_counter() - t0
+        status = "PASS" if not problems else "FAIL"
+        print(f"  {label:<52} {status}  ({dt:4.1f}s)")
+        for p in problems:
+            print(f"      {p}")
+            failures.append(f"{label}: {p}")
+
+    def sharded_update_contract(wus):
+        def check():
+            program, ctx = _sc_trainer_program(wus, 1)
+            problems = [str(f) for f in
+                        _significant(check_step_program(program, **ctx))]
+            mod = program.module
+            rs = [c for c in mod.collectives
+                  if c.kind == "reduce-scatter" or c.reduce_scatter_form]
+            ags = [c for c in mod.collectives if c.kind == "all-gather"]
+            n_leaves = len(ctx["param_leaf_sizes"])
+            if len(rs) < n_leaves:
+                problems.append(
+                    f"expected >= {n_leaves} reduce-scatter(-form) "
+                    f"gradient reductions, found {len(rs)}")
+            if len(ags) != n_leaves:
+                problems.append(
+                    f"expected exactly {n_leaves} param all-gathers, "
+                    f"found {len(ags)}")
+            if not program.donation_landed:
+                problems.append("no input_output_alias in the compiled "
+                                "step (donation dropped)")
+            return problems
+        return check
+
+    def ga_scan_contract():
+        def check():
+            program, ctx = _sc_trainer_program("zero2", 2)
+            problems = [str(f) for f in
+                        _significant(check_step_program(program, **ctx))]
+            # per-microbatch all-reduces in the body are the contract's
+            # expected traffic; WEIGHT re-gathers are the hazard
+            body_gathers = [c for c in program.module.collectives
+                            if c.in_loop_body and c.kind == "all-gather"]
+            if body_gathers:
+                problems.append(
+                    f"{len(body_gathers)} all-gather(s) inside the "
+                    "ga-scan body — the replicated anchor was lost")
+            if not program.module.while_bodies:
+                problems.append("no while loop found — the ga scan did "
+                                "not lower as a loop (contract stale?)")
+            return problems
+        return check
+
+    def bf16_contract():
+        def check():
+            program, ctx = _sc_trainer_program("zero2", 1, "bf16")
+            problems = [str(f) for f in
+                        _significant(check_step_program(program, **ctx))]
+            if not any(dt == "bf16" for dt in program.dot_dtypes()):
+                problems.append("no bf16 dot in the StableHLO — the "
+                                "policy's casts were gated out")
+            return problems
+        return check
+
+    def fp32_identity_contract():
+        def check():
+            program, ctx = _sc_trainer_program("zero1", 1, "fp32")
+            baseline, _ = _sc_trainer_program("zero1", 1, None)
+            ctx = dict(ctx)
+            ctx["baseline"] = baseline
+            return [str(f) for f in
+                    _significant(check_step_program(program, **ctx))]
+        return check
+
+    print("shardcheck contracts (dp=2 CPU mesh, no training run):")
+    gate("zero1: reduce-scatter + param gather, no full AR",
+         sharded_update_contract("zero1"))
+    gate("zero2: reduce-scatter + param gather, no full AR",
+         sharded_update_contract("zero2"))
+    gate("ga scan: replicated anchor kept (no body collective)",
+         ga_scan_contract())
+    gate("bf16: half dots, fp32 masters/loss at the boundary",
+         bf16_contract())
+    gate("fp32 preset: convert-op-identical to pre-policy",
+         fp32_identity_contract())
+    dt = time.perf_counter() - t_total
+    print(f"shardcheck contracts: "
+          f"{'PASS' if not failures else 'FAIL'} in {dt:.1f}s")
+    return 0 if not failures else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("hlo", nargs="?",
+                    help="a saved compiled-HLO text dump to analyze")
+    ap.add_argument("--stablehlo", default=None,
+                    help="the matching lowered StableHLO dump (enables "
+                         "the precision/donation-request rules)")
+    ap.add_argument("--wus", default="off",
+                    help="declared weight_update_sharding (off|zero1|zero2)")
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--accum", type=int, default=1,
+                    help="declared gradient_accumulation")
+    ap.add_argument("--param-count", type=int, default=None)
+    ap.add_argument("--precision", default=None)
+    ap.add_argument("--expect-donation", action="store_true")
+    ap.add_argument("--self-check", action="store_true",
+                    help="fixture gate: every SC rule fires on its "
+                         "KNOWN_BAD program, silent on KNOWN_GOOD")
+    ap.add_argument("--contracts", action="store_true",
+                    help="statically re-prove the zero1/zero2/bf16 "
+                         "program contracts (run by run_checks.sh)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, (slug, desc) in sorted(RULES.items()):
+            print(f"{rule}  {slug:<26} {RULE_SEVERITY[rule]:<8} {desc}")
+        return 0
+    if args.self_check:
+        return self_check()
+    if args.contracts:
+        return contracts()
+    if not args.hlo:
+        ap.error("an HLO dump (or --self-check / --contracts) is required")
+
+    with open(args.hlo, "r", encoding="utf-8") as fh:
+        hlo = fh.read()
+    stablehlo = ""
+    if args.stablehlo:
+        with open(args.stablehlo, "r", encoding="utf-8") as fh:
+            stablehlo = fh.read()
+    program = StepProgram(stablehlo=stablehlo, hlo=hlo)
+    findings = check_step_program(
+        program, weight_update_sharding=args.wus, dp=args.dp,
+        gradient_accumulation=args.accum, param_count=args.param_count,
+        precision=args.precision,
+        expect_donation=True if args.expect_donation else None)
+    if findings:
+        print(format_findings(findings, header=f"{args.hlo}:"))
+    else:
+        print(f"{args.hlo}: clean")
+    return 1 if has_errors(findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
