@@ -216,14 +216,17 @@ def _failure_record(metric: str, detail: str, open_spans, kind: str
     ``failed`` (the supervisor's headline selection skips it), the
     open/error span stack naming the phase that hung or raised, and the
     resilience counters (retries/rollbacks/skipped batches/injected
-    faults) so the record carries the run's fault history next to its
-    diagnosis."""
+    faults — plus the ``elastic_*`` family: resizes, elections,
+    scale-ups, fences, barrier timeouts) so the record carries the
+    run's fault history next to its diagnosis."""
     from deeplearning4j_tpu.profiling.metrics import get_registry
+    reg = get_registry()
     return {"metric": metric, "value": 0.0, "unit": "samples/sec/chip",
             "vs_baseline": 0.0, "failed": True,
             "error": {"kind": kind, "detail": detail,
                       "open_spans": list(open_spans),
-                      "resilience": get_registry().snapshot("resilience_")}}
+                      "resilience": {**reg.snapshot("resilience_"),
+                                     **reg.snapshot("elastic_")}}}
 
 
 class _RungWatchdog:

@@ -213,13 +213,15 @@ def bad_elastic_indivisible():
                   "elastic_resize_widths": [3, 2, 1]}
 
 
-def bad_elastic_grow():
-    """A planned 'surviving' width of 8 on a dp=4 mesh: an elastic
-    resize only shrinks (hosts are lost, not gained) — the plan is
-    nonsense and must be rejected statically."""
+def bad_elastic_grow_indivisible():
+    """A scale-up plan to dp=6 on a dp=4 mesh whose global batch of 32
+    cannot split 6 ways: the rejoin admission the plan claims to
+    support would raise ``ElasticError`` at the post-grow resume —
+    rejected statically (grown widths are legal since ISSUE 12; this
+    one just doesn't divide the batch)."""
     conf, _ = good_mlp()
     return conf, {"mesh": {"dp": 4}, "batch_size": 32,
-                  "elastic_resize_widths": [8]}
+                  "elastic_resize_widths": [6]}
 
 
 def bad_duplicate_name():
@@ -327,7 +329,7 @@ KNOWN_BAD: List[Tuple[str, str, Callable]] = [
     ("precision-non-float", "GC015", bad_fp16_bad_dtype),
     ("dp-unsharded-iterator", "GC013", bad_dp_unsharded_iterator),
     ("elastic-resize-indivisible", "GC014", bad_elastic_indivisible),
-    ("elastic-resize-grows", "GC014", bad_elastic_grow),
+    ("elastic-grow-indivisible", "GC014", bad_elastic_grow_indivisible),
 ]
 
 
@@ -442,14 +444,16 @@ def good_mlp_pipeline():
 
 
 def good_mlp_elastic():
-    """A dp=4 zero1 fleet with a legal survival plan: batch 64 divides
-    every planned surviving width (2 and the sole-survivor dp=1, where
-    zero1 degrades to the replicated layout) and the large layers keep
-    re-evaluated padding negligible — must validate clean."""
+    """A dp=4 zero1 fleet with a legal resize plan in BOTH directions:
+    batch 64 divides every planned shrink width (2 and the
+    sole-survivor dp=1, where zero1 degrades to the replicated layout)
+    AND the scale-up width 8 a rejoining replacement would grow the
+    mesh to, and the large layers keep re-evaluated padding negligible
+    at every width — must validate clean."""
     conf, _ = good_mlp()
     return conf, {"mesh": {"dp": 4}, "batch_size": 64,
                   "weight_update_sharding": "zero1",
-                  "elastic_resize_widths": [2, 1]}
+                  "elastic_resize_widths": [8, 2, 1]}
 
 
 def good_moe_ep():

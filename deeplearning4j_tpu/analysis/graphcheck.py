@@ -39,14 +39,17 @@ Rules (stable ids; severities in parentheses):
                                     NamedSharding layout — every batch
                                     lands replicated and is resharded
                                     inside the step
-- GC014 elastic-resize    (error)   a planned surviving dp width (the
-                                    mesh an elastic resize would leave
-                                    after host loss) cannot split the
-                                    global batch, or is not a possible
-                                    surviving width; (warning) zero1
-                                    pad-to-divisible waste re-evaluated
-                                    at the surviving width exceeds the
-                                    GC011 threshold
+- GC014 elastic-resize    (error)   a planned post-resize dp width — a
+                                    SURVIVING width after host loss OR
+                                    a GROWN width a scale-up admission
+                                    would reach (ISSUE 12) — cannot
+                                    split the global batch, or is not a
+                                    possible width (< 1, or equal to
+                                    the current dp: not a resize);
+                                    (warning) zero1 pad-to-divisible
+                                    waste re-evaluated at the
+                                    post-resize width exceeds the GC011
+                                    threshold
 - GC015 precision-policy  (error)   the policy's compute dtype is not a
                                     float dtype; (warning) half-precision
                                     compute (bf16/fp16) with no fp32
@@ -95,8 +98,9 @@ RULES: Dict[str, Tuple[str, str]] = {
     "GC012": ("vertex-arity", "vertex input count != n_inputs()"),
     "GC013": ("input-unsharded", "dp >= 2 mesh fed by a non-sharded "
                                  "iterator"),
-    "GC014": ("elastic-resize", "planned surviving width cannot split "
-                                "the batch / is impossible"),
+    "GC014": ("elastic-resize", "planned post-resize width (shrink or "
+                                "scale-up) cannot split the batch / is "
+                                "impossible"),
     "GC015": ("precision-policy", "non-float compute dtype, or half "
                                   "precision without a loss scale"),
 }
@@ -492,26 +496,32 @@ def _check_elastic(findings: List[Finding],
                    weight_update_sharding,
                    elastic_resize_widths) -> None:
     """GC014: post-resize mesh legality. ``elastic_resize_widths`` lists
-    the surviving dp widths an elastic resize could leave (e.g. [2, 1]
-    for a 4-host fleet planning for up to 3 preemptions). Each width
-    must still divide the global batch — ``ElasticTrainer`` splits the
-    SAME global batch among the survivors, so an indivisible width
-    turns a survivable host loss into a hard ``ElasticError`` at resume
-    — and under zero1 the pad-to-divisible waste is re-evaluated at the
-    new width (the GC011 economics change with the axis size)."""
+    the dp widths an elastic resize could leave: SURVIVING widths after
+    host loss (e.g. [2, 1] for a 4-host fleet planning for up to 3
+    preemptions) and — since scale-UP admission exists (ISSUE 12) —
+    GROWN widths a rejoining replacement host would reach (e.g. 8 for
+    a dp=4 fleet that may be topped back up). Each width must divide
+    the global batch — ``ElasticTrainer`` splits the SAME global batch
+    among the post-resize world, so an indivisible width turns a
+    survivable resize into a hard ``ElasticError`` at resume — and
+    under zero1/zero2 the pad-to-divisible waste is re-evaluated at
+    the new width (the GC011 economics change with the axis size)."""
     if not elastic_resize_widths:
         return
     dp = _dp_size(axes)
     zero1 = _wus_mode(weight_update_sharding) in SHARDED_WUS_MODES
     for w in elastic_resize_widths:
         w = int(w)
-        if w < 1 or (dp and w >= dp):
+        if w < 1 or (dp and w == dp):
             findings.append(Finding(
                 "GC014", Severity.ERROR, f"resize dp={w}",
-                f"{w} is not a possible surviving width of a dp="
-                f"{dp if dp else '<none>'} mesh — an elastic resize only "
-                "shrinks the data axis (hosts are lost, not gained)",
-                f"plan widths in [1, {dp - 1 if dp else '?'}]"))
+                f"{w} is not a possible post-resize width of a dp="
+                f"{dp if dp else '<none>'} mesh — a resize shrinks "
+                "(hosts lost) or grows (replacements admitted) the data "
+                "axis; planning the current width is a no-op entry that "
+                "usually means a typo in the plan",
+                f"plan widths in [1, {dp - 1 if dp else '?'}] for "
+                f"shrink or > {dp if dp else '?'} for scale-up"))
             continue
         if batch_size is not None and batch_size % w != 0:
             findings.append(Finding(

@@ -52,6 +52,24 @@ Chaos seams (``resilience/faultinject``): ``slow_input`` stalls the Nth
 stack names ``input:wait`` — a slow pipeline is a measurement, not a
 mystery hang); ``io_error`` raises on the Nth reader read (the retry
 policy must absorb it, counted in ``input_read_retries_total``).
+
+**Windowed shuffle (ISSUE 12)** — pure source order is bad for
+convergence on sorted corpora, but an unbounded shuffle is
+un-resumable. ``shuffle_window=W`` applies a deterministic bounded-
+buffer shuffle to the SHARDED source order (the buffer holds at most
+``W`` sources, and no source is emitted more than ``W - 1`` positions
+early), seeded by
+``shuffle_seed`` and the epoch counter: the emission order is a pure
+function of ``(seed, epoch, shard)``, never of decode timing. That
+purity is what makes shuffled input **cursor-resumable**:
+``cursor_state()`` captures ``{seed, window, epoch, emitted}``, and a
+fresh pipeline with ``restore_cursor(state)`` replays the exact same
+emission order and silently skips the already-consumed prefix — the
+resumed tail is bitwise the unbroken run's (``tools/input_smoke.py``
+gates this), with no batch dropped, doubled, or re-randomized.
+Trainers that persist a ``TrainingCursor`` record the pipeline's
+``shuffle_signature()`` next to their data position, so a resume
+against a differently-shuffled pipeline is rejected up front.
 """
 
 from __future__ import annotations
@@ -76,6 +94,7 @@ from deeplearning4j_tpu.profiling.tracer import get_tracer
 
 __all__ = [
     "StreamingInputPipeline", "IdxPair", "shard_sources", "read_idx",
+    "windowed_shuffle_order",
 ]
 
 logger = logging.getLogger(__name__)
@@ -112,6 +131,34 @@ def shard_sources(sources: Sequence, num_shards: Optional[int] = None,
             len(sources), num_shards, -(-len(sources) // num_shards),
             len(sources) // num_shards)
     return sources[shard_index::num_shards]
+
+
+# ---------------------------------------------------------------------------
+# windowed shuffle (bounded, deterministic, resumable)
+# ---------------------------------------------------------------------------
+
+def windowed_shuffle_order(n: int, window: int, rng) -> List[int]:
+    """Deterministic bounded-buffer shuffle of ``range(n)``: stream the
+    indices through a buffer of at most ``window`` entries, emitting a
+    random buffer member each time the buffer fills (then draining it).
+    The buffer bound is what makes the shuffle streamable: no element
+    is emitted more than ``window - 1`` positions EARLY (it cannot
+    enter the buffer before its source position), so readers never need
+    to run further than ``window`` ahead of emission. The output is a
+    pure function of ``(n, window, rng state)``: replaying with the
+    same seeded ``rng`` reproduces the order exactly (the resumability
+    contract). ``window <= 1`` is the identity (shuffle off)."""
+    if window <= 1 or n <= 1:
+        return list(range(n))
+    order: List[int] = []
+    buf: List[int] = []
+    for i in range(n):
+        buf.append(i)
+        if len(buf) >= min(window, n):
+            order.append(buf.pop(int(rng.integers(len(buf)))))
+    while buf:
+        order.append(buf.pop(int(rng.integers(len(buf)))))
+    return order
 
 
 # ---------------------------------------------------------------------------
@@ -255,6 +302,13 @@ class StreamingInputPipeline(DataSetIterator):
     The emitted batch ORDER is the sharded source order — a fit through
     the pipeline is trajectory-identical to the same batches through a
     sync iterator (``tools/input_smoke.py`` gates this).
+    ``shuffle_window=W > 1`` replaces source order with a deterministic
+    windowed shuffle of it (seeded by ``shuffle_seed`` + the epoch
+    counter; a ``W``-entry buffer, so no source is emitted more than
+    ``W - 1`` early) that stays cursor-resumable: ``cursor_state()`` /
+    ``restore_cursor()`` replay the exact emission order across a
+    crash or elastic resize, consumed prefix skipped — see the module
+    docstring.
     """
 
     def __init__(self, sources: Sequence, *,
@@ -268,7 +322,8 @@ class StreamingInputPipeline(DataSetIterator):
                  place: bool = True,
                  read_retries: int = 3, retry_base_s: float = 0.05,
                  retry_max_s: float = 1.0, cache_dir: Optional[str] = None,
-                 reorder_window: Optional[int] = None):
+                 reorder_window: Optional[int] = None,
+                 shuffle_window: int = 0, shuffle_seed: int = 0):
         if (num_shards is None) != (shard_index is None):
             raise ValueError("pass num_shards and shard_index together "
                              "(or neither, for the multihost defaults)")
@@ -295,6 +350,8 @@ class StreamingInputPipeline(DataSetIterator):
                            else self._readers + self._decoders
                            + self._queue_size)
         self._rng = random.Random(0x1D4)
+        self._shuffle_window = max(0, int(shuffle_window))
+        self._shuffle_seed = int(shuffle_seed)
         for src in self._all_sources:
             self._check_source(src)
         self.stall_s = 0.0          # consumer time blocked in next()
@@ -304,6 +361,17 @@ class StreamingInputPipeline(DataSetIterator):
         self._peek = None
         self._done = False
         self._closed = False
+        # shuffle epoch/position bookkeeping (the resumable-RNG cursor):
+        # _epochs_started seeds the NEXT generation's shuffle order;
+        # _gen_epoch/_gen_emitted describe the current one; _resume_skip
+        # is the restored cursor's already-consumed prefix, drained
+        # silently on the next start
+        self._epochs_started = 0
+        self._gen_epoch = 0
+        self._gen_emitted = 0
+        self._resume_skip = 0
+        self._skip_left = 0
+        self._closed_state: Optional[dict] = None
 
     # ------------------------------------------------------------- contract
     @property
@@ -329,6 +397,61 @@ class StreamingInputPipeline(DataSetIterator):
             self._dtype = dtype
         if place is not None:
             self._place = place
+        return self
+
+    # ----------------------------------------------------- shuffle cursor
+    def shuffle_signature(self) -> Optional[dict]:
+        """The shuffle identity a resumable trainer records next to its
+        data position (``TrainingCursor.extra["input"]``): resuming
+        against a pipeline with a DIFFERENT signature would replay the
+        cursor tail over a re-randomized order, so trainers reject the
+        mismatch up front. None when shuffling is off."""
+        if self._shuffle_window <= 1:
+            return None
+        return {"kind": "windowed_shuffle", "seed": self._shuffle_seed,
+                "window": self._shuffle_window}
+
+    def cursor_state(self) -> dict:
+        """Where the shuffled stream stands: the RNG identity (seed +
+        window — the order is a pure function of them and the epoch)
+        plus the window cursor (epoch, batches emitted this epoch).
+        Hand this to a fresh pipeline's ``restore_cursor`` to resume
+        the exact emission order, consumed-prefix excluded."""
+        if self._started:
+            return {"shuffle_seed": self._shuffle_seed,
+                    "shuffle_window": self._shuffle_window,
+                    "epoch": self._gen_epoch,
+                    "emitted": self._gen_emitted + self._skip_left}
+        if self._closed_state is not None:
+            # shut down mid-epoch (close()): where consumption stood
+            return dict(self._closed_state)
+        return {"shuffle_seed": self._shuffle_seed,
+                "shuffle_window": self._shuffle_window,
+                "epoch": self._epochs_started,
+                "emitted": self._resume_skip}
+
+    def restore_cursor(self, state: dict) -> "StreamingInputPipeline":
+        """Resume a shuffled stream exactly: the next iteration replays
+        epoch ``state["epoch"]``'s emission order and silently drops
+        the first ``state["emitted"]`` batches (they were consumed
+        before the crash/resize). The pipeline must be constructed with
+        the SAME ``shuffle_seed``/``shuffle_window`` the state records
+        — anything else would re-randomize the tail, so it raises."""
+        want = {"shuffle_seed": self._shuffle_seed,
+                "shuffle_window": self._shuffle_window}
+        got = {k: state.get(k) for k in want}
+        if got != want:
+            raise ValueError(
+                f"cursor records shuffle state {got} but this pipeline "
+                f"was built with {want}: resuming would replay the "
+                "tail over a different emission order — construct the "
+                "pipeline with the recorded seed/window")
+        if self._started:
+            raise RuntimeError(
+                "restore_cursor() must run before iteration starts "
+                "(construct a fresh pipeline, restore, then iterate)")
+        self._epochs_started = int(state.get("epoch", 0))
+        self._resume_skip = max(0, int(state.get("emitted", 0)))
         return self
 
     def _check_source(self, src) -> None:
@@ -359,9 +482,41 @@ class StreamingInputPipeline(DataSetIterator):
             from deeplearning4j_tpu.parallel import multihost
             self.num_shards = multihost.process_count()
             self.shard_index = multihost.process_index()
+        shard = shard_sources(self._all_sources, self.num_shards,
+                              self.shard_index)
+        epoch = self._epochs_started
+        self._epochs_started += 1
+        self._gen_epoch = epoch
+        self._gen_emitted = 0
+        self._closed_state = None
+        skip = self._resume_skip
+        self._resume_skip = 0
+        if self._shuffle_window > 1:
+            # emission order = windowed shuffle of the SHARDED source
+            # order, a pure function of (seed, epoch) — permuting the
+            # source list up front reuses the whole in-order reorder
+            # machinery unchanged, and keeps the order independent of
+            # decode timing (the resumability contract)
+            order = windowed_shuffle_order(
+                len(shard), self._shuffle_window,
+                np.random.default_rng([self._shuffle_seed, epoch]))
+            shard = [shard[i] for i in order]
+        if skip and self._batch_size is None and all(
+                isinstance(s, (DataSet, MultiDataSet)) for s in shard):
+            # resume SEEK fast path: when every source is provably one
+            # batch (in-memory DataSets, no batch_size splitting),
+            # emission order == the (permuted) list order, so the
+            # consumed prefix is dropped by slicing — O(tail) resume
+            # instead of re-reading/decoding/staging the prefix just
+            # to discard it. Other source shapes (batch_by splits,
+            # decode_fn lists) fall back to the consumer-side drain.
+            drop = min(skip, len(shard))
+            shard = shard[drop:]
+            self._gen_emitted = drop
+            skip -= drop
+        self._skip_left = skip
         gen = self._gen = _Generation(
-            shard_sources(self._all_sources, self.num_shards,
-                          self.shard_index),
+            shard,
             self._queue_size, self._device_buffer, self._readers)
         self._threads: List[threading.Thread] = []
         for k in range(self._readers):
@@ -385,6 +540,16 @@ class StreamingInputPipeline(DataSetIterator):
     def _shutdown(self) -> None:
         if not self._started:
             return
+        # freeze the cursor BEFORE tearing the generation down:
+        # cursor_state() after close() must describe the INTERRUPTED
+        # epoch (where consumption stood), not silently roll over to
+        # the next epoch at position 0 — that would lose the epoch's
+        # unconsumed tail on resume with no error
+        self._closed_state = {"shuffle_seed": self._shuffle_seed,
+                              "shuffle_window": self._shuffle_window,
+                              "epoch": self._gen_epoch,
+                              "emitted": self._gen_emitted
+                              + self._skip_left}
         gen = self._gen
         gen.stop.set()
         with gen.ready_cv:
@@ -667,7 +832,15 @@ class StreamingInputPipeline(DataSetIterator):
             stall = faultinject.on_input_next()
             if stall > 0.0:
                 time.sleep(stall)
-            self._peek = self._gen.out_q.get()
+            item = self._gen.out_q.get()
+            # resumed-cursor replay: the already-consumed prefix of the
+            # (re-derived, identical) emission order is dropped silently
+            # so the consumer sees exactly the unconsumed tail
+            while self._skip_left > 0 and item[0] == "data":
+                self._skip_left -= 1
+                self._gen_emitted += 1
+                item = self._gen.out_q.get()
+            self._peek = item
         waited = time.perf_counter() - t0
         self.stall_s += waited
         reg.counter("input_stall_seconds_total",
@@ -696,6 +869,7 @@ class StreamingInputPipeline(DataSetIterator):
         if tag == "data":
             self._peek = None
             self.batches_emitted += 1
+            self._gen_emitted += 1
             self.samples_emitted += payload.num_examples()
             reg = self._metrics()
             reg.counter("input_batches_total",

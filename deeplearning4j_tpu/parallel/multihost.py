@@ -92,7 +92,8 @@ def initialize(coordinator: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None,
                local_device_ids=None,
-               elastic: bool = False) -> None:
+               elastic: bool = False,
+               host_service: Optional[bool] = None) -> None:
     """Bring this process into the global runtime
     (wraps jax.distributed.initialize; safe to call once per process).
 
@@ -110,11 +111,35 @@ def initialize(coordinator: Optional[str] = None,
     heartbeat files + step-barrier timeouts, which can actually react.
     Elastic mode requires explicit coordinator/num_processes/process_id
     (no TPU-pod auto-detection yet).
+
+    ``host_service`` (elastic mode only) controls whether THIS process
+    hosts the runtime's coordination service. Default (None): process 0
+    hosts it, the classic wiring — sufficient when rank 0's loss is
+    handled by restart. Pass ``host_service=False`` on every process
+    and run the service EXTERNALLY (``serve_coordination`` /
+    ``python -m deeplearning4j_tpu.parallel.multihost serve <port>
+    <n>``) for full rank-0 survivability: jaxlib's coordination client
+    polls the service for errors from a background thread, and losing
+    the service mid-poll ABORTS the surviving client process
+    (observed: ``coordination_service_agent ... Polled an error`` ->
+    ``std::bad_cast`` terminate) — no Python-level knob can catch it,
+    so the service must simply outlive every training host. An
+    external service owned by the scheduler/driver does exactly that;
+    after it, losing ANY training host — rank 0 included — is
+    detected and survived by the elastic layer's own lease/heartbeat
+    protocol.
     """
     global _initialized
     if _initialized:
         return
     _ensure_cpu_collectives()
+    if host_service is not None and not elastic:
+        raise ValueError(
+            "host_service is an elastic-mode knob (external coordination "
+            "service); without elastic=True jax.distributed.initialize "
+            "would still make process 0 host its own service and the two "
+            "would fight over the coordinator port — pass elastic=True, "
+            "or drop host_service")
     if elastic:
         if coordinator is None or num_processes is None or process_id is None:
             raise ValueError(
@@ -127,7 +152,8 @@ def initialize(coordinator: Optional[str] = None,
                 "(the direct client bootstrap does not thread device "
                 "visibility); pin devices via CUDA_VISIBLE_DEVICES / "
                 "JAX flags instead")
-        _initialize_elastic(coordinator, num_processes, process_id)
+        _initialize_elastic(coordinator, num_processes, process_id,
+                            host_service=host_service)
         _initialized = True
         return
     kwargs = {}
@@ -144,13 +170,21 @@ def initialize(coordinator: Optional[str] = None,
 
 
 def _initialize_elastic(coordinator: str, num_processes: int,
-                        process_id: int) -> None:
+                        process_id: int,
+                        host_service: Optional[bool] = None) -> None:
     """The preemption-tolerant bootstrap: same wiring as
     jax.distributed.initialize, but the client is built directly so the
     failure-handling knobs jax does not expose can be set. Process 0
-    hosts the coordination service (its loss is NOT survivable in
-    process — see ElasticTrainer docs; survivors restart at the new
-    width and resume through the cross-width checkpoint restore)."""
+    hosts the runtime's coordination service, but that service is NOT
+    the liveness authority: with the benign callback + hour-scale
+    windows below, a peer losing the service-hosting process (rank 0
+    included) keeps running — its stuck collectives are detected by the
+    elastic layer's own heartbeat files + bounded step-barrier waits,
+    and the lease-based rendezvous protocol (resilience/elastic.py)
+    elects the lowest surviving rank as the new coordinator. After a
+    restart the outer scheduler renumbers survivors, so whichever
+    process is the NEW rank 0 hosts a fresh service — the service
+    follows the lease, never the other way around."""
     from jax._src import distributed as jdist
     from jax._src import xla_bridge
     from jax._src.lib import xla_extension
@@ -161,7 +195,9 @@ def _initialize_elastic(coordinator: str, num_processes: int,
     gs = jdist.global_state
     if gs.client is not None:
         raise RuntimeError("distributed runtime already initialized")
-    if process_id == 0:
+    if host_service is None:
+        host_service = process_id == 0
+    if host_service:
         port = coordinator.rsplit(":", 1)[1]
         gs.service = xla_extension.get_distributed_runtime_service(
             f"[::]:{port}", num_processes,
@@ -182,7 +218,7 @@ def _initialize_elastic(coordinator: str, num_processes: int,
 # ---------------------------------------------------------------------------
 # effective topology — the resize seam
 # ---------------------------------------------------------------------------
-# After an elastic resize the surviving world is smaller than what
+# After an elastic resize the surviving world differs from what
 # jax.process_count() reports (the runtime's view is frozen at
 # initialize time). Everything that reasons about the per-host data/
 # checkpoint contract — local_batch_slice, shard_sources, the sharded
@@ -190,6 +226,27 @@ def _initialize_elastic(coordinator: str, num_processes: int,
 # can install the post-resize world without re-initializing jax.
 
 _topology_override: Optional[Tuple[int, int]] = None  # (count, index)
+
+#: the current rendezvous epoch (resilience/elastic.py's lease-based
+#: group-membership counter: +1 per resize, shrink OR grow). Stamped
+#: into every checkpoint cursor/manifest via CheckpointManager.topology
+#: so a restore can tell which incarnation of the fleet cut it; 0
+#: outside elastic runs.
+_rendezvous_epoch: int = 0
+
+
+def set_rendezvous_epoch(epoch: int) -> None:
+    """Install the current rendezvous epoch (called by ElasticTrainer
+    at bootstrap and on every lease transition — election or scale-up
+    admission). Checkpoint topology records pick it up from here."""
+    global _rendezvous_epoch
+    _rendezvous_epoch = int(epoch)
+
+
+def rendezvous_epoch() -> int:
+    """The lease-based coordination layer's current epoch (0 when not
+    training elastically)."""
+    return _rendezvous_epoch
 
 
 def set_topology_override(count: int, index: int) -> None:
@@ -300,6 +357,38 @@ def input_pipeline(sources, mesh=None, **kwargs):
     return StreamingInputPipeline(sources, mesh=mesh, **kwargs)
 
 
+def serve_coordination(port: int, num_processes: int) -> None:
+    """Run the distributed runtime's coordination service in a process
+    of its OWN (no training, no devices): the external-service half of
+    rank-0-survivable elastic training. Every training process then
+    calls ``initialize(..., elastic=True, host_service=False)`` —
+    whichever training host dies, the service (and with it the
+    surviving clients' error-poll streams) stays up, so survival is
+    decided entirely by the lease/heartbeat protocol. Liveness windows
+    are the elastic ones (effectively never), because host-failure
+    detection belongs to resilience/elastic.py. Prints ``READY`` once
+    listening; blocks until terminated (the scheduler/driver owns the
+    lifecycle and kills it after the job)."""
+    import sys
+    import time as _time
+
+    from jax._src.lib import xla_extension
+    service = xla_extension.get_distributed_runtime_service(
+        f"[::]:{int(port)}", int(num_processes),
+        heartbeat_interval=_ELASTIC_HEARTBEAT_INTERVAL_S,
+        max_missing_heartbeats=_ELASTIC_MAX_MISSING_HEARTBEATS)
+    print(f"READY coordination service on port {port} for "
+          f"{num_processes} processes", flush=True)
+    try:
+        while True:
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        service.shutdown()
+        print("coordination service shut down", file=sys.stderr, flush=True)
+
+
 def data_parallel_trainer(net, n_model: int = 1,
                           gradient_accumulation: int = 1,
                           weight_update_sharding=None,
@@ -333,3 +422,13 @@ def data_parallel_trainer(net, n_model: int = 1,
         net, ctx, gradient_accumulation=gradient_accumulation,
         weight_update_sharding=weight_update_sharding,
         precision=precision, **kwargs)
+
+
+if __name__ == "__main__":   # pragma: no cover — thin sidecar CLI
+    # python -m deeplearning4j_tpu.parallel.multihost serve <port> <nprocs>
+    import sys as _sys
+    if len(_sys.argv) == 4 and _sys.argv[1] == "serve":
+        serve_coordination(int(_sys.argv[2]), int(_sys.argv[3]))
+    else:
+        _sys.exit("usage: python -m deeplearning4j_tpu.parallel.multihost "
+                  "serve <port> <num_processes>")

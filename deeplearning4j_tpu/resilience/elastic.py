@@ -51,18 +51,64 @@ single-process to fleet-grade resilience (ROADMAP item 5):
   at the same width produces identical losses, which is exactly what
   ``tools/elastic_smoke.py`` gates.
 
+**Coordination is an epoch-numbered, lease-based rendezvous over the
+shared directory** — no single host is load-bearing (ISSUE 12):
+
+- The ``lease.json`` record (atomic rename, like every other persistent
+  write here) names the current **rendezvous epoch**, the coordinator
+  holding the lease, the member world, and any join requests pending
+  admission. Epoch increments on every membership change — shrink or
+  grow — and is stamped into every checkpoint cursor/manifest
+  (``CheckpointManager.topology``) via
+  ``multihost.set_rendezvous_epoch``.
+- **Election**: ANY host's death — the coordinator / original rank 0
+  included — is detected by the survivors' own heartbeat+barrier
+  machinery (the runtime's coordination service is disarmed in elastic
+  mode and is never the liveness authority). The **lowest surviving
+  rank wins the lease**: every survivor computes the same verdict from
+  the same heartbeat files, the winner writes the next-epoch lease,
+  and ``elastic_elections_total`` counts it. A sole survivor — whoever
+  it is — continues in process; multiple survivors raise
+  ``ElasticRestartRequired`` carrying the elected coordinator and the
+  new rendezvous epoch so the outer scheduler can restart exactly that
+  world (renumbered 0..n-1; the new rank 0 hosts the fresh runtime
+  service — the service follows the lease).
+- **Scale-UP**: a replacement host announces itself by writing a join
+  request into the rendezvous directory (``request_join``; the
+  ``rejoin_host`` chaos kind simulates it). The coordinator snapshots
+  pending joins into the lease at each checkpoint — a write that is
+  causally ordered before every peer's next step by the step's own
+  collectives — and at the next EPOCH BOUNDARY the whole world admits
+  them: epoch+1, the mesh grows back toward the original dp width, and
+  all members raise ``ElasticRestartRequired(grow=True)``. On restart
+  the zero1/zero2 ``(dp, chunk)`` state is reshard-restored BITWISE at
+  the wider width (the same un-pad/re-flatten path that shrinks; the
+  grow direction is gated by ``tools/elastic_smoke.py`` phase 3 and
+  ``tests/test_elastic.py``). Admission needs ``checkpoint_every >= 1``
+  — a joiner without a checkpoint to restore from has nothing to
+  resume.
+- **Fencing**: a PARTITIONED host (alive, but its heartbeats stop
+  landing — the ``partition_host`` chaos kind) must assume its peers
+  have declared it dead and re-formed. Once its own
+  ``write_stale_s`` exceeds the heartbeat timeout it **self-fences**
+  (``ElasticFenced``, counted ``elastic_fenced_total``): no further
+  steps AND no further checkpoint-shard writes — a fenced host never
+  commits a torn shard into the new world's checkpoint directory.
+
 Invariants kept: every persistent write goes through
 ``resilience/atomic.py`` (heartbeats use plain atomic rename without
 fsync — they are liveness signals, not state, and a per-beat fsync
 would hammer both the disk and the checkpoint-commit chaos seam); the
 divergence sentinel stays inside the compiled step across rebuilds;
-every detection/resize lands in ``elastic_*`` /
-``resilience_host_failures_total`` counters and tracer events.
+every detection/resize/election/admission/fence lands in ``elastic_*``
+/ ``resilience_host_failures_total`` counters and tracer events, with
+the current epoch on the ``elastic_epoch`` gauge.
 
 Limitations (documented, enforced with clear errors): data-parallel
-meshes only; the coordination service lives on original rank 0, whose
-loss is not survivable in process (jaxlib's polled-error path aborts
-the client) — survivors take the restart-resume path instead.
+meshes only; a multi-host surviving world cannot re-rendezvous
+collectives inside the old runtime (probe-verified gloo limitation), so
+it restarts via ``ElasticRestartRequired`` — in-process continuation is
+for the sole survivor.
 """
 
 from __future__ import annotations
@@ -93,21 +139,51 @@ class ElasticError(RuntimeError):
     """Elastic-layer failure that is NOT a survivable host loss."""
 
 
+class ElasticFenced(ElasticError):
+    """This host's own heartbeat stopped landing for a full timeout
+    window (partition / unwritable coordination dir): its peers have —
+    correctly, from their view — declared it dead and re-formed the
+    world without it. The fenced host must contribute NOTHING further:
+    no steps, no checkpoint shards (a torn shard in the new world's
+    commit protocol is how a split brain corrupts state). Counted in
+    ``elastic_fenced_total``."""
+
+
 class ElasticRestartRequired(ElasticError):
-    """More than one host survived a loss: the old runtime cannot
-    re-rendezvous their collectives in process. The outer scheduler
-    restarts the surviving ranks at the new width; on restart the same
-    ``ElasticTrainer`` resumes them through the cross-width
+    """The group must re-form at a new width the old runtime cannot
+    reach in process — more than one survivor after a loss, or a
+    scale-UP admission (``grow=True``). Carries the world the outer
+    scheduler must (re)start, the ELECTED coordinator (lowest surviving
+    rank, holding the lease), and the new rendezvous ``epoch`` the
+    lease announces; the ``lease.json`` in the coordination directory
+    is the authoritative copy of the same record. On restart the same
+    ``ElasticTrainer`` resumes every member through the cross-width
     reshard-restore."""
 
-    def __init__(self, survivors: List[int], dead: List[int]):
+    def __init__(self, survivors: List[int], dead: List[int],
+                 coordinator: Optional[int] = None,
+                 epoch: Optional[int] = None, grow: bool = False):
         self.survivors = list(survivors)
         self.dead = list(dead)
-        super().__init__(
-            f"hosts {sorted(dead)} lost; surviving world {sorted(survivors)} "
-            f"must restart at dp-width of {len(survivors)} process(es) and "
-            "resume from the latest checkpoint (in-process continuation is "
-            "only possible for a sole survivor)")
+        self.coordinator = (min(survivors) if coordinator is None
+                            else int(coordinator))
+        self.epoch = epoch
+        self.grow = bool(grow)
+        if grow:
+            msg = (f"world {sorted(survivors)} admitted replacement "
+                   f"host(s) at rendezvous epoch {epoch}: the outer "
+                   f"scheduler restarts all {len(survivors)} process(es) "
+                   f"at the grown width (coordinator rank "
+                   f"{self.coordinator} holds the lease) and the sharded "
+                   "state reshard-restores bitwise at the wider width")
+        else:
+            msg = (f"hosts {sorted(dead)} lost; surviving world "
+                   f"{sorted(survivors)} elected rank {self.coordinator} "
+                   f"coordinator at rendezvous epoch {epoch} and must "
+                   f"restart at dp-width of {len(survivors)} process(es), "
+                   "resuming from the latest checkpoint (in-process "
+                   "continuation is only possible for a sole survivor)")
+        super().__init__(msg)
 
 
 class _HostsLost(Exception):
@@ -124,6 +200,108 @@ class _HostsLost(Exception):
 #: chaos, checkpoint integrity, operator interrupt)
 _PASSTHROUGH = (RollbackRequested, DivergenceError, KilledByFault,
                 FaultInjected, KeyboardInterrupt)
+
+
+# ---------------------------------------------------------------------------
+# the rendezvous lease (epoch-numbered group membership)
+# ---------------------------------------------------------------------------
+
+LEASE_NAME = "lease.json"
+_JOIN_RE = "join_p*.json"
+
+
+def _lease_path(directory: Union[str, Path]) -> Path:
+    return Path(directory) / LEASE_NAME
+
+
+def read_lease(directory: Union[str, Path]) -> Optional[Dict[str, Any]]:
+    """The current lease record ({epoch, coordinator, world, pending,
+    time}) or None when the rendezvous directory holds none yet.
+    Unreadable/partial files read as None (the writer's atomic rename
+    means that can only be a pre-first-lease state)."""
+    try:
+        d = json.loads(_lease_path(directory).read_text())
+        return {"epoch": int(d["epoch"]),
+                "coordinator": int(d["coordinator"]),
+                "world": [int(r) for r in d["world"]],
+                "pending": [int(r) for r in d.get("pending", [])],
+                "time": float(d.get("time", 0.0))}
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def write_lease(directory: Union[str, Path], epoch: int, world: List[int],
+                coordinator: int, pending: Optional[List[int]] = None
+                ) -> None:
+    """Atomically publish a lease: the coordinator named here holds the
+    rendezvous for ``epoch`` over ``world``. ``pending`` lists join
+    requests recorded but not yet admitted (they admit at the next
+    epoch boundary). Single-writer by protocol: only the coordinator —
+    the lowest rank of ``world``, which every member computes
+    identically — writes, so the atomic rename is ordering, not
+    arbitration."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    path = _lease_path(directory)
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(json.dumps({
+        "epoch": int(epoch), "coordinator": int(coordinator),
+        "world": sorted(int(r) for r in world),
+        "pending": sorted(int(r) for r in (pending or [])),
+        "time": time.time()}))
+    os.replace(tmp, path)
+
+
+def request_join(directory: Union[str, Path], rank: int) -> Path:
+    """A (replacement) host announces itself to the rendezvous: writes
+    ``join_p<rank>.json`` atomically and returns its path. The
+    coordinator snapshots pending requests into the lease at each
+    checkpoint and the world admits them at the next epoch boundary
+    (``ElasticTrainer._maybe_scale_up``). Announcements EXPIRE: lease
+    snapshots ignore requests older than the trainer's join TTL, so a
+    joiner keeps re-announcing (idempotent — each call refreshes the
+    timestamp) until admitted. Expiry is what keeps a leftover request
+    from a joiner that died — or from a previous run — out of the
+    lease: admitting a host that will never start would wedge the
+    restarted fleet at initialize until its init timeout."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    path = directory / f"join_p{int(rank)}.json"
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(json.dumps({"rank": int(rank), "time": time.time()}))
+    os.replace(tmp, path)
+    return path
+
+
+def pending_join_ranks(directory: Union[str, Path],
+                       max_age_s: Optional[float] = None) -> List[int]:
+    """Ranks with a join request on disk (sorted; unreadable files are
+    skipped — the joiner's next announcement replaces them).
+    ``max_age_s`` drops requests whose announcement timestamp is older
+    (see ``request_join``: joiners re-announce until admitted)."""
+    ranks = []
+    now = time.time()
+    for p in Path(directory).glob(_JOIN_RE):
+        try:
+            d = json.loads(p.read_text())
+            if max_age_s is not None and \
+                    now - float(d.get("time", 0.0)) > max_age_s:
+                continue
+            ranks.append(int(d["rank"]))
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+    return sorted(set(ranks))
+
+
+def clear_join_requests(directory: Union[str, Path],
+                        ranks: List[int]) -> None:
+    """Consume admitted join requests (coordinator-only, after the
+    admission lease is published)."""
+    for r in ranks:
+        try:
+            (Path(directory) / f"join_p{int(r)}.json").unlink()
+        except OSError:
+            pass
 
 
 # ---------------------------------------------------------------------------
@@ -165,6 +343,11 @@ class HostHeartbeat:
             self.beat()
 
     def beat(self) -> None:
+        if faultinject.heartbeat_suppressed():
+            # partition_host chaos: the process lives, its beats don't
+            # land — _last_written stalls, so the self-fencing contract
+            # (write_stale_s past the fleet timeout) engages naturally
+            return
         path = _heartbeat_path(self.directory, self.rank)
         tmp = path.with_name(path.name + ".tmp")
         try:
@@ -263,6 +446,11 @@ class ElasticTrainer:
         self.step_timeout_s = float(step_timeout_s)
         self.max_barrier_waits = max(1, int(max_barrier_waits))
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        #: join announcements older than this never enter a lease
+        #: snapshot — a joiner re-announces until admitted, so a stale
+        #: request (dead joiner / previous run) ages out instead of
+        #: wedging a grow-restart on a host that will never start
+        self.join_ttl_s = max(60.0, 20.0 * self.heartbeat_timeout_s)
         self.commit_timeout_s = float(commit_timeout_s)
         self.sentinel = sentinel
         self.resume = resume
@@ -296,9 +484,38 @@ class ElasticTrainer:
         self._c_reshard_restores = reg.counter(
             "elastic_reshard_restores_total",
             help="checkpoint restores across a dp-width change")
+        self._c_elections = reg.counter(
+            "elastic_elections_total",
+            help="coordinator elections this process participated in "
+                 "(lowest surviving rank takes the lease)")
+        self._c_scale_ups = reg.counter(
+            "elastic_scale_ups_total",
+            help="scale-UP admissions: replacement hosts admitted at an "
+                 "epoch boundary, growing the mesh")
+        self._c_fenced = reg.counter(
+            "elastic_fenced_total",
+            help="self-fencing events: this host's own heartbeat went "
+                 "stale past the fleet timeout and it refused to keep "
+                 "training/committing into a re-formed world")
         self._g_dp = reg.gauge(
             "elastic_dp_width", help="current data-parallel width")
+        self._g_epoch = reg.gauge(
+            "elastic_epoch",
+            help="current rendezvous epoch (+1 per membership change, "
+                 "shrink or grow)")
 
+        # adopt (or found) the rendezvous lease. A fresh fleet starts at
+        # epoch 0 with rank 0 holding the lease; a restarted fleet finds
+        # the lease the pre-restart election/admission published and the
+        # new coordinator re-anchors it over the renumbered world.
+        lease = read_lease(self.heartbeat_dir)
+        self.rdv_epoch = int(lease["epoch"]) if lease else 0
+        if self._rank == min(self._world) and (
+                lease is None or lease["world"] != sorted(self._world)):
+            write_lease(self.heartbeat_dir, self.rdv_epoch, self._world,
+                        self._rank, pending=self._pending_for_lease())
+
+        self._input_sig: Optional[Dict[str, Any]] = None
         self._hb = HostHeartbeat(self.heartbeat_dir, self._rank,
                                  heartbeat_interval_s).start()
         self._bootstrap(initial=True)
@@ -318,6 +535,10 @@ class ElasticTrainer:
         resize."""
         from deeplearning4j_tpu.parallel.mesh import MeshContext
         from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+        # every checkpoint cut from here on is stamped with the current
+        # rendezvous epoch (cursor + sharded manifest, via topology())
+        self._multihost.set_rendezvous_epoch(self.rdv_epoch)
+        self._g_epoch.set(self.rdv_epoch)
         if len(self._world) != self._jax.process_count():
             self._multihost.set_topology_override(
                 len(self._world), self._world.index(self._rank))
@@ -448,18 +669,17 @@ class ElasticTrainer:
                              duration=stall):
                 time.sleep(stall)
         faultinject.check_kill(step_id)
-        if (len(self._world) > 1
-                and self._hb.write_stale_s() > self.heartbeat_timeout_s):
-            # our own beacon has not landed for a full timeout window:
-            # the peers are (correctly, from their view) about to
-            # declare this host dead and resize without it — stop
-            # contributing steps instead of splitting the brain
-            raise ElasticError(
-                f"this host's heartbeat has not been written for "
-                f"{self._hb.write_stale_s():.1f}s (> "
-                f"{self.heartbeat_timeout_s}s): peers will declare it "
-                "dead; refusing to keep training into a split brain "
-                "(is the heartbeat directory writable?)")
+        faultinject.check_partition(step_id)
+        join_rank = faultinject.check_rejoin(step_id)
+        if join_rank is not None:
+            # the simulated replacement host's announcement: a join
+            # request lands in the rendezvous dir; admission happens at
+            # the next epoch boundary via the lease-recorded snapshot
+            if join_rank < 0:
+                join_rank = next(r for r in range(len(self._world) + 1)
+                                 if r not in self._world)
+            request_join(self.heartbeat_dir, join_rank)
+        self._check_fence(f"step {step_id}")
         self._hb.step = step_id
         local = self._local_view(batch)
         box: Dict[str, Any] = {}
@@ -522,21 +742,69 @@ class ElasticTrainer:
             raise e
         return box["loss"]
 
+    # ---------------------------------------------------------------- fencing
+    def _check_fence(self, where: str) -> None:
+        """Self-fencing gate, run before every step AND every checkpoint
+        write: once this host's own beacon has not landed for a full
+        timeout window, its peers have (correctly, from their view)
+        declared it dead and re-formed — contributing anything further
+        is a split brain, and a checkpoint shard written now would tear
+        the new world's commit. Raise instead."""
+        if len(self._world) <= 1:
+            return
+        stale = self._hb.write_stale_s()
+        if stale <= self.heartbeat_timeout_s:
+            return
+        self._c_fenced.inc()
+        get_tracer().instant("elastic_fenced", where=where,
+                             stale_s=round(stale, 3))
+        raise ElasticFenced(
+            f"this host's heartbeat has not been written for "
+            f"{stale:.1f}s (> {self.heartbeat_timeout_s}s) at {where}: "
+            "peers have declared it dead and re-formed the world; "
+            "self-fencing — no further steps or checkpoint shards from "
+            "this process (network partition, or is the rendezvous "
+            "directory writable?)")
+
     # ----------------------------------------------------------------- resize
     def _on_hosts_lost(self, lost: _HostsLost) -> None:
+        """Detection verdict -> election. The survivors re-form: the
+        lowest surviving rank wins the lease and publishes the
+        next-epoch record (every survivor computes the identical
+        verdict from the same heartbeat files, so the single-writer
+        protocol needs no arbitration). Sole survivor: continue in
+        process. Multiple survivors: raise ``ElasticRestartRequired``
+        carrying the elected coordinator + epoch."""
         tracer = get_tracer()
         for r in sorted(set(lost.dead)):
             self._c_host_failures.inc()
             tracer.instant("host_failure", rank=r, where=lost.where)
-        logger.warning("host(s) %s lost at %s; surviving world %s",
-                       sorted(set(lost.dead)), lost.where,
-                       [r for r in self._world if r not in lost.dead])
-        self._world = [r for r in self._world if r not in lost.dead]
-        if self._rank not in self._world:
+        self._follow_newer_lease(f"host loss at {lost.where}")
+        survivors = [r for r in self._world if r not in lost.dead]
+        if self._rank not in survivors:
             raise ElasticError("this process was declared dead by its own "
                                "detector — heartbeat directory clock skew?")
-        if len(self._world) > 1:
-            raise ElasticRestartRequired(self._world, lost.dead)
+        elected = min(survivors)
+        new_epoch = self.rdv_epoch + 1
+        self._c_elections.inc()
+        tracer.instant("elastic_election", epoch=new_epoch,
+                       coordinator=elected, dead=sorted(set(lost.dead)))
+        logger.warning(
+            "host(s) %s lost at %s; surviving world %s elected rank %d "
+            "coordinator at rendezvous epoch %d",
+            sorted(set(lost.dead)), lost.where, survivors, elected,
+            new_epoch)
+        self._world = survivors
+        self.rdv_epoch = new_epoch
+        if self._rank == elected:
+            # the winner takes the lease — including a sole survivor of
+            # the ORIGINAL coordinator's death (rank 0 is not special)
+            write_lease(self.heartbeat_dir, new_epoch, survivors, elected,
+                        pending=self._pending_for_lease(world=survivors))
+        if len(survivors) > 1:
+            raise ElasticRestartRequired(survivors, lost.dead,
+                                         coordinator=elected,
+                                         epoch=new_epoch)
         old_dp = self.mesh.n_data if self.mesh else 0
         with tracer.span("elastic:resize", old_dp=old_dp):
             self._c_resizes.inc()
@@ -544,18 +812,115 @@ class ElasticTrainer:
         tracer.instant("elastic_resize", old_dp=old_dp,
                        new_dp=self.mesh.n_data)
 
+    def _follow_newer_lease(self, where: str) -> Optional[Dict[str, Any]]:
+        """The lease is AUTHORITATIVE: epochs only move forward, and a
+        member observing a lease newer than its own epoch must follow
+        it rather than form a divergent world. The scenario this
+        closes: a join lands exactly at an epoch boundary, the
+        coordinator admits it and exits into the grow-restart, and a
+        peer that read the lease a moment earlier misses the admission
+        — without this check the peer would 'survive' its vanished
+        coordinator by resizing solo while the scheduler restarts the
+        grown world: a split brain with two worlds writing
+        checkpoints. Raising RestartRequired with the lease's record
+        re-converges everyone on the same epoch.
+
+        Returns the ONE lease snapshot it read when it does not raise —
+        callers deciding on lease contents (admission) must reuse that
+        snapshot rather than re-reading: a second read could land after
+        a peer's transition and see a state this method never vetted
+        (the TOCTOU variant of the same split brain)."""
+        lease = read_lease(self.heartbeat_dir)
+        if lease is None or lease["epoch"] <= self.rdv_epoch:
+            return lease
+        if self._rank not in lease["world"]:
+            self._c_fenced.inc()
+            get_tracer().instant("elastic_fenced", where=where,
+                                 lease_epoch=lease["epoch"])
+            raise ElasticFenced(
+                f"the rendezvous lease moved to epoch {lease['epoch']} "
+                f"(world {lease['world']}) without this rank "
+                f"({self._rank}) at {where}: the group has re-formed "
+                "without us — self-fencing instead of training into a "
+                "split brain")
+        old_world = self._world
+        self._world = list(lease["world"])
+        self.rdv_epoch = int(lease["epoch"])
+        raise ElasticRestartRequired(
+            self._world, [r for r in old_world if r not in self._world],
+            coordinator=lease["coordinator"], epoch=lease["epoch"],
+            grow=len(self._world) > len(old_world))
+
+    # --------------------------------------------------------------- scale-up
+    def _maybe_scale_up(self) -> None:
+        """Epoch-boundary admission: join requests the coordinator
+        snapshotted into the lease at a PRIOR checkpoint (a write that
+        is causally before every member's next step — the step's own
+        collectives order it) are admitted by the whole world at once.
+        Raises ``ElasticRestartRequired(grow=True)`` for every member;
+        the coordinator first publishes the next-epoch lease over the
+        grown world and consumes the join files."""
+        # a peer may already have published this admission (or another
+        # transition) — follow the newer lease instead of re-deciding.
+        # The decision below uses the SAME snapshot the follow check
+        # vetted: re-reading here could land after a peer's admission
+        # write and see pending=[] — silently skipping the admission
+        # this member was supposed to join (the TOCTOU split brain).
+        lease = self._follow_newer_lease("epoch boundary")
+        pending = [r for r in (lease or {}).get("pending", [])
+                   if r not in self._world]
+        if not pending:
+            return
+        new_world = sorted(set(self._world) | set(pending))
+        new_epoch = self.rdv_epoch + 1
+        coordinator = min(new_world)
+        self._c_scale_ups.inc()
+        get_tracer().instant("elastic_scale_up", epoch=new_epoch,
+                             joined=pending, world=new_world)
+        logger.warning(
+            "admitting replacement host(s) %s at epoch boundary: world "
+            "%s -> %s, rendezvous epoch %d (restart required to grow "
+            "the mesh)", pending, self._world, new_world, new_epoch)
+        if self._rank == min(self._world):
+            write_lease(self.heartbeat_dir, new_epoch, new_world,
+                        coordinator, pending=[])
+            clear_join_requests(self.heartbeat_dir, pending)
+        self._world = new_world
+        self.rdv_epoch = new_epoch
+        raise ElasticRestartRequired(new_world, [], coordinator=coordinator,
+                                     epoch=new_epoch, grow=True)
+
     # -------------------------------------------------------------------- fit
     def fit(self, data, epochs: int = 1) -> "ElasticTrainer":
         """Train ``epochs`` over the GLOBAL batches in ``data`` under the
-        elastic contract. Identical call on every process; survives any
-        non-coordinator host loss mid-epoch."""
+        elastic contract. Identical call on every process; survives ANY
+        host loss mid-epoch — the coordinator included (survivors elect
+        a new one) — and admits replacement hosts at epoch boundaries."""
         from deeplearning4j_tpu.resilience.trainer import \
             FaultTolerantTrainer
+        sig = getattr(data, "shuffle_signature", None)
+        self._input_sig = sig() if callable(sig) else None
         batches = FaultTolerantTrainer._materialize(data)
         if not batches:
             return self
         n = len(batches)
         cursor = self._cursor
+        if cursor is not None:
+            # symmetric guard: shuffled-vs-unshuffled in EITHER
+            # direction replays the cursor tail over a different
+            # emission order (an unshuffled cursor — including any
+            # pre-shuffle-era cursor, which records nothing — resumed
+            # through a shuffled pipeline is just as re-randomized as
+            # the reverse)
+            recorded = (cursor.extra or {}).get("input")
+            if recorded != self._input_sig:
+                raise ElasticError(
+                    f"the checkpoint cursor records input shuffle state "
+                    f"{recorded} but the supplied data announces "
+                    f"{self._input_sig}: resuming would re-randomize the "
+                    "emission order and the cursor tail would replay "
+                    "DIFFERENT batches — supply input with the recorded "
+                    "shuffle seed/window (None = unshuffled)")
         epoch, pos = (cursor.epoch, cursor.data_position) if cursor \
             else (0, 0)
         order = FaultTolerantTrainer._cursor_order(cursor, n)
@@ -574,6 +939,18 @@ class ElasticTrainer:
                         # checkpoint_every=0 disables ALL saves (e.g. a
                         # read-only checkpoint dir), not just in-epoch
                         self._save(epoch=epoch + 1, next_pos=0)
+                    # EPOCH BOUNDARY: admit any lease-recorded join
+                    # requests (scale-up; raises RestartRequired) —
+                    # but only while work remains: a grow-restart after
+                    # the FINAL epoch would spin the whole fleet up
+                    # just to exit, and fit() would report completion
+                    # as a restart request. (A join landing in the last
+                    # epoch stays pending for a future run.) With
+                    # checkpoint_every=0 the lease never records
+                    # pending joins — a joiner with no checkpoint to
+                    # restore from has nothing to resume into
+                    if epoch + 1 < epochs:
+                        self._maybe_scale_up()
                     epoch, pos, order = epoch + 1, 0, list(range(n))
                     continue
                 step_id = self.net.iteration_count + 1
@@ -602,10 +979,19 @@ class ElasticTrainer:
 
     def _save(self, epoch: int, next_pos: int,
               order: Optional[List[int]] = None) -> None:
+        # a partitioned host must never land a shard in a world that
+        # has re-formed without it — fence BEFORE the write, not after
+        self._check_fence("checkpoint save")
         cursor = TrainingCursor.of(self.net, epoch=epoch,
                                    data_position=next_pos)
         if order is not None and order != list(range(len(order))):
             cursor.extra["order"] = list(order)
+        if self._input_sig is not None:
+            # the input pipeline's shuffle identity rides with the
+            # cursor: a resume against a differently-shuffled pipeline
+            # is rejected up front instead of silently replaying the
+            # tail over a re-randomized order
+            cursor.extra["input"] = dict(self._input_sig)
         try:
             self.manager.save(self.net, cursor=cursor)
         except CheckpointError:
@@ -615,6 +1001,45 @@ class ElasticTrainer:
             if dead:
                 raise _HostsLost(dead, "checkpoint commit") from None
             raise
+        self._snapshot_pending_joins()
+
+    def _pending_for_lease(self, world: Optional[List[int]] = None
+                           ) -> List[int]:
+        """Join-file ranks eligible to be recorded as lease-pending.
+        Empty whenever checkpointing is off: admission is documented to
+        need ``checkpoint_every >= 1`` (a joiner with no checkpoint has
+        nothing to resume), and a stale join file from a previous run
+        must not smuggle an admission past that gate through the
+        founding or election lease writes."""
+        if not self.checkpoint_every:
+            return []
+        world = self._world if world is None else world
+        return [r for r in pending_join_ranks(self.heartbeat_dir,
+                                              max_age_s=self.join_ttl_s)
+                if r not in world]
+
+    def _snapshot_pending_joins(self) -> None:
+        """Coordinator-only, after each committed checkpoint: record
+        join requests into the lease. The write happens strictly before
+        any member's next step completes (steps are collectives this
+        process participates in), so by the epoch boundary EVERY member
+        reads the same pending set — deterministic admission without a
+        barrier of its own."""
+        if self._rank != min(self._world):
+            return
+        pending = self._pending_for_lease()
+        lease = read_lease(self.heartbeat_dir)
+        if lease is not None and lease["epoch"] > self.rdv_epoch:
+            # the group moved past us while we were saving (e.g. peers
+            # elected around a coordinator they declared dead that is
+            # actually just slow): epochs only move FORWARD — never
+            # clobber the newer lease with our stale epoch. The next
+            # step/boundary's _follow_newer_lease converges or fences.
+            return
+        if lease is not None and lease.get("pending", []) == pending:
+            return
+        write_lease(self.heartbeat_dir, self.rdv_epoch, self._world,
+                    self._rank, pending=pending)
 
     # ---------------------------------------------------------------- cleanup
     def close(self) -> None:

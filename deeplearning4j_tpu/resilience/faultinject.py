@@ -81,6 +81,31 @@ Elastic / multi-host fault kinds (PR 8, the topology-change seams):
   silent hang — and then complete the step when the straggler catches
   up, because its heartbeats stayed fresh.
 
+Fleet-coordination fault kinds (ISSUE 12, the lease/rendezvous seams):
+
+- ``kill_coordinator`` — the rank-0 variant of ``kill_host``: arm it on
+  the COORDINATOR (the lease holder / lowest rank). Same hard
+  ``os._exit``; the point of the separate kind is the survivors' path —
+  they must ELECT a new coordinator (lowest surviving rank takes the
+  lease at the next rendezvous epoch, ``elastic_elections_total``)
+  instead of merely shrinking around a dead follower.
+- ``rejoin_host``      — at training step N, a replacement host
+  announces itself: a join request for ``rank`` (default: the lowest
+  rank not in the current world) lands in the rendezvous directory.
+  The coordinator must record it in the lease at the next checkpoint
+  and ADMIT it at the next epoch boundary (``elastic_scale_ups_total``
+  + an ``elastic_scale_up`` instant), growing the mesh back toward the
+  original dp width through a bitwise reshard-restore.
+- ``partition_host``   — from training step N, THIS host's heartbeat
+  writes are suppressed for ``duration`` seconds (0 = until the
+  schedule is cleared) while the process keeps running: a network
+  partition, not a death. Peers must classify the stale heartbeats as
+  a loss; the partitioned host must SELF-FENCE
+  (``elastic_fenced_total``) — refusing further steps and, crucially,
+  further checkpoint-shard writes — rather than keep committing state
+  into a world that has re-formed without it (split brain / torn
+  shard).
+
 Faults are one-shot: each schedule entry fires once, is counted in the
 metrics registry (``resilience_faults_injected_total``) and stamped as a
 tracer instant event, then disarms. ``step`` indexing is 1-based and
@@ -104,7 +129,8 @@ from deeplearning4j_tpu.profiling.tracer import get_tracer
 _KINDS = ("raise", "nan", "truncate_checkpoint", "drop_connection",
           "slow_loris", "hang_backend", "burst", "corrupt_frame",
           "poison_row", "slow_batch", "slow_input", "io_error",
-          "kill_host", "slow_host")
+          "kill_host", "slow_host", "kill_coordinator", "rejoin_host",
+          "partition_host")
 
 #: exit code of a ``kill_host`` hard exit — distinct so test drivers can
 #: assert the victim died BY the fault, not by a bug
@@ -138,6 +164,7 @@ class Fault:
     #                      drop_connection: "sub" (default) | "pub"
     duration: float = 0.0
     count: int = 0
+    rank: int = -1   # rejoin_host: the joining rank (-1 = lowest free)
     fired: bool = False
 
     def __post_init__(self):
@@ -169,6 +196,10 @@ _predict_loads = 0
 _batch_dispatches = 0
 _input_nexts = 0
 _reader_reads = 0
+#: monotonic deadline until which heartbeat writes are suppressed
+#: (``partition_host``); None = no partition in effect, inf = until the
+#: schedule is cleared
+_partition_until: Optional[float] = None
 
 
 def set_schedule(schedule: Optional[FaultSchedule]) -> None:
@@ -177,6 +208,7 @@ def set_schedule(schedule: Optional[FaultSchedule]) -> None:
     global _schedule, _commit_calls, _recv_calls, _pub_calls
     global _dispatch_calls, _frame_sends, _loris_sends
     global _predict_loads, _batch_dispatches, _input_nexts, _reader_reads
+    global _partition_until
     with _lock:
         _schedule = schedule
         _commit_calls = 0
@@ -189,6 +221,7 @@ def set_schedule(schedule: Optional[FaultSchedule]) -> None:
         _batch_dispatches = 0
         _input_nexts = 0
         _reader_reads = 0
+        _partition_until = None
 
 
 def clear() -> None:
@@ -254,17 +287,19 @@ def poison_batch(batch, step: int):
 
 def check_kill(step: int) -> None:
     """Called by ElasticTrainer per training step (before dispatch); a
-    ``kill_host`` fault scheduled for ``step`` hard-exits THIS process
-    with ``KILL_HOST_EXIT_CODE`` — no flushing, no cleanup, no exception
-    a handler could catch: exactly what a preemption leaves behind. The
-    ``fault_injected`` instant and counter land in-process first (they
-    die with it; the surviving hosts' detection counters are the
-    observable record)."""
+    ``kill_host`` (or ``kill_coordinator`` — same mechanics, armed on
+    the lease holder) fault scheduled for ``step`` hard-exits THIS
+    process with ``KILL_HOST_EXIT_CODE`` — no flushing, no cleanup, no
+    exception a handler could catch: exactly what a preemption leaves
+    behind. The ``fault_injected`` instant and counter land in-process
+    first (they die with it; the surviving hosts' detection counters
+    are the observable record)."""
     with _lock:
         hit = None
         if _schedule is not None:
             for f in _schedule.pending():
-                if f.kind == "kill_host" and f.step == step:
+                if f.kind in ("kill_host", "kill_coordinator") \
+                        and f.step == step:
                     hit = f
                     break
             if hit is not None:
@@ -292,6 +327,53 @@ def host_step_stall(step: int) -> float:
                 _fire(f, step=step, duration=f.duration)
                 return max(0.0, f.duration)
         return 0.0
+
+
+def check_rejoin(step: int) -> Optional[int]:
+    """Called by ElasticTrainer per training step; a ``rejoin_host``
+    fault scheduled for ``step`` returns the rank the simulated
+    replacement host joins as (``Fault.rank``; -1 = let the caller pick
+    the lowest rank not in its world). The caller writes the join
+    request into the rendezvous directory — exactly the announcement a
+    real replacement host would make — and the admission machinery
+    takes it from there. None = no rejoin scheduled for this step."""
+    with _lock:
+        if _schedule is None:
+            return None
+        for f in _schedule.pending():
+            if f.kind == "rejoin_host" and f.step == step:
+                _fire(f, step=step, rank=f.rank)
+                return int(f.rank)
+        return None
+
+
+def check_partition(step: int) -> None:
+    """Called by ElasticTrainer per training step; a ``partition_host``
+    fault scheduled for ``step`` opens the heartbeat-suppression window
+    (``duration`` seconds; 0 = until the schedule is cleared). The
+    process keeps running — only its liveness signal disappears, the
+    signature of a network partition rather than a crash."""
+    global _partition_until
+    with _lock:
+        if _schedule is None:
+            return
+        for f in _schedule.pending():
+            if f.kind == "partition_host" and f.step == step:
+                _fire(f, step=step, duration=f.duration)
+                _partition_until = (float("inf") if f.duration <= 0
+                                    else time.monotonic() + f.duration)
+                return
+
+
+def heartbeat_suppressed() -> bool:
+    """Consulted by ``HostHeartbeat.beat`` before every write: True
+    while a ``partition_host`` window is open — the beat is silently
+    dropped, the file on disk goes stale, and both sides of the
+    partition contract engage (peer-side loss classification, victim's
+    self-fencing via ``write_stale_s``)."""
+    with _lock:
+        return (_partition_until is not None
+                and time.monotonic() < _partition_until)
 
 
 def on_checkpoint_commit(tmp: Path, final: Path) -> None:

@@ -178,8 +178,13 @@ class CheckpointManager:
     # --------------------------------------------------------------- topology
     def topology(self) -> Dict[str, Any]:
         """The mesh topology checkpoints cut by this manager run on:
-        data-parallel width, weight-update-sharding mode, and surviving
-        process count (elastic-aware via ``multihost.effective_*``)."""
+        data-parallel width, weight-update-sharding mode, surviving
+        process count (elastic-aware via ``multihost.effective_*``),
+        and the rendezvous epoch of the fleet incarnation that cut it
+        (the lease-based coordination counter — 0 outside elastic
+        runs). The epoch rides in every cursor AND sharded manifest so
+        a restore can attribute the checkpoint to a specific pre- or
+        post-resize world."""
         dp = 1
         if self.mesh_ctx is not None:
             try:
@@ -189,11 +194,13 @@ class CheckpointManager:
         try:
             from deeplearning4j_tpu.parallel import multihost
             nproc = multihost.effective_process_count()
+            repoch = multihost.rendezvous_epoch()
         except Exception:
-            nproc = 1
+            nproc, repoch = 1, 0
         return {"dp": dp,
                 "weight_update_sharding": self.weight_update_sharding,
-                "process_count": nproc}
+                "process_count": nproc,
+                "rendezvous_epoch": repoch}
 
     def _check_topology(self, info: "CheckpointInfo",
                         reshard: bool) -> bool:
@@ -276,8 +283,25 @@ class CheckpointManager:
                 path = self.directory / (name + ".zip")
                 ModelSerializer.write_model(net, path,
                                             save_updater=self.save_updater)
-            atomic_write_bytes(self._cursor_path(path),
-                               cursor.to_json().encode())
+            # single-writer discipline for the shared sharded dir: every
+            # process calls save(), but the cursor — identical on every
+            # SPMD rank (same net state, same order) — is written by
+            # effective rank 0 only. Two ranks racing atomic_write_bytes
+            # on ONE final path collide on its deterministic .tmp name
+            # (observed under load: FileNotFoundError at the second
+            # rename). The cursor also lands after save_sharded's
+            # COMMIT, so a cursor on disk always describes a committed
+            # checkpoint.
+            write_cursor = True
+            if self.sharded:
+                try:
+                    from deeplearning4j_tpu.parallel import multihost
+                    write_cursor = multihost.effective_process_index() == 0
+                except Exception:
+                    write_cursor = True
+            if write_cursor:
+                atomic_write_bytes(self._cursor_path(path),
+                                   cursor.to_json().encode())
         self._c_saved.inc()
         self._rotate(keep=path)
         return path

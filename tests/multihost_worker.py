@@ -16,10 +16,22 @@ num_procs = int(sys.argv[2])
 port = sys.argv[3]
 #: "spmd" (default) = the synchronous-parity phases below;
 #: "elastic" = ElasticTrainer chaos run (1 device/process, kill_host /
-#: slow_host armed via env, prints TRAJ/METRICS);
+#: slow_host / kill_coordinator / rejoin_host armed via env, prints
+#: TRAJ/METRICS — and RESTART when the run ends in a group re-form);
+#: "elastic_rank0" = the elastic run with the fault armed on RANK 0
+#: (the coordinator): the survivor must ELECT itself (ISSUE 12);
+#: "elastic_rejoin" = single-process elastic run with a rejoin_host
+#: fault: a replacement announces itself mid-epoch and the epoch
+#: boundary must ADMIT it (scale-up restart request);
 #: "elastic_ref" = single-process clean dp=1 restart from a specific
 #: checkpoint of a previous elastic run (the bitwise reference)
 mode = sys.argv[4] if len(sys.argv) > 4 else "spmd"
+if mode == "elastic_rank0":
+    os.environ.setdefault("ELASTIC_FAULT_RANK", "0")
+    os.environ.setdefault("ELASTIC_FAULT_KIND", "kill_coordinator")
+if mode == "elastic_rejoin":
+    os.environ.setdefault("ELASTIC_FAULT_KIND", "rejoin_host")
+    os.environ.setdefault("ELASTIC_EPOCHS", "2")
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 _DEVS = 1 if mode.startswith("elastic") else 4
@@ -66,32 +78,51 @@ def _elastic_batches():
 
 
 def _run_elastic() -> None:
-    """The preemption chaos phase: both processes train under
-    ElasticTrainer; env arms a kill_host/slow_host fault on rank 1. The
-    survivor must finish the epoch and print the exactly-once record."""
+    """The preemption/coordination chaos phase: every process trains
+    under ElasticTrainer; env arms a kill_host / kill_coordinator /
+    slow_host / rejoin_host fault on ``ELASTIC_FAULT_RANK``. Survivors
+    must finish (or request a group re-form — printed as RESTART) and
+    print the exactly-once record + elastic counters."""
     import json
 
     from deeplearning4j_tpu.profiling.metrics import get_registry
     from deeplearning4j_tpu.resilience import faultinject
-    from deeplearning4j_tpu.resilience.elastic import ElasticTrainer
+    from deeplearning4j_tpu.resilience.elastic import (
+        ElasticRestartRequired, ElasticTrainer)
     from deeplearning4j_tpu.resilience.faultinject import (Fault,
                                                            FaultSchedule)
 
     print(f"worker {proc_id}: initializing elastic runtime", flush=True)
-    multihost.initialize(coordinator=f"localhost:{port}",
-                         num_processes=num_procs, process_id=proc_id,
-                         elastic=True)
+    # ELASTIC_EXTERNAL_SERVICE=1: the driver runs the coordination
+    # service as a sidecar (rank-0-survivable mode) — no training
+    # process hosts it, so killing ANY rank leaves the service (and
+    # the survivors' error-poll streams) up
+    multihost.initialize(
+        coordinator=f"localhost:{port}",
+        num_processes=num_procs, process_id=proc_id, elastic=True,
+        host_service=(False if os.environ.get("ELASTIC_EXTERNAL_SERVICE")
+                      else None))
     fault_step = int(os.environ.get("ELASTIC_FAULT_STEP", "0"))
-    if fault_step and proc_id == 1:
+    victim = int(os.environ.get("ELASTIC_FAULT_RANK", "1"))
+    if fault_step and proc_id == victim:
         faultinject.set_schedule(FaultSchedule([Fault(
             kind=os.environ.get("ELASTIC_FAULT_KIND", "kill_host"),
             step=fault_step,
-            duration=float(os.environ.get("ELASTIC_FAULT_S", "6.0")))]))
+            duration=float(os.environ.get("ELASTIC_FAULT_S", "6.0")),
+            rank=int(os.environ.get("ELASTIC_JOIN_RANK", "-1")))]))
     trainer = ElasticTrainer(
         _elastic_factory, os.environ["ELASTIC_CKPT"],
         weight_update_sharding="zero1", checkpoint_every=1, keep_last=50,
         step_timeout_s=2.0, heartbeat_timeout_s=3.0, commit_timeout_s=30.0)
-    trainer.fit(_elastic_batches(), epochs=1)
+    try:
+        trainer.fit(_elastic_batches(),
+                    epochs=int(os.environ.get("ELASTIC_EPOCHS", "1")))
+    except ElasticRestartRequired as e:
+        # the group must re-form (election with >1 survivor, or a
+        # scale-up admission): hand the lease record to the driver
+        print("RESTART " + json.dumps(
+            {"survivors": e.survivors, "coordinator": e.coordinator,
+             "epoch": e.epoch, "grow": e.grow}), flush=True)
     print("TRAJ " + json.dumps(trainer.trajectory), flush=True)
     print("WORLD " + json.dumps(trainer.world), flush=True)
     reg = get_registry()
@@ -123,7 +154,7 @@ def _run_elastic_ref() -> None:
     print("REFLOSSES " + " ".join(f"{l:.17g}" for l in losses), flush=True)
 
 
-if mode == "elastic":
+if mode in ("elastic", "elastic_rank0", "elastic_rejoin"):
     _run_elastic()
     sys.exit(0)
 if mode == "elastic_ref":

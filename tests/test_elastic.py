@@ -1,9 +1,15 @@
-"""Elastic preemption-tolerance unit tests (ISSUE 8) — everything that
-does NOT need two real processes (those live in test_multihost.py's
+"""Elastic preemption-tolerance unit tests (ISSUEs 8 + 12) — everything
+that does NOT need two real processes (those live in test_multihost.py's
 elastic chaos cases): cross-width zero1 checkpoint reshard bitwise vs a
-replicated gather, the up-front topology mismatch error, heartbeat
-liveness, the topology override seam, and single-process ElasticTrainer
-resume semantics."""
+replicated gather IN BOTH DIRECTIONS (shrink and scale-up), the
+up-front topology mismatch error, heartbeat liveness, the topology
+override seam, single-process ElasticTrainer resume semantics, the
+lease-based rendezvous protocol (election on any-rank death incl. the
+coordinator, epoch numbering, scale-up admission at epoch boundaries),
+and partition self-fencing."""
+
+import json
+import time
 
 import numpy as np
 import pytest
@@ -18,11 +24,22 @@ from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
 from deeplearning4j_tpu.parallel import MeshContext, ParallelTrainer
 from deeplearning4j_tpu.parallel import multihost
 from deeplearning4j_tpu.parallel.checkpoint import read_topology
+from deeplearning4j_tpu.profiling.metrics import get_registry
+from deeplearning4j_tpu.resilience import faultinject
 from deeplearning4j_tpu.resilience.atomic import CheckpointError
 from deeplearning4j_tpu.resilience.elastic import (ElasticError,
+                                                   ElasticFenced,
+                                                   ElasticRestartRequired,
                                                    ElasticTrainer,
                                                    HostHeartbeat,
-                                                   read_heartbeat_ages)
+                                                   _HostsLost,
+                                                   clear_join_requests,
+                                                   pending_join_ranks,
+                                                   read_heartbeat_ages,
+                                                   read_lease,
+                                                   request_join,
+                                                   write_lease)
+from deeplearning4j_tpu.resilience.faultinject import Fault, FaultSchedule
 from deeplearning4j_tpu.resilience.manager import CheckpointManager
 
 
@@ -66,12 +83,12 @@ def _train_and_save_zero1(tmp_path, dp=4, steps=3):
 # cross-width reshard restore
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("dp_new", [2, 1])
+@pytest.mark.parametrize("dp_new", [8, 2, 1])
 def test_cross_width_restore_bitwise_vs_replicated_gather(tmp_path, dp_new):
-    """save at dp=4 -> restore at dp=2 / dp=1: every zero1 (4, chunk)
-    updater view un-pads BITWISE to the replicated gather of the
-    original, params restore exactly, and a new-width trainer attaches
-    and trains."""
+    """save at dp=4 -> restore at dp=8 (the scale-UP direction a rejoin
+    admission takes) / dp=2 / dp=1: every zero1 (4, chunk) updater view
+    un-pads BITWISE to the replicated gather of the original, params
+    restore exactly, and a new-width trainer attaches and trains."""
     ref_opt, ref_params = _train_and_save_zero1(tmp_path, dp=4)
     net = _net()
     mesh = MeshContext.create(n_data=dp_new, n_model=1,
@@ -115,7 +132,7 @@ def test_topology_recorded_in_cursor_and_manifest(tmp_path):
     info = mgr.latest_valid()
     topo = info.cursor.topology
     assert topo == {"dp": 4, "weight_update_sharding": "zero1",
-                    "process_count": 1}
+                    "process_count": 1, "rendezvous_epoch": 0}
     # and independently in the sharded manifest (cursor-less readers)
     assert read_topology(info.path) == topo
 
@@ -305,6 +322,414 @@ def test_recovery_without_checkpoint_clears_trajectory(tmp_path):
                                "loss": 1.0}]
         trainer._bootstrap()  # empty dir: cursor is None
         assert trainer.trajectory == []
+    finally:
+        trainer.close()
+
+
+# ---------------------------------------------------------------------------
+# lease-based rendezvous: election, epoch numbering, scale-up, fencing
+# ---------------------------------------------------------------------------
+
+def _snap():
+    reg = get_registry()
+    return reg.snapshot("elastic_") | reg.snapshot("resilience_host")
+
+
+def _delta(before, after, key):
+    return after.get(key, 0.0) - before.get(key, 0.0)
+
+
+def test_lease_roundtrip_and_join_requests(tmp_path):
+    assert read_lease(tmp_path) is None
+    write_lease(tmp_path, 3, [1, 2, 5], 1, pending=[7])
+    lease = read_lease(tmp_path)
+    assert lease["epoch"] == 3 and lease["coordinator"] == 1
+    assert lease["world"] == [1, 2, 5] and lease["pending"] == [7]
+    assert pending_join_ranks(tmp_path) == []
+    request_join(tmp_path, 4)
+    request_join(tmp_path, 0)
+    request_join(tmp_path, 4)  # idempotent re-announce
+    assert pending_join_ranks(tmp_path) == [0, 4]
+    clear_join_requests(tmp_path, [0])
+    assert pending_join_ranks(tmp_path) == [4]
+    # announcements expire: an aged request never enters a lease
+    # snapshot (a joiner re-announces until admitted)
+    stale = tmp_path / "join_p4.json"
+    stale.write_text(json.dumps({"rank": 4, "time": time.time() - 999}))
+    assert pending_join_ranks(tmp_path, max_age_s=60.0) == []
+    assert pending_join_ranks(tmp_path) == [4]  # unfiltered read keeps it
+
+
+def test_expired_join_request_not_snapshotted_into_lease(tmp_path):
+    """A join request older than the trainer's TTL (dead joiner or a
+    previous run's leftover) must not ride any lease write — admitting
+    a host that will never start would wedge the grow-restart."""
+    hb = tmp_path / "heartbeats"
+    hb.mkdir(parents=True)
+    (hb / "join_p7.json").write_text(
+        json.dumps({"rank": 7, "time": time.time() - 3600}))
+    trainer = ElasticTrainer(_net, tmp_path, checkpoint_every=1,
+                             step_timeout_s=30.0)
+    try:
+        assert read_lease(trainer.heartbeat_dir)["pending"] == []
+        trainer.fit([_batch(np.random.default_rng(0))
+                     for _ in range(2)], epochs=2)   # no admission
+        assert trainer.consumed_indices(1) == [0, 1]
+        assert (read_lease(trainer.heartbeat_dir))["pending"] == []
+    finally:
+        trainer.close()
+
+
+def test_initial_boot_founds_epoch0_lease(tmp_path):
+    trainer = ElasticTrainer(_net, tmp_path, checkpoint_every=0,
+                             step_timeout_s=30.0)
+    try:
+        lease = read_lease(trainer.heartbeat_dir)
+        assert lease is not None
+        assert lease["epoch"] == 0 and lease["coordinator"] == 0
+        assert lease["world"] == [0]
+        assert trainer.rdv_epoch == 0
+    finally:
+        trainer.close()
+
+
+def test_election_on_coordinator_death_lowest_survivor_takes_lease(
+        tmp_path):
+    """dp=4 world loses rank 0 (the coordinator): the survivors elect
+    rank 1 — this process, which writes the epoch-1 lease — and a
+    multi-survivor world raises ElasticRestartRequired carrying the
+    elected coordinator and the new rendezvous epoch."""
+    trainer = ElasticTrainer(_net, tmp_path, checkpoint_every=0,
+                             step_timeout_s=30.0)
+    before = _snap()
+    try:
+        trainer._world = [0, 1, 2, 3]
+        trainer._rank = 1          # we are a survivor, lowest of them
+        with pytest.raises(ElasticRestartRequired) as ei:
+            trainer._on_hosts_lost(_HostsLost([0], "step 5 barrier"))
+        exc = ei.value
+        assert exc.survivors == [1, 2, 3]
+        assert exc.dead == [0]
+        assert exc.coordinator == 1
+        assert exc.epoch == 1
+        assert not exc.grow
+        lease = read_lease(trainer.heartbeat_dir)
+        assert lease["epoch"] == 1 and lease["coordinator"] == 1
+        assert lease["world"] == [1, 2, 3]
+        after = _snap()
+        assert _delta(before, after, "elastic_elections_total") == 1.0
+        assert _delta(before, after,
+                      "resilience_host_failures_total") == 1.0
+    finally:
+        trainer.close()
+
+
+def test_election_non_elected_survivor_does_not_write_lease(tmp_path):
+    """Rank 2 surviving the same loss computes the same verdict but the
+    lease stays rank 1's to write (single-writer protocol)."""
+    trainer = ElasticTrainer(_net, tmp_path, checkpoint_every=0,
+                             step_timeout_s=30.0)
+    try:
+        boot = read_lease(trainer.heartbeat_dir)
+        trainer._world = [0, 1, 2, 3]
+        trainer._rank = 2
+        with pytest.raises(ElasticRestartRequired) as ei:
+            trainer._on_hosts_lost(_HostsLost([0], "step 5 barrier"))
+        assert ei.value.coordinator == 1 and ei.value.epoch == 1
+        # the epoch-1 lease was NOT written by this (non-elected) rank
+        assert read_lease(trainer.heartbeat_dir) == boot
+    finally:
+        trainer.close()
+
+
+def test_sole_survivor_of_coordinator_death_continues_in_process(
+        tmp_path):
+    """World [0, 1] loses rank 0 — the coordinator. Rank 1 is the sole
+    survivor: it elects ITSELF (original rank 0 is not special), takes
+    the epoch-1 lease, resizes in process, and subsequent checkpoints
+    are stamped with the new rendezvous epoch."""
+    trainer = ElasticTrainer(_net, tmp_path, checkpoint_every=1,
+                             step_timeout_s=30.0)
+    before = _snap()
+    try:
+        trainer._world = [0, 1]
+        trainer._rank = 1
+        trainer._on_hosts_lost(_HostsLost([0], "step 2 barrier"))
+        assert trainer.world == [1]
+        assert trainer.rdv_epoch == 1
+        assert trainer.dp_width >= 1   # rebuilt in process
+        lease = read_lease(trainer.heartbeat_dir)
+        assert lease["epoch"] == 1 and lease["coordinator"] == 1
+        after = _snap()
+        assert _delta(before, after, "elastic_elections_total") == 1.0
+        assert _delta(before, after, "elastic_resizes_total") == 1.0
+        # the post-election topology stamp
+        assert trainer.manager.topology()["rendezvous_epoch"] == 1
+    finally:
+        trainer.close()
+        multihost.set_rendezvous_epoch(0)
+
+
+def test_scale_up_admission_at_epoch_boundary(tmp_path):
+    """A rejoin_host fault announces a replacement (rank 5) at step 2;
+    the coordinator snapshots it into the lease at that step's
+    checkpoint, and at the epoch boundary the world admits it:
+    ElasticRestartRequired(grow=True) carrying the grown world and the
+    next epoch, lease updated, join file consumed."""
+    rng = np.random.default_rng(0)
+    batches = [_batch(rng) for _ in range(3)]
+    trainer = ElasticTrainer(_net, tmp_path, checkpoint_every=1,
+                             step_timeout_s=30.0)
+    before = _snap()
+    faultinject.set_schedule(FaultSchedule(
+        [Fault(kind="rejoin_host", step=2, rank=5)]))
+    try:
+        with pytest.raises(ElasticRestartRequired) as ei:
+            trainer.fit(batches, epochs=2)
+        exc = ei.value
+        assert exc.grow
+        assert exc.survivors == [0, 5]
+        assert exc.coordinator == 0
+        assert exc.epoch == 1
+        # the whole epoch trained before admission (boundary, not
+        # mid-epoch) and the boundary checkpoint exists to resume from
+        assert trainer.consumed_indices(0) == [0, 1, 2]
+        info = trainer.manager.latest_valid()
+        assert info.cursor.epoch == 1 and info.cursor.data_position == 0
+        lease = read_lease(trainer.heartbeat_dir)
+        assert lease["epoch"] == 1 and lease["world"] == [0, 5]
+        assert lease["pending"] == []
+        assert pending_join_ranks(trainer.heartbeat_dir) == []
+        after = _snap()
+        assert _delta(before, after, "elastic_scale_ups_total") == 1.0
+    finally:
+        faultinject.clear()
+        trainer.close()
+        multihost.set_rendezvous_epoch(0)
+
+
+def test_no_scale_up_at_the_final_epoch_boundary(tmp_path):
+    """A join landing in the LAST epoch is not admitted — a
+    grow-restart with no work left would spin the fleet up just to
+    exit, and fit() would report completion as a restart request. The
+    request stays pending for a future run."""
+    rng = np.random.default_rng(0)
+    trainer = ElasticTrainer(_net, tmp_path, checkpoint_every=1,
+                             step_timeout_s=30.0)
+    faultinject.set_schedule(FaultSchedule(
+        [Fault(kind="rejoin_host", step=2, rank=5)]))
+    try:
+        trainer.fit([_batch(rng) for _ in range(3)], epochs=1)
+        assert trainer.consumed_indices(0) == [0, 1, 2]
+        assert pending_join_ranks(trainer.heartbeat_dir) == [5]
+        lease = read_lease(trainer.heartbeat_dir)
+        assert lease["epoch"] == 0 and lease["pending"] == [5]
+    finally:
+        faultinject.clear()
+        trainer.close()
+
+
+def test_scale_up_needs_checkpointing(tmp_path):
+    """checkpoint_every=0: the lease never records pending joins (and a
+    joiner would have no checkpoint to resume from), so the run
+    completes without admission and the join request stays pending."""
+    rng = np.random.default_rng(0)
+    trainer = ElasticTrainer(_net, tmp_path, checkpoint_every=0,
+                             step_timeout_s=30.0)
+    faultinject.set_schedule(FaultSchedule(
+        [Fault(kind="rejoin_host", step=1, rank=3)]))
+    try:
+        # epochs=2 so the epoch-0 boundary is NOT the final one: the
+        # admission path runs and must still decline (no checkpoints)
+        trainer.fit([_batch(rng) for _ in range(2)], epochs=2)
+        assert trainer.consumed_indices(0) == [0, 1]
+        assert trainer.consumed_indices(1) == [0, 1]
+        assert pending_join_ranks(trainer.heartbeat_dir) == [3]
+        assert (read_lease(trainer.heartbeat_dir) or {}).get(
+            "pending", []) == []
+    finally:
+        faultinject.clear()
+        trainer.close()
+
+
+def test_partition_host_self_fences_and_never_commits(tmp_path):
+    """The fencing chaos gate (ISSUE 12 acceptance): a partition_host
+    fault stops this host's heartbeats at step 2 while it keeps
+    running; once its own staleness passes the fleet timeout it must
+    raise ElasticFenced BEFORE dispatching another step — and no
+    checkpoint may be committed after the fence (a partitioned host
+    never writes a shard into a world that has re-formed without
+    it)."""
+    rng = np.random.default_rng(0)
+    batches = [_batch(rng) for _ in range(5)]
+    trainer = ElasticTrainer(_net, tmp_path, checkpoint_every=1,
+                             step_timeout_s=30.0,
+                             heartbeat_interval_s=0.05,
+                             heartbeat_timeout_s=0.4)
+    before = _snap()
+    # partition at step 2 (indefinite), then a slow step 3 long enough
+    # for this host's own staleness to cross the fleet timeout
+    faultinject.set_schedule(FaultSchedule([
+        Fault(kind="partition_host", step=2, duration=0.0),
+        Fault(kind="slow_host", step=3, duration=0.8)]))
+    try:
+        trainer._world = [0, 1]   # pretend a peer exists: fencing arms
+        with pytest.raises(ElasticFenced, match="self-fencing"):
+            trainer.fit(batches, epochs=1)
+        after = _snap()
+        assert _delta(before, after, "elastic_fenced_total") >= 1.0
+        # steps 1 and 2 trained and checkpointed; nothing after the
+        # partition's staleness window may have been committed
+        infos = trainer.manager.checkpoints()
+        assert infos, "pre-fence checkpoints must exist"
+        assert max(i.step for i in infos) <= 2
+        # and the on-disk heartbeat really went stale (what peers see)
+        assert read_heartbeat_ages(trainer.heartbeat_dir)[0] >= 0.4
+    finally:
+        faultinject.clear()
+        trainer.close()
+
+
+def test_save_is_fenced_directly(tmp_path):
+    """The checkpoint-write seam fences independently of the step path:
+    a host whose beacon stopped landing must refuse manager.save."""
+    trainer = ElasticTrainer(_net, tmp_path, checkpoint_every=1,
+                             step_timeout_s=30.0,
+                             heartbeat_timeout_s=0.2)
+    try:
+        trainer._world = [0, 1]
+        trainer._hb._last_written = time.monotonic() - 10.0
+        n_before = len(trainer.manager.checkpoints())
+        with pytest.raises(ElasticFenced):
+            trainer._save(epoch=0, next_pos=1)
+        assert len(trainer.manager.checkpoints()) == n_before
+    finally:
+        trainer.close()
+
+
+def test_newer_lease_is_followed_not_overridden(tmp_path):
+    """The lease is authoritative: a member that detects a 'loss' but
+    finds the lease already moved to a newer epoch must FOLLOW it (the
+    group re-formed — e.g. an admission it raced) instead of forming a
+    divergent solo world; and a member the newer lease excludes must
+    self-fence."""
+    trainer = ElasticTrainer(_net, tmp_path, checkpoint_every=0,
+                             step_timeout_s=30.0)
+    try:
+        # group moved to epoch 2 WITH us: follow it
+        write_lease(trainer.heartbeat_dir, 2, [0, 1], 0)
+        trainer._world = [0, 1]
+        with pytest.raises(ElasticRestartRequired) as ei:
+            trainer._on_hosts_lost(_HostsLost([1], "step 3 barrier"))
+        assert ei.value.epoch == 2 and ei.value.survivors == [0, 1]
+        assert not ei.value.grow
+        # group moved on WITHOUT us: fence, never split-brain
+        write_lease(trainer.heartbeat_dir, 3, [1, 2], 1)
+        trainer.rdv_epoch = 2
+        trainer._world = [0, 1, 2]
+        with pytest.raises(ElasticFenced, match="re-formed without"):
+            trainer._on_hosts_lost(_HostsLost([1], "step 4 barrier"))
+    finally:
+        trainer.close()
+
+
+def test_restart_adopts_lease_epoch_over_renumbered_world(tmp_path):
+    """After an election, the outer scheduler restarts survivors
+    renumbered 0..n-1: the restarted trainer must adopt the lease's
+    EPOCH (the membership counter survives the restart) and re-anchor
+    the lease over the renumbered world."""
+    hb_dir = tmp_path / "heartbeats"
+    write_lease(hb_dir, 2, [1, 3], 1)   # what the pre-restart election left
+    trainer = ElasticTrainer(_net, tmp_path, checkpoint_every=0,
+                             step_timeout_s=30.0)
+    try:
+        assert trainer.rdv_epoch == 2
+        lease = read_lease(hb_dir)
+        assert lease["epoch"] == 2
+        assert lease["world"] == [0]      # renumbered current world
+        assert lease["coordinator"] == 0
+        assert trainer.manager.topology()["rendezvous_epoch"] == 2
+    finally:
+        trainer.close()
+        multihost.set_rendezvous_epoch(0)
+
+
+# ---------------------------------------------------------------------------
+# shuffled-input cursor integration
+# ---------------------------------------------------------------------------
+
+def _shuffled_pipe(batches, seed):
+    from deeplearning4j_tpu.datasets.pipeline import StreamingInputPipeline
+    return StreamingInputPipeline(list(batches), num_shards=1,
+                                  shard_index=0, shuffle_window=3,
+                                  shuffle_seed=seed, place=False)
+
+
+def test_cursor_records_shuffle_signature_and_rejects_mismatch(tmp_path):
+    """ElasticTrainer persists the input pipeline's shuffle identity in
+    every cursor; resuming against a differently-seeded pipeline would
+    silently replay the tail over a re-randomized order, so it raises
+    up front instead."""
+    rng = np.random.default_rng(0)
+    batches = [_batch(rng) for _ in range(4)]
+    first = ElasticTrainer(_net, tmp_path, checkpoint_every=1,
+                           step_timeout_s=30.0)
+    try:
+        first.fit(_shuffled_pipe(batches, seed=11), epochs=1)
+        info = first.manager.latest_valid()
+        assert info.cursor.extra["input"] == {
+            "kind": "windowed_shuffle", "seed": 11, "window": 3}
+    finally:
+        first.close()
+
+    second = ElasticTrainer(_net, tmp_path, checkpoint_every=1,
+                            step_timeout_s=30.0)
+    try:
+        with pytest.raises(ElasticError, match="re-randomize"):
+            second.fit(_shuffled_pipe(batches, seed=99), epochs=1)
+        # the matching pipeline resumes cleanly (epoch already done)
+        second.fit(_shuffled_pipe(batches, seed=11), epochs=1)
+        assert second.trajectory == []
+    finally:
+        second.close()
+
+
+def test_unshuffled_cursor_rejects_shuffled_resume(tmp_path):
+    """The guard is symmetric: a cursor from an UNSHUFFLED run (which
+    records no input signature — indistinguishable from a
+    pre-shuffle-era cursor) must refuse to resume through a shuffled
+    pipeline, whose emission order differs just as much."""
+    rng = np.random.default_rng(0)
+    batches = [_batch(rng) for _ in range(4)]
+    first = ElasticTrainer(_net, tmp_path, checkpoint_every=1,
+                           step_timeout_s=30.0)
+    try:
+        first.fit(batches, epochs=1)   # plain list: no signature
+    finally:
+        first.close()
+    second = ElasticTrainer(_net, tmp_path, checkpoint_every=1,
+                            step_timeout_s=30.0)
+    try:
+        with pytest.raises(ElasticError, match="re-randomize"):
+            second.fit(_shuffled_pipe(batches, seed=11), epochs=2)
+    finally:
+        second.close()
+
+
+def test_stale_join_file_cannot_bypass_checkpoint_gate_at_boot(tmp_path):
+    """A join file left over from a previous run must not ride the
+    FOUNDING lease into an admission when checkpointing is off — the
+    documented checkpoint_every >= 1 gate applies to every lease
+    write, not just the per-save snapshot."""
+    request_join(tmp_path / "heartbeats", 7)
+    trainer = ElasticTrainer(_net, tmp_path, checkpoint_every=0,
+                             step_timeout_s=30.0)
+    try:
+        assert read_lease(trainer.heartbeat_dir)["pending"] == []
+        # and the epoch boundary admits nothing
+        trainer.fit([_batch(np.random.default_rng(0))
+                     for _ in range(2)], epochs=1)
+        assert trainer.consumed_indices(0) == [0, 1]
     finally:
         trainer.close()
 

@@ -287,11 +287,34 @@ def test_gc014_indivisible_surviving_width():
     assert bad[0].severity == Severity.ERROR
 
 
-def test_gc014_growing_width_rejected():
+def test_gc014_grown_width_legal_when_divisible():
+    """Scale-up exists (ISSUE 12): a planned grown width that divides
+    the batch is a legal plan entry, no finding."""
     conf, _ = fixtures.good_mlp()
     findings = check_multilayer(conf, mesh={"dp": 4}, batch_size=32,
                                 elastic_resize_widths=[8])
-    assert any(f.rule == "GC014" and "8" in f.location for f in findings)
+    assert not [f for f in findings if f.rule == "GC014"]
+
+
+def test_gc014_grown_width_must_divide_batch():
+    """A grown width that cannot split the global batch is the same
+    hard ElasticError at post-grow resume a shrink would be — error."""
+    conf, _ = fixtures.good_mlp()
+    findings = check_multilayer(conf, mesh={"dp": 4}, batch_size=32,
+                                elastic_resize_widths=[6])
+    bad = [f for f in findings if f.rule == "GC014"]
+    assert len(bad) == 1 and bad[0].severity == Severity.ERROR
+    assert "dp=6" in bad[0].location
+
+
+def test_gc014_current_width_is_noop_plan_error():
+    """Planning the CURRENT width is not a resize — flagged as a
+    plan typo."""
+    conf, _ = fixtures.good_mlp()
+    findings = check_multilayer(conf, mesh={"dp": 4}, batch_size=32,
+                                elastic_resize_widths=[4])
+    assert any(f.rule == "GC014" and f.severity == Severity.ERROR
+               and "dp=4" in f.location for f in findings)
 
 
 def test_gc014_zero1_pad_waste_reevaluated():

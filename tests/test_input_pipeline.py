@@ -521,3 +521,110 @@ def test_reset_with_stuck_straggler_cannot_corrupt_the_new_run():
         assert _tags(_drain(pipe)) == [0, 1, 2, 3]
     finally:
         gate.set()
+
+
+# ----------------------------------------------------- windowed shuffle
+
+def _shuffled(srcs, seed=11, window=4, **kw):
+    kw.setdefault("num_shards", 1)
+    kw.setdefault("shard_index", 0)
+    kw.setdefault("place", False)
+    return StreamingInputPipeline(srcs, shuffle_window=window,
+                                  shuffle_seed=seed, **kw)
+
+
+def test_windowed_shuffle_order_is_bounded_deterministic_permutation():
+    from deeplearning4j_tpu.datasets.pipeline import windowed_shuffle_order
+    rng = np.random.default_rng([7, 0])
+    order = windowed_shuffle_order(50, 8, rng)
+    assert sorted(order) == list(range(50))
+    assert order != list(range(50))
+    # the buffer bound: no element emitted more than window-1 EARLY
+    assert all(pos >= v - 7 for pos, v in enumerate(order))
+    # pure function of the seeded rng
+    assert order == windowed_shuffle_order(
+        50, 8, np.random.default_rng([7, 0]))
+    # window <= 1 is the identity (shuffle off)
+    assert windowed_shuffle_order(9, 1, rng) == list(range(9))
+
+
+def test_shuffled_emission_deterministic_per_seed_and_epoch():
+    srcs = [_tagged(i) for i in range(10)]
+    o1 = _tags(_drain(_shuffled(srcs)))
+    o2 = _tags(_drain(_shuffled(srcs)))
+    assert o1 == o2                       # same seed -> same order
+    assert sorted(o1) == list(range(10))  # a permutation, exactly once
+    assert o1 != list(range(10))          # and actually shuffled
+    assert _tags(_drain(_shuffled(srcs, seed=99))) != o1
+    # the epoch counter reseeds: a reset() emits a DIFFERENT (but
+    # deterministic) permutation for the next epoch
+    pipe = _shuffled(srcs)
+    first = _tags([b for b in pipe])
+    pipe.reset()
+    second = _tags(_drain(pipe))
+    assert first == o1 and sorted(second) == list(range(10))
+    assert second != first
+
+
+def test_shuffle_cursor_resume_replays_tail_exactly_once():
+    """The resumability contract: a fresh pipeline restored from a
+    mid-stream cursor emits exactly the unconsumed tail, in exactly the
+    unbroken run's order — nothing dropped, doubled or re-randomized."""
+    srcs = [_tagged(i) for i in range(10)]
+    unbroken = _tags(_drain(_shuffled(srcs)))
+
+    broken = _shuffled(srcs)
+    head = []
+    for _ in range(4):
+        head.append(broken.next())
+    state = broken.cursor_state()
+    broken.close()                        # the "crash"
+    assert state == {"shuffle_seed": 11, "shuffle_window": 4,
+                     "epoch": 0, "emitted": 4}
+
+    resumed = _shuffled(srcs).restore_cursor(state)
+    tail = _drain(resumed)
+    assert _tags(head) + _tags(tail) == unbroken
+
+
+def test_restore_cursor_rejects_mismatched_shuffle_identity():
+    srcs = [_tagged(i) for i in range(4)]
+    state = _shuffled(srcs).cursor_state()
+    with pytest.raises(ValueError, match="different emission order"):
+        _shuffled(srcs, seed=99).restore_cursor(state)
+    with pytest.raises(ValueError, match="different emission order"):
+        _shuffled(srcs, window=2).restore_cursor(state)
+    started = _shuffled(srcs)
+    started.next()
+    with pytest.raises(RuntimeError, match="before iteration"):
+        started.restore_cursor(state)
+    started.close()
+
+
+def test_shuffle_signature_present_only_when_shuffling():
+    srcs = [_tagged(i) for i in range(3)]
+    assert _shuffled(srcs).shuffle_signature() == {
+        "kind": "windowed_shuffle", "seed": 11, "window": 4}
+    plain = StreamingInputPipeline(srcs, num_shards=1, shard_index=0,
+                                   place=False)
+    assert plain.shuffle_signature() is None
+    assert _tags(_drain(plain)) == [0, 1, 2]  # source order untouched
+
+
+def test_cursor_state_after_close_describes_interrupted_epoch():
+    """close() mid-epoch must not roll the cursor to the next epoch at
+    position 0 — that would silently drop the interrupted epoch's
+    unconsumed tail on resume. State captured after close() equals the
+    state captured just before it, and resuming from it replays the
+    tail exactly."""
+    srcs = [_tagged(i) for i in range(8)]
+    unbroken = _tags(_drain(_shuffled(srcs, window=3)))
+    pipe = _shuffled(srcs, window=3)
+    head = [pipe.next() for _ in range(3)]
+    before = pipe.cursor_state()
+    pipe.close()
+    after = pipe.cursor_state()
+    assert after == before == {"shuffle_seed": 11, "shuffle_window": 3,
+                               "epoch": 0, "emitted": 3}
+    resumed = _shuffled(srcs, window=3).restore_cursor(after)
+    assert _tags(head) + _tags(_drain(resumed)) == unbroken

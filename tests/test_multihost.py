@@ -159,11 +159,45 @@ from deeplearning4j_tpu.resilience.faultinject import (  # noqa: E402
     KILL_HOST_EXIT_CODE)
 
 
+def _spawn_coordination_sidecar(port, nprocs, env, timeout=60.0):
+    """The external coordination service (rank-0-survivable mode): a
+    process of its own that no training host's death can take down.
+    Polls for the READY line under a wall clock — a wedged sidecar
+    fails the test inside ``timeout``, never hangs it on a blocking
+    readline."""
+    import tempfile
+    import time
+    log = tempfile.NamedTemporaryFile("w+", suffix="_sidecar.log",
+                                      delete=False)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "deeplearning4j_tpu.parallel.multihost",
+         "serve", str(port), str(nprocs)],
+        stdout=log, stderr=subprocess.STDOUT,
+        env=env, cwd=os.path.dirname(HERE))
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        log.seek(0)
+        out = log.read()
+        if "READY" in out:
+            return proc
+        if proc.poll() is not None:
+            break
+        time.sleep(0.1)
+    proc.kill()
+    proc.wait(timeout=30)
+    log.seek(0)
+    pytest.fail(f"sidecar failed to report READY within {timeout:.0f}s "
+                f"(rc={proc.returncode}):\n" + log.read()[-2000:])
+
+
 def _spawn_elastic(tmp_path, fault_kind, fault_step, fault_s=6.0,
-                   timeout=420):
-    """Run the 2-process elastic worker phase; returns (returncodes,
-    outputs)."""
-    import json
+                   timeout=420, mode="elastic", nprocs=2, extra_env=None,
+                   external_service=False):
+    """Run an elastic worker phase (``nprocs`` processes in ``mode``);
+    returns (returncodes, outputs). EVERY worker — and the coordination
+    sidecar, when ``external_service`` — is reaped on every failure
+    path: an orphan's spinning XLA device threads poison subsequent
+    runs on the box (the PR-8 deflake discipline)."""
     import tempfile
     port = _free_port()
     env = _worker_env()
@@ -171,25 +205,39 @@ def _spawn_elastic(tmp_path, fault_kind, fault_step, fault_s=6.0,
     env["ELASTIC_FAULT_KIND"] = fault_kind
     env["ELASTIC_FAULT_STEP"] = str(fault_step)
     env["ELASTIC_FAULT_S"] = str(fault_s)
+    env.update(extra_env or {})
+    sidecar = None
+    if external_service:
+        env["ELASTIC_EXTERNAL_SERVICE"] = "1"
+        sidecar = _spawn_coordination_sidecar(port, nprocs, env)
     logdir = tempfile.mkdtemp(prefix="elastic")
-    logs = [open(os.path.join(logdir, f"w{i}.log"), "w+") for i in range(2)]
+    logs = [open(os.path.join(logdir, f"w{i}.log"), "w+")
+            for i in range(nprocs)]
     procs = [subprocess.Popen(
-        [sys.executable, WORKER, str(i), "2", str(port), "elastic"],
+        [sys.executable, WORKER, str(i), str(nprocs), str(port), mode],
         stdout=logs[i], stderr=subprocess.STDOUT, env=env,
-        cwd=os.path.dirname(HERE)) for i in range(2)]
+        cwd=os.path.dirname(HERE)) for i in range(nprocs)]
     rcs, outs = [], []
-    for i, p in enumerate(procs):
-        try:
-            p.wait(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
+    try:
+        for i, p in enumerate(procs):
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                logs[i].seek(0)
+                pytest.fail(
+                    "elastic worker hung — detection must be bounded:\n"
+                    + logs[i].read()[-3000:])
             logs[i].seek(0)
-            pytest.fail("elastic worker hung — detection must be bounded:\n"
-                        + logs[i].read()[-3000:])
-        logs[i].seek(0)
-        rcs.append(p.returncode)
-        outs.append(logs[i].read())
+            rcs.append(p.returncode)
+            outs.append(logs[i].read())
+    finally:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+                q.wait(timeout=30)
+        if sidecar is not None:
+            sidecar.kill()
+            sidecar.wait(timeout=30)
     return rcs, outs
 
 
@@ -237,6 +285,92 @@ def test_kill_host_survivor_resizes_and_resumes_exactly(tmp_path):
     survivor_tail = [e["loss"] for e in traj if e["step"] > 3]
     np.testing.assert_array_equal(np.float64(survivor_tail),
                                   np.float64(ref_losses))
+
+
+def test_kill_coordinator_survivor_elects_itself_and_resumes(tmp_path):
+    """ISSUE 12's headline case: rank 0 — the coordinator, the host
+    PR 8 documented as unsurvivable — dies at step 4. Rank 1 must
+    detect the loss, ELECT itself (lowest surviving rank takes the
+    epoch-1 lease), resize to dp=1 IN PROCESS, and finish the epoch
+    exactly-once with a post-resume tail bitwise equal to a clean dp=1
+    restart from the same checkpoint + cursor."""
+    rcs, outs = _spawn_elastic(tmp_path, "kill_coordinator", fault_step=4,
+                               mode="elastic_rank0",
+                               extra_env={"ELASTIC_FAULT_RANK": "0"},
+                               external_service=True)
+    assert rcs[0] == KILL_HOST_EXIT_CODE, outs[0][-2000:]  # died BY the fault
+    assert rcs[1] == 0, outs[1][-3000:]
+
+    traj = _parse_tagged(outs[1], "TRAJ")
+    assert [e["index"] for e in traj if e["epoch"] == 0] == list(range(6))
+    assert _parse_tagged(outs[1], "WORLD") == [1]
+    metrics = _parse_tagged(outs[1], "METRICS")
+    assert metrics["elastic_elections_total"] == 1.0
+    assert metrics["elastic_resizes_total"] == 1.0
+    assert metrics["resilience_host_failures_total"] == 1.0
+    assert metrics["elastic_dp_width"] == 1.0
+    assert metrics["elastic_epoch"] == 1.0
+
+    # the lease on disk records the election verbatim
+    import json
+    lease = json.loads((tmp_path / "heartbeats" / "lease.json").read_text())
+    assert lease["epoch"] == 1 and lease["coordinator"] == 1
+    assert lease["world"] == [1]
+
+    # bitwise gate: clean dp=1 restart from the last pre-kill checkpoint
+    env = _worker_env()
+    env["ELASTIC_CKPT"] = str(tmp_path)
+    env["ELASTIC_RESUME_STEP"] = "3"
+    ref = subprocess.run(
+        [sys.executable, WORKER, "0", "1", str(_free_port()), "elastic_ref"],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=os.path.dirname(HERE))
+    assert ref.returncode == 0, ref.stdout[-2000:] + ref.stderr[-2000:]
+    line = next(ln for ln in ref.stdout.splitlines()
+                if ln.startswith("REFLOSSES"))
+    ref_losses = [float(v) for v in line.split()[1:]]
+    survivor_tail = [e["loss"] for e in traj if e["step"] > 3]
+    np.testing.assert_array_equal(np.float64(survivor_tail),
+                                  np.float64(ref_losses))
+
+
+@pytest.mark.slow
+def test_rejoin_host_admitted_at_epoch_boundary_and_group_resumes(
+        tmp_path):
+    """Scale-up, end to end minus the bitwise-wide-ref (that lives in
+    tools/elastic_smoke.py phase 3): a sole host trains epoch 0 while a
+    rejoin_host fault announces a replacement at step 3; the epoch
+    boundary must ADMIT it (RESTART record carrying the grown world +
+    epoch); the restarted 2-process group must resume epoch 1 at dp=2
+    and consume it exactly once with identical trajectories."""
+    rcs, outs = _spawn_elastic(
+        tmp_path, "rejoin_host", fault_step=3, mode="elastic_rejoin",
+        nprocs=1,
+        extra_env={"ELASTIC_JOIN_RANK": "1", "ELASTIC_EPOCHS": "2",
+                   "ELASTIC_FAULT_RANK": "0"})
+    assert rcs == [0], outs[0][-3000:]
+    restart = _parse_tagged(outs[0], "RESTART")
+    assert restart == {"survivors": [0, 1], "coordinator": 0,
+                       "epoch": 1, "grow": True}
+    traj_a = _parse_tagged(outs[0], "TRAJ")
+    assert [e["index"] for e in traj_a if e["epoch"] == 0] == list(range(6))
+    metrics_a = _parse_tagged(outs[0], "METRICS")
+    assert metrics_a["elastic_scale_ups_total"] == 1.0
+    assert metrics_a["elastic_resizes_total"] == 0.0
+
+    # stage B: the scheduler's restart of the grown world — 2 fresh
+    # processes, no fault, resuming the boundary checkpoint at dp=2
+    rcs, outs = _spawn_elastic(
+        tmp_path, "kill_host", fault_step=0, mode="elastic", nprocs=2,
+        extra_env={"ELASTIC_EPOCHS": "2"})
+    assert rcs == [0, 0], outs[0][-2000:] + outs[1][-2000:]
+    t0, t1 = (_parse_tagged(o, "TRAJ") for o in outs)
+    assert t0 == t1  # synchronous SPMD at the grown width
+    assert [e["index"] for e in t0 if e["epoch"] == 1] == list(range(6))
+    assert [e for e in t0 if e["epoch"] == 0] == []  # epoch 0 not replayed
+    m0 = _parse_tagged(outs[0], "METRICS")
+    assert m0["elastic_epoch"] == 1.0
+    assert m0["elastic_resizes_total"] == 0.0
 
 
 def test_slow_host_surfaces_as_barrier_timeout_not_hang(tmp_path):

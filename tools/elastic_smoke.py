@@ -1,22 +1,38 @@
 #!/usr/bin/env python
-"""Elastic-training smoke stage (tools/run_checks.sh): a 2-process CPU
-run (1 device per process, dp=2, zero1) loses rank 1 to a hard
-``kill_host`` at step 4. The surviving rank 0 must
+"""Elastic-training smoke stage (tools/run_checks.sh): three phases on
+2-process CPU (1 device per process, gloo collectives), each ending in
+a BITWISE comparison against a clean restart at the resulting width and
+an exactly-once cursor-tail check. Exit 0 = the whole detect ->
+elect -> resize/grow -> reshard-restore -> tail-resume lifecycle is
+wired end to end.
 
-1. detect the loss within its bounded step-barrier/heartbeat windows
-   (never a silent hang — the driver enforces a wall clock),
-2. resize the mesh to dp=1 and reshard-restore the latest valid
-   sharded checkpoint (zero1 ``(2, chunk)`` updater views un-padded to
-   full shape),
-3. finish the epoch consuming exactly the unconsumed tail — every
-   batch index once, none dropped or doubled,
-4. produce a post-resume loss trajectory that is BITWISE identical to
-   a clean dp=1 run restarted from the same checkpoint + cursor, and
-5. serve ``/api/metrics`` showing exactly one ``elastic_resizes_total``
-   (fetched over a real HTTP socket, the PR-2 wiring).
+Phase 1 — kill_host (the PR-8 classic): rank 1 dies at step 4; rank 0
+  detects within its bounded windows, resizes to dp=1,
+  reshard-restores (zero1 ``(2, chunk)`` views un-padded), finishes the
+  epoch consuming exactly the unconsumed tail, bitwise vs a clean dp=1
+  restart — and serves ``/api/metrics`` over a real HTTP socket with
+  exactly one ``elastic_resizes_total``.
 
-Exit 0 = the detect -> resize -> reshard-restore -> tail-resume
-lifecycle is wired end to end.
+Phase 2 — kill_coordinator (ISSUE 12): rank 0 — the coordinator — dies
+  at step 4. The coordination service runs EXTERNALLY (sidecar
+  process; ``multihost.serve_coordination``), so rank 1 survives the
+  service host's death, ELECTS itself (lowest surviving rank takes the
+  epoch-1 lease), resizes to dp=1 in process, and finishes
+  exactly-once, bitwise vs the same clean dp=1 restart. The driver
+  reads the lease back from disk: epoch 1, coordinator 1, world [1].
+
+Phase 3 — rejoin -> scale-UP (ISSUE 12): a sole host trains epoch 0 at
+  dp=1 while a ``rejoin_host`` fault announces a replacement (rank 1)
+  at step 3; the epoch boundary must ADMIT it
+  (``ElasticRestartRequired(grow=True)`` + the epoch-1 lease naming
+  world [0, 1]). The restarted 2-process group resumes epoch 1 at
+  dp=2, consuming it exactly once — bitwise vs a clean 2-process dp=2
+  (zero1) restart from the boundary checkpoint.
+
+The driver process stays jax-free; every compute half is a re-exec'd
+subprocess, reaped on all failure paths. Bounded retries apply ONLY on
+the documented upstream gloo slot-race signature
+(``gloo::EnforceNotMet`` — see tests/test_multihost.py).
 """
 
 import json
@@ -30,11 +46,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 KILL_STEP = 4
+REJOIN_STEP = 3
 # Must equal faultinject.KILL_HOST_EXIT_CODE (tested in
 # tests/test_elastic.py); hand-copied because importing the package
 # pulls in jax, and this driver process must stay jax-free.
 KILL_HOST_EXIT_CODE = 117
 N_BATCHES = 6
+_GLOO_RACE_MARKER = "gloo::EnforceNotMet"
 
 
 # ---------------------------------------------------------------------------
@@ -64,55 +82,75 @@ def _batches():
             for _ in range(N_BATCHES)]
 
 
-def _worker(rank: int, port: str, ckpt: str) -> int:
+def _jax_cpu():
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                + " --xla_force_host_platform_device_count=1")
     import jax
     jax.config.update("jax_platforms", "cpu")
+    return jax
+
+
+def _worker(rank: int, port: str, ckpt: str) -> int:
+    _jax_cpu()
     from deeplearning4j_tpu.parallel import multihost
+    from deeplearning4j_tpu.profiling.metrics import get_registry
     from deeplearning4j_tpu.resilience import faultinject
-    from deeplearning4j_tpu.resilience.elastic import ElasticTrainer
+    from deeplearning4j_tpu.resilience.elastic import (
+        ElasticRestartRequired, ElasticTrainer)
     from deeplearning4j_tpu.resilience.faultinject import (Fault,
                                                            FaultSchedule)
-    multihost.initialize(coordinator=f"localhost:{port}", num_processes=2,
-                         process_id=rank, elastic=True)
-    if rank == 1:
-        faultinject.set_schedule(FaultSchedule(
-            [Fault(kind="kill_host", step=KILL_STEP)]))
+    nprocs = int(os.environ.get("SMOKE_NPROCS", "2"))
+    multihost.initialize(
+        coordinator=f"localhost:{port}", num_processes=nprocs,
+        process_id=rank, elastic=True,
+        host_service=(False if os.environ.get("SMOKE_EXTERNAL") else None))
+    fault_step = int(os.environ.get("SMOKE_FAULT_STEP", "0"))
+    if fault_step and rank == int(os.environ.get("SMOKE_VICTIM", "1")):
+        faultinject.set_schedule(FaultSchedule([Fault(
+            kind=os.environ.get("SMOKE_KIND", "kill_host"),
+            step=fault_step,
+            rank=int(os.environ.get("SMOKE_JOIN_RANK", "-1")))]))
     trainer = ElasticTrainer(
         _factory, ckpt, weight_update_sharding="zero1",
         checkpoint_every=1, keep_last=50,
         step_timeout_s=2.0, heartbeat_timeout_s=3.0, commit_timeout_s=30.0)
-    trainer.fit(_batches(), epochs=1)
+    try:
+        trainer.fit(_batches(),
+                    epochs=int(os.environ.get("SMOKE_EPOCHS", "1")))
+    except ElasticRestartRequired as e:
+        print("RESTART " + json.dumps(
+            {"survivors": e.survivors, "coordinator": e.coordinator,
+             "epoch": e.epoch, "grow": e.grow}), flush=True)
     print("TRAJ " + json.dumps(trainer.trajectory), flush=True)
+    reg = get_registry()
+    print("METRICS " + json.dumps(
+        dict(reg.snapshot("elastic_")) | dict(reg.snapshot(
+            "resilience_host"))), flush=True)
 
-    # the /api/metrics gate: serve the registry on an ephemeral port and
-    # read elastic_resizes_total back over a real HTTP socket
-    import urllib.request
+    if os.environ.get("SMOKE_HTTP"):
+        # the /api/metrics gate: serve the registry on an ephemeral
+        # port and read elastic_resizes_total back over a real socket
+        import urllib.request
 
-    from deeplearning4j_tpu.ui.server import UIServer
-    server = UIServer(port=0).start()
-    text = urllib.request.urlopen(
-        f"http://127.0.0.1:{server.port}/api/metrics", timeout=10
-    ).read().decode()
-    resizes = [ln.split()[-1] for ln in text.splitlines()
-               if ln.startswith("elastic_resizes_total")]
-    print("HTTP_RESIZES " + (resizes[0] if resizes else "absent"),
-          flush=True)
-    server.stop()
+        from deeplearning4j_tpu.ui.server import UIServer
+        server = UIServer(port=0).start()
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/api/metrics", timeout=10
+        ).read().decode()
+        resizes = [ln.split()[-1] for ln in text.splitlines()
+                   if ln.startswith("elastic_resizes_total")]
+        print("HTTP_RESIZES " + (resizes[0] if resizes else "absent"),
+              flush=True)
+        server.stop()
     trainer.close()
     return 0
 
 
 def _ref(ckpt: str, resume_step: int) -> int:
     """Clean dp=1 restart from the resume checkpoint: the bitwise
-    reference trajectory."""
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                               + " --xla_force_host_platform_device_count=1")
-    import jax
-    jax.config.update("jax_platforms", "cpu")
+    reference trajectory for phases 1 and 2."""
+    _jax_cpu()
     from deeplearning4j_tpu.parallel import MeshContext, ParallelTrainer
     from deeplearning4j_tpu.resilience.manager import CheckpointManager
     net = _factory()
@@ -124,6 +162,36 @@ def _ref(ckpt: str, resume_step: int) -> int:
     batches = _batches()
     losses = [float(trainer.fit_batch(batches[i]))
               for i in range(cursor.data_position, len(batches))]
+    print("REFLOSSES " + " ".join(f"{l:.17g}" for l in losses), flush=True)
+    return 0
+
+
+def _ref2(rank: int, port: str, ckpt: str, resume_step: int) -> int:
+    """Clean 2-process dp=2 (zero1) restart from the scale-up boundary
+    checkpoint: the bitwise reference for phase 3's grown world."""
+    jax = _jax_cpu()
+    from deeplearning4j_tpu.parallel import (MeshContext, ParallelTrainer,
+                                             multihost)
+    from deeplearning4j_tpu.resilience.elastic import ElasticTrainer
+    from deeplearning4j_tpu.resilience.manager import CheckpointManager
+    multihost.initialize(coordinator=f"localhost:{port}",
+                         num_processes=2, process_id=rank)
+    net = _factory()
+    mesh = MeshContext.create(n_data=2)
+    mgr = CheckpointManager(ckpt, sharded=True, mesh_ctx=mesh,
+                            weight_update_sharding="zero1")
+    info = next(i for i in mgr.checkpoints() if i.step == resume_step)
+    cursor = mgr.restore(net, info, reshard=True)
+    trainer = ParallelTrainer(net, mesh, weight_update_sharding="zero1")
+    batches = _batches()
+    losses = []
+    for i in range(cursor.data_position, len(batches)):
+        local = ElasticTrainer._slice_batch(
+            batches[i], multihost.local_batch_slice(
+                batches[i].num_examples()))
+        losses.append(float(trainer.fit_batch(local)))
+        # serialize steps on the gloo path (slot-race discipline)
+        jax.block_until_ready((net.params, net.opt_state))
     print("REFLOSSES " + " ".join(f"{l:.17g}" for l in losses), flush=True)
     return 0
 
@@ -145,78 +213,303 @@ def _tagged(out: str, tag: str) -> str:
                 if ln.startswith(tag + " "))[len(tag) + 1:]
 
 
-def main() -> int:
-    port = _free_port()
-    ckpt = tempfile.mkdtemp(prefix="elastic_smoke_ckpt")
+def _base_env() -> dict:
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
+    for k in list(env):
+        if k.startswith("SMOKE_"):
+            del env[k]
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    me = os.path.abspath(__file__)
-    logs = [tempfile.NamedTemporaryFile("w+", suffix=f"_w{i}.log",
-                                        delete=False) for i in range(2)]
-    procs = [subprocess.Popen(
-        [sys.executable, me, "--worker", str(i), str(port), ckpt],
-        stdout=logs[i], stderr=subprocess.STDOUT, env=env)
-        for i in range(2)]
-    outs = []
-    for i, p in enumerate(procs):
-        try:
-            # the wall clock IS the no-silent-hang gate: detection +
-            # resume must complete well inside it
-            p.wait(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            logs[i].seek(0)
-            print("elastic_smoke: FAIL worker hung (detection must be "
-                  "bounded)\n" + logs[i].read()[-3000:])
-            return 1
-        logs[i].seek(0)
-        outs.append(logs[i].read())
-    if procs[1].returncode != KILL_HOST_EXIT_CODE:
-        print(f"elastic_smoke: FAIL rank 1 exited {procs[1].returncode}, "
-              f"wanted kill_host's {KILL_HOST_EXIT_CODE}\n" + outs[1][-3000:])
-        return 1
-    if procs[0].returncode != 0:
-        print("elastic_smoke: FAIL survivor crashed\n" + outs[0][-3000:])
-        return 1
+    return env
 
-    traj = json.loads(_tagged(outs[0], "TRAJ"))
+
+class _GlooRace(Exception):
+    """A worker died of the documented upstream gloo slot race — the
+    attempt (only) is retryable."""
+
+
+def _spawn(argv_per_proc, env, tag, timeout=300):
+    """Spawn one subprocess per argv, wait, reap EVERYTHING on every
+    path, return (returncodes, outputs)."""
+    logs = [tempfile.NamedTemporaryFile("w+", suffix=f"_{tag}{i}.log",
+                                        delete=False)
+            for i in range(len(argv_per_proc))]
+    procs = [subprocess.Popen(argv, stdout=logs[i],
+                              stderr=subprocess.STDOUT, env=env)
+             for i, argv in enumerate(argv_per_proc)]
+    rcs, outs = [], []
+    try:
+        for i, p in enumerate(procs):
+            try:
+                # the wall clock IS the no-silent-hang gate: detection +
+                # resume must complete well inside it
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                logs[i].seek(0)
+                raise AssertionError(
+                    f"elastic_smoke: {tag} worker {i} hung (detection "
+                    "must be bounded)\n" + logs[i].read()[-3000:])
+            logs[i].seek(0)
+            rcs.append(p.returncode)
+            outs.append(logs[i].read())
+    finally:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+                q.wait(timeout=30)
+    return rcs, outs
+
+
+def _start_sidecar(port: int, nprocs: int, env: dict, timeout: float = 60.0):
+    """Bounded READY wait: stdout goes to a file polled under a wall
+    clock — a sidecar that wedges before printing READY (port bind,
+    import stall) fails the smoke inside ``timeout`` instead of
+    hanging the driver on a blocking readline forever."""
+    import time
+    log = tempfile.NamedTemporaryFile("w+", suffix="_sidecar.log",
+                                      delete=False)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "deeplearning4j_tpu.parallel.multihost",
+         "serve", str(port), str(nprocs)],
+        stdout=log, stderr=subprocess.STDOUT, env=env)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        log.seek(0)
+        out = log.read()
+        if "READY" in out:
+            return proc
+        if proc.poll() is not None:
+            break
+        time.sleep(0.1)
+    proc.kill()
+    proc.wait(timeout=30)
+    log.seek(0)
+    raise AssertionError(
+        "elastic_smoke: coordination sidecar failed to report READY "
+        f"within {timeout:.0f}s (rc={proc.returncode}):\n"
+        + log.read()[-2000:])
+
+
+def _check_gloo_race(rcs, outs, expected_kill_ranks=()):
+    """Raise _GlooRace when a worker death carries the upstream race's
+    own signature (retryable); pass otherwise."""
+    for i, (rc, out) in enumerate(zip(rcs, outs)):
+        if rc not in (0, None) and i not in expected_kill_ranks \
+                and _GLOO_RACE_MARKER in out:
+            raise _GlooRace(f"worker {i} hit the gloo slot race")
+
+
+def _me():
+    return os.path.abspath(__file__)
+
+
+def _kill_phase(name, victim, kind, external):
+    """Phases 1 and 2 share one shape: 2-process run, hard-kill one
+    rank at KILL_STEP, survivor finishes exactly-once and matches the
+    clean dp=1 restart bitwise."""
+    port = _free_port()
+    ckpt = tempfile.mkdtemp(prefix=f"elastic_smoke_{name}")
+    env = _base_env()
+    env.update({"SMOKE_FAULT_STEP": str(KILL_STEP), "SMOKE_VICTIM":
+                str(victim), "SMOKE_KIND": kind})
+    survivor = 1 - victim
+    if survivor == 0:
+        env["SMOKE_HTTP"] = "1"   # phase 1 carries the HTTP metrics gate
+    sidecar = None
+    if external:
+        env["SMOKE_EXTERNAL"] = "1"
+        sidecar = _start_sidecar(port, 2, _base_env())
+    try:
+        rcs, outs = _spawn(
+            [[sys.executable, _me(), "--worker", str(i), str(port), ckpt]
+             for i in range(2)], env, name)
+    finally:
+        if sidecar is not None:
+            sidecar.kill()
+            sidecar.wait(timeout=30)
+    if rcs[victim] != KILL_HOST_EXIT_CODE:
+        _check_gloo_race(rcs, outs)
+        print(f"elastic_smoke: FAIL {name} rank {victim} exited "
+              f"{rcs[victim]}, wanted kill's {KILL_HOST_EXIT_CODE}\n"
+              + outs[victim][-3000:])
+        return False
+    if rcs[survivor] != 0:
+        _check_gloo_race(rcs, outs, expected_kill_ranks=(victim,))
+        print(f"elastic_smoke: FAIL {name} survivor crashed\n"
+              + outs[survivor][-3000:])
+        return False
+
+    traj = json.loads(_tagged(outs[survivor], "TRAJ"))
     indices = [e["index"] for e in traj if e["epoch"] == 0]
     if indices != list(range(N_BATCHES)):
-        print(f"elastic_smoke: FAIL batch indices {indices} != exactly-once "
-              f"{list(range(N_BATCHES))}")
-        return 1
+        print(f"elastic_smoke: FAIL {name} batch indices {indices} != "
+              f"exactly-once {list(range(N_BATCHES))}")
+        return False
+    metrics = json.loads(_tagged(outs[survivor], "METRICS"))
+    want = {"elastic_resizes_total": 1.0,
+            "resilience_host_failures_total": 1.0,
+            "elastic_dp_width": 1.0}
+    if victim == 0:
+        # the coordinator died: the survivor must have held an election
+        # and the epoch-1 lease must name it on disk
+        want |= {"elastic_elections_total": 1.0, "elastic_epoch": 1.0}
+        lease = json.loads(open(os.path.join(
+            ckpt, "heartbeats", "lease.json")).read())
+        if (lease["epoch"], lease["coordinator"],
+                lease["world"]) != (1, survivor, [survivor]):
+            print(f"elastic_smoke: FAIL {name} lease {lease} does not "
+                  f"record rank {survivor}'s election at epoch 1")
+            return False
+    bad = {k: metrics.get(k) for k, v in want.items()
+           if metrics.get(k) != v}
+    if bad:
+        print(f"elastic_smoke: FAIL {name} counters {bad} != "
+              f"{ {k: want[k] for k in bad} }")
+        return False
 
-    resizes = _tagged(outs[0], "HTTP_RESIZES")
-    try:
-        resizes = float(resizes)
-    except ValueError:
-        resizes = None
-    if resizes != 1.0:
-        print(f"elastic_smoke: FAIL /api/metrics elastic_resizes_total = "
-              f"{resizes!r}, wanted exactly one")
-        return 1
+    if victim == 1:
+        resizes = _tagged(outs[survivor], "HTTP_RESIZES")
+        try:
+            resizes = float(resizes)
+        except ValueError:
+            resizes = None
+        if resizes != 1.0:
+            print(f"elastic_smoke: FAIL /api/metrics "
+                  f"elastic_resizes_total = {resizes!r}, wanted one")
+            return False
 
     ref = subprocess.run(
-        [sys.executable, me, "--ref", ckpt, str(KILL_STEP - 1)],
-        capture_output=True, text=True, timeout=300, env=env)
+        [sys.executable, _me(), "--ref", ckpt, str(KILL_STEP - 1)],
+        capture_output=True, text=True, timeout=300, env=_base_env())
     if ref.returncode != 0:
-        print("elastic_smoke: FAIL reference run\n"
+        print(f"elastic_smoke: FAIL {name} reference run\n"
               + ref.stdout[-2000:] + ref.stderr[-2000:])
-        return 1
-    ref_losses = [float(v) for v in
-                  _tagged(ref.stdout, "REFLOSSES").split()]
+        return False
+    ref_losses = [float(v) for v in _tagged(ref.stdout,
+                                            "REFLOSSES").split()]
     tail = [e["loss"] for e in traj if e["step"] > KILL_STEP - 1]
     if tail != ref_losses:
-        print(f"elastic_smoke: FAIL post-resume trajectory {tail} is not "
-              f"bitwise the clean dp=1 restart's {ref_losses}")
-        return 1
+        print(f"elastic_smoke: FAIL {name} post-resume trajectory "
+              f"{tail} is not bitwise the clean dp=1 restart's "
+              f"{ref_losses}")
+        return False
+    print(f"elastic_smoke: {name} OK — {kind}@{KILL_STEP} -> rank "
+          f"{survivor} resumed at dp=1, {len(tail)} post-resume steps "
+          "bitwise-matched")
+    return True
 
-    print(f"elastic_smoke: PASS kill_host@{KILL_STEP} -> dp=1 resume, "
-          f"{len(tail)} post-resume steps bitwise-matched, exactly one "
-          "resize on /api/metrics")
+
+def _rejoin_phase():
+    """Phase 3: sole host + rejoin announcement -> boundary admission ->
+    restarted 2-process world resumes epoch 1 at dp=2, bitwise vs the
+    clean wide restart."""
+    ckpt = tempfile.mkdtemp(prefix="elastic_smoke_p3")
+    env = _base_env()
+    env.update({"SMOKE_NPROCS": "1", "SMOKE_FAULT_STEP": str(REJOIN_STEP),
+                "SMOKE_VICTIM": "0", "SMOKE_KIND": "rejoin_host",
+                "SMOKE_JOIN_RANK": "1", "SMOKE_EPOCHS": "2"})
+    rcs, outs = _spawn(
+        [[sys.executable, _me(), "--worker", "0", str(_free_port()), ckpt]],
+        env, "p3a")
+    if rcs != [0]:
+        print("elastic_smoke: FAIL rejoin stage A crashed\n"
+              + outs[0][-3000:])
+        return False
+    restart = json.loads(_tagged(outs[0], "RESTART"))
+    if restart != {"survivors": [0, 1], "coordinator": 0, "epoch": 1,
+                   "grow": True}:
+        print(f"elastic_smoke: FAIL admission record {restart} != grown "
+              "world [0, 1] at epoch 1")
+        return False
+    metrics = json.loads(_tagged(outs[0], "METRICS"))
+    if metrics.get("elastic_scale_ups_total") != 1.0:
+        print(f"elastic_smoke: FAIL elastic_scale_ups_total = "
+              f"{metrics.get('elastic_scale_ups_total')!r}, wanted one")
+        return False
+    lease = json.loads(open(os.path.join(ckpt, "heartbeats",
+                                         "lease.json")).read())
+    if (lease["epoch"], lease["world"]) != (1, [0, 1]):
+        print(f"elastic_smoke: FAIL lease {lease} does not admit "
+              "world [0, 1] at epoch 1")
+        return False
+
+    # stage B: the scheduler's restart of the grown world
+    port = _free_port()
+    env_b = _base_env()
+    env_b["SMOKE_EPOCHS"] = "2"
+    rcs, outs = _spawn(
+        [[sys.executable, _me(), "--worker", str(i), str(port), ckpt]
+         for i in range(2)], env_b, "p3b")
+    if rcs != [0, 0]:
+        _check_gloo_race(rcs, outs)
+        print("elastic_smoke: FAIL grown world crashed\n"
+              + outs[0][-2000:] + outs[1][-2000:])
+        return False
+    trajs = [json.loads(_tagged(o, "TRAJ")) for o in outs]
+    if trajs[0] != trajs[1]:
+        print("elastic_smoke: FAIL grown-world trajectories diverge "
+              "across processes")
+        return False
+    epoch1 = [e for e in trajs[0] if e["epoch"] == 1]
+    if [e["index"] for e in epoch1] != list(range(N_BATCHES)) \
+            or [e for e in trajs[0] if e["epoch"] == 0]:
+        print(f"elastic_smoke: FAIL grown world consumed "
+              f"{[e['index'] for e in epoch1]} of epoch 1 (and "
+              f"{len(trajs[0]) - len(epoch1)} stale epoch-0 entries) — "
+              "wanted exactly the unconsumed epoch")
+        return False
+
+    # stage C: clean 2-process dp=2 restart from the boundary checkpoint
+    port = _free_port()
+    rcs, outs = _spawn(
+        [[sys.executable, _me(), "--ref2", str(i), str(port), ckpt,
+          str(N_BATCHES)] for i in range(2)], _base_env(), "p3c")
+    if rcs != [0, 0]:
+        _check_gloo_race(rcs, outs)
+        print("elastic_smoke: FAIL wide reference run crashed\n"
+              + outs[0][-2000:] + outs[1][-2000:])
+        return False
+    ref_losses = [float(v) for v in _tagged(outs[0], "REFLOSSES").split()]
+    got = [e["loss"] for e in epoch1]
+    if got != ref_losses:
+        print(f"elastic_smoke: FAIL post-scale-up trajectory {got} is "
+              f"not bitwise the clean dp=2 restart's {ref_losses}")
+        return False
+    print(f"elastic_smoke: p3 OK — rejoin@{REJOIN_STEP} admitted at the "
+          f"epoch boundary, dp=1 -> dp=2, {len(got)} grown-world steps "
+          "bitwise-matched vs the clean wide restart")
+    return True
+
+
+def main() -> int:
+    phases = [
+        ("p1", lambda: _kill_phase("p1", victim=1, kind="kill_host",
+                                   external=False)),
+        ("p2", lambda: _kill_phase("p2", victim=0,
+                                   kind="kill_coordinator",
+                                   external=True)),
+        ("p3", _rejoin_phase),
+    ]
+    for name, phase in phases:
+        ok = False
+        for attempt in range(3):
+            try:
+                ok = phase()
+                break
+            except _GlooRace as e:
+                print(f"elastic_smoke: {name} attempt {attempt + 1} hit "
+                      f"the upstream gloo race ({e}); retrying")
+            except AssertionError as e:
+                print(str(e))
+                break
+        if not ok:
+            print(f"elastic_smoke: FAIL ({name})")
+            return 1
+    print("elastic_smoke: PASS all three phases — kill-host resume, "
+          "kill-coordinator election, rejoin scale-up: each tail "
+          "bitwise vs a clean restart at the resulting width, cursor "
+          "consumed exactly once")
     return 0
 
 
@@ -225,4 +518,7 @@ if __name__ == "__main__":
         sys.exit(_worker(int(sys.argv[2]), sys.argv[3], sys.argv[4]))
     if len(sys.argv) > 1 and sys.argv[1] == "--ref":
         sys.exit(_ref(sys.argv[2], int(sys.argv[3])))
+    if len(sys.argv) > 1 and sys.argv[1] == "--ref2":
+        sys.exit(_ref2(int(sys.argv[2]), sys.argv[3], sys.argv[4],
+                       int(sys.argv[5])))
     sys.exit(main())

@@ -15,9 +15,16 @@ exists to hide). Gates, per ISSUE 7's acceptance criteria:
    below the sync baseline's.
 3. The ``input_*`` stage counters actually accumulated on the metrics
    registry (the /api/metrics wiring).
+4. **Shuffle-on resume parity (ISSUE 12)** — with the windowed shuffle
+   enabled, a run broken after 3 batches and resumed through a FRESH
+   pipeline restored from ``cursor_state()`` must be BITWISE identical
+   (per-step losses and final params) to the unbroken shuffled run:
+   the shuffle RNG + window cursor replay the exact same emission
+   order, the consumed prefix exactly once skipped, the tail exactly
+   once trained, nothing re-randomized.
 
-Exit 0 = the input pipeline is wired end to end and measurably faster
-than the sync feed on a slow source.
+Exit 0 = the input pipeline is wired end to end, measurably faster
+than the sync feed on a slow source, and shuffled-yet-resumable.
 """
 
 import os
@@ -133,10 +140,53 @@ def main() -> int:
               f"{missing} (have {sorted(snap)})")
         return 1
 
+    # -- shuffle-on resume parity (ISSUE 12) --------------------------------
+    SHUF = {"shuffle_window": 4, "shuffle_seed": 17,
+            "num_shards": 1, "shard_index": 0}
+    BREAK_AT = 3
+
+    def run_shuffled(resume: bool):
+        net = build()
+        tr = ParallelTrainer(net, MeshContext.create(n_data=DP, n_model=1))
+        losses = []
+
+        def consume(pipe, upto=None):
+            while (upto is None or len(losses) < upto) and pipe.has_next():
+                losses.append(float(tr.fit_batch(pipe.next())))
+
+        pipe = StreamingInputPipeline(list(batches), **SHUF)
+        if not resume:
+            consume(pipe)
+        else:
+            consume(pipe, upto=BREAK_AT)
+            state = pipe.cursor_state()
+            pipe.close()                      # the "crash"
+            pipe = StreamingInputPipeline(list(batches), **SHUF)
+            pipe.restore_cursor(state)        # fresh pipeline, same order
+            consume(pipe)
+        return losses, np.asarray(net.params_flat())
+
+    unbroken_losses, unbroken_params = run_shuffled(resume=False)
+    resumed_losses, resumed_params = run_shuffled(resume=True)
+    if len(unbroken_losses) != BATCHES:
+        print(f"input_smoke: FAIL shuffled run consumed "
+              f"{len(unbroken_losses)} batches, wanted {BATCHES}")
+        return 1
+    if np.float64(unbroken_losses).tobytes() \
+            != np.float64(resumed_losses).tobytes():
+        print(f"input_smoke: FAIL shuffled resume re-randomized the "
+              f"order — unbroken {unbroken_losses} vs resumed "
+              f"{resumed_losses}")
+        return 1
+    if unbroken_params.tobytes() != resumed_params.tobytes():
+        print("input_smoke: FAIL shuffled resumed params diverged "
+              "bitwise from the unbroken run")
+        return 1
     print(f"input_smoke: OK — {BATCHES} LeNet steps bitwise loss-equal, "
           f"input_stall_s {stall_pipe:.3f}s (pipeline) < "
           f"{stall_sync:.3f}s (sync, {DECODE_DELAY_S * 1e3:.0f}ms sleepy "
-          f"decode/batch), {stall_pipe / max(stall_sync, 1e-9):.2f}x")
+          f"decode/batch), {stall_pipe / max(stall_sync, 1e-9):.2f}x; "
+          f"shuffled resume@{BREAK_AT} bitwise == unbroken shuffled run")
     return 0
 
 

@@ -85,8 +85,10 @@ if [ "${1:-}" != "--fast" ]; then
     stage "bench smoke (input+serve rungs)" bench_smoke
     stage "zero1 smoke"      env JAX_PLATFORMS=cpu python tools/zero1_smoke.py
     stage "zero2 smoke"      env JAX_PLATFORMS=cpu python tools/zero2_smoke.py
-    stage "input smoke"      env JAX_PLATFORMS=cpu python tools/input_smoke.py
-    stage "elastic smoke"    env JAX_PLATFORMS=cpu python tools/elastic_smoke.py
+    stage "input smoke (+shuffle resume)" env JAX_PLATFORMS=cpu \
+        python tools/input_smoke.py
+    stage "elastic smoke (3 phases)" env JAX_PLATFORMS=cpu \
+        python tools/elastic_smoke.py
     stage "tier-1 tests"     tier1
 fi
 
