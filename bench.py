@@ -210,6 +210,17 @@ def _precision_fields(default: str = "float32") -> dict:
             "params_dtype": pol.params_dtype}
 
 
+def _tuned_precision_fields(tuned) -> dict:
+    """compute/params dtypes of a BENCH_AUTOTUNE run — what the tuned
+    trainer ACTUALLY ran at. Unlike :func:`_precision_fields`, the
+    BENCH_PRECISION env knob does NOT apply: the tuner chose the
+    policy, and the record must name what ran."""
+    from deeplearning4j_tpu.nn.updater import PrecisionPolicy
+    pol = PrecisionPolicy.parse(tuned.precision)
+    return {"compute_dtype": pol.compute_dtype,
+            "params_dtype": pol.params_dtype}
+
+
 def _failure_record(metric: str, detail: str, open_spans, kind: str
                     ) -> dict:
     """A rung failure as a first-class JSON record: value 0, marked
@@ -527,6 +538,36 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
             out.append(DataSet(x, y))
         return out
 
+    # BENCH_AUTOTUNE=1 (ISSUE 13): hand this rung's configuration to the
+    # autotuner — search, prune, probe — then train THROUGH the chosen
+    # TunedConfig. The record carries the prediction and the per-config
+    # calibration gap next to the measured number (the same surface
+    # tools/autotune_smoke.py and SC007 read).
+    tuned = trainer = None
+    if os.environ.get("BENCH_AUTOTUNE", "0") == "1":
+        t = time.perf_counter()
+        try:
+            from deeplearning4j_tpu.autotune import autotune as _autotune
+            with tracer.span("autotune"):
+                tuned = _autotune(
+                    net, global_batch=batch, batch=batches(1)[0],
+                    top_k=int(os.environ.get("BENCH_AUTOTUNE_TOPK", "2")),
+                    probe_steps=2)
+                trainer = tuned.trainer(net)
+            gap = tuned.measured_vs_predicted_gap
+            _stamp(f"autotune in {time.perf_counter() - t:.1f}s: "
+                   f"{tuned.candidate.slug()} "
+                   f"(predicted {tuned.predicted_step_s:.2e}s/step, "
+                   f"gap {f'{gap:.1f}x' if gap is not None else 'n/a'}, "
+                   f"{tuned.search})")
+        except Exception:  # noqa: BLE001 — tuner failure must not cost
+            tuned = trainer = None       # the rung; train untuned
+            _stamp("autotune FAILED (rung continues untuned):\n"
+                   + traceback.format_exc(limit=10))
+    fit_batch = trainer.fit_batch if trainer is not None else net.fit_batch
+    fit_scan = (trainer.fit_batches_scan if trainer is not None
+                else net.fit_batches_scan)
+
     # Stage a small rotation of distinct batches in DEVICE memory once
     # (bf16 on TPU via the DevicePrefetchIterator host-cast path — halves
     # tunnel bytes and is the native MXU dtype), then time the training
@@ -547,7 +588,7 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
     t = time.perf_counter()
     with tracer.span("warmup", steps=warmup):
         for i in range(warmup):
-            loss = net.fit_batch(staged[i % len(staged)])
+            loss = fit_batch(staged[i % len(staged)])
             jax.block_until_ready(net.params)
             _stamp(f"warmup step {i + 1}/{warmup} done "
                    f"(+{time.perf_counter() - t:.1f}s, "
@@ -571,7 +612,7 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
             t_next = time.perf_counter()
             b = next(feed)
             input_stall += time.perf_counter() - t_next
-            net.fit_batch(b)
+            fit_batch(b)
         jax.block_until_ready(net.params)
         dt_loop = time.perf_counter() - t0
     sps_loop = batch * steps / dt_loop
@@ -597,12 +638,12 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
         with tracer.span("timed_scan", steps=steps):
             window = [staged[i % len(staged)] for i in range(steps)]
             t0 = time.perf_counter()
-            net.fit_batches_scan(window)  # warmup: compiles the program
+            fit_scan(window)  # warmup: compiles the program
             jax.block_until_ready(net.params)
             _stamp(f"scan program compiled+warm in "
                    f"{time.perf_counter() - t0:.1f}s; timing...")
             t0 = time.perf_counter()
-            net.fit_batches_scan(window)
+            fit_scan(window)
             jax.block_until_ready(net.params)
             dt_scan = time.perf_counter() - t0
         sps_scan = batch * steps / dt_scan
@@ -640,7 +681,7 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
                         else None))
                     jax.block_until_ready([d.features for d in put])
                 with stats.phase("step"):
-                    net.fit_batch(staged[i % len(staged)])
+                    fit_batch(staged[i % len(staged)])
                     jax.block_until_ready(net.params)
             phase_breakdown = {
                 name: round(p["mean_s"], 4)
@@ -660,7 +701,22 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
         t = time.perf_counter()
         try:
             with tracer.span("cost_analysis"):
-                cost = net.cost_analysis(staged[0])
+                if trainer is not None:
+                    # the program that actually ran is the TUNED
+                    # trainer's sharded step — cost-analyze IT, not the
+                    # untuned net's own single-device step (the record
+                    # must name what actually ran; same invariant as
+                    # the wus fields above)
+                    from deeplearning4j_tpu.analysis.shardcheck import (
+                        hlo_comm_bytes)
+                    program = trainer.step_program(staged[0])
+                    pcost = dict(program.cost)
+                    cost = {"flops_per_step": pcost.get("flops"),
+                            "bytes_accessed": pcost.get("bytes_accessed"),
+                            "comm_bytes_hlo": hlo_comm_bytes(program),
+                            "peak_flops_per_chip": peak_flops(device_kind)}
+                else:
+                    cost = net.cost_analysis(staged[0])
             flops_per_step = cost.get("flops_per_step")
             bytes_accessed = cost.get("bytes_accessed")
             # shardcheck's SC007 surface: the MEASURED program's actual
@@ -686,13 +742,19 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
     # count, for the layout under test (BENCH_WUS=off|zero1|zero2,
     # BENCH_ACCUM=k) — the fields a real-TPU ladder compares against
     # the replicated baseline to attribute an MFU delta to the layout.
-    wus_mode = os.environ.get("BENCH_WUS", "off")
+    # under BENCH_AUTOTUNE the layout under test is the TUNED one, not
+    # the env knobs — the record must name what actually ran
+    wus_mode = (tuned.weight_update_sharding if tuned is not None
+                else os.environ.get("BENCH_WUS", "off"))
     comm_bytes = updater_hbm = gradient_hbm = None
     try:
         from deeplearning4j_tpu.profiling.cost import weight_update_cost
         wuc = weight_update_cost(
-            net, dp=jax.device_count(),
-            gradient_accumulation=int(os.environ.get("BENCH_ACCUM", "1")),
+            net,
+            dp=tuned.dp if tuned is not None else jax.device_count(),
+            gradient_accumulation=(
+                tuned.gradient_accumulation if tuned is not None
+                else int(os.environ.get("BENCH_ACCUM", "1"))),
             weight_update_sharding=wus_mode)
         comm_bytes = wuc["comm_bytes_per_step"]
         updater_hbm = wuc["updater_hbm_bytes"]
@@ -747,11 +809,20 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
         "comm_bytes_hlo": comm_bytes_hlo,
         "updater_hbm_bytes": updater_hbm,
         "gradient_hbm_bytes": gradient_hbm,
+        # ISSUE 13: the autotune calibration surface — present on every
+        # record (schema-checked in run_checks.sh); populated when
+        # BENCH_AUTOTUNE=1 ran the rung at the tuner's chosen config
+        "autotuned": tuned is not None,
+        "predicted_step_s": (tuned.predicted_step_s
+                             if tuned is not None else None),
+        "measured_vs_predicted_gap": (tuned.measured_vs_predicted_gap
+                                      if tuned is not None else None),
         "phase_breakdown_s_per_step": phase_breakdown,
         "pallas_lstm_parity": parity,
-        **_precision_fields("bfloat16" if on_accel
-                            and cfg["dtype"] == "bfloat16"
-                            else "float32"),
+        **(_tuned_precision_fields(tuned) if tuned is not None
+           else _precision_fields(
+               "bfloat16" if on_accel and cfg["dtype"] == "bfloat16"
+               else "float32")),
     }
 
 
@@ -831,6 +902,11 @@ def _run_input_rung(jax, smoke: bool, on_accel: bool, device_kind: str,
         "input_stage_seconds": stages,
         "reader_workers": cfg["reader_workers"],
         "decode_workers": cfg["decode_workers"],
+        # schema uniformity (ISSUE 13): the pipeline-alone rung trains
+        # no step, so there is nothing for the autotuner to choose
+        "autotuned": False,
+        "predicted_step_s": None,
+        "measured_vs_predicted_gap": None,
         **_precision_fields(),
     }
 
@@ -974,6 +1050,11 @@ def _run_serve_rung(jax, smoke: bool, on_accel: bool, device_kind: str,
         "max_wait_ms": cfg["max_wait_ms"],
         "batch_size_mix": stats["batch_size_mix"],
         "compile_s": stats["compile_s"],
+        # schema uniformity (ISSUE 13): the serve rung's bucket ladder
+        # is fixed by the rung config, not chosen by the autotuner
+        "autotuned": False,
+        "predicted_step_s": None,
+        "measured_vs_predicted_gap": None,
         **_precision_fields(),
     }
 

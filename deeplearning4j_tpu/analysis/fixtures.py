@@ -224,6 +224,17 @@ def bad_elastic_grow_indivisible():
                   "elastic_resize_widths": [6]}
 
 
+def bad_mistuned_dp1():
+    """The MLP validated at dp=1 while declaring an 8-chip fleet
+    (``autotune_devices=8``): the autotuner's best legal config splits
+    the same step ~8 ways, so the analytic mistuning ratio blows the
+    GC016 2x threshold — 7 chips idle is exactly the "2-5x lost to
+    config mistuning" failure mode the rule exists for."""
+    conf, _ = good_mlp()
+    return conf, {"mesh": {"dp": 1}, "batch_size": 64,
+                  "autotune_devices": 8}
+
+
 def bad_duplicate_name():
     """Two layers both named 'hidden' — the flat-view param contract
     (and every by-name lookup) silently collapses them."""
@@ -330,6 +341,7 @@ KNOWN_BAD: List[Tuple[str, str, Callable]] = [
     ("dp-unsharded-iterator", "GC013", bad_dp_unsharded_iterator),
     ("elastic-resize-indivisible", "GC014", bad_elastic_indivisible),
     ("elastic-grow-indivisible", "GC014", bad_elastic_grow_indivisible),
+    ("mistuned-single-replica", "GC016", bad_mistuned_dp1),
 ]
 
 
@@ -488,6 +500,20 @@ def good_mlp_pp():
     return conf, {"mesh": {"dp": 2, "pp": 2}, "batch_size": 32}
 
 
+def good_mlp_autotuned():
+    """The MLP at a well-tuned shape for an 8-chip fleet: all devices
+    on the data axis with a batch large enough that compute (which dp
+    splits perfectly) dominates the per-step gradient exchange — the
+    GC016 ratio lands near 1x and the rule stays quiet. (At SMALL
+    batches the same mesh is genuinely comm-bound and the analytic
+    model prefers a mixed dp x tp shape — that is the rule working,
+    not noise; the clean twin keeps compute dominant so the verdict is
+    robust to cost-constant drift.)"""
+    conf, _ = good_mlp()
+    return conf, {"mesh": {"dp": 8}, "batch_size": 256,
+                  "autotune_devices": 8}
+
+
 KNOWN_GOOD: List[Tuple[str, Callable]] = [
     ("mlp", good_mlp),
     ("cnn", good_cnn),
@@ -500,6 +526,7 @@ KNOWN_GOOD: List[Tuple[str, Callable]] = [
     ("mlp-bf16-zero2", good_mlp_bf16_zero2),
     ("mlp-sharded-pipeline", good_mlp_pipeline),
     ("mlp-elastic-plan", good_mlp_elastic),
+    ("mlp-autotuned", good_mlp_autotuned),
 ]
 
 #: rule id -> the KNOWN_GOOD fixture that exercises that rule's trigger
@@ -520,6 +547,7 @@ KNOWN_GOOD_FOR: Dict[str, str] = {
     "GC013": "mlp-sharded-pipeline", # dp mesh fed by a sharded pipeline
     "GC014": "mlp-elastic-plan",     # every planned width divides batch
     "GC015": "mlp-bf16-zero2",       # bf16 with an explicit loss scale
+    "GC016": "mlp-autotuned",        # already at the tuner's best shape
 }
 
 

@@ -59,6 +59,15 @@ Rules (stable ids; severities in parentheses):
                                     fp32's exponent range, so this is a
                                     footgun warning there and a real
                                     hazard for fp16)
+- GC016 config-mistuned   (warning) the validated configuration's
+                                    analytic step time is more than 2x
+                                    the autotuner's best legal config
+                                    for the same model and device count
+                                    (``autotune_devices=``) — speed is
+                                    being left on the table (arXiv
+                                    2001.04206's 2-5x mistuning loss);
+                                    run ``autotune()`` or adopt the
+                                    named config
 
 Entry points: ``check_multilayer`` / ``check_graph`` /
 ``validate_config`` (dispatch), plus ``.validate()`` hooks installed on
@@ -103,6 +112,9 @@ RULES: Dict[str, Tuple[str, str]] = {
                                 "impossible"),
     "GC015": ("precision-policy", "non-float compute dtype, or half "
                                   "precision without a loss scale"),
+    "GC016": ("config-mistuned", "analytic step time > 2x the "
+                                 "autotuner's best legal config for "
+                                 "the same model/device count"),
 }
 
 # pp stage partitions whose heaviest stage exceeds the mean by this factor
@@ -544,6 +556,74 @@ def _check_elastic(findings: List[Finding],
                     "surviving width"))
 
 
+#: a config predicted slower than this multiple of the best legal
+#: config for the same model/device count is GC016's "leaving speed on
+#: the table" territory (the 2-5x loss arXiv 2001.04206 measured)
+MISTUNE_RATIO = 2.0
+
+
+def _check_mistuned(findings: List[Finding], conf, walk,
+                    axes: Dict[str, int], batch_size: Optional[int],
+                    weight_update_sharding, precision,
+                    autotune_devices) -> None:
+    """GC016: compare the validated configuration's analytic step time
+    against the autotuner's best legal config for the same model at
+    ``autotune_devices`` chips. Opt-in (the device count must be
+    given — a config alone does not know its fleet). Both sides use
+    the SAME config-only census (``autotune.model.census_from_conf``),
+    so the ratio is self-consistent even where absolute FLOPs are a
+    parameter-count estimate; the best config is found by
+    ``autotune.tuner.analytic_best`` — the tuner's own ranking and
+    legality (validate_config, without this rule), never a
+    re-implementation."""
+    if not autotune_devices or int(autotune_devices) < 2 \
+            or not batch_size:
+        return
+    from deeplearning4j_tpu.autotune import model as _am
+    from deeplearning4j_tpu.autotune.space import Candidate
+    from deeplearning4j_tpu.autotune.tuner import analytic_best
+    census = _am.census_from_conf(conf, walk=walk)
+    if census.param_count <= 0:
+        return  # shape inference failed — GC005 already reported
+    compute, _ = _precision_fields(precision)
+    current = Candidate(
+        dp=_dp_size(axes) or 1,
+        tp=axes.get("model") or axes.get("tp") or 1,
+        pp=axes.get("pp") or 1, sp=axes.get("sp") or 1,
+        precision=compute or "fp32",
+        weight_update_sharding=_wus_mode(weight_update_sharding))
+    # fixed reference constants, NOT Hardware.detect(): a validator's
+    # verdict must not depend on which box runs it (and a pure metadata
+    # walk must not initialize a jax backend)
+    hw = _am.Hardware.reference()
+    try:
+        cur = _am.predict(census, current, batch_size, hardware=hw)
+        best = analytic_best(census, int(autotune_devices), batch_size,
+                             hardware=hw)
+    except Exception:  # noqa: BLE001 — an advisory rule must not throw
+        return
+    if best is None:
+        return  # no legal config at that device count: nothing to beat
+    best_cand, best_cost = best
+    if best_cost["step_s"] <= 0:
+        return
+    ratio = cur["step_s"] / best_cost["step_s"]
+    if ratio > MISTUNE_RATIO:
+        findings.append(Finding(
+            "GC016", Severity.WARNING, current.slug(),
+            f"this configuration's analytic step time is {ratio:.1f}x "
+            f"the best legal config for {autotune_devices} device(s) "
+            f"({best_cand.slug()}: {best_cost['step_s']:.2e}s vs "
+            f"{cur['step_s']:.2e}s per step) — speed is being left on "
+            "the table",
+            f"run deeplearning4j_tpu.autotune.autotune() or adopt "
+            f"{best_cand.slug()} (dp={best_cand.dp}, tp={best_cand.tp}, "
+            f"pp={best_cand.pp}, sp={best_cand.sp}, "
+            f"accum={best_cand.gradient_accumulation}, "
+            f"precision={best_cand.precision}, "
+            f"wus={best_cand.weight_update_sharding})"))
+
+
 def _optimal_max_stage(costs: List[int], n_stages: int) -> int:
     """Heaviest stage of the OPTIMAL contiguous partition — the same
     minimize-the-max objective as parallel/pipeline.partition_stages with
@@ -631,7 +711,9 @@ def check_multilayer(conf, *, mesh=None, batch_size: Optional[int] = None,
                      weight_update_sharding=None,
                      input_iterator=None,
                      elastic_resize_widths=None,
-                     precision=None) -> List[Finding]:
+                     precision=None,
+                     autotune_devices: Optional[int] = None
+                     ) -> List[Finding]:
     """Validate a MultiLayerConfiguration. Pure CPU metadata walk — no
     arrays are built."""
     from deeplearning4j_tpu.analysis.memory import DEFAULT_HBM_BYTES
@@ -686,6 +768,13 @@ def check_multilayer(conf, *, mesh=None, batch_size: Optional[int] = None,
                    _mesh_axes(mesh), batch_size, weight_update_sharding,
                    elastic_resize_widths)
     _check_precision(findings, *_conf_precision(conf, precision))
+    if not any(f.severity == Severity.ERROR for f in findings):
+        # advisory only, and the comparison assumes a runnable config —
+        # same gate as the graph path
+        _check_mistuned(findings, conf, walk, _mesh_axes(mesh),
+                        batch_size, weight_update_sharding,
+                        _conf_precision(conf, precision)[0],
+                        autotune_devices)
     _check_hbm(findings, rep, batch_size, hbm_bytes or DEFAULT_HBM_BYTES)
     return findings
 
@@ -810,7 +899,8 @@ def check_graph(conf, *, mesh=None, batch_size: Optional[int] = None,
                 weight_update_sharding=None,
                 input_iterator=None,
                 elastic_resize_widths=None,
-                precision=None) -> List[Finding]:
+                precision=None,
+                autotune_devices: Optional[int] = None) -> List[Finding]:
     """Validate a ComputationGraphConfiguration — including configs the
     builder itself would refuse to construct (cycles, dangling refs),
     which is why this walk never calls ``_resolve_shapes``."""
@@ -914,6 +1004,10 @@ def check_graph(conf, *, mesh=None, batch_size: Optional[int] = None,
                    elastic_resize_widths)
     _check_precision(findings, *_conf_precision(conf, precision))
     if not any(f.severity == Severity.ERROR for f in findings):
+        _check_mistuned(findings, conf, walk, _mesh_axes(mesh),
+                        batch_size, weight_update_sharding,
+                        _conf_precision(conf, precision)[0],
+                        autotune_devices)
         _check_hbm(findings, rep, batch_size,
                    hbm_bytes or DEFAULT_HBM_BYTES)
     return findings
@@ -928,21 +1022,27 @@ def validate_config(conf, *, mesh=None, batch_size: Optional[int] = None,
                     weight_update_sharding=None,
                     input_iterator=None,
                     elastic_resize_widths=None,
-                    precision=None) -> List[Finding]:
-    """Dispatch on configuration type."""
+                    precision=None,
+                    autotune_devices: Optional[int] = None
+                    ) -> List[Finding]:
+    """Dispatch on configuration type. ``autotune_devices``: opt into
+    the GC016 mistuning comparison against the autotuner's best legal
+    config for that many chips."""
     if hasattr(conf, "nodes"):
         return check_graph(conf, mesh=mesh, batch_size=batch_size,
                            hbm_bytes=hbm_bytes,
                            weight_update_sharding=weight_update_sharding,
                            input_iterator=input_iterator,
                            elastic_resize_widths=elastic_resize_widths,
-                           precision=precision)
+                           precision=precision,
+                           autotune_devices=autotune_devices)
     return check_multilayer(conf, mesh=mesh, batch_size=batch_size,
                             hbm_bytes=hbm_bytes,
                             weight_update_sharding=weight_update_sharding,
                             input_iterator=input_iterator,
                             elastic_resize_widths=elastic_resize_widths,
-                            precision=precision)
+                            precision=precision,
+                            autotune_devices=autotune_devices)
 
 
 def iter_config_layers(conf) -> Iterator[Tuple[str, object,

@@ -1,0 +1,32 @@
+"""autotune: cost-model-driven configuration search with measured-probe
+validation (ROADMAP item 4; µ-cuDNN arXiv 1804.04806 generalized from
+conv microbatch sizes to the whole training configuration).
+
+    from deeplearning4j_tpu.autotune import autotune
+
+    tuned = autotune(net, devices=8, hbm_budget=16 << 30)
+    trainer = tuned.trainer(net)            # or ParallelTrainer(net,
+    trainer.fit(data)                       #        tuned=tuned)
+
+The search is CPU-provable end to end: enumeration and pruning are pure
+metadata, ranking is the analytic cost model, and the probes are short
+real compiled steps on whatever backend is attached. See
+``tools/autotune.py`` (CLI) and ``tools/autotune_smoke.py`` (the
+run_checks gate).
+"""
+
+from deeplearning4j_tpu.autotune.config import ProbeRecord, TunedConfig
+from deeplearning4j_tpu.autotune.space import (
+    Candidate, default_candidate, enumerate_space, mesh_shapes,
+    serve_bucket_set,
+)
+from deeplearning4j_tpu.autotune.tuner import (
+    AutotuneError, analytic_search, autotune,
+)
+
+__all__ = [
+    "autotune", "analytic_search", "AutotuneError",
+    "TunedConfig", "ProbeRecord",
+    "Candidate", "enumerate_space", "mesh_shapes",
+    "default_candidate", "serve_bucket_set",
+]

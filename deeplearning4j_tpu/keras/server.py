@@ -167,8 +167,16 @@ class KerasServer:
                  breaker_slow_call_s: float = 30.0,
                  io_timeout: float = 60.0, batching: bool = True,
                  max_batch: int = 32, max_wait_ms: float = 5.0,
-                 batch_deadline_margin_ms: float = 50.0):
+                 batch_deadline_margin_ms: float = 50.0,
+                 tuned=None):
         from deeplearning4j_tpu.keras.batching import BatchScheduler
+        # tuned= (a TunedConfig from deeplearning4j_tpu.autotune): the
+        # batching scheduler adopts the tuned serving bucket set — its
+        # top bucket becomes max_batch, so the gateway's compiled-bucket
+        # ladder is exactly the pow2 set the autotuner budgeted for.
+        # An explicit non-default max_batch wins.
+        if tuned is not None and max_batch == 32:
+            max_batch = tuned.serve_max_batch
         self._batcher = (BatchScheduler(
             max_batch=max_batch, max_wait_ms=max_wait_ms,
             deadline_margin_ms=batch_deadline_margin_ms)

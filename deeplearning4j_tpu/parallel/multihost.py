@@ -392,7 +392,7 @@ def serve_coordination(port: int, num_processes: int) -> None:
 def data_parallel_trainer(net, n_model: int = 1,
                           gradient_accumulation: int = 1,
                           weight_update_sharding=None,
-                          precision=None, **kwargs):
+                          precision=None, tuned=None, **kwargs):
     """One-call multihost trainer: build the global mesh over every
     process's devices and wrap ``net`` in a ``ParallelTrainer``.
 
@@ -412,16 +412,41 @@ def data_parallel_trainer(net, n_model: int = 1,
     — same cast seams as ``ParallelTrainer``; composes with every
     weight-update-sharding mode.
 
+    ``tuned`` (a ``TunedConfig`` from ``deeplearning4j_tpu.autotune``):
+    run at the autotuner's chosen configuration — supplies
+    ``n_model`` (its tp width) plus the accumulation / sharding /
+    precision knobs left at their defaults, over the GLOBAL device
+    mesh. Explicit kwargs win, exactly as on ``ParallelTrainer``.
+
     Call ``initialize()`` first (TPU pods: with no args). Every process
     then feeds process-LOCAL batch shards to ``fit_batch`` as usual.
     """
     from deeplearning4j_tpu.parallel.mesh import MeshContext
     from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
-    ctx = MeshContext.create(n_model=n_model)
+    if tuned is not None:
+        if tuned.pp > 1:
+            # the flat dp x tp (x sp) mesh this helper builds cannot
+            # carry a pipeline schedule — running anyway would silently
+            # train a DIFFERENT layout than the TunedConfig promises
+            raise ValueError(
+                f"TunedConfig plans pp={tuned.pp}; "
+                "multihost.data_parallel_trainer builds a flat mesh — "
+                "build a PipelineTrainer from tuned.candidate instead")
+        if n_model == 1:
+            n_model = tuned.tp
+    ctx = MeshContext.create(n_model=n_model,
+                             n_seq=tuned.sp if tuned is not None else 1)
+    if tuned is not None and len(ctx.mesh.devices.flat) \
+            != tuned.device_count:
+        logger.warning(
+            "TunedConfig was searched for %d device(s) but the global "
+            "mesh has %d — the tuned knobs still apply, but re-running "
+            "autotune() at this fleet size may choose differently",
+            tuned.device_count, len(ctx.mesh.devices.flat))
     return ParallelTrainer(
         net, ctx, gradient_accumulation=gradient_accumulation,
         weight_update_sharding=weight_update_sharding,
-        precision=precision, **kwargs)
+        precision=precision, tuned=tuned, **kwargs)
 
 
 if __name__ == "__main__":   # pragma: no cover — thin sidecar CLI

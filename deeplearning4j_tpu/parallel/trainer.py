@@ -85,6 +85,15 @@ class ParallelTrainer:
     fp32 master weights + every post-gradient op (loss, clip, optax,
     divergence sentinel) in fp32 — composing with every
     weight-update-sharding mode. The fp32 default gates all casts out.
+
+    ``tuned`` (a :class:`~deeplearning4j_tpu.autotune.config.
+    TunedConfig`): construct at the autotuner's chosen configuration —
+    fills the mesh (when none is given) and any of
+    ``gradient_accumulation`` / ``weight_update_sharding`` /
+    ``precision`` left at their defaults. Explicit kwargs win, so a
+    tuned config can be partially overridden. Probe parity
+    (``tools/autotune_smoke.py``) gates that this path trains bitwise
+    identically to hand-building the same knobs.
     """
 
     def __init__(self, net, mesh: Optional[MeshContext] = None,
@@ -92,7 +101,17 @@ class ParallelTrainer:
                  donate_params: bool = True,
                  collect_training_stats: bool = False,
                  weight_update_sharding=None,
-                 precision=None):
+                 precision=None,
+                 tuned=None):
+        if tuned is not None:
+            if mesh is None:
+                mesh = tuned.mesh_context()
+            if gradient_accumulation == 1:
+                gradient_accumulation = tuned.gradient_accumulation
+            if weight_update_sharding is None:
+                weight_update_sharding = tuned.weight_update_sharding
+            if precision is None:
+                precision = tuned.precision
         self.net = net
         self.mesh = mesh or MeshContext.create()
         self.gradient_accumulation = max(1, gradient_accumulation)

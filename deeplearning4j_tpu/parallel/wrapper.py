@@ -56,7 +56,14 @@ class ParallelWrapper:
     ``net.conf.training.precision``): each worker's forward/backward
     runs in the compute dtype against its fp32 master replica — cast
     seams identical to ``ParallelTrainer``'s, applied per worker inside
-    the vmap."""
+    the vmap.
+
+    ``tuned`` (a ``TunedConfig`` from ``deeplearning4j_tpu.autotune``):
+    fills the mesh, workers (= the tuned dp width),
+    ``weight_update_sharding`` and ``precision`` when those are left at
+    their defaults; the tuned ``gradient_accumulation`` maps onto
+    ``averaging_frequency`` (the knob it descends from — see the module
+    docstring). Explicit kwargs win."""
 
     def __init__(self, net: MultiLayerNetwork, workers: Optional[int] = None,
                  prefetch_buffer: int = 16, averaging_frequency: int = 1,
@@ -64,7 +71,19 @@ class ParallelWrapper:
                  mesh: Optional[MeshContext] = None,
                  report_score_after_averaging: bool = True,
                  weight_update_sharding=None,
-                 precision=None):
+                 precision=None,
+                 tuned=None):
+        if tuned is not None:
+            if mesh is None:
+                mesh = tuned.mesh_context()
+            if workers is None:
+                workers = tuned.dp
+            if averaging_frequency == 1:
+                averaging_frequency = tuned.gradient_accumulation
+            if weight_update_sharding is None:
+                weight_update_sharding = tuned.weight_update_sharding
+            if precision is None:
+                precision = tuned.precision
         net._check_init()
         self.net = net
         self.mesh = mesh or MeshContext.create()

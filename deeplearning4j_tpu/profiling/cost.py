@@ -31,6 +31,7 @@ dispatch cache is separate from the AOT path) — call it once per
 
 from __future__ import annotations
 
+import weakref
 from typing import Optional
 
 # Peak dense matmul FLOP/s per chip (bf16 where the chip has bf16 MXUs),
@@ -161,22 +162,81 @@ def dp_gradient_hbm_bytes(param_count: int, dp: int,
     return total
 
 
+# Per-net census cache (ISSUE 13): the autotuner's configuration sweeps
+# call weight_update_cost / train_step_cost once per CANDIDATE, but the
+# underlying numbers depend only on the net (param sizes, updater) and —
+# for the compiled census — the batch signature. Keyed on the net object
+# itself (weak: a released net must not pin its params' metadata — and
+# NOTHING stored in a value may strongly reach the net, or the weak key
+# never dies), so a 100-config sweep pays the model walk and the AOT
+# compile once, not 100 times. param_census returns the cached dict
+# itself (read-only by contract); train_step_cost returns a fresh copy
+# per call (its callers mutate their results).
+_PARAM_CENSUS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_STEP_COST: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def param_census(net) -> dict:
+    """{param_count, dtype_bytes, updater} for an initialized container,
+    memoized on net identity (the flops/param census every candidate of
+    an autotune sweep shares). The returned dict is the cached object —
+    treat it as read-only."""
+    try:
+        cached = _PARAM_CENSUS.get(net)
+    except TypeError:  # un-weakref-able container: compute, don't cache
+        cached = None
+    if cached is not None:
+        return cached
+    import jax
+    import numpy as np
+    leaves = jax.tree_util.tree_leaves(net.params)
+    census = {
+        "param_count": sum(
+            int(np.prod(np.shape(leaf))) if np.shape(leaf) else 1
+            for leaf in leaves),
+        "dtype_bytes": (np.dtype(leaves[0].dtype).itemsize
+                        if leaves and hasattr(leaves[0], "dtype") else 4),
+        "updater": net.conf.training.updater.name,
+    }
+    try:
+        _PARAM_CENSUS[net] = census
+    except TypeError:
+        pass
+    return census
+
+
+def _batch_signature(batch) -> tuple:
+    """Hashable (shapes + dtypes) key of a DataSet/MultiDataSet — the
+    only batch facts a compiled step's cost analysis depends on."""
+    import numpy as np
+
+    def sig(x):
+        if x is None:
+            return None
+        if isinstance(x, dict):
+            return tuple(sorted((k, sig(v)) for k, v in x.items()))
+        return (tuple(np.shape(x)), str(np.asarray(x).dtype)
+                if not hasattr(x, "dtype") else str(x.dtype))
+
+    return (sig(getattr(batch, "features", None)),
+            sig(getattr(batch, "labels", None)),
+            sig(getattr(batch, "features_mask", None)),
+            sig(getattr(batch, "labels_mask", None)))
+
+
 def weight_update_cost(net, dp: int,
                        gradient_accumulation: int = 1,
                        weight_update_sharding: str = "off") -> dict:
     """Both weight-update cost fields for an initialized container (or
     a ``ParallelTrainer``'s wrapped net): analytic per-update comm bytes
     and per-chip updater-state HBM, for the given data-parallel degree
-    and layout. Pure metadata — reads only param sizes and the conf."""
-    import jax
-    import numpy as np
-    leaves = jax.tree_util.tree_leaves(net.params)
-    param_count = sum(int(np.prod(np.shape(leaf))) if np.shape(leaf) else 1
-                      for leaf in leaves)
-    dtype_bytes = 4
-    if leaves and hasattr(leaves[0], "dtype"):
-        dtype_bytes = np.dtype(leaves[0].dtype).itemsize
-    updater = net.conf.training.updater.name
+    and layout. Pure metadata — reads only param sizes and the conf
+    (memoized per net via :func:`param_census`, so a config sweep never
+    re-walks the model)."""
+    census = param_census(net)
+    param_count = census["param_count"]
+    dtype_bytes = census["dtype_bytes"]
+    updater = census["updater"]
     return {
         "weight_update_sharding": weight_update_sharding,
         "dp": int(dp),
@@ -260,12 +320,32 @@ def train_step_cost(net, batch, peak: Optional[float] = None) -> dict:
     per-chip collective bytes on the ring model (shardcheck's SC007
     surface) — 0 for a single-device program, and the number a sharded
     program's cost-model prediction is calibrated against.
+
+    Memoized on (net's built step fn, batch signature, peak): the AOT
+    compile is the expensive part, and an autotune sweep asks for the
+    same program's census once per candidate. The cache entry pins the
+    step fn only WEAKLY and is dropped whenever the net's current step
+    is a different object — so a sentinel attach/detach (a rebuilt
+    program) misses instead of serving stale numbers, a collected fn
+    cannot alias a new one by id reuse, and the entry's contents never
+    strongly reach the net (the step's closure holds the net, so a
+    strong ref here would make the weak key immortal).
     """
     import jax
 
     net._check_init()
     if net._train_step_fn is None:
         net._train_step_fn = net._build_train_step()
+    cache_key = (_batch_signature(batch), peak)
+    try:
+        entry = _STEP_COST.get(net)
+    except TypeError:
+        entry = None
+    if entry is not None and entry[0]() is not net._train_step_fn:
+        entry = None  # step rebuilt: every cached program is stale
+    hit = entry[1].get(cache_key) if entry is not None else None
+    if hit is not None:
+        return dict(hit)
     args = step_example_args(net, batch)
     n_examples = batch.num_examples()
     comm_bytes_hlo = None
@@ -298,4 +378,11 @@ def train_step_cost(net, batch, peak: Optional[float] = None) -> dict:
         "device_kind": device_kind,
         "peak_flops_per_chip": peak,
     }
+    try:
+        if entry is None:
+            entry = (weakref.ref(net._train_step_fn), {})
+            _STEP_COST[net] = entry
+        entry[1][cache_key] = dict(out)
+    except TypeError:
+        pass
     return out

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""autotune CLI: cost-model-driven configuration search.
+
+Usage:
+    python tools/autotune.py model.json --devices 8 \
+        [--batch 64] [--hbm-budget-gib 16] [--top-k 3] [--no-probe] \
+        [--out tuned.json]
+    python tools/autotune.py --model lenet --devices 2 --batch 16
+
+File mode loads a serialized ``MultiLayerConfiguration`` (JSON or
+YAML), initializes the container, runs the search (graphcheck-pruned,
+cost-model-ranked, measured-probe-validated on whatever backend is
+attached — CPU included), prints the TunedConfig summary + probe
+table, and optionally writes the JSON artifact so the tuned config can
+be checked in next to the model. ``--model`` picks a named built-in
+family instead of a file. ``--no-probe`` stops after the analytic
+ranking (no compile, no measurement — fast planning mode).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _load_net(args):
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    if args.model:
+        if args.model == "lenet":
+            from deeplearning4j_tpu.models.lenet import lenet_mnist
+            conf = lenet_mnist()
+        elif args.model == "mlp":
+            from deeplearning4j_tpu.analysis.fixtures import good_mlp
+            conf, _ = good_mlp()
+        else:
+            raise SystemExit(f"unknown --model {args.model!r}; "
+                             "have: lenet, mlp")
+        return MultiLayerNetwork(conf).init()
+    with open(args.config, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    if args.config.endswith((".yaml", ".yml")):
+        import yaml
+        d = yaml.safe_load(text)
+    else:
+        d = json.loads(text)
+    from deeplearning4j_tpu.analysis.graphcheck import load_config_dict
+    conf = load_config_dict(d)
+    if hasattr(conf, "nodes"):
+        raise SystemExit(
+            "graph configs need an example batch the CLI cannot "
+            "synthesize — call autotune(ComputationGraph(conf).init(), "
+            "batch=...) from Python")
+    return MultiLayerNetwork(conf).init()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("config", nargs="?",
+                    help="serialized config (.json/.yaml)")
+    ap.add_argument("--model", default=None,
+                    help="named built-in model family (lenet, mlp) "
+                         "instead of a config file")
+    ap.add_argument("--devices", type=int, default=None,
+                    help="chips to plan for (default: all attached)")
+    ap.add_argument("--batch", type=int, default=32,
+                    help="global training batch size to plan for")
+    ap.add_argument("--hbm-budget-gib", type=float, default=None,
+                    help="per-chip HBM budget in GiB (default: the "
+                         "graphcheck DEFAULT_HBM_BYTES budget)")
+    ap.add_argument("--top-k", type=int, default=3,
+                    help="candidates to validate with measured probes")
+    ap.add_argument("--no-probe", action="store_true",
+                    help="analytic ranking only (no compile/measure)")
+    ap.add_argument("--probe-steps", type=int, default=3)
+    ap.add_argument("--out", default=None,
+                    help="write the TunedConfig JSON here (atomic)")
+    args = ap.parse_args(argv)
+
+    if not args.config and not args.model:
+        ap.error("a config file or --model is required")
+
+    from deeplearning4j_tpu.autotune import AutotuneError, autotune
+    net = _load_net(args)
+    budget = (int(args.hbm_budget_gib * 1024 ** 3)
+              if args.hbm_budget_gib else None)
+    try:
+        tuned = autotune(net, devices=args.devices, hbm_budget=budget,
+                         global_batch=args.batch,
+                         top_k=0 if args.no_probe else args.top_k,
+                         probe_steps=args.probe_steps)
+    except AutotuneError as e:
+        print(f"autotune: {e}", file=sys.stderr)
+        return 1
+    print(tuned.summary())
+    if args.out:
+        tuned.save(args.out)
+        print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,12 +29,15 @@ stage() {
 
 bench_smoke() {
     rm -f /tmp/_bench_smoke.jsonl
-    JAX_PLATFORMS=cpu BENCH_SMOKE=1 BENCH_RUNGS=input,serve BENCH_CHILD=1 \
+    JAX_PLATFORMS=cpu BENCH_SMOKE=1 BENCH_RUNGS=lenet,input,serve \
+        BENCH_AUTOTUNE=1 BENCH_CHILD=1 \
         python bench.py | tee /tmp/_bench_smoke.jsonl || return 1
     # every successful rung record must carry the ISSUE-10 precision
-    # fields and the ISSUE-11 comm_bytes_hlo calibration field
+    # fields, the ISSUE-11 comm_bytes_hlo calibration field, and the
+    # ISSUE-13 autotune fields; the autotuned lenet rung must land a
+    # finite measured-vs-predicted calibration gap
     python - <<'PY'
-import json
+import json, math
 recs = []
 for line in open("/tmp/_bench_smoke.jsonl"):
     line = line.strip()
@@ -49,7 +52,19 @@ missing = [r.get("metric") for r in recs
 assert not missing, f"records missing compute_dtype/params_dtype: {missing}"
 missing = [r.get("metric") for r in recs if "comm_bytes_hlo" not in r]
 assert not missing, f"records missing comm_bytes_hlo: {missing}"
-print(f"bench record schema: {len(recs)} records OK")
+missing = [r.get("metric") for r in recs
+           if not {"autotuned", "predicted_step_s",
+                   "measured_vs_predicted_gap"} <= set(r)]
+assert not missing, f"records missing autotune fields: {missing}"
+tuned = [r for r in recs if r.get("autotuned")]
+assert tuned, "BENCH_AUTOTUNE=1 but no record ran autotuned"
+bad = [r["metric"] for r in tuned
+       if not (r.get("predicted_step_s") and r.get(
+           "measured_vs_predicted_gap") is not None
+           and math.isfinite(r["measured_vs_predicted_gap"]))]
+assert not bad, f"autotuned records without a finite calibration gap: {bad}"
+print(f"bench record schema: {len(recs)} records OK "
+      f"({len(tuned)} autotuned)")
 PY
 }
 
@@ -82,9 +97,10 @@ if [ "${1:-}" != "--fast" ]; then
     stage "profiling smoke"  env JAX_PLATFORMS=cpu python tools/profiling_smoke.py
     stage "chaos smoke"      env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
     stage "serve smoke"      env JAX_PLATFORMS=cpu python tools/serve_smoke.py
-    stage "bench smoke (input+serve rungs)" bench_smoke
+    stage "bench smoke (autotuned lenet + input + serve)" bench_smoke
     stage "zero1 smoke"      env JAX_PLATFORMS=cpu python tools/zero1_smoke.py
     stage "zero2 smoke"      env JAX_PLATFORMS=cpu python tools/zero2_smoke.py
+    stage "autotune smoke"   env JAX_PLATFORMS=cpu python tools/autotune_smoke.py
     stage "input smoke (+shuffle resume)" env JAX_PLATFORMS=cpu \
         python tools/input_smoke.py
     stage "elastic smoke (3 phases)" env JAX_PLATFORMS=cpu \
