@@ -286,7 +286,8 @@ class _RungWatchdog:
 # rung configurations
 # ---------------------------------------------------------------------------
 
-_RUNGS = ("lenet", "small", "full", "vgg", "lstm", "xl", "input", "serve")
+_RUNGS = ("lenet", "small", "full", "vgg", "lstm", "lm", "xl", "input",
+          "serve")
 
 
 def _rung_config(rung: str, smoke: bool):
@@ -336,6 +337,21 @@ def _rung_config(rung: str, smoke: bool):
                     batch=4 if smoke else 32, steps=2 if smoke else 20,
                     warmup=2, dtype="float32",
                     metric="charlstm_b32_t64_samples_per_sec_per_chip")
+    if rung == "lm":
+        # ISSUE 14: the GPT decoder LM — the composition workload
+        # (attention + LayerNorm + residual graph + tied head). channels
+        # carries the sequence length, classes the char vocab (the
+        # charlstm convention); the record's headline converts to
+        # tokens/sec/chip and carries seq_len + analytic MFU.
+        return dict(model="gpt", height=0, width=0,
+                    channels=8 if smoke else 128,     # seq_len
+                    classes=16 if smoke else 96,      # charset
+                    d_model=32 if smoke else 256,
+                    n_heads=2 if smoke else 8,
+                    n_layers=2 if smoke else 4,
+                    batch=4 if smoke else 32, steps=2 if smoke else 20,
+                    warmup=2, dtype="float32",
+                    metric="gpt_char_b32_t128_tokens_per_sec_per_chip")
     if rung == "input":
         # input-pipeline throughput, no training step: N sources decode
         # into MNIST-shaped minibatches through the staged pipeline
@@ -510,6 +526,14 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
                 .layer(RnnOutputLayer(n_out=K, activation="softmax",
                                       loss="mcxent"))
                 .set_input_type(InputType.recurrent(K, T)).build()).init()
+        elif cfg["model"] == "gpt":
+            from deeplearning4j_tpu.models.gpt import gpt_decoder
+            from deeplearning4j_tpu.nn.graph import ComputationGraph
+            net = ComputationGraph(gpt_decoder(
+                vocab_size=cfg["classes"], seq_len=cfg["channels"],
+                d_model=cfg["d_model"], n_heads=cfg["n_heads"],
+                n_layers=cfg["n_layers"], seed=7,
+                dtype=cfg["dtype"])).init()
         else:
             from deeplearning4j_tpu.models.resnet import resnet50
             from deeplearning4j_tpu.nn.graph import ComputationGraph
@@ -528,7 +552,8 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
     def batches(n):
         out = []
         for _ in range(n):
-            if cfg["model"] == "charlstm":  # one-hot char sequences
+            if cfg["model"] in ("charlstm", "gpt"):
+                # one-hot char sequences, next-char targets (C = T)
                 ids = rng.integers(0, K, (batch, C + 1))
                 eye = np.eye(K, dtype=np.float32)
                 out.append(DataSet(eye[ids[:, :-1]], eye[ids[:, 1:]]))
@@ -783,7 +808,7 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
     # them would report a meaningless ratio
     base = (_banked_baseline(cfg["metric"])
             if on_accel and not smoke else None)
-    return {
+    rec = {
         "metric": cfg["metric"] + ("" if on_accel and not smoke
                                    else "_SMOKE"),
         "value": round(sps, 2),
@@ -824,6 +849,22 @@ def _run_rung(jax, rung: str, smoke: bool, on_accel: bool, device_kind: str,
                "bfloat16" if on_accel and cfg["dtype"] == "bfloat16"
                else "float32")),
     }
+    if rung == "lm":
+        # the LM rung's headline is token throughput: every sample is a
+        # seq_len-token window, so tokens/sec/chip = samples/sec x T
+        # (schema-checked in run_checks.sh: tokens_per_sec_per_chip,
+        # seq_len, and a finite analytic_mfu must be present)
+        seq_len = cfg["channels"]
+        rec["seq_len"] = seq_len
+        rec["tokens_per_sec_per_chip"] = round(sps * seq_len, 2)
+        rec["unit"] = "tokens/sec/chip"
+        rec["value"] = rec["tokens_per_sec_per_chip"]
+        rec["samples_per_sec_per_chip"] = round(sps, 2)
+        # the banked baseline stores the HEADLINE (tokens/sec) — the
+        # ratio must compare like with like, not samples vs tokens
+        rec["vs_baseline"] = (round(rec["value"] / base, 3)
+                              if base else 1.0)
+    return rec
 
 
 def _run_input_rung(jax, smoke: bool, on_accel: bool, device_kind: str,

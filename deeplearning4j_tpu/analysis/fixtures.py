@@ -235,6 +235,39 @@ def bad_mistuned_dp1():
                   "autotune_devices": 8}
 
 
+def bad_sp_without_attention():
+    """An sp=2 sequence-parallel axis over a pure MLP: no attention
+    layer exists to ring, so the sp chips idle (GC017 warning)."""
+    conf, _ = good_mlp()
+    return conf, {"mesh": {"dp": 2, "sp": 2}, "batch_size": 8}
+
+
+def bad_pp_cross_composition():
+    """pp composed with sp — a mesh shape no trainer runs (GC017
+    error: ParallelTrainer has no pp; the pipeline trainers have no
+    sp ring)."""
+    conf, _ = good_mlp()
+    return conf, {"mesh": {"dp": 1, "pp": 2, "sp": 2}, "batch_size": 8}
+
+
+def bad_pp_with_zero2():
+    """zero2 weight-update sharding under pipeline parallelism: the
+    pipeline trainers apply the replicated update, so the sharded
+    layout would silently never form (GC017 error)."""
+    conf, _ = good_mlp()
+    return conf, {"mesh": {"dp": 2, "pp": 2}, "batch_size": 8,
+                  "weight_update_sharding": "zero2"}
+
+
+def bad_pp_splits_residual():
+    """A pp axis deeper than the transformer DAG's single-tensor cut
+    points: the extra stage boundaries would have to split a block's
+    residual stream (GC017 warning — the GPT LM's pipeline hazard)."""
+    from deeplearning4j_tpu.models.gpt import gpt_tiny
+    conf = gpt_tiny(vocab_size=16, seq_len=8, n_layers=1)
+    return conf, {"mesh": {"dp": 1, "pp": 8}, "batch_size": 8}
+
+
 def bad_duplicate_name():
     """Two layers both named 'hidden' — the flat-view param contract
     (and every by-name lookup) silently collapses them."""
@@ -342,6 +375,10 @@ KNOWN_BAD: List[Tuple[str, str, Callable]] = [
     ("elastic-resize-indivisible", "GC014", bad_elastic_indivisible),
     ("elastic-grow-indivisible", "GC014", bad_elastic_grow_indivisible),
     ("mistuned-single-replica", "GC016", bad_mistuned_dp1),
+    ("sp-without-attention", "GC017", bad_sp_without_attention),
+    ("pp-cross-composition", "GC017", bad_pp_cross_composition),
+    ("pp-with-zero2", "GC017", bad_pp_with_zero2),
+    ("pp-splits-residual", "GC017", bad_pp_splits_residual),
 ]
 
 
@@ -514,8 +551,20 @@ def good_mlp_autotuned():
                   "autotune_devices": 8}
 
 
+def good_gpt_composed():
+    """The GPT decoder LM at its composed configuration (ISSUE 14):
+    dp x sp mesh with zero2 weight-update sharding — every GC017
+    trigger surface exercised cleanly (sp WITH ring-capable attention,
+    no pp cross-composition, cut points unsplit)."""
+    from deeplearning4j_tpu.models.gpt import gpt_tiny
+    conf = gpt_tiny(vocab_size=16, seq_len=8)
+    return conf, {"mesh": {"dp": 2, "sp": 2}, "batch_size": 8,
+                  "weight_update_sharding": "zero2"}
+
+
 KNOWN_GOOD: List[Tuple[str, Callable]] = [
     ("mlp", good_mlp),
+    ("gpt-composed", good_gpt_composed),
     ("cnn", good_cnn),
     ("rnn", good_rnn),
     ("graph-merge", good_graph_merge),
@@ -548,6 +597,7 @@ KNOWN_GOOD_FOR: Dict[str, str] = {
     "GC014": "mlp-elastic-plan",     # every planned width divides batch
     "GC015": "mlp-bf16-zero2",       # bf16 with an explicit loss scale
     "GC016": "mlp-autotuned",        # already at the tuner's best shape
+    "GC017": "gpt-composed",         # dp x sp x zero2 with real attention
 }
 
 
@@ -826,6 +876,16 @@ def sc_bad_comm_model_mismatch():
     return program, ctx
 
 
+def sc_bad_sp_ring_absent():
+    """Claims sp=2 sequence parallelism over a program compiled WITHOUT
+    an sp axis — no collective-permute exists, so the ring the claim
+    promises never formed (SC008's defect: sp chips that buy nothing)."""
+    program, ctx = _sc_trainer_program("off", 1)
+    ctx = dict(ctx)
+    ctx["sp"] = 2
+    return program, ctx
+
+
 SC_KNOWN_BAD: List[Tuple[str, str, Callable]] = [
     ("zero1-full-allreduce", "SC001", sc_bad_full_allreduce),
     ("zero1-double-gather", "SC002", sc_bad_double_gather),
@@ -835,6 +895,7 @@ SC_KNOWN_BAD: List[Tuple[str, str, Callable]] = [
     ("donation-missing", "SC005", sc_bad_donation_missing),
     ("host-callback-in-step", "SC006", sc_bad_host_callback),
     ("comm-model-mismatch", "SC007", sc_bad_comm_model_mismatch),
+    ("sp-ring-absent", "SC008", sc_bad_sp_ring_absent),
 ]
 
 
@@ -860,6 +921,46 @@ def sc_good_replicated():
     return _sc_trainer_program("off", 1)
 
 
+@lru_cache(maxsize=None)
+def _sc_attn_trainer_program():
+    """A REAL ParallelTrainer step of a causal-attention model on a
+    dp=1 x sp=2 mesh — the ring-attention program SC008's claim is
+    proven against (the GPT LM's composition surface, ISSUE 14)."""
+    import jax
+    import numpy as np
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.mesh import MeshContext
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater("adam", learning_rate=1e-3)
+            .weight_init("xavier")
+            .list()
+            .layer(SelfAttentionLayer(n_heads=2, causal=True,
+                                      block_size=4,
+                                      activation="identity"))
+            .layer(RnnOutputLayer(n_out=4, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(8, 8))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    mesh = MeshContext.create(n_data=1, n_model=1, n_seq=2,
+                              devices=jax.devices()[:2])
+    trainer = ParallelTrainer(net, mesh)
+    rng = np.random.default_rng(0)
+    batch = DataSet(rng.normal(size=(4, 8, 8)).astype(np.float32),
+                    np.eye(4, dtype=np.float32)[
+                        rng.integers(0, 4, (4, 8))])
+    return trainer.step_program(batch), trainer.shardcheck_context()
+
+
+def sc_good_sp_ring():
+    return _sc_attn_trainer_program()
+
+
+
+
 def sc_good_fp32_preset_identity():
     """The fp32 PRESET program checked against the pre-policy baseline:
     SC004 must find them convert-op-identical (the bitwise-parity
@@ -878,6 +979,7 @@ SC_KNOWN_GOOD: List[Tuple[str, Callable]] = [
     ("bf16-zero2-step", sc_good_bf16_zero2),
     ("fp32-preset-identity", sc_good_fp32_preset_identity),
     ("replicated-step", sc_good_replicated),
+    ("sp-ring-step", sc_good_sp_ring),
 ]
 
 #: rule id -> the SC_KNOWN_GOOD fixture exercising that rule's trigger
@@ -890,4 +992,5 @@ SC_GOOD_FOR: Dict[str, str] = {
     "SC005": "zero1-step",            # donation requested AND landed
     "SC006": "replicated-step",       # no host transfer in the step
     "SC007": "zero1-step",            # HLO == model within tolerance
+    "SC008": "sp-ring-step",          # sp claim with the ring present
 }

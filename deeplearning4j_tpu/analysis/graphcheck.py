@@ -68,6 +68,21 @@ Rules (stable ids; severities in parentheses):
                                     2001.04206's 2-5x mistuning loss);
                                     run ``autotune()`` or adopt the
                                     named config
+- GC017 composition-legality (error) mesh axes composed in a shape no
+                                    trainer can run — pp with sp or tp,
+                                    or zero1/zero2 under pp (the
+                                    pipeline trainers replicate the
+                                    update); (warning) an sp axis over
+                                    a model with no ring-capable
+                                    attention layer (nothing rings, the
+                                    chips idle), or a pp axis deeper
+                                    than the DAG's single-tensor cut
+                                    points (the extra stage boundaries
+                                    would split a residual stream —
+                                    e.g. a transformer block's — so
+                                    those stages degrade to identity
+                                    pass-throughs). Flushed out by the
+                                    GPT decoder LM (ISSUE 14).
 
 Entry points: ``check_multilayer`` / ``check_graph`` /
 ``validate_config`` (dispatch), plus ``.validate()`` hooks installed on
@@ -115,6 +130,12 @@ RULES: Dict[str, Tuple[str, str]] = {
     "GC016": ("config-mistuned", "analytic step time > 2x the "
                                  "autotuner's best legal config for "
                                  "the same model/device count"),
+    "GC017": ("composition-legality", "strategy axes composed in a "
+                                      "shape no trainer runs (pp with "
+                                      "sp/tp/zero), sp without a "
+                                      "ring-capable attention layer, "
+                                      "or pp deeper than the DAG's "
+                                      "single-tensor cut points"),
 }
 
 # pp stage partitions whose heaviest stage exceeds the mean by this factor
@@ -473,6 +494,153 @@ def _check_mesh(findings: List[Finding], body_layers: List[Tuple[str, object]],
                     "axis"))
 
 
+def graph_cut_points(conf, order: Optional[List[str]] = None
+                     ) -> List[Tuple[int, str]]:
+    """Valid single-tensor pipeline stage boundaries of a DAG: positions
+    ``p`` in the topological order where exactly ONE node's activation
+    crosses from the prefix ``topo[:p]`` to the suffix — the single
+    tensor the GPipe ring can carry. Returns [(p, crossing_node_name)].
+    A residual/skip connection spanning a candidate boundary (e.g. a
+    transformer block's residual stream around its attention sublayer)
+    disqualifies it: two tensors would cross.
+
+    This is the CANONICAL implementation — jax-free on purpose, so the
+    GC017 validator can run it; ``parallel/pipeline.
+    find_graph_cut_points`` (the GraphPipelineTrainer's stage-cut
+    source) delegates here, so the validator's verdict and the
+    trainer's partition can never drift."""
+    topo = list(order if order is not None
+                else conf.topological_order or conf.nodes)
+    consumers: Dict[str, List[str]] = {n: [] for n in topo}
+    for n in topo:
+        for i in conf.nodes[n].inputs:
+            if i in consumers:   # lenient: dangling refs are GC003's job
+                consumers[i].append(n)
+    out_set = set(conf.network_outputs)
+    cuts: List[Tuple[int, str]] = []
+    prefix: set = set()
+    crossing: set = set()
+    for p, n in enumerate(topo):
+        prefix.add(n)
+        crossing.add(n)
+        crossing = {m for m in crossing
+                    if m in out_set
+                    or any(c not in prefix for c in consumers[m])}
+        if len(crossing) == 1:
+            cuts.append((p + 1, next(iter(crossing))))
+    return cuts
+
+
+def _graph_single_tensor_cuts(conf, order: List[str]) -> int:
+    """Count the INTERIOR body-boundary cut points GC017's pp-depth
+    warning compares against — the same filtering
+    ``GraphPipelineTrainer._partition`` applies to
+    :func:`graph_cut_points` (cuts must land strictly inside the
+    non-input, non-head body)."""
+    nodes = conf.nodes
+    out_set = set(conf.network_outputs)
+    body = [n for n in order
+            if nodes[n].kind != "input" and n not in out_set]
+    body_set = set(body)
+    topo_to_bidx: Dict[int, int] = {}
+    b = 0
+    for p, name in enumerate(order):
+        topo_to_bidx[p + 1] = b + (1 if name in body_set else 0)
+        if name in body_set:
+            b += 1
+    cut_bidx: set = set()
+    for p, crossing in graph_cut_points(conf, order):
+        if crossing not in body_set:
+            continue
+        bidx = topo_to_bidx[p]
+        if 0 < bidx < len(body):
+            cut_bidx.add(bidx)
+    return len(cut_bidx)
+
+
+def _check_composition(findings: List[Finding],
+                       body_layers: List[Tuple[str, object]],
+                       axes: Dict[str, int],
+                       weight_update_sharding,
+                       conf=None, order: Optional[List[str]] = None
+                       ) -> None:
+    """GC017: composition legality of the strategy cross-product (the
+    rule the GPT decoder LM flushed out — ISSUE 14). Some mesh-axis
+    combinations are UNREACHABLE: ``ParallelTrainer`` composes
+    dp x tp x sp (one SPMD step) and the pipeline trainers compose
+    dp x pp (the GPipe ring), but no trainer runs pp with sp or tp, and
+    the pipeline trainers apply the replicated weight update only — a
+    zero1/zero2 claim under pp would silently not shard. And some
+    compositions are legal but buy nothing: an sp axis over a model
+    with no ring-capable attention layer splits NOTHING (the autotune
+    cost model ranks those honestly; this is the config-time warning),
+    and a pp axis deeper than the DAG's single-tensor cut points forces
+    identity stages — on a transformer that means the requested stage
+    boundaries would have to split a block's residual stream, which the
+    ring cannot carry."""
+    sp = axes.get("sp") or 1
+    pp = axes.get("pp") or 1
+    tp = axes.get("model") or axes.get("tp") or 1
+    wus = _wus_mode(weight_update_sharding)
+    if pp > 1 and sp > 1:
+        findings.append(Finding(
+            "GC017", Severity.ERROR, f"pp={pp},sp={sp}",
+            "no trainer composes pipeline parallelism with ring-"
+            "attention sequence parallelism — ParallelTrainer runs "
+            "dp x tp x sp, the pipeline trainers run dp x pp; a mesh "
+            "with both axes is unreachable",
+            "drop one axis (put the chips on dp), or stage the model "
+            "with pp and keep sequences whole per stage"))
+    if pp > 1 and tp > 1:
+        findings.append(Finding(
+            "GC017", Severity.ERROR, f"pp={pp},tp={tp}",
+            "no trainer composes pipeline parallelism with tensor "
+            "parallelism — the pipeline trainers pack stage params "
+            "into flat ring buffers, which cannot carry a "
+            "'model'-sharded kernel",
+            "drop one axis, or shard kernels with tp under "
+            "ParallelTrainer at pp=1"))
+    if pp > 1 and wus in SHARDED_WUS_MODES:
+        findings.append(Finding(
+            "GC017", Severity.ERROR, f"pp={pp},wus={wus}",
+            f"weight_update_sharding={wus!r} under pipeline "
+            "parallelism: the pipeline trainers apply the REPLICATED "
+            "update (compute_updates) — the sharded layout would "
+            "silently never form, paying zero1/zero2's bookkeeping "
+            "for none of its memory",
+            "train zero1/zero2 on a dp(/sp) mesh via ParallelTrainer, "
+            "or run the pipeline with weight_update_sharding='off'"))
+    if sp > 1 and body_layers:
+        ring_capable = [
+            lbl for lbl, l in body_layers
+            if "Attention" in type(l).__name__
+            and getattr(l, "sequence_parallel", True)]
+        if not ring_capable:
+            findings.append(Finding(
+                "GC017", Severity.WARNING, f"sp={sp}",
+                f"an sp={sp} sequence-parallel axis over a model with "
+                "no ring-capable attention layer: nothing rings, the "
+                "sp chips idle through every step (the autotune cost "
+                "model ranks such shapes with sp_effective=1 for the "
+                "same reason)",
+                "add a SelfAttentionLayer (sequence_parallel=True) or "
+                "put the chips on the data axis"))
+    if (pp > 1 and conf is not None and order is not None
+            and hasattr(conf, "nodes")):
+        cuts = _graph_single_tensor_cuts(conf, order)
+        if cuts + 1 < pp:
+            findings.append(Finding(
+                "GC017", Severity.WARNING, f"pp={pp}",
+                f"the DAG has only {cuts} single-tensor cut point(s) "
+                f"— {pp} pipeline stages would need {pp - 1}; every "
+                "other requested boundary lands inside a residual/"
+                "skip region (two tensors would cross the ring), so "
+                f"{pp - 1 - cuts} stage(s) degrade to identity "
+                "pass-throughs that only add bubble ticks",
+                f"use pp<={cuts + 1}, or restructure the graph so "
+                "more block boundaries carry a single tensor"))
+
+
 def _check_input(findings: List[Finding], axes: Dict[str, int],
                  input_iterator) -> None:
     """GC013: a dp >= 2 mesh fed by a non-sharded iterator. Duck-typed
@@ -763,6 +931,8 @@ def check_multilayer(conf, *, mesh=None, batch_size: Optional[int] = None,
     _check_mesh(findings, body, mesh, batch_size, counts=counts)
     _check_zero1(findings, [(lbl, l) for lbl, l, _ in walk],
                  _mesh_axes(mesh), weight_update_sharding)
+    _check_composition(findings, [(lbl, l) for lbl, l, _ in walk],
+                       _mesh_axes(mesh), weight_update_sharding)
     _check_input(findings, _mesh_axes(mesh), input_iterator)
     _check_elastic(findings, [(lbl, l) for lbl, l, _ in walk],
                    _mesh_axes(mesh), batch_size, weight_update_sharding,
@@ -998,6 +1168,9 @@ def check_graph(conf, *, mesh=None, batch_size: Optional[int] = None,
     _check_mesh(findings, body, mesh, batch_size, counts=counts)
     _check_zero1(findings, [(lbl, l) for lbl, l, _ in walk],
                  _mesh_axes(mesh), weight_update_sharding)
+    _check_composition(findings, [(lbl, l) for lbl, l, _ in walk],
+                       _mesh_axes(mesh), weight_update_sharding,
+                       conf=conf, order=order)
     _check_input(findings, _mesh_axes(mesh), input_iterator)
     _check_elastic(findings, [(lbl, l) for lbl, l, _ in walk],
                    _mesh_axes(mesh), batch_size, weight_update_sharding,

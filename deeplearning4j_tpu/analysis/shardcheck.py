@@ -109,6 +109,11 @@ RULES: Dict[str, Tuple[str, str]] = {
     "SC007": ("comm-bytes-calibration",
               "HLO-derived collective bytes vs the cost-model "
               "prediction (tolerance-gated calibration metric)"),
+    "SC008": ("sp-ring-absent",
+              "trainer claims sp>1 sequence parallelism but the "
+              "compiled step contains no collective-permute — the "
+              "ring attention never formed (every chip attends over "
+              "the full sequence, or the layer declined the ring)"),
 }
 
 #: severity when the rule FIRES as a defect (SC002/SC007 also emit
@@ -121,6 +126,7 @@ RULE_SEVERITY = {
     "SC005": Severity.ERROR,
     "SC006": Severity.ERROR,
     "SC007": Severity.WARNING,
+    "SC008": Severity.ERROR,
 }
 
 #: default SC007 gate: |HLO - predicted| / predicted above this warns
@@ -747,6 +753,30 @@ def _check_sc006(findings, mod: HloModule) -> None:
             "debug flag); feed data as step arguments, not infeed"))
 
 
+def _check_sc008(findings, mod: HloModule, sp: int) -> None:
+    """SC008: an sp>1 claim must show the ring — ring attention's KV
+    rotation lowers to collective-permute ops (one per ring hop,
+    typically inside the ring scan's while body). A compiled step with
+    NO collective-permute under an sp claim means the sequence axis is
+    sharded but never ringed: every attention layer declined the ring
+    (non-divisible T, ``sequence_parallel=False``, or no attention
+    layer at all — graphcheck GC017's config-time warning, proven here
+    on the compiled program) and the sp chips buy nothing."""
+    if sp <= 1:
+        return
+    if any(c.kind == "collective-permute" for c in mod.collectives):
+        return
+    findings.append(Finding(
+        "SC008", Severity.ERROR, f"sp={sp}",
+        "trainer claims sp-axis sequence parallelism but the compiled "
+        "step contains no collective-permute — the ring attention "
+        "never formed",
+        "check the model has a SelfAttentionLayer with "
+        "sequence_parallel=True, the sequence length divides the sp "
+        "axis, and the batch divides the data axis (the layer "
+        "declines the ring otherwise); or drop the sp axis"))
+
+
 def _check_sc007(findings, program: StepProgram, wus: str, dp: int,
                  gradient_accumulation: int,
                  param_count: Optional[int],
@@ -787,6 +817,7 @@ def check_step_program(program: StepProgram, *,
                        weight_update_sharding="off",
                        dp: int = 1,
                        gradient_accumulation: int = 1,
+                       sp: int = 1,
                        precision=None,
                        baseline: Optional[StepProgram] = None,
                        expect_donation: Optional[bool] = None,
@@ -808,24 +839,37 @@ def check_step_program(program: StepProgram, *,
     findings: List[Finding] = []
     wus = _wus_mode(weight_update_sharding)
     dp = int(dp or 1)
+    sp = int(sp or 1)
     mod = program.module
     if param_leaf_sizes and param_count is None:
         param_count = sum(int(s) for s in param_leaf_sizes)
     if check_scan is None:
         check_scan = wus in ("zero1", "zero2") and gradient_accumulation > 1
-    _check_sc001(findings, mod, wus, dp)           # also marks rs-form
+    # On an sp mesh the trainer deliberately runs the layout-
+    # UNCONSTRAINED zero path (the anchored (dp, chunk) view without
+    # the sharding-constraint op — see the sp_mesh note in
+    # parallel/trainer.py: the constraint makes GSPMD double-apply the
+    # sp psum to pure-reduction gradient leaves). The reduce-scatter
+    # layout contract (SC001) and the dp ring-model calibration (SC007)
+    # therefore do not apply; SC008 instead proves the sp claim's OWN
+    # program contract — the ring's collective-permute must be present.
+    sp_unconstrained = sp > 1 and wus in ("zero1", "zero2")
+    _check_sc001(findings, mod, "off" if sp_unconstrained else wus, dp)
     _classify_reduce_scatter_form(mod, dp)         # for off-mode census
-    _check_sc002(findings, mod, wus, dp, param_leaf_sizes)
-    _check_sc003(findings, mod, check_scan, dp)
+    _check_sc002(findings, mod,
+                 "off" if sp_unconstrained else wus, dp, param_leaf_sizes)
+    _check_sc003(findings, mod, check_scan and not sp_unconstrained, dp)
     _check_sc004(findings, program, precision, baseline)
     _check_sc005(findings, program, expect_donation)
     _check_sc006(findings, mod)
+    _check_sc008(findings, mod, sp)
     # gate the calibration only where the ring model applies: the
     # ga-scan path hides per-microbatch traffic in loop bodies whose
     # trip counts the text dump does not carry, and callers whose comm
     # pattern is not the dp gradient exchange (ParallelWrapper's
-    # parameter averaging) opt out with check_cost=False
-    if check_cost:
+    # parameter averaging) opt out with check_cost=False; an sp mesh
+    # adds per-layer ring traffic the dp-update model does not cover
+    if check_cost and sp == 1:
         _check_sc007(findings, program, wus, dp, gradient_accumulation,
                      param_count, cost_tolerance,
                      gate=gradient_accumulation == 1)

@@ -22,19 +22,56 @@ from typing import Optional
 import numpy as np
 
 
+def _one_hot_labels(rng, t, batch_size: int):
+    """Deterministic one-hot labels matching one loss head's OUTPUT
+    InputType: [B, K] for feed-forward heads, [B, T, K] per-timestep
+    for recurrent heads (the LM case)."""
+    k = max(2, int(t.size or 2))
+    if t.kind == "rnn":
+        T = int(t.timesteps or 1)
+        return np.eye(k, dtype=np.float32)[
+            rng.integers(0, k, (batch_size, T))]
+    return np.eye(k, dtype=np.float32)[rng.integers(0, k, batch_size)]
+
+
 def synthesize_batch(conf, batch_size: int):
-    """A deterministic synthetic DataSet for a shape-resolved
-    MultiLayer config (seeded by the conf's own seed): random-normal
-    features in the input type's example shape, one-hot labels at the
-    loss head's width. Graph configs carry multiple named inputs —
-    callers pass a real batch for those."""
-    from deeplearning4j_tpu.datasets.dataset import DataSet
+    """A deterministic synthetic batch for a shape-resolved config
+    (seeded by the conf's own seed).
+
+    MultiLayer configs: random-normal features in the input type's
+    example shape, one-hot labels at the loss head's width.
+
+    ComputationGraph configs (ROADMAP item 4d): one feature array per
+    ``network_inputs`` entry from the declared ``input_types``, one
+    one-hot label array per ``network_outputs`` head from the RESOLVED
+    output type — returned as a DataSet for single-input/single-output
+    graphs (every trainer path accepts it) and a MultiDataSet
+    otherwise, so ``autotune(ComputationGraph(...), ...)`` and
+    ``tools/autotune.py`` need no explicit example batch."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+    rng = np.random.default_rng(int(conf.training.seed))
+    if hasattr(conf, "nodes"):  # ComputationGraph configuration
+        if not conf.input_types or not conf.resolved_types:
+            raise ValueError(
+                "cannot synthesize a probe batch: the graph config has "
+                "no input_types (call set_input_types(...) at build, or "
+                "pass batch= to autotune())")
+        feats = []
+        for name in conf.network_inputs:
+            t = conf.input_types[name]
+            feats.append(rng.normal(
+                size=(batch_size,) + tuple(t.example_shape())
+                ).astype(np.float32))
+        labels = [_one_hot_labels(rng, conf.resolved_types[o], batch_size)
+                  for o in conf.network_outputs]
+        if len(feats) == 1 and len(labels) == 1:
+            return DataSet(feats[0], labels[0])
+        return MultiDataSet(feats, labels)
     input_type = getattr(conf, "input_type", None)
     if input_type is None:
         raise ValueError(
             "cannot synthesize a probe batch: the config has no "
-            "input_type (graph configs: pass batch= to autotune())")
-    rng = np.random.default_rng(int(conf.training.seed))
+            "input_type")
     feats = rng.normal(size=(batch_size,) + tuple(
         input_type.example_shape())).astype(np.float32)
     head = conf.layers[-1]

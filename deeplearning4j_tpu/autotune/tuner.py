@@ -161,8 +161,10 @@ def autotune(net, devices=None, hbm_budget: Optional[int] = None,
     trainers and the serving gateway accept directly (``tuned=``).
 
     ``batch``: an example DataSet for the FLOP census and the probes
-    (synthesized deterministically from the config when omitted —
-    MultiLayer configs only; graph configs must pass one).
+    (synthesized deterministically from the config when omitted — for
+    BOTH config kinds: graph configs synthesize per-input features and
+    per-head one-hot labels from their declared/resolved types, a
+    MultiDataSet when the graph is multi-input/-output).
     ``global_batch``: the training batch size the search plans for
     (default: the example batch's row count).
     ``top_k``: how many analytically-best candidates get a measured

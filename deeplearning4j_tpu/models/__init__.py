@@ -9,3 +9,4 @@ from deeplearning4j_tpu.models.lenet import lenet_mnist  # noqa: F401
 from deeplearning4j_tpu.models.vgg import vgg16  # noqa: F401
 from deeplearning4j_tpu.models.resnet import resnet50  # noqa: F401
 from deeplearning4j_tpu.models.char_rnn import char_rnn_lstm  # noqa: F401
+from deeplearning4j_tpu.models.gpt import gpt_decoder, gpt_tiny  # noqa: F401

@@ -47,9 +47,15 @@ _RNN_LAYERS = {"LSTM", "GravesLSTM", "GravesBidirectionalLSTM", "SimpleRnn",
                "GRU", "RnnOutputLayer", "Convolution1DLayer",
                "Subsampling1DLayer", "SelfAttentionLayer",
                "LastTimeStepLayer", "TimeDistributedLayer",
-               "ZeroPadding1DLayer"}
+               "ZeroPadding1DLayer", "PositionalEmbeddingLayer",
+               "TiedRnnOutputLayer"}
 _ANY_LAYERS = {"BatchNormalization", "GlobalPoolingLayer", "ActivationLayer",
-               "DropoutLayer", "LossLayer", "ReshapeLayer", "PermuteLayer"}
+               "DropoutLayer", "LossLayer", "ReshapeLayer", "PermuteLayer",
+               # feature-axis normalization is rank-agnostic: a LayerNorm
+               # between attention blocks must keep its rnn-typed input
+               # (an auto Rnn->FF preprocessor here would strip the time
+               # axis the transformer's residual stream carries)
+               "LayerNormalization"}
 
 
 def expected_input_kind(layer: BaseLayerConf) -> str:

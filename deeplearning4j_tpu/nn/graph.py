@@ -83,6 +83,23 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
         self._layer_nodes = [n for n in conf.topological_order
                              if conf.nodes[n].kind == "layer"]
         self._output_layers = [conf.nodes[o] for o in conf.network_outputs]
+        # weight tying (TiedRnnOutputLayer.tied_to): resolve once, fail
+        # loudly at construction — a dangling tie would otherwise only
+        # surface as a missing-param KeyError deep inside a traced step
+        for name in self._layer_nodes:
+            tied = getattr(conf.nodes[name].layer, "tied_to", None)
+            if not tied:
+                continue
+            src = conf.nodes.get(tied)
+            if src is None or src.kind != "layer":
+                raise ValueError(
+                    f"node {name!r}: tied_to={tied!r} does not name a "
+                    "layer node in this graph")
+            if "W" not in (src.layer.param_order() or []):
+                raise ValueError(
+                    f"node {name!r}: tied_to node {tied!r} "
+                    f"({type(src.layer).__name__}) has no 'W' param to "
+                    "tie to")
 
     # ------------------------------------------------------------------ init
     def init(self, params=None) -> "ComputationGraph":
@@ -113,6 +130,18 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
     def _check_init(self):
         if self.params is None:
             raise RuntimeError("Call init() before using the network")
+
+    def _layer_params(self, params, name: str):
+        """Effective params of one layer node: its own dict, plus — for a
+        tied head (``layer.tied_to``) — the tied node's token-embedding
+        matrix injected as ``W_tok``. Indexing ``params`` (not a cached
+        array) keeps autodiff honest: the head's gradient flows into the
+        embedding's ``W``, which is the whole point of weight tying."""
+        node = self.conf.nodes[name]
+        tied = getattr(node.layer, "tied_to", None)
+        if tied:
+            return {**params[name], "W_tok": params[tied]["W"]}
+        return params[name]
 
 
     def set_listeners(self, *listeners: IterationListener):
@@ -199,7 +228,8 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
                 h = layer._dropout_input(h, train and not layer.frozen, sub)
                 scan_fn = (jax.checkpoint(layer.scan) if remat
                            else layer.scan)
-                h, c_out = scan_fn(params[name], h, c_in, cur_mask)
+                h, c_out = scan_fn(self._layer_params(params, name), h,
+                                   c_in, cur_mask)
                 new_carries[name] = c_out
                 s = states[name]
             else:
@@ -210,8 +240,8 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
                                     mask=m)
                 if remat:
                     apply_fn = jax.checkpoint(apply_fn)
-                h, s = apply_fn(params[name], h, states[name], sub,
-                                cur_mask)
+                h, s = apply_fn(self._layer_params(params, name), h,
+                                states[name], sub, cur_mask)
                 if layer.frozen:
                     s = states[name]
             acts[name] = h
@@ -284,9 +314,9 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
             if lm is None:
                 lbl = labels[out_name]
                 lm = out_masks.get(out_name) if lbl.ndim > 2 else None
-            total = total + layer.compute_loss(params[out_name],
-                                               acts[out_name],
-                                               labels[out_name], mask=lm)
+            total = total + layer.compute_loss(
+                self._layer_params(params, out_name), acts[out_name],
+                labels[out_name], mask=lm)
         return total
 
     def _loss_fn(self, params, states, inputs, labels: Dict[str, Array],

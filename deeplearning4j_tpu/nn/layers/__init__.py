@@ -57,3 +57,7 @@ from deeplearning4j_tpu.nn.layers.shape import (  # noqa: F401
 )
 from deeplearning4j_tpu.nn.layers.variational import VariationalAutoencoder  # noqa: F401
 from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer  # noqa: F401
+from deeplearning4j_tpu.nn.layers.embedding import (  # noqa: F401
+    PositionalEmbeddingLayer,
+    TiedRnnOutputLayer,
+)

@@ -1059,25 +1059,12 @@ def find_graph_cut_points(conf) -> List[Tuple[int, str]]:
     ``topo[:p]`` to the suffix — the single tensor the ring can carry.
     Returns [(p, crossing_node_name)]. ResNet-style block chains cut at
     every block output; a skip connection spanning a candidate boundary
-    disqualifies it (two tensors would cross)."""
-    topo = list(conf.topological_order)
-    consumers = {n: [] for n in topo}
-    for n in topo:
-        for i in conf.nodes[n].inputs:
-            consumers[i].append(n)
-    out_set = set(conf.network_outputs)
-    cuts = []
-    prefix = set()
-    crossing = set()
-    for p, n in enumerate(topo):
-        prefix.add(n)
-        crossing.add(n)
-        crossing = {m for m in crossing
-                    if m in out_set
-                    or any(c not in prefix for c in consumers[m])}
-        if len(crossing) == 1:
-            cuts.append((p + 1, next(iter(crossing))))
-    return cuts
+    disqualifies it (two tensors would cross). The algorithm itself is
+    ``analysis/graphcheck.graph_cut_points`` — ONE implementation, so
+    the GC017 composition validator and this trainer's partition can
+    never disagree about which cuts exist."""
+    from deeplearning4j_tpu.analysis.graphcheck import graph_cut_points
+    return graph_cut_points(conf)
 
 
 class GraphPipelineTrainer(_RingFitMixin):
@@ -1173,6 +1160,15 @@ class GraphPipelineTrainer(_RingFitMixin):
             if getattr(l, "supports_carry", False):
                 raise ValueError(f"layer node {name!r} is recurrent — "
                                  "unsupported in the graph pipeline v1")
+            if getattr(l, "tied_to", None) and name not in self.out_names:
+                # tied weights resolve at the LOSS seam (outside the
+                # ring), where the full params dict is in scope; a tied
+                # layer inside a stage would need its partner's params
+                # in the packed buffer — not wired
+                raise ValueError(
+                    f"layer node {name!r} ties weights (tied_to="
+                    f"{l.tied_to!r}) but is not an output head — only "
+                    "tied LOSS heads are supported in the graph pipeline")
         if conf.training.backprop_type == "truncated_bptt":
             # the single-device graph windows updates via _fit_tbptt;
             # running full-sequence BPTT here instead would silently
@@ -1435,8 +1431,12 @@ class GraphPipelineTrainer(_RingFitMixin):
                 if node.preprocessor is not None:
                     h = node.preprocessor.transform(h, None)
                 lab = labels[o] if isinstance(labels, dict) else labels
+                # tied head (TiedRnnOutputLayer): the container's one
+                # tying seam injects the tied node's embedding matrix
+                # from the FULL params tree — the head's gradient flows
+                # into the embedding alongside the ring path's own use
                 data_loss = data_loss + node.layer.compute_loss(
-                    params[o], h, lab, mask=None)
+                    net._layer_params(params, o), h, lab, mask=None)
             # l1_l2_penalty wants a LIST aligned with layer_list (the
             # graph loss path does the same, nn/graph.py:296-299)
             reg = l1_l2_penalty([params[n] for n in net._layer_nodes],

@@ -178,6 +178,23 @@ class ParallelTrainer:
             dp = mesh_ctx.zero1_shards(z_axis)
             z_sharding = mesh_ctx.zero1_sharding(z_axis)
             rep_sharding = mesh_ctx.replicated()
+            # COMPOSITION WORKAROUND (flushed out by the GPT LM, ISSUE
+            # 14): on a mesh that ALSO carries an 'sp' axis, the
+            # with_sharding_constraint(zero1_shard_leaf(g), P(dp, None))
+            # op makes GSPMD double-apply the sp-axis psum to gradient
+            # leaves whose grad is a pure reduction over the (data, sp)-
+            # sharded batch (measured on CPU dp=2 x sp=2, jax 0.4.37:
+            # a loss-head bias gradient comes back exactly sp-times too
+            # large; every other leaf bitwise-identical; the replicated
+            # anchor alone and the unconstrained (dp, chunk) reshape are
+            # both correct — ONLY the explicit shard constraint
+            # miscompiles). Under sp, keep the anchored (dp, chunk)
+            # VIEW but skip the layout constraint: values stay exactly
+            # the replicated program's (the bitwise spine holds,
+            # tools/lm_smoke.py gates it); the in-step gradient may
+            # stay replicated instead of reduce-scattered — a layout
+            # pessimization on sp meshes, never a correctness change.
+            sp_mesh = mesh_ctx.seq_axis is not None
 
             def pin_replicated(tree):
                 return jax.tree.map(
@@ -206,8 +223,12 @@ class ParallelTrainer:
                 gradient-memory win, and the anchored per-microbatch
                 sum stays transient.
                 """
-                if in_scan or not zero2:
+                if in_scan or not zero2 or sp_mesh:
                     g = pin_replicated(g)
+                if sp_mesh:
+                    # see sp_mesh above: anchored view, no constraint
+                    return jax.tree.map(
+                        lambda t: zero1_shard_leaf(t, dp), g)
                 return jax.tree.map(
                     lambda t: jax.lax.with_sharding_constraint(
                         zero1_shard_leaf(t, dp), z_sharding), g)
@@ -362,6 +383,8 @@ class ParallelTrainer:
             weight_update_sharding=self.weight_update_sharding.mode,
             dp=self.mesh.n_data,
             gradient_accumulation=self.gradient_accumulation,
+            sp=(self.mesh.mesh.shape[self.mesh.seq_axis]
+                if self.mesh.seq_axis else 1),
             precision=self.precision,
             expect_donation=self._donate,
             param_leaf_sizes=param_leaf_sizes(self.net.params))

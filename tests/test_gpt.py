@@ -1,0 +1,406 @@
+"""GPT decoder LM (ISSUE 14): the composition workload.
+
+Covers the model itself (build/validate/serde/weight tying/training),
+the strategy compositions on the repo's parity spine (dp x sp x zero2 x
+bf16 under ParallelTrainer, dp x pp under GraphPipelineTrainer — the
+FAST dp x sp tier-1 variant runs always, the full composition matrix is
+``slow``), the GC017 composition-legality rule, SC008's sp-ring program
+contract, and the autotune graph-batch synthesis (ROADMAP item 4d).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis.findings import Severity
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.models.gpt import (
+    char_lm_batches, char_lm_sources, char_vocab, gpt_tiny,
+    synthetic_char_text,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import (
+    PositionalEmbeddingLayer, TiedRnnOutputLayer,
+)
+from deeplearning4j_tpu.parallel.mesh import MeshContext
+from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+TEXT = synthetic_char_text(6000, seed=1)
+CHARSET = char_vocab(TEXT)
+V, T, B = len(CHARSET), 8, 8
+
+
+def _conf(**kw):
+    kw.setdefault("seed", 7)
+    return gpt_tiny(vocab_size=V, seq_len=T, **kw)
+
+
+def _batches(n=2, batch=B):
+    return char_lm_batches(TEXT, T, batch, charset=CHARSET, max_batches=n)
+
+
+def _losses(trainer_or_net, batches):
+    fit = getattr(trainer_or_net, "fit_batch")
+    return [np.float32(np.asarray(fit(b))) for b in batches]
+
+
+def _bitwise(a, b):
+    return all(x.tobytes() == y.tobytes() for x, y in zip(a, b))
+
+
+# ---------------------------------------------------------------- the model
+
+def test_gpt_config_validates_clean():
+    assert _conf().validate(batch_size=B) == []
+
+
+def test_gpt_trains_and_loss_decreases():
+    net = ComputationGraph(_conf()).init()
+    batches = char_lm_batches(TEXT, T, 16, charset=CHARSET, max_batches=4)
+    first = float(np.asarray(net.fit_batch(batches[0])))
+    for _ in range(6):
+        for b in batches:
+            net.fit_batch(b)
+    assert float(np.asarray(net.fit_batch(batches[0]))) < first
+
+
+def test_gpt_head_is_weight_tied():
+    """The tied head owns no params and really projects through the
+    embedding matrix: logits == h @ W_emb.T at init (proven against an
+    untied twin whose head W is SET to the embedding's transpose)."""
+    net = ComputationGraph(_conf()).init()
+    assert net.params["head"] == {}
+    untied = ComputationGraph(_conf(tie_weights=False)).init()
+    # same seed => same embedding; COPY the tied projection in (no
+    # aliasing — both nets' fit steps donate their param buffers)
+    import jax.numpy as jnp
+    for name in net.params:
+        for k in net.params[name]:
+            untied.params[name][k] = jnp.array(
+                np.asarray(net.params[name][k]))
+    untied.params["head"]["W"] = jnp.array(
+        np.asarray(net.params["embed"]["W"]).T)
+    untied.params["head"]["b"] = jnp.zeros((V,), jnp.float32)
+    b0 = _batches(1)[0]
+    np.testing.assert_allclose(
+        np.asarray(net.output(b0.features)),
+        np.asarray(untied.output(b0.features)), rtol=1e-6, atol=1e-7)
+    # and the head's gradient flows INTO the embedding: one step moves
+    # the tied embed.W differently from the untied twin's
+    net.fit_batch(b0)
+    untied.fit_batch(b0)
+    assert not np.allclose(np.asarray(net.params["embed"]["W"]),
+                           np.asarray(untied.params["embed"]["W"]),
+                           atol=1e-9)
+
+
+def test_tied_head_validation():
+    from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    g = (NeuralNetConfiguration.builder().graph_builder()
+         .add_inputs("tokens")
+         .add_layer("embed", PositionalEmbeddingLayer(n_out=8), "tokens")
+         .add_layer("head", TiedRnnOutputLayer(
+             n_out=4, tied_to="nope", activation="softmax"), "embed")
+         .set_outputs("head")
+         .set_input_types(InputType.recurrent(4, 4)))
+    with pytest.raises(ValueError, match="tied_to"):
+        ComputationGraph(g.build())
+
+
+def test_positional_embedding_adds_learned_positions():
+    layer = PositionalEmbeddingLayer(n_out=6, activation="identity")
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    layer.set_n_in(InputType.recurrent(5, 4))
+    params = layer.init_params(jax.random.PRNGKey(0))
+    x = np.zeros((2, 4, 5), np.float32)
+    x[:, :, 1] = 1.0  # every position is token 1
+    out, _ = layer.apply(params, x, state={}, train=False, rng=None)
+    want = (np.asarray(params["W"])[1] + np.asarray(params["b"])
+            + np.asarray(params["P"])[:4])
+    np.testing.assert_allclose(np.asarray(out)[0], want, rtol=1e-6)
+    # sequences past the learned table are a loud error
+    with pytest.raises(ValueError, match="position table"):
+        layer.apply(params, np.zeros((1, 9, 5), np.float32),
+                    state={}, train=False, rng=None)
+
+
+# ----------------------------------------------------------------- conf serde
+
+def test_gpt_conf_serde_roundtrip():
+    from deeplearning4j_tpu.nn.conf.graph_builder import (
+        ComputationGraphConfiguration)
+    conf = _conf()
+    again = ComputationGraphConfiguration.from_json(conf.to_json())
+    assert again.to_json() == conf.to_json()
+    again_y = ComputationGraphConfiguration.from_yaml(conf.to_yaml())
+    assert again_y.to_json() == conf.to_json()
+    # the round-tripped config trains identically (bitwise, same seed)
+    a = _losses(ComputationGraph(conf).init(), _batches(2))
+    b = _losses(ComputationGraph(again).init(), _batches(2))
+    assert _bitwise(a, b)
+
+
+def test_lm_building_blocks_pre_field_configs_load():
+    """Configs serialized BEFORE a field existed must still load: drop
+    the newest fields from each building block's dict and deserialize."""
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.layers.base import layer_from_dict
+    att = SelfAttentionLayer(n_heads=2, causal=True)
+    d = att.to_dict()
+    d.pop("sequence_parallel", None)   # pre-sp-era attention config
+    old = layer_from_dict(d)
+    assert old.sequence_parallel is True
+    head = TiedRnnOutputLayer(n_out=4, tied_to="embed")
+    d = head.to_dict()
+    assert d["tied_to"] == "embed"     # tie survives serde
+    d.pop("tied_to")
+    assert layer_from_dict(d).tied_to is None
+    emb = PositionalEmbeddingLayer(n_out=8, max_timesteps=16)
+    again = layer_from_dict(emb.to_dict())
+    assert (again.n_out, again.max_timesteps) == (8, 16)
+
+
+def test_keras_import_maps_lm_building_blocks():
+    """The Keras importer maps LayerNormalization into the same layer
+    class the LM stacks, and the result round-trips the conf serde."""
+    from deeplearning4j_tpu.keras.keras_import import KerasLayerMapper
+    from deeplearning4j_tpu.nn.layers import LayerNormalization
+    from deeplearning4j_tpu.nn.layers.base import layer_from_dict
+    ln = KerasLayerMapper.map("LayerNormalization",
+                              {"epsilon": 1e-4, "axis": -1})
+    assert isinstance(ln, LayerNormalization) and ln.eps == 1e-4
+    again = layer_from_dict(ln.to_dict())
+    assert isinstance(again, LayerNormalization) and again.eps == 1e-4
+
+
+# ------------------------------------------------- composition (fast, tier-1)
+
+def test_composed_dp_sp_zero2_bitwise_fast():
+    """The tier-1 composition gate: dp=2 x sp=2 (ring attention) with
+    zero2 == the same mesh replicated, bitwise — 2 steps (the full
+    matrix incl. bf16/pp/accum is the slow test + tools/lm_smoke.py)."""
+    batches = _batches(2)
+
+    def run(wus):
+        net = ComputationGraph(_conf()).init()
+        tr = ParallelTrainer(net, MeshContext.create(
+            n_data=2, n_model=1, n_seq=2), weight_update_sharding=wus)
+        return net, _losses(tr, batches)
+
+    n_off, l_off = run(None)
+    n_z, l_z = run("zero2")
+    assert _bitwise(l_z, l_off)
+    assert (np.asarray(n_z.params_flat()).tobytes()
+            == np.asarray(n_off.params_flat()).tobytes())
+
+
+@pytest.mark.slow
+def test_full_composition_matrix_slow():
+    """The full cross-product on CPU: dp x sp x zero2 x bf16 x accum
+    under ParallelTrainer, dp x pp under GraphPipelineTrainer — every
+    fp32 leg bitwise vs its replicated twin, pp bitwise vs the
+    single-replica program, bf16 leg loss-bitwise with fp32 masters."""
+    from jax.sharding import Mesh
+
+    from deeplearning4j_tpu.parallel.pipeline import GraphPipelineTrainer
+    batches = _batches(3)
+
+    def run(n_data, n_seq=1, wus=None, precision=None, accum=1):
+        net = ComputationGraph(_conf()).init()
+        tr = ParallelTrainer(
+            net, MeshContext.create(n_data=n_data, n_model=1,
+                                    n_seq=n_seq),
+            gradient_accumulation=accum, weight_update_sharding=wus,
+            precision=precision)
+        return net, _losses(tr, batches)
+
+    ref_net = ComputationGraph(_conf()).init()
+    ref = _losses(ref_net, batches)
+    # dp x zero2 x accum
+    n_a, l_a = run(4, accum=2)
+    n_b, l_b = run(4, wus="zero2", accum=2)
+    assert _bitwise(l_a, l_b)
+    # dp x sp x zero1/zero2 x accum
+    n_c, l_c = run(2, n_seq=2, accum=2)
+    n_d, l_d = run(2, n_seq=2, wus="zero2", accum=2)
+    assert _bitwise(l_c, l_d)
+    # bf16 masters stay fp32, loss-bitwise vs same-mesh bf16 replicated
+    n_e, l_e = run(2, n_seq=2, precision="bf16")
+    n_f, l_f = run(2, n_seq=2, wus="zero2", precision="bf16")
+    assert _bitwise(l_e, l_f)
+    assert {str(p.dtype) for p in jax.tree_util.tree_leaves(n_f.params)} \
+        == {"float32"}
+    # dp x pp GPipe: M=1 bitwise vs the single-replica program
+    pp_net = ComputationGraph(_conf()).init()
+    tr = GraphPipelineTrainer(
+        pp_net, Mesh(np.array(jax.devices()[:2]).reshape(2), ("pp",)),
+        n_microbatches=1)
+    l_pp = _losses(tr, batches)
+    assert _bitwise(l_pp, ref)
+    # dp x pp with microbatches tracks within tolerance
+    dpp_net = ComputationGraph(_conf()).init()
+    tr2 = GraphPipelineTrainer(
+        dpp_net, Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                      ("dp", "pp")), n_microbatches=2)
+    l_dpp = _losses(tr2, batches)
+    assert max(abs(float(a) - float(b))
+               for a, b in zip(l_dpp, ref)) < 1e-4
+
+
+def test_graph_pipeline_rejects_tied_non_head():
+    """A tied layer INSIDE a stage cannot resolve its partner's params
+    from the ring buffer — construction must fail loudly."""
+    from jax.sharding import Mesh
+
+    from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import RnnOutputLayer
+    from deeplearning4j_tpu.parallel.pipeline import GraphPipelineTrainer
+    g = (NeuralNetConfiguration.builder().graph_builder()
+         .add_inputs("tokens")
+         .add_layer("embed", PositionalEmbeddingLayer(
+             n_out=8, activation="identity"), "tokens")
+         .add_layer("mid", TiedRnnOutputLayer(
+             n_out=4, tied_to="embed", activation="softmax"), "embed")
+         .add_layer("out", RnnOutputLayer(n_out=4, activation="softmax",
+                                          loss="mcxent"), "mid")
+         .set_outputs("out")
+         .set_input_types(InputType.recurrent(4, 4)))
+    net = ComputationGraph(g.build()).init()
+    with pytest.raises(ValueError, match="tied"):
+        GraphPipelineTrainer(
+            net, Mesh(np.array(jax.devices()[:2]).reshape(2), ("pp",)))
+
+
+# ----------------------------------------------------------- GC017 + SC008
+
+def test_gc017_sp_without_attention_warns():
+    from deeplearning4j_tpu.analysis.fixtures import good_mlp
+    conf, _ = good_mlp()
+    f = conf.validate(mesh={"dp": 2, "sp": 2}, batch_size=8)
+    assert any(x.rule == "GC017" and x.severity == Severity.WARNING
+               for x in f)
+
+
+def test_gc017_quiet_on_the_composed_lm():
+    f = _conf().validate(mesh={"dp": 2, "sp": 2}, batch_size=8,
+                         weight_update_sharding="zero2")
+    assert not [x for x in f if x.rule == "GC017"]
+
+
+@pytest.mark.parametrize("mesh,wus", [
+    ({"dp": 1, "pp": 2, "sp": 2}, None),
+    ({"dp": 1, "pp": 2, "tp": 2}, None),
+    ({"dp": 2, "pp": 2}, "zero2"),
+])
+def test_gc017_unreachable_compositions_error(mesh, wus):
+    f = _conf().validate(mesh=mesh, batch_size=8,
+                         weight_update_sharding=wus)
+    assert any(x.rule == "GC017" and x.severity == Severity.ERROR
+               for x in f)
+
+
+def test_gc017_pp_deeper_than_cut_points_warns():
+    conf = gpt_tiny(vocab_size=V, seq_len=T, n_layers=1)
+    f = conf.validate(mesh={"dp": 1, "pp": 8}, batch_size=8)
+    hits = [x for x in f if x.rule == "GC017"]
+    assert hits and all(x.severity == Severity.WARNING for x in hits)
+    assert "cut point" in hits[0].message
+
+
+def test_autotune_prunes_unreachable_compositions():
+    """The tuner consumes GC017 ERROR findings as hard constraints:
+    no pp x sp / pp x tp / pp x zero candidate survives pruning."""
+    from deeplearning4j_tpu.autotune.model import census_from_conf
+    from deeplearning4j_tpu.autotune.tuner import analytic_search
+    survivors, counters = analytic_search(
+        census_from_conf(_conf()), n_devices=8, global_batch=8)
+    assert counters["pruned_illegal"] > 0
+    for cand, _ in survivors:
+        assert not (cand.pp > 1 and (cand.sp > 1 or cand.tp > 1))
+        assert not (cand.pp > 1
+                    and cand.weight_update_sharding != "off")
+
+
+def test_sc008_fires_on_false_sp_claim():
+    from deeplearning4j_tpu.analysis.fixtures import sc_bad_sp_ring_absent
+    from deeplearning4j_tpu.analysis.shardcheck import check_step_program
+    program, ctx = sc_bad_sp_ring_absent()
+    assert "SC008" in {f.rule for f in check_step_program(program, **ctx)}
+
+
+def test_sp_trainer_shardcheck_clean_with_ring():
+    from deeplearning4j_tpu.analysis.fixtures import sc_good_sp_ring
+    from deeplearning4j_tpu.analysis.shardcheck import check_step_program
+    program, ctx = sc_good_sp_ring()
+    assert ctx["sp"] == 2
+    bad = [f for f in check_step_program(program, **ctx)
+           if f.severity != Severity.INFO]
+    assert not bad, bad
+
+
+# ------------------------------------------------- autotune batch synthesis
+
+def test_synthesize_batch_graph_single_io():
+    from deeplearning4j_tpu.autotune.probe import synthesize_batch
+    ds = synthesize_batch(_conf(), 4)
+    assert isinstance(ds, DataSet)
+    assert ds.features.shape == (4, T, V)
+    assert ds.labels.shape == (4, T, V)
+    assert np.allclose(ds.labels.sum(axis=-1), 1.0)  # one-hot rows
+
+
+def test_synthesize_batch_graph_multi_io():
+    from deeplearning4j_tpu.analysis.fixtures import good_graph_merge
+    from deeplearning4j_tpu.autotune.probe import synthesize_batch
+    conf, _ = good_graph_merge()
+    mds = synthesize_batch(conf, 6)
+    assert isinstance(mds, (DataSet, MultiDataSet))
+    # two inputs -> MultiDataSet with per-input shapes
+    assert isinstance(mds, MultiDataSet)
+    assert [f.shape for f in mds.features] == [(6, 12), (6, 8)]
+    assert [l.shape for l in mds.labels] == [(6, 3)]
+
+
+def test_autotune_gpt_needs_no_example_batch():
+    """ROADMAP 4d end to end: autotune(graph LM) with NO batch= —
+    legality-pruned, ranked, probed on the synthesized batch, and the
+    tuned trainer reproduces a hand-built one bitwise (probe parity)."""
+    from deeplearning4j_tpu.autotune import autotune
+    net = ComputationGraph(_conf()).init()
+    tuned = autotune(net, devices=2, global_batch=8, top_k=1,
+                     probe_steps=1, probe_warmup=1)
+    assert tuned.measured_step_s is not None
+    batches = _batches(2)
+    tuned_net = ComputationGraph(_conf()).init()
+    l_tuned = _losses(tuned.trainer(tuned_net), batches)
+    hand_net = ComputationGraph(_conf()).init()
+    hand = ParallelTrainer(
+        hand_net, MeshContext.create(n_data=tuned.dp, n_model=tuned.tp,
+                                     n_seq=tuned.sp),
+        gradient_accumulation=tuned.gradient_accumulation,
+        weight_update_sharding=tuned.weight_update_sharding,
+        precision=tuned.precision)
+    assert _bitwise(l_tuned, _losses(hand, batches))
+
+
+# -------------------------------------------------- char data path (pipeline)
+
+def test_char_lm_sources_through_streaming_pipeline():
+    """The char data path behind the sharded streaming front: the
+    pipeline's ordered emission reproduces the plain batch stream, and
+    a fit through it is trajectory-bitwise with the direct fit."""
+    from deeplearning4j_tpu.datasets.pipeline import StreamingInputPipeline
+    sources, cs = char_lm_sources(TEXT, T, B, n_sources=3,
+                                  charset=CHARSET)
+    plain = char_lm_batches(TEXT, T, B, charset=cs)
+    pipe = StreamingInputPipeline(sources, num_shards=1, shard_index=0,
+                                  reader_workers=2, decode_workers=2)
+    got = list(pipe)
+    # source-order emission: shard 0's batches first, then shard 1's...
+    want = [b for s in range(3) for b in plain[s::3]]
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert np.asarray(g.features).tobytes() == w.features.tobytes()
+        assert np.asarray(g.labels).tobytes() == w.labels.tobytes()

@@ -28,6 +28,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
 def _load_net(args):
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     if args.model:
         if args.model == "lenet":
@@ -36,24 +37,29 @@ def _load_net(args):
         elif args.model == "mlp":
             from deeplearning4j_tpu.analysis.fixtures import good_mlp
             conf, _ = good_mlp()
+        elif args.model == "gpt":
+            # the composition workload (ISSUE 14): a graph config —
+            # probe batches are synthesized from its declared types
+            # (autotune/probe.synthesize_batch graph path)
+            from deeplearning4j_tpu.models.gpt import gpt_tiny
+            conf = gpt_tiny(vocab_size=16, seq_len=8)
         else:
             raise SystemExit(f"unknown --model {args.model!r}; "
-                             "have: lenet, mlp")
-        return MultiLayerNetwork(conf).init()
-    with open(args.config, "r", encoding="utf-8") as fh:
-        text = fh.read()
-    if args.config.endswith((".yaml", ".yml")):
-        import yaml
-        d = yaml.safe_load(text)
+                             "have: lenet, mlp, gpt")
     else:
-        d = json.loads(text)
-    from deeplearning4j_tpu.analysis.graphcheck import load_config_dict
-    conf = load_config_dict(d)
+        with open(args.config, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        if args.config.endswith((".yaml", ".yml")):
+            import yaml
+            d = yaml.safe_load(text)
+        else:
+            d = json.loads(text)
+        from deeplearning4j_tpu.analysis.graphcheck import load_config_dict
+        conf = load_config_dict(d)
     if hasattr(conf, "nodes"):
-        raise SystemExit(
-            "graph configs need an example batch the CLI cannot "
-            "synthesize — call autotune(ComputationGraph(conf).init(), "
-            "batch=...) from Python")
+        if not getattr(conf, "resolved_types", None):
+            conf._resolve_shapes()
+        return ComputationGraph(conf).init()
     return MultiLayerNetwork(conf).init()
 
 
@@ -62,7 +68,7 @@ def main(argv=None) -> int:
     ap.add_argument("config", nargs="?",
                     help="serialized config (.json/.yaml)")
     ap.add_argument("--model", default=None,
-                    help="named built-in model family (lenet, mlp) "
+                    help="named built-in model family (lenet, mlp, gpt) "
                          "instead of a config file")
     ap.add_argument("--devices", type=int, default=None,
                     help="chips to plan for (default: all attached)")

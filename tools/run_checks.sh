@@ -29,7 +29,7 @@ stage() {
 
 bench_smoke() {
     rm -f /tmp/_bench_smoke.jsonl
-    JAX_PLATFORMS=cpu BENCH_SMOKE=1 BENCH_RUNGS=lenet,input,serve \
+    JAX_PLATFORMS=cpu BENCH_SMOKE=1 BENCH_RUNGS=lenet,input,serve,lm \
         BENCH_AUTOTUNE=1 BENCH_CHILD=1 \
         python bench.py | tee /tmp/_bench_smoke.jsonl || return 1
     # every successful rung record must carry the ISSUE-10 precision
@@ -63,8 +63,18 @@ bad = [r["metric"] for r in tuned
            "measured_vs_predicted_gap") is not None
            and math.isfinite(r["measured_vs_predicted_gap"]))]
 assert not bad, f"autotuned records without a finite calibration gap: {bad}"
+# ISSUE 14: the lm rung's record must carry the token-throughput schema
+# with a finite analytic MFU
+lm = [r for r in recs if r.get("rung") == "lm"]
+assert lm, "no lm rung record emitted"
+for r in lm:
+    for fld in ("tokens_per_sec_per_chip", "seq_len", "analytic_mfu"):
+        v = r.get(fld)
+        assert v is not None and math.isfinite(float(v)), \
+            f"lm record {fld} missing or non-finite: {v!r}"
 print(f"bench record schema: {len(recs)} records OK "
-      f"({len(tuned)} autotuned)")
+      f"({len(tuned)} autotuned, lm tokens/sec/chip "
+      f"{lm[0]['tokens_per_sec_per_chip']} @ seq {lm[0]['seq_len']})")
 PY
 }
 
@@ -97,9 +107,11 @@ if [ "${1:-}" != "--fast" ]; then
     stage "profiling smoke"  env JAX_PLATFORMS=cpu python tools/profiling_smoke.py
     stage "chaos smoke"      env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
     stage "serve smoke"      env JAX_PLATFORMS=cpu python tools/serve_smoke.py
-    stage "bench smoke (autotuned lenet + input + serve)" bench_smoke
+    stage "bench smoke (autotuned lenet + input + serve + lm)" bench_smoke
     stage "zero1 smoke"      env JAX_PLATFORMS=cpu python tools/zero1_smoke.py
     stage "zero2 smoke"      env JAX_PLATFORMS=cpu python tools/zero2_smoke.py
+    stage "lm composition smoke" env JAX_PLATFORMS=cpu \
+        python tools/lm_smoke.py
     stage "autotune smoke"   env JAX_PLATFORMS=cpu python tools/autotune_smoke.py
     stage "input smoke (+shuffle resume)" env JAX_PLATFORMS=cpu \
         python tools/input_smoke.py
