@@ -87,6 +87,11 @@ BENCH_HISTORY = {
     # serving rung (ISSUE 6): requests/sec inside the latency SLO
     # through the continuous-batching KerasServer
     "keras_serve_requests_per_sec": None,
+    # lm_serve rung (ISSUE 15): generated tokens/sec inside the latency
+    # SLO through the TOKEN-level continuous-batching gateway (KV
+    # caches + prefill/decode AOT buckets); the record also carries the
+    # whole-predict baseline on the same workload
+    "lm_serve_tokens_per_sec_at_slo": None,
     # input rung (ISSUE 7): samples/sec through the sharded streaming
     # input pipeline ALONE (read+decode+h2d, no training step) —
     # CPU-runnable, so input-pipeline PRs are measurable off-TPU too
@@ -287,7 +292,7 @@ class _RungWatchdog:
 # ---------------------------------------------------------------------------
 
 _RUNGS = ("lenet", "small", "full", "vgg", "lstm", "lm", "xl", "input",
-          "serve")
+          "serve", "lm_serve")
 
 
 def _rung_config(rung: str, smoke: bool):
@@ -375,6 +380,25 @@ def _rung_config(rung: str, smoke: bool):
                     max_batch=8 if smoke else 16,
                     max_wait_ms=5.0, features=32, classes=8,
                     metric="keras_serve_requests_per_sec")
+    if rung == "lm_serve":
+        # ISSUE 15: token-level LM serving — C concurrent clients fire
+        # mixed-length generations at the continuous-batching decode
+        # gateway. Headline = generated tokens/sec INSIDE the SLO; the
+        # record carries TTFT p50/p99 and the PR 6 whole-predict
+        # baseline measured on the same workload (vs_whole_predict must
+        # exceed 1.0 or the KV-cache path is mis-wired).
+        return dict(model="gpt_serve",
+                    vocab=13 if smoke else 64,
+                    seq_len=16 if smoke else 128,
+                    d_model=16 if smoke else 128,
+                    n_heads=2 if smoke else 4,
+                    n_layers=2 if smoke else 4,
+                    clients=3 if smoke else 8,
+                    requests=6 if smoke else 48,
+                    max_new_tokens=6 if smoke else 32,
+                    slo_ms=30_000 if smoke else 2_000,
+                    max_rows=4 if smoke else 16,
+                    metric="lm_serve_tokens_per_sec_at_slo")
     raise ValueError(f"unknown rung {rung!r}; valid: {_RUNGS}")
 
 
@@ -1100,6 +1124,219 @@ def _run_serve_rung(jax, smoke: bool, on_accel: bool, device_kind: str,
     }
 
 
+def _run_lm_serve_rung(jax, smoke: bool, on_accel: bool,
+                       device_kind: str, platform: str) -> dict:
+    """The `lm_serve` rung (ISSUE 15): token-level continuous batching
+    through the gateway. C concurrent clients fire mixed-length
+    generations; requests join/leave the decode batch every step. The
+    headline is generated tokens/sec INSIDE the SLO; the record carries
+    TTFT p50/p99 and the PR 6 whole-predict baseline (each token
+    re-runs the full padded window as an ordinary batched predict) on
+    the same workload — the number token-level scheduling must beat."""
+    import tempfile
+    import threading as _threading
+
+    cfg = _rung_config("lm_serve", smoke)
+    _stamp(f"rung 'lm_serve': {cfg}")
+    tracer = get_tracer()
+
+    from deeplearning4j_tpu.keras.server import KerasClient, KerasServer
+    from deeplearning4j_tpu.models.gpt import gpt_decoder
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+    V, L = cfg["vocab"], cfg["seq_len"]
+    t = time.perf_counter()
+    with tracer.span("lm_serve_build_model"):
+        net = ComputationGraph(gpt_decoder(
+            V, L, d_model=cfg["d_model"], n_heads=cfg["n_heads"],
+            n_layers=cfg["n_layers"], seed=11)).init()
+    _stamp(f"lm_serve model built in {time.perf_counter() - t:.1f}s")
+
+    rng = np.random.default_rng(9)
+    clients, n_requests = cfg["clients"], cfg["requests"]
+    max_new, slo_s = cfg["max_new_tokens"], cfg["slo_ms"] / 1000.0
+    per_client = max(1, n_requests // clients)
+    # mixed prompt lengths spanning several pow2 prefill buckets
+    lengths = [max(1, L // 8), max(2, L // 4), max(3, L // 2 - 1)]
+    prompts = [rng.integers(0, V, lengths[k % len(lengths)]).tolist()
+               for k in range(per_client * clients)]
+
+    with tempfile.TemporaryDirectory() as d:
+        model = os.path.join(d, "gpt_serve.zip")
+        ModelSerializer.write_model(net, model)
+        srv = KerasServer(max_concurrency=clients,
+                          queue_depth=2 * clients,
+                          max_batch=cfg["max_rows"])
+        try:
+            def storm(timed: bool):
+                done, lock = [], _threading.Lock()
+                start = _threading.Barrier(clients + 1)
+
+                def client(idx: int) -> None:
+                    cli = KerasClient(srv.host, srv.port)
+                    start.wait(60.0)
+                    for k in range(per_client):
+                        p = prompts[idx * per_client + k]
+                        t0 = time.perf_counter()
+                        try:
+                            r = cli.generate(p, max_new, model=model)
+                            with lock:
+                                done.append((
+                                    time.perf_counter() - t0,
+                                    len(r["tokens"]), r["ttft_ms"]))
+                        except Exception as e:  # noqa: BLE001 — recorded
+                            with lock:
+                                done.append((None, 0,
+                                             f"{type(e).__name__}: {e}"))
+                    cli.close()
+
+                threads = [_threading.Thread(target=client, args=(i,),
+                                             daemon=True)
+                           for i in range(clients)]
+                for th in threads:
+                    th.start()
+                with tracer.span("lm_serve_storm", timed=timed):
+                    start.wait(60.0)
+                    t0 = time.perf_counter()
+                    for th in threads:
+                        th.join(600.0)
+                    return done, time.perf_counter() - t0
+
+            # warmup wave: compiles every prefill/decode bucket the
+            # timed wave will hit — the timed storm runs zero-recompile
+            t = time.perf_counter()
+            storm(timed=False)
+            compile_s = srv._gen.stats()["compile_s"]
+            compiles_after_warm = srv._gen.stats()["compiles"]
+            _stamp(f"lm_serve warmup wave in {time.perf_counter() - t:.1f}s "
+                   f"({compiles_after_warm} bucket compiles, "
+                   f"{compile_s:.1f}s compiling)")
+            done, wall = storm(timed=True)
+            recompiles = srv._gen.stats()["compiles"] - compiles_after_warm
+
+            # whole-predict baseline: each token re-runs the FULL padded
+            # window through the PR 6 predict scheduler (fixed [1, L, V]
+            # shape — the sane way to serve an LM without a KV cache)
+            base_per_client = max(1, per_client // 2) if not smoke \
+                else per_client
+            eye = np.eye(V, dtype=np.float32)
+
+            def baseline_client(idx: int, files_dir: str, out: list,
+                                lock) -> None:
+                cli = KerasClient(srv.host, srv.port)
+                for k in range(base_per_client):
+                    p = list(prompts[idx * per_client + k])
+                    n_gen = 0
+                    for step in range(max_new):
+                        x = np.zeros((1, L, V), np.float32)
+                        x[0, :len(p)] = eye[np.asarray(p)]
+                        fp = os.path.join(files_dir,
+                                          f"b{idx}_{k}_{step}.npy")
+                        np.save(fp, x)
+                        try:
+                            y = cli.predict(fp, model=model)
+                        except Exception:  # noqa: BLE001
+                            break
+                        p.append(int(np.asarray(y)[0, len(p) - 1]
+                                     .argmax()))
+                        n_gen += 1
+                        if len(p) >= L:
+                            break
+                    with lock:
+                        out.append(n_gen)
+                cli.close()
+
+            base_out, base_lock = [], _threading.Lock()
+            # warm EVERY predict bucket the baseline storm can
+            # coalesce into ([r, L, V] for pow2 r up to the client
+            # count) — the token-level side got an untimed warmup
+            # wave, so the baseline must not pay compiles in its
+            # timed window either
+            warm = KerasClient(srv.host, srv.port)
+            from deeplearning4j_tpu.util.math_utils import next_pow_of_2
+            top_bucket = min(next_pow_of_2(clients), cfg["max_rows"])
+            r = 1
+            while r <= top_bucket:   # incl. the padded non-pow2 case
+                xw = np.zeros((r, L, V), np.float32)
+                xw[:, 0, 0] = 1.0
+                fp = os.path.join(d, f"warm{r}.npy")
+                np.save(fp, xw)
+                warm.predict(fp, model=model)
+                r <<= 1
+            warm.close()
+            threads = [_threading.Thread(
+                target=baseline_client, args=(i, d, base_out, base_lock),
+                daemon=True) for i in range(clients)]
+            with tracer.span("lm_serve_whole_predict_baseline"):
+                t0 = time.perf_counter()
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join(600.0)
+                base_wall = time.perf_counter() - t0
+            base_tokens = sum(base_out)
+            stats = srv._gen.stats()
+        finally:
+            srv.drain(grace_s=5.0)
+
+    from deeplearning4j_tpu.keras.batching import quantile
+    ok = [(lat, n, ttft) for lat, n, ttft in done if lat is not None]
+    errors = [ttft for lat, _, ttft in done if lat is None]
+    tokens_total = sum(n for _, n, _ in ok)
+    tokens_slo = sum(n for lat, n, _ in ok if lat <= slo_s)
+    tps = tokens_total / wall if wall > 0 else 0.0
+    tps_slo = tokens_slo / wall if wall > 0 else 0.0
+    base_tps = base_tokens / base_wall if base_wall > 0 else 0.0
+    ttfts = sorted(t for _, _, t in ok if isinstance(t, (int, float)))
+    ttft_p50 = quantile(ttfts, 0.5) if ttfts else None
+    ttft_p99 = quantile(ttfts, 0.99) if ttfts else None
+    _stamp(f"lm_serve storm: {tokens_total} tokens in {wall:.2f}s -> "
+           f"{tps:.1f} tok/s ({tps_slo:.1f} inside {cfg['slo_ms']}ms "
+           f"SLO), ttft p50={ttft_p50}ms p99={ttft_p99}ms, "
+           f"whole-predict baseline {base_tps:.1f} tok/s "
+           f"(x{tps / base_tps if base_tps else float('inf'):.1f}), "
+           f"{recompiles} recompiles in timed wave, "
+           f"{len(errors)} errors")
+    base = (_banked_baseline(cfg["metric"])
+            if on_accel and not smoke else None)
+    return {
+        "metric": cfg["metric"] + ("" if on_accel and not smoke
+                                   else "_SMOKE"),
+        "value": round(tps_slo, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tps_slo / base, 3) if base else 1.0,
+        "device_kind": device_kind,
+        "platform": platform,
+        "rung": "lm_serve",
+        "comm_bytes_hlo": None,   # inference: no gradient collectives
+        "clients": clients,
+        "requests": len(ok),
+        "request_errors": errors[:5],
+        "slo_ms": cfg["slo_ms"],
+        "input_stall_s": 0.0,     # schema uniformity (ISSUE 7)
+        "seq_len": L,
+        "max_new_tokens": max_new,
+        "tokens_per_sec": round(tps, 2),
+        "tokens_per_sec_at_slo": round(tps_slo, 2),
+        "ttft_p50_ms": ttft_p50,
+        "ttft_p99_ms": ttft_p99,
+        "whole_predict_tokens_per_sec": round(base_tps, 2),
+        "vs_whole_predict": (round(tps / base_tps, 3) if base_tps
+                             else None),
+        "decode_recompiles_timed_wave": recompiles,
+        "max_rows": cfg["max_rows"],
+        "bucket_mix": stats["bucket_mix"],
+        "compile_s": stats["compile_s"],
+        # schema uniformity (ISSUE 13): the decode bucket ladder is
+        # fixed by the rung config, not chosen by the autotuner
+        "autotuned": False,
+        "predicted_step_s": None,
+        "measured_vs_predicted_gap": None,
+        **_precision_fields(),
+    }
+
+
 def _run_child() -> int:
     smoke = os.environ.get("BENCH_SMOKE", os.environ.get("BENCH_SMALL",
                                                          "0")) == "1"
@@ -1151,6 +1388,9 @@ def _run_child() -> int:
                 if rung == "serve":
                     rec = _run_serve_rung(jax, smoke, on_accel,
                                           device_kind, platform)
+                elif rung == "lm_serve":
+                    rec = _run_lm_serve_rung(jax, smoke, on_accel,
+                                             device_kind, platform)
                 elif rung == "input":
                     rec = _run_input_rung(jax, smoke, on_accel,
                                           device_kind, platform)

@@ -876,6 +876,39 @@ def sc_bad_comm_model_mismatch():
     return program, ctx
 
 
+@lru_cache(maxsize=None)
+def _sc_gpt_decode_program(donate: bool = True):
+    """The REAL token-level decode step (ISSUE 15): the GPT tiny
+    model's [rows, 1, V] decode program with its KV caches threaded as
+    carry state — donated (the serving engine's contract, SC009's
+    KNOWN_GOOD) or not (the defect)."""
+    import jax
+    import numpy as np
+    from deeplearning4j_tpu.analysis.shardcheck import lower_step_program
+    from deeplearning4j_tpu.models.gpt import gpt_tiny
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    net = ComputationGraph(gpt_tiny(vocab_size=8, seq_len=8)).init()
+    _, decode = net.decode_fns()
+    rows = 2
+    caches = net.init_decode_cache(rows)
+    n_cache_leaves = 2 * len(net.kv_cache_nodes())
+    x = jax.ShapeDtypeStruct((rows, 1, 8), np.float32)
+    pos = jax.ShapeDtypeStruct((rows,), np.int32)
+    jitted = (jax.jit(decode, donate_argnums=(2,)) if donate
+              else jax.jit(decode))
+    program = lower_step_program(jitted, net.params, net.states, caches,
+                                 x, pos)
+    return program, dict(expect_cache_alias=n_cache_leaves)
+
+
+def sc_bad_decode_cache_not_donated():
+    """A decode step claiming donated KV caches, jitted WITHOUT
+    donate_argnums: no input_output_alias lands, every token pays a
+    full-cache copy (SC009's defect)."""
+    program, ctx = _sc_gpt_decode_program(False)
+    return program, dict(ctx)
+
+
 def sc_bad_sp_ring_absent():
     """Claims sp=2 sequence parallelism over a program compiled WITHOUT
     an sp axis — no collective-permute exists, so the ring the claim
@@ -896,6 +929,7 @@ SC_KNOWN_BAD: List[Tuple[str, str, Callable]] = [
     ("host-callback-in-step", "SC006", sc_bad_host_callback),
     ("comm-model-mismatch", "SC007", sc_bad_comm_model_mismatch),
     ("sp-ring-absent", "SC008", sc_bad_sp_ring_absent),
+    ("decode-cache-not-donated", "SC009", sc_bad_decode_cache_not_donated),
 ]
 
 
@@ -959,6 +993,13 @@ def sc_good_sp_ring():
     return _sc_attn_trainer_program()
 
 
+def sc_good_gpt_decode():
+    """The serving engine's ACTUAL decode program (donate_argnums on
+    the caches): SC009 must find every cache buffer aliased."""
+    program, ctx = _sc_gpt_decode_program(True)
+    return program, dict(ctx)
+
+
 
 
 def sc_good_fp32_preset_identity():
@@ -980,6 +1021,7 @@ SC_KNOWN_GOOD: List[Tuple[str, Callable]] = [
     ("fp32-preset-identity", sc_good_fp32_preset_identity),
     ("replicated-step", sc_good_replicated),
     ("sp-ring-step", sc_good_sp_ring),
+    ("gpt-decode-step", sc_good_gpt_decode),
 ]
 
 #: rule id -> the SC_KNOWN_GOOD fixture exercising that rule's trigger
@@ -993,4 +1035,5 @@ SC_GOOD_FOR: Dict[str, str] = {
     "SC006": "replicated-step",       # no host transfer in the step
     "SC007": "zero1-step",            # HLO == model within tolerance
     "SC008": "sp-ring-step",          # sp claim with the ring present
+    "SC009": "gpt-decode-step",       # cache donation landed as aliases
 }

@@ -104,6 +104,11 @@ class MemoryReport:
     remat: bool = False
     weight_update_sharding: str = "off"
     dp: int = 1
+    # token-level serving (ISSUE 15): resident KV-cache bytes for a
+    # ``decode_rows``-row decode bucket — the number the generation
+    # engine's ring-buffer eviction budget is set against
+    decode_rows: int = 0
+    kv_cache_total_bytes: int = 0
 
     # ------------------------------------------------------------ aggregates
     @property
@@ -202,26 +207,73 @@ class MemoryReport:
             f"  peak layer wset:     {mb(self.peak_layer_working_set_bytes)}"
             f"  ({self.vmem_pressure():.1f}x VMEM)",
         ]
+        if self.decode_rows:
+            lines.append(
+                f"  KV cache (serve):    {mb(self.kv_cache_total_bytes)}"
+                f"  ({self.decode_rows} decode rows — the ring-buffer "
+                "eviction budget surface)")
         return "\n".join(lines)
+
+
+def kv_cache_bytes(conf, rows: int, max_len: Optional[int] = None
+                   ) -> int:
+    """Config-only estimate of a ``rows``-row decode bucket's resident
+    KV caches (2 x [rows, H, max_len, D] per CAUSAL attention layer in
+    the config's dtype) — the serving twin of the training HBM terms,
+    and what ``memory_report(..., decode_rows=N)`` folds in. Returns 0
+    for configs with no causal attention (nothing decodes
+    incrementally)."""
+    from deeplearning4j_tpu.analysis.graphcheck import iter_config_layers
+    db = _dtype_bytes(conf.training.dtype)
+    layers = list(iter_config_layers(conf))
+    ml = max_len
+    if ml is None:
+        # the GRAPH-WIDE static cache length, exactly as the container's
+        # decode_max_len resolves it: any layer's position-table
+        # capacity (PositionalEmbeddingLayer.max_timesteps may exceed
+        # the input window) wins over the input-type timesteps
+        for _name, layer, _out in layers:
+            if getattr(layer, "max_timesteps", 0):
+                ml = int(layer.max_timesteps)
+                break
+        if not ml:
+            for t in getattr(conf, "input_types", {}).values():
+                if t is not None and t.kind == "rnn" and t.timesteps:
+                    ml = int(t.timesteps)
+                    break
+    if not ml:
+        return 0
+    total = 0
+    for _name, layer, _out in layers:
+        if not getattr(layer, "causal", False) \
+                or not hasattr(layer, "cache_shape"):
+            continue
+        total += 2 * int(np.prod(layer.cache_shape(rows, ml))) * db
+    return total
 
 
 def memory_report(conf, batch_size: int = 32, layers=None,
                   weight_update_sharding: str = "off",
-                  dp: int = 1) -> MemoryReport:
+                  dp: int = 1, decode_rows: int = 0) -> MemoryReport:
     """Build a MemoryReport for either configuration type. Requires a
     shape-resolved config (input types set); layers whose params cannot be
     abstract-evaluated contribute zero (graphcheck flags those
     separately). ``layers``: optional pre-inferred (name, layer_conf,
     out_type) triples from a validation pass already in flight — avoids
     re-walking shapes. ``weight_update_sharding``/``dp``: model the
-    ZeRO-1 updater-state layout (see :class:`MemoryReport`)."""
+    ZeRO-1 updater-state layout (see :class:`MemoryReport`).
+    ``decode_rows``: additionally estimate the token-level serving
+    engine's resident KV caches at that decode-bucket width."""
     from deeplearning4j_tpu.analysis.graphcheck import iter_config_layers
     training = conf.training
     rep = MemoryReport(batch_size=batch_size, dtype=training.dtype,
                        updater=training.updater.name,
                        remat=getattr(training, "remat", False),
                        weight_update_sharding=weight_update_sharding,
-                       dp=max(1, int(dp)))
+                       dp=max(1, int(dp)),
+                       decode_rows=max(0, int(decode_rows)))
+    if rep.decode_rows:
+        rep.kv_cache_total_bytes = kv_cache_bytes(conf, rep.decode_rows)
     for name, layer, out_type in (layers if layers is not None
                                   else iter_config_layers(conf)):
         try:

@@ -114,6 +114,11 @@ RULES: Dict[str, Tuple[str, str]] = {
               "compiled step contains no collective-permute — the "
               "ring attention never formed (every chip attends over "
               "the full sequence, or the layer declined the ring)"),
+    "SC009": ("kv-cache-not-donated",
+              "decode-step program claiming KV-cache donation does "
+              "not show the cache buffers in input_output_alias — "
+              "every decode step copies the whole cache instead of "
+              "updating it in place"),
 }
 
 #: severity when the rule FIRES as a defect (SC002/SC007 also emit
@@ -127,6 +132,7 @@ RULE_SEVERITY = {
     "SC006": Severity.ERROR,
     "SC007": Severity.WARNING,
     "SC008": Severity.ERROR,
+    "SC009": Severity.ERROR,
 }
 
 #: default SC007 gate: |HLO - predicted| / predicted above this warns
@@ -777,6 +783,42 @@ def _check_sc008(findings, mod: HloModule, sp: int) -> None:
         "declines the ring otherwise); or drop the sp axis"))
 
 
+def _check_sc009(findings, program: StepProgram,
+                 expect_cache_alias: Optional[int]) -> None:
+    """SC009 (ISSUE 15): a token-level decode step threads its KV
+    caches as carry state and must DONATE them — the claim is the
+    number of cache leaf buffers (2 per attention layer); the compiled
+    module must carry at least that many ``input_output_alias`` pairs.
+    Without the aliasing every decode step materializes a second full
+    [rows, H, max_len, D] cache per attention layer: 2x resident cache
+    HBM plus a full-cache memcpy PER GENERATED TOKEN — the exact
+    throughput cliff iteration-level scheduling exists to avoid."""
+    if not expect_cache_alias or expect_cache_alias < 1:
+        return
+    landed = program.module.alias_pairs
+    if landed >= expect_cache_alias:
+        return
+    if program.stablehlo and not program.donation_requested:
+        findings.append(Finding(
+            "SC009", Severity.ERROR, "<entry>",
+            f"decode step claims {expect_cache_alias} donated KV-cache "
+            "buffers but the lowered program requests no donation (no "
+            "donate_argnums reached jit) — every decode step copies "
+            "the full cache instead of updating it in place",
+            "jit the decode step with donate_argnums on the cache "
+            "argument (keras/generation.py donates argnum 2)"))
+    else:
+        findings.append(Finding(
+            "SC009", Severity.ERROR, "<entry>",
+            f"decode step claims {expect_cache_alias} donated KV-cache "
+            f"buffers but only {landed} input_output_alias pair(s) "
+            "survived compilation — un-aliased cache buffers double "
+            "the resident KV HBM and pay a full-cache copy per token",
+            "check the cache dtypes/shapes match between the donated "
+            "input and its output (aliasing needs identical shapes), "
+            "or a backend that cannot alias"))
+
+
 def _check_sc007(findings, program: StepProgram, wus: str, dp: int,
                  gradient_accumulation: int,
                  param_count: Optional[int],
@@ -826,6 +868,7 @@ def check_step_program(program: StepProgram, *,
                        cost_tolerance: float = COMM_BYTES_TOLERANCE,
                        check_scan: Optional[bool] = None,
                        check_cost: bool = True,
+                       expect_cache_alias: Optional[int] = None,
                        ) -> List[Finding]:
     """Run every SC rule over one captured step program.
 
@@ -863,6 +906,7 @@ def check_step_program(program: StepProgram, *,
     _check_sc005(findings, program, expect_donation)
     _check_sc006(findings, mod)
     _check_sc008(findings, mod, sp)
+    _check_sc009(findings, program, expect_cache_alias)
     # gate the calibration only where the ring model applies: the
     # ga-scan path hides per-microbatch traffic in loop bodies whose
     # trip counts the text dump does not carry, and callers whose comm

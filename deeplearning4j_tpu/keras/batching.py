@@ -70,6 +70,187 @@ PREDICT_LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
 
 FLUSH_REASONS = ("full", "deadline", "idle")
 
+#: priority classes for the batch queue (ISSUE 15): an INTERACTIVE
+#: request is inserted ahead of every queued BULK request, so a latency-
+#: sensitive predict/generate never waits behind a bulk scorer's
+#: backlog. Ordering is stable within a class (FIFO).
+PRIORITIES = {"interactive": 0, "bulk": 1}
+
+
+def priority_rank(priority: str) -> int:
+    try:
+        return PRIORITIES[priority]
+    except KeyError:
+        raise ValueError(f"unknown priority {priority!r}; "
+                         f"one of {tuple(PRIORITIES)}") from None
+
+
+def priority_insert(queue, item, *, front_of_class: bool = False) -> None:
+    """Insert ``item`` (anything with a ``priority`` rank) into a
+    priority-ordered deque: ahead of every lower-priority entry, FIFO
+    within its class — the ONE insert discipline both batch queues
+    (predict and generate) share. ``front_of_class`` puts the item
+    ahead of its own class too (an evicted victim that already waited
+    its turn)."""
+    if front_of_class:
+        idx = next((i for i, q in enumerate(queue)
+                    if q.priority >= item.priority), len(queue))
+        queue.insert(idx, item)
+        return
+    if queue and queue[-1].priority > item.priority:
+        idx = next(i for i, q in enumerate(queue)
+                   if q.priority > item.priority)
+        queue.insert(idx, item)
+    else:
+        queue.append(item)
+
+
+class CompileCache:
+    """Cross-model AOT compile cache with a GLOBAL entry/bytes budget
+    (ISSUE 15 satellite). PR 6 cached one compiled executable per
+    (model, bucket, shape) with no bound except the model LRU — a
+    gateway serving many models with ragged traffic could accumulate
+    executables without limit. This cache is shared by every scheduler
+    in the process (predict buckets AND generation prefill/decode
+    buckets): entries are LRU-ordered across models, the budget counts
+    entries and compiled bytes (XLA's own memory analysis where the
+    backend reports it), and evictions land in
+    ``serving_compile_cache_evictions_total``. A model evicted from the
+    server LRU still drops all of its entries at once
+    (``evict_model`` — the cache is evicted WITH the model cache)."""
+
+    def __init__(self, max_entries: int = 128,
+                 max_bytes: Optional[int] = 512 * 1024 * 1024):
+        if max_entries < 1:
+            raise ValueError("max_entries must be >= 1")
+        self.max_entries = int(max_entries)
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._entries = collections.OrderedDict()  # key -> (value, nbytes)
+        self._bytes = 0
+
+    @staticmethod
+    def compiled_nbytes(compiled) -> int:
+        """Budget-relevant footprint of one XLA executable: generated
+        code + scratch. Backends without memory analysis cost 0 bytes
+        (the entry budget still bounds them)."""
+        try:
+            ma = compiled.memory_analysis()
+            return int(getattr(ma, "generated_code_size_in_bytes", 0)
+                       + getattr(ma, "temp_size_in_bytes", 0))
+        except Exception:  # noqa: BLE001 — sizing is best-effort
+            return 0
+
+    def get(self, key):
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            self._entries.move_to_end(key)
+            return entry[0]
+
+    def put(self, key, value, nbytes: int = 0) -> None:
+        evicted = 0
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (value, int(nbytes))
+            self._bytes += int(nbytes)
+            while len(self._entries) > 1 and (
+                    len(self._entries) > self.max_entries
+                    or (self.max_bytes is not None
+                        and self._bytes > self.max_bytes)):
+                _, (_, nb) = self._entries.popitem(last=False)
+                self._bytes -= nb
+                evicted += 1
+            self._publish_locked()
+        if evicted:
+            get_registry().counter(
+                "serving_compile_cache_evictions_total",
+                help="AOT-compiled steps evicted by the cross-model "
+                     "compile-cache budget").inc(evicted)
+
+    def _publish_locked(self) -> None:
+        reg = get_registry()
+        reg.gauge("serving_compile_cache_entries",
+                  help="AOT-compiled steps resident in the cross-model "
+                       "compile cache").set(len(self._entries))
+        reg.gauge("serving_compile_cache_bytes",
+                  help="estimated compiled bytes resident in the "
+                       "cross-model compile cache").set(self._bytes)
+
+    def remove(self, key) -> None:
+        """Drop one entry (a put that lost a race with eviction)."""
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+                self._publish_locked()
+
+    def evict_model(self, owner: int, model_key: str) -> None:
+        """Drop every entry one scheduler cached for one model key —
+        called when the server LRU evicts the model."""
+        with self._lock:
+            for k in [k for k in self._entries
+                      if k[0] == owner and k[1] == model_key]:
+                self._bytes -= self._entries.pop(k)[1]
+            self._publish_locked()
+
+    def evict_owner(self, owner: int) -> None:
+        """Drop every entry a (stopped) scheduler owns — owner serials
+        are never reused, so a dead scheduler's executables would
+        otherwise sit in the GLOBAL cache until the budget pushes them
+        out."""
+        with self._lock:
+            for k in [k for k in self._entries if k[0] == owner]:
+                self._bytes -= self._entries.pop(k)[1]
+            self._publish_locked()
+
+    def keys(self) -> List[tuple]:
+        with self._lock:
+            return list(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes}
+
+
+_compile_cache_lock = threading.Lock()
+_compile_cache: Optional[CompileCache] = None
+_owner_serial = 0
+
+
+def next_cache_owner() -> int:
+    """Monotonic owner id for compile-cache keys. ``id(scheduler)``
+    would be reused after garbage collection, letting a new scheduler
+    hit a dead scheduler's stale executables (compiled against another
+    model's shapes)."""
+    global _owner_serial
+    with _compile_cache_lock:
+        _owner_serial += 1
+        return _owner_serial
+
+
+def get_compile_cache() -> CompileCache:
+    """The process-global compile cache every scheduler shares — ONE
+    budget across models, buckets, and predict/generate kinds."""
+    global _compile_cache
+    with _compile_cache_lock:
+        if _compile_cache is None:
+            _compile_cache = CompileCache()
+        return _compile_cache
+
+
+def set_compile_cache(cache: Optional[CompileCache]
+                      ) -> Optional[CompileCache]:
+    """Swap the global cache (tests / budget reconfiguration); returns
+    the previous one."""
+    global _compile_cache
+    with _compile_cache_lock:
+        prev, _compile_cache = _compile_cache, cache
+        return prev
+
 
 def bucket_rows(rows: int) -> int:
     """The padded row count for a ``rows``-row batch: the next power of
@@ -100,9 +281,10 @@ class _Pending:
     future (event + result/error) its handler thread waits on."""
 
     __slots__ = ("features", "deadline", "event", "result", "error",
-                 "rows", "shape_key", "t0")
+                 "rows", "shape_key", "t0", "priority")
 
-    def __init__(self, features: np.ndarray, deadline: Deadline):
+    def __init__(self, features: np.ndarray, deadline: Deadline,
+                 priority: int = 0):
         self.features = features
         self.deadline = deadline
         self.event = threading.Event()
@@ -112,28 +294,39 @@ class _Pending:
         # only exact non-batch shape + dtype matches may share a batch
         self.shape_key = (tuple(features.shape[1:]), str(features.dtype))
         self.t0 = time.monotonic()
+        self.priority = priority
 
 
 class _LatencyWindow:
-    """Bounded reservoir of recent predict latencies; publishes p50/p99
-    gauges on every observation (a scrape of ``/api/metrics`` sees the
-    current quantiles without histogram interpolation)."""
+    """Bounded reservoir of recent latencies; publishes p50/p99 gauges
+    on every observation (a scrape of ``/api/metrics`` sees the current
+    quantiles without histogram interpolation). The metric family is
+    parameterized so the generation scheduler's TTFT window shares the
+    machinery (``serving_ttft_*``) with the predict window."""
 
     # republish the gauges every Nth observation: a per-request sort of
     # the whole reservoir would serialize the serving hot path for
     # quantiles that only matter at scrape cadence
     REFRESH_EVERY = 16
 
-    def __init__(self, maxlen: int = 1024):
+    def __init__(self, maxlen: int = 1024,
+                 hist_name: str = "serving_predict_seconds",
+                 hist_help: str = "end-to-end predict latency "
+                                  "(admission to response), successful "
+                                  "requests",
+                 gauge_prefix: str = "serving_predict",
+                 gauge_what: str = "predict latency"):
         self._lock = threading.Lock()
         self._window = collections.deque(maxlen=maxlen)
         self._since_refresh = 0
+        self._hist_name = hist_name
+        self._hist_help = hist_help
+        self._gauge_prefix = gauge_prefix
+        self._gauge_what = gauge_what
 
     def observe(self, seconds: float) -> None:
         get_registry().histogram(
-            "serving_predict_seconds",
-            help="end-to-end predict latency (admission to "
-                 "response), successful requests",
+            self._hist_name, help=self._hist_help,
             buckets=PREDICT_LATENCY_BUCKETS).observe(seconds)
         with self._lock:
             self._window.append(seconds)
@@ -145,14 +338,13 @@ class _LatencyWindow:
         if refresh:
             self._publish(*self.quantiles())
 
-    @staticmethod
-    def _publish(p50: float, p99: float) -> None:
+    def _publish(self, p50: float, p99: float) -> None:
         reg = get_registry()
-        reg.gauge("serving_predict_p50_ms",
-                  help="median predict latency over the recent "
+        reg.gauge(f"{self._gauge_prefix}_p50_ms",
+                  help=f"median {self._gauge_what} over the recent "
                        "window (ms)").set(p50 * 1000.0)
-        reg.gauge("serving_predict_p99_ms",
-                  help="p99 predict latency over the recent window "
+        reg.gauge(f"{self._gauge_prefix}_p99_ms",
+                  help=f"p99 {self._gauge_what} over the recent window "
                        "(ms)").set(p99 * 1000.0)
 
     def quantiles(self) -> Tuple[Optional[float], Optional[float]]:
@@ -173,7 +365,8 @@ class BatchScheduler:
 
     def __init__(self, max_batch: int = 32, max_wait_ms: float = 5.0,
                  deadline_margin_ms: float = 50.0,
-                 idle_thread_s: float = 30.0):
+                 idle_thread_s: float = 30.0,
+                 compile_cache: Optional[CompileCache] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         # buckets are powers of two "up to max_batch": normalize down so
@@ -186,7 +379,14 @@ class BatchScheduler:
         self._queues: Dict[str, collections.deque] = {}
         self._backends: Dict[str, tuple] = {}  # key -> (model, lock)
         self._dispatchers: Dict[str, threading.Thread] = {}
-        self._compiled: Dict[tuple, object] = {}
+        # compiled steps live in the budgeted CROSS-MODEL cache (global
+        # by default): per-scheduler keys, one process-wide budget
+        self._compiled = (compile_cache if compile_cache is not None
+                          else get_compile_cache())
+        self._cache_owner = next_cache_owner()
+        # observed request-size mix: (shape_key, bucket) -> batches
+        # executed — the speculative-prewarm signal
+        self._bucket_mix: collections.Counter = collections.Counter()
         self._stopping = False
         # serve-rung stats (also on /api/metrics, but the bench child
         # wants per-scheduler numbers, not process-global ones)
@@ -205,17 +405,20 @@ class BatchScheduler:
 
     # -------------------------------------------------------------- submit
     def submit(self, key: str, model, lock: threading.Lock,
-               features: np.ndarray, deadline: Deadline) -> np.ndarray:
+               features: np.ndarray, deadline: Deadline,
+               priority: str = "interactive") -> np.ndarray:
         """Queue one predict for ``key`` and block until its rows are
         back. Raises the request's own structured error (DEADLINE /
-        NONFINITE / the singleton re-execution's failure)."""
+        NONFINITE / the singleton re-execution's failure).
+        ``priority``: queue class — an ``interactive`` request is
+        inserted ahead of every queued ``bulk`` request."""
         features = np.asarray(features)
         if features.ndim < 1 or features.shape[0] < 1:
             raise ValueError(
                 f"predict features must have a leading batch axis with "
                 f">= 1 rows, got shape {features.shape}")
         deadline.check("predict enqueue")
-        pending = _Pending(features, deadline)
+        pending = _Pending(features, deadline, priority_rank(priority))
         with self._cond:
             if self._stopping:
                 raise DrainingError("batch scheduler stopped")
@@ -223,8 +426,8 @@ class BatchScheduler:
             # caller for the life of this op: a cache swap mid-queue
             # cannot retarget the request
             self._backends[key] = (model, lock)
-            self._queues.setdefault(key, collections.deque()).append(
-                pending)
+            queue = self._queues.setdefault(key, collections.deque())
+            priority_insert(queue, pending)
             worker = self._dispatchers.get(key)
             if worker is None or not worker.is_alive():
                 worker = threading.Thread(
@@ -431,6 +634,9 @@ class BatchScheduler:
         self._flush_counter(reason).inc()
         with self._stats_lock:
             self._batch_sizes[len(batch)] += 1
+            rows = sum(p.rows for p in batch)
+            self._bucket_mix[(batch[0].shape_key,
+                              bucket_rows(rows))] += 1
 
     # ------------------------------------------------------- compile cache
     def _runner(self, key: str, model, bucket: int, shape_key):
@@ -441,15 +647,14 @@ class BatchScheduler:
         can never serve stale weights from a cache hit. Falls back to
         the model's own jitted ``output`` when the container exposes no
         AOT seam (jit still caches per shape: one trace per bucket)."""
-        cache_key = (key, bucket, shape_key)
-        with self._cond:
-            runner = self._compiled.get(cache_key)
+        cache_key = (self._cache_owner, key, bucket, shape_key)
+        runner = self._compiled.get(cache_key)
         if runner is not None:
             return runner
         t0 = time.perf_counter()
-        runner = self._aot_compile(model, bucket, shape_key)
+        runner, nbytes = self._aot_compile(model, bucket, shape_key)
         if runner is None:
-            runner = lambda m, x: m.output(x)  # noqa: E731
+            runner, nbytes = (lambda m, x: m.output(x)), 0  # noqa: E731
         elapsed = time.perf_counter() - t0
         get_registry().counter(
             "serving_compile_seconds_total",
@@ -460,7 +665,11 @@ class BatchScheduler:
         with self._cond:
             current = self._backends.get(key)
             if current is not None and current[0] is model:
-                self._compiled[cache_key] = runner
+                # put UNDER the cond: an evict_model racing between the
+                # check and the put could otherwise land a stale
+                # executable for a gone model (the cache's own lock is
+                # a leaf — no path nests it around the cond)
+                self._compiled.put(cache_key, runner, nbytes)
             # else: the key was evicted (or swapped to a fresh load)
             # while we compiled — serve this batch with the uncached
             # runner and let the next batch compile against the
@@ -471,7 +680,9 @@ class BatchScheduler:
     def _aot_compile(model, bucket: int, shape_key):
         """``jit(infer).lower(spec).compile()`` against the container's
         cached jitted inference forward; params/states remain call
-        arguments so fit updates keep the executable valid."""
+        arguments so fit updates keep the executable valid. Returns
+        ``(runner, compiled_bytes)`` — the bytes charge the cross-model
+        compile-cache budget."""
         import jax
 
         shape, dtype = shape_key
@@ -481,36 +692,94 @@ class BatchScheduler:
             if hasattr(model, "layers"):  # MultiLayerNetwork
                 compiled = jitted.lower(model.params, model.states,
                                         spec, None).compile()
-                return lambda m, x: compiled(m.params, m.states,
-                                             x, None)
+                return (lambda m, x: compiled(m.params, m.states,
+                                              x, None),
+                        CompileCache.compiled_nbytes(compiled))
             # ComputationGraph: dict input map, list of outputs
             name = model.conf.network_inputs[0]
             compiled = jitted.lower(model.params, model.states,
                                     {name: spec}, None).compile()
-            return lambda m, x: compiled(m.params, m.states,
-                                         {name: x}, None)[0]
+            return (lambda m, x: compiled(m.params, m.states,
+                                          {name: x}, None)[0],
+                    CompileCache.compiled_nbytes(compiled))
         except Exception:  # noqa: BLE001 — AOT is an optimization
-            return None
+            return None, 0
+
+    # ----------------------------------------------------------- prewarming
+    def prewarm(self, key: str, model, top: int = 4) -> int:
+        """Speculatively AOT-compile the ``top`` most-observed
+        (feature shape, bucket) combinations for a freshly loaded
+        model, so the first real wave against it pays zero compiles.
+        The signal is the scheduler's OBSERVED request-size mix across
+        every model it has served (traffic shape is a gateway property,
+        not a model property). Returns the number of buckets compiled;
+        call from a background thread — compiles are slow."""
+        with self._stats_lock:
+            mix = self._bucket_mix.most_common()
+        done = 0
+        # pin the backend so _runner may cache against it — but
+        # remember OUR insertion: if the server LRU evicts this model
+        # while we compile and no request re-registers it, the pin
+        # must come back out or the dead model object leaks in
+        # _backends forever
+        pin = (model, threading.Lock())
+        with self._cond:
+            if self._stopping:
+                return 0
+            pinned = key not in self._backends
+            if pinned:
+                self._backends[key] = pin
+        try:
+            for (shape_key, bucket), _ in mix:
+                if done >= top:
+                    break
+                cache_key = (self._cache_owner, key, bucket, shape_key)
+                if self._compiled.get(cache_key) is not None:
+                    continue
+                with self._cond:
+                    if self._stopping:
+                        break
+                try:
+                    self._runner(key, model, bucket, shape_key)
+                except Exception:  # noqa: BLE001 — speculative
+                    continue
+                done += 1
+        finally:
+            if pinned:
+                with self._cond:
+                    if (self._backends.get(key) is pin
+                            and not self._queues.get(key)):
+                        self._backends.pop(key)
+        if done:
+            get_registry().counter(
+                "serving_prewarmed_buckets_total",
+                help="AOT buckets compiled speculatively from the "
+                     "observed request-size mix").inc(done)
+        return done
 
     # ------------------------------------------------------------ lifecycle
     def evict_model(self, key: str) -> None:
         """Drop the compiled-step cache for an evicted model — the AOT
-        cache is keyed like the server's LRU and dies with it."""
+        cache is keyed like the server's LRU and dies with it. Purge
+        and backend-pop happen under ONE cond hold so they serialize
+        against _runner's check-and-put (purging first would let a
+        concurrent put re-land a stale executable)."""
         with self._cond:
-            for cache_key in [k for k in self._compiled if k[0] == key]:
-                del self._compiled[cache_key]
+            self._compiled.evict_model(self._cache_owner, key)
             self._backends.pop(key, None)
             if not self._queues.get(key):  # drop the empty deque too
                 self._queues.pop(key, None)
 
     def stop(self, grace_s: float = 5.0) -> None:
-        """Fail queued work with DRAINING, wake and join dispatchers."""
+        """Fail queued work with DRAINING, wake and join dispatchers;
+        release this scheduler's slice of the global compile cache."""
         with self._cond:
             self._stopping = True
             self._cond.notify_all()
             workers = list(self._dispatchers.values())
         for w in workers:
             w.join(grace_s)
+        self._compiled.evict_owner(self._cache_owner)
 
     def stats(self) -> dict:
         """Per-scheduler serve stats (the bench serve rung's record)."""

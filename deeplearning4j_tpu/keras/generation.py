@@ -1,0 +1,846 @@
+"""Token-level continuous batching for autoregressive decoders
+(ISSUE 15 — the iteration-level LM serving engine).
+
+PR 6's scheduler batches *whole predicts*: every request occupies its
+batch for the full dispatch. For an autoregressive decoder that wastes
+the accelerator on every step a short request pads out a long one — the
+right scheduling unit is the DECODE STEP. This module serves the GPT
+decoder (``models/gpt.py``) iteration-level:
+
+- **Requests join and leave the running batch every decode step.** A
+  per-model decode loop owns a pow2-row bucket; an admitted request is
+  prefilled (its own pow2 prompt-length bucket), its KV cache row is
+  inserted into the bucket, and from then on each loop iteration
+  decodes ONE token for every live row. A finished (or failed) row
+  leaves immediately; the bucket compacts to the next power of two.
+- **Prefill and decode are separate AOT buckets.** Prefill compiles per
+  pow2 prompt length (``jit(prefill).lower(...).compile()`` — params
+  and states stay arguments, so ``fit`` never invalidates a bucket);
+  decode compiles per pow2 row count. Steady state runs with ZERO
+  recompiles: a second wave of identical bucket shapes adds no traces.
+- **KV caches are carry-threaded state** (the serving analog of the
+  tBPTT scan carries in ``nn/graph.py``): static ``[rows, H, max_len,
+  D]`` shapes per attention node, donated to the decode step every
+  iteration (shardcheck SC009 statically verifies the donation landed
+  as ``input_output_alias``), each row masking its own prefix — which
+  is what makes batched greedy decode BITWISE equal to singleton
+  decode on CPU, join/leave churn included.
+- **Ring-buffer cache eviction under HBM pressure.** The bucket grows
+  on demand until ``cache_budget_bytes`` (or ``max_rows``) stops it;
+  past that, an INTERACTIVE arrival evicts the oldest-admitted BULK
+  row (ring order) instead of waiting behind it — the victim's prompt
+  + generated-so-far tokens re-queue and RE-PREFILL when capacity
+  returns (never garbage: the re-prefilled cache is rebuilt from the
+  tokens, not salvaged). ``evict_cache`` chaos forces the same path.
+- **Priority classes**: the admission queue orders ``interactive``
+  ahead of ``bulk`` (stable FIFO within a class) — same discipline as
+  the predict scheduler's queue.
+
+Every PR 6 invariant carries over: admission only through the server's
+ServiceGuard, the nonfinite sentinel runs PER ROW per step (a poisoned
+request fails alone MID-STREAM — ``poison_decode`` chaos proves it; its
+batchmates keep decoding), a batch-level decode failure re-runs each
+row as a singleton before anything surfaces, and compiled steps live in
+the budgeted cross-model :class:`~.batching.CompileCache`.
+
+Observability: ``serving_generated_tokens_total``,
+``serving_decode_steps_total``, ``serving_decode_batch_rows``
+histogram, ``serving_ttft_seconds`` + ``serving_ttft_p50/p99_ms``
+(time-to-first-token = admission to the prefill's first token),
+``serving_kv_cache_bytes`` gauge, ``serving_kv_evictions_total`` /
+``serving_reprefills_total``, and ``serve:prefill`` / ``serve:decode``
+tracer spans.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.keras.batching import (CompileCache, _LatencyWindow,
+                                               get_compile_cache,
+                                               next_cache_owner,
+                                               priority_insert,
+                                               priority_rank)
+from deeplearning4j_tpu.profiling.metrics import get_registry
+from deeplearning4j_tpu.profiling.tracer import get_tracer
+from deeplearning4j_tpu.resilience import faultinject
+from deeplearning4j_tpu.resilience.sentinel import host_nonfinite
+from deeplearning4j_tpu.resilience.service import (Deadline,
+                                                   DeadlineExceeded,
+                                                   DrainingError,
+                                                   NonFiniteOutput)
+from deeplearning4j_tpu.util.math_utils import next_pow_of_2
+
+#: row-count edges for the serving_decode_batch_rows histogram
+DECODE_ROWS_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+
+class _GenRequest:
+    """One generation in flight: the prompt (plus any tokens already
+    generated before a cache eviction), its budget, and the future the
+    submitting handler thread blocks on."""
+
+    __slots__ = ("prompt", "max_new", "priority", "deadline", "event",
+                 "tokens", "error", "t0", "ttft_s", "index", "steps",
+                 "reprefills", "admit_seq", "model_obj")
+
+    def __init__(self, prompt: np.ndarray, max_new: int, priority: int,
+                 deadline: Deadline, index: int):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.priority = priority
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.tokens: List[int] = []      # generated so far
+        self.error: Optional[BaseException] = None
+        self.t0 = time.monotonic()
+        self.ttft_s: Optional[float] = None
+        self.index = index               # admission order (chaos seam)
+        self.steps = 0                   # decode steps taken
+        self.reprefills = 0
+        self.admit_seq = -1              # ring position (eviction order)
+        self.model_obj = None            # the weights my tokens came from
+
+    def history(self) -> np.ndarray:
+        """prompt + generated tokens — what a re-prefill rebuilds from."""
+        if not self.tokens:
+            return self.prompt
+        return np.concatenate([self.prompt,
+                               np.asarray(self.tokens, np.int32)])
+
+    def fail(self, error: BaseException) -> None:
+        self.error = error
+        self.event.set()
+
+    def finish(self) -> None:
+        self.event.set()
+
+
+class _Engine:
+    """Per-model decode state: the pow2 row bucket, its KV caches, and
+    the AOT-compiled prefill/decode executables. All mutation happens on
+    the owning scheduler's decode-loop thread; the scheduler lock only
+    guards the queue handoff."""
+
+    def __init__(self, scheduler: "GenerationScheduler", key: str,
+                 model, lock: threading.Lock):
+        prefill, decode = model.decode_fns()   # validates decodability
+        self.scheduler = scheduler
+        self.key = key
+        self.model = model
+        self.lock = lock
+        self._prefill_fn = prefill
+        self._decode_fn = decode
+        self.vocab = model.decode_vocab()
+        self.max_len = model.decode_max_len()
+        self.row_bytes = model.decode_cache_bytes(1)
+        budget = scheduler.cache_budget_bytes
+        if budget is not None and budget < self.row_bytes:
+            raise ValueError(
+                f"cache_budget_bytes={budget} cannot hold even one "
+                f"decode row ({self.row_bytes} bytes/row)")
+        self.rows = 0
+        self.caches = None
+        self.slots: List[Optional[_GenRequest]] = []
+        self.tokens: List[int] = []      # next token to feed, per slot
+        self.positions: List[int] = []   # next decode position, per slot
+        self.iteration = 0
+        self._admit_seq = 0
+        self._eye = np.eye(self.vocab, dtype=np.float32)
+
+    # ---------------------------------------------------------- compiled
+    def _compiled(self, kind: str, bucket: int):
+        """The AOT executable for one (kind, bucket): ``("prefill",
+        pow2 prompt len)`` or ``("decode", pow2 rows)`` — cached in the
+        budgeted cross-model cache, compiled once. Caches are DONATED
+        (argnums 2): each call consumes the previous iteration's cache
+        buffers in place of allocating a second copy."""
+        sched = self.scheduler
+        cache_key = (sched._cache_owner, self.key, kind, bucket)
+        runner = sched._compiled.get(cache_key)
+        if runner is not None:
+            return runner
+        import jax
+        t0 = time.perf_counter()
+        fn = self._prefill_fn if kind == "prefill" else self._decode_fn
+        caches = self.model.init_decode_cache(
+            bucket if kind == "decode" else 1)
+        if kind == "prefill":
+            x = jax.ShapeDtypeStruct((1, bucket, self.vocab), np.float32)
+            aux = jax.ShapeDtypeStruct((1,), np.int32)
+        else:
+            x = jax.ShapeDtypeStruct((bucket, 1, self.vocab), np.float32)
+            aux = jax.ShapeDtypeStruct((bucket,), np.int32)
+        compiled = jax.jit(fn, donate_argnums=(2,)).lower(
+            self.model.params, self.model.states, caches, x, aux
+        ).compile()
+        elapsed = time.perf_counter() - t0
+        get_registry().counter(
+            "serving_compile_seconds_total",
+            help="seconds spent AOT-compiling per-bucket predict "
+                 "steps").inc(elapsed)
+        with sched._stats_lock:
+            sched.compile_s += elapsed
+            sched.compiles += 1
+            sched._compiles_per_bucket[(self.key, kind, bucket)] += 1
+
+        def runner(params, states, c, xv, av, _c=compiled):
+            return _c(params, states, c, xv, av)
+
+        with sched._cond:
+            cur = sched._backends.get(self.key)
+            if cur is not None and cur[0] is self.model:
+                # cache only while the key still maps to THIS model
+                # object — an evict (purge serializes on this cond) or
+                # a swap-to-fresh-load while we compiled must not get
+                # a stale executable re-landed behind it
+                sched._compiled.put(
+                    cache_key, runner,
+                    CompileCache.compiled_nbytes(compiled))
+        return runner
+
+    def prewarm(self, mix, top: int) -> int:
+        """Speculatively compile the most-observed prefill/decode
+        buckets for this (fresh) engine before traffic needs them."""
+        done = 0
+        for (kind, bucket), _ in mix:
+            if done >= top:
+                break
+            if self.scheduler._compiled.get(
+                    (self.scheduler._cache_owner, self.key, kind,
+                     bucket)) is None:
+                try:
+                    self._compiled(kind, bucket)
+                    done += 1
+                except Exception:  # noqa: BLE001 — prewarm is speculative
+                    continue
+        if done:
+            get_registry().counter(
+                "serving_prewarmed_buckets_total",
+                help="AOT buckets compiled speculatively from the "
+                     "observed request-size mix").inc(done)
+        return done
+
+    # ------------------------------------------------------------ prefill
+    def prefill_bucket(self, n_tokens: int) -> int:
+        return min(next_pow_of_2(n_tokens), self.max_len)
+
+    def _prefill(self, req: _GenRequest):
+        """Run the request's prompt (or re-prefill history) through its
+        pow2 length bucket; returns (first token, 1-row caches)."""
+        history = req.history()
+        L = len(history)
+        bucket = self.prefill_bucket(L)
+        x = np.zeros((1, bucket, self.vocab), np.float32)
+        x[0, :L] = self._eye[history]
+        runner = self._compiled("prefill", bucket)
+        with self.scheduler._stats_lock:   # traffic mix (prewarm signal)
+            self.scheduler._mix[("prefill", bucket)] += 1
+        with get_tracer().span("serve:prefill", model=self.key,
+                               bucket=bucket, tokens=L):
+            with self.lock:
+                probs, caches = runner(
+                    self.model.params, self.model.states,
+                    self.model.init_decode_cache(1), x,
+                    np.asarray([L], np.int32))
+        return int(np.asarray(probs)[0].argmax()), caches
+
+    # ----------------------------------------------------- slot lifecycle
+    def active(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def _publish_cache_gauge(self) -> None:
+        with self.scheduler._cond:   # _engines mutates under the cond
+            self.scheduler._publish_kv_gauge_locked()
+
+    def _grow_allowed(self, new_rows: int) -> bool:
+        if new_rows > self.scheduler.max_rows:
+            return False
+        budget = self.scheduler.cache_budget_bytes
+        return budget is None or new_rows * self.row_bytes <= budget
+
+    def _resize(self, new_rows: int) -> None:
+        """Re-bucket the decode batch: live rows keep their cache
+        contents (row gather — values untouched, so parity is
+        unaffected); free rows' contents are irrelevant because a JOIN
+        always overwrites its whole cache row."""
+        import jax.numpy as jnp
+        live = [i for i, s in enumerate(self.slots) if s is not None]
+        assert len(live) <= new_rows
+        if self.caches is None:
+            self.caches = self.model.init_decode_cache(new_rows)
+        elif new_rows != self.rows:
+            idx = np.asarray(live + [0] * (new_rows - len(live)),
+                             np.int32)
+            self.caches = {n: {k: jnp.take(v, idx, axis=0)
+                               for k, v in kv.items()}
+                           for n, kv in self.caches.items()}
+        new_slots: List[Optional[_GenRequest]] = [None] * new_rows
+        new_tokens, new_positions = [0] * new_rows, [0] * new_rows
+        for j, i in enumerate(live):
+            new_slots[j] = self.slots[i]
+            new_tokens[j] = self.tokens[i]
+            new_positions[j] = self.positions[i]
+        self.slots, self.tokens, self.positions = (new_slots, new_tokens,
+                                                   new_positions)
+        self.rows = new_rows
+        self._publish_cache_gauge()
+
+    def try_admit(self, req: _GenRequest) -> bool:
+        """JOIN: prefill the request and insert its cache row. Returns
+        False when no capacity exists (caller re-queues)."""
+        row = next((i for i, s in enumerate(self.slots) if s is None),
+                   None)
+        if row is None:
+            new_rows = next_pow_of_2(self.active() + 1)
+            if not self._grow_allowed(new_rows):
+                if not self._preempt_for(req):
+                    return False
+                row = next(i for i, s in enumerate(self.slots)
+                           if s is None)
+            else:
+                self._resize(new_rows)
+                row = next(i for i, s in enumerate(self.slots)
+                           if s is None)
+        if req.tokens and req.model_obj is not self.model:
+            # an evicted victim re-admitted after the model was
+            # reloaded as a NEW object: re-prefilling its old-model
+            # tokens under the new weights would blend two models in
+            # one response — fail it honestly instead
+            req.fail(RuntimeError(
+                "model reloaded while this generation awaited "
+                "re-prefill after a cache eviction; retry"))
+            return True
+        req.model_obj = self.model
+        history_len = len(req.history())
+        try:
+            first, cache1 = self._prefill(req)
+        except Exception as e:  # noqa: BLE001 — fail THIS request alone
+            req.fail(e)
+            return True
+        if req.ttft_s is None:  # a re-prefilled victim keeps its first
+            req.ttft_s = time.monotonic() - req.t0
+            self.scheduler.ttft.observe(req.ttft_s)
+        req.tokens.append(first)
+        req.admit_seq = self._admit_seq
+        self._admit_seq += 1
+        self.slots[row] = req
+        self.tokens[row] = first
+        # next decode writes `first`'s K/V at position = history length
+        self.positions[row] = history_len
+        for name, kv in cache1.items():
+            for k, v in kv.items():
+                self.caches[name][k] = self.caches[name][k].at[row].set(
+                    v[0])
+        if len(req.tokens) >= req.max_new \
+                or self.positions[row] >= self.max_len:
+            self._complete(row)      # prompt-only TTFT request
+        return True
+
+    def _preempt_for(self, req: _GenRequest) -> bool:
+        """Ring-buffer eviction under pressure: an INTERACTIVE arrival
+        evicts the oldest-admitted BULK row rather than waiting behind
+        it. Bulk arrivals never preempt."""
+        if req.priority != 0:
+            return False
+        victims = [(s.admit_seq, i) for i, s in enumerate(self.slots)
+                   if s is not None and s.priority > 0]
+        if not victims:
+            return False
+        self.evict_row(min(victims)[1], reason="preempt")
+        return True
+
+    def evict_row(self, row: int, reason: str = "pressure") -> None:
+        """LEAVE (involuntary): push the victim back onto the queue;
+        its history re-prefills when capacity returns — the cache row
+        is abandoned, never reused."""
+        victim = self.slots[row]
+        if victim is None:
+            return
+        victim.reprefills += 1
+        self.slots[row] = None
+        reg = get_registry()
+        reg.counter("serving_kv_evictions_total",
+                    help="KV-cache rows evicted (ring-buffer pressure "
+                         "or chaos)").inc()
+        reg.counter("serving_reprefills_total",
+                    help="evicted generations re-queued for "
+                         "re-prefill").inc()
+        get_tracer().instant("kv_evicted", model=self.key, row=row,
+                             reason=reason)
+        self.scheduler._requeue(self.key, victim)
+
+    def ring_victim(self) -> Optional[int]:
+        """Oldest-admitted live row — the ring-buffer eviction order."""
+        live = [(s.admit_seq, i) for i, s in enumerate(self.slots)
+                if s is not None]
+        return min(live)[1] if live else None
+
+    def _complete(self, row: int) -> None:
+        req = self.slots[row]
+        self.slots[row] = None
+        get_registry().counter(
+            "serving_generated_tokens_total",
+            help="tokens generated by the decode engine").inc(
+                len(req.tokens))
+        with self.scheduler._stats_lock:
+            self.scheduler.tokens_out += len(req.tokens)
+        req.finish()
+
+    # ------------------------------------------------------------- decode
+    def decode_iteration(self) -> None:
+        """One engine step: decode ONE token for every live row."""
+        self.iteration += 1
+        live = [i for i, s in enumerate(self.slots) if s is not None]
+        if not live:
+            return
+        # deadline-blown rows leave before paying for the step
+        for i in list(live):
+            req = self.slots[i]
+            if req.deadline.expired():
+                req.fail(DeadlineExceeded(
+                    "generate: budget exhausted mid-stream at "
+                    f"token {len(req.tokens)}"))
+                self.slots[i] = None
+                live.remove(i)
+        if not live:
+            return
+        x = np.zeros((self.rows, 1, self.vocab), np.float32)
+        for i in live:
+            x[i, 0] = self._eye[self.tokens[i]]
+        positions = np.asarray(self.positions, np.int32)
+        runner = self._compiled("decode", self.rows)
+        tracer = get_tracer()
+        with tracer.span("serve:decode", model=self.key, rows=self.rows,
+                         live=len(live), iteration=self.iteration):
+            try:
+                with self.lock:
+                    probs, self.caches = runner(
+                        self.model.params, self.model.states,
+                        self.caches, x, positions)
+                probs = np.asarray(probs)
+            except Exception:  # noqa: BLE001 — isolate batchmates
+                # batch-level decode failure: re-run each live row ALONE
+                # before surfacing anything (PR 6 singleton-fallback
+                # discipline, per decode step)
+                get_registry().counter(
+                    "serving_decode_fallbacks_total",
+                    help="decode steps re-run as singletons after a "
+                         "batch-level failure").inc()
+                if self._caches_deleted():
+                    # the failed call had already CONSUMED the donated
+                    # cache buffers (a runtime fault after dispatch):
+                    # the singleton fallback has nothing to slice.
+                    # Rebuild instead of failing everyone — every live
+                    # row re-queues for RE-PREFILL from its tokens,
+                    # the same never-garbage path eviction uses.
+                    for i in list(live):
+                        self.evict_row(i, reason="donated-cache-lost")
+                    self.caches = self.model.init_decode_cache(self.rows)
+                    return
+                probs = self._singleton_fallback(live, x, positions)
+        reg = get_registry()
+        reg.counter("serving_decode_steps_total",
+                    help="batched decode steps executed").inc()
+        reg.histogram("serving_decode_batch_rows",
+                      help="live generations per decode step",
+                      buckets=DECODE_ROWS_BUCKETS).observe(len(live))
+        with self.scheduler._stats_lock:   # traffic mix (prewarm signal)
+            self.scheduler._mix[("decode", self.rows)] += 1
+        for i in live:
+            req = self.slots[i]
+            if req is None:
+                continue
+            row_probs = probs[i] if probs is not None else None
+            if row_probs is None:
+                continue  # fallback already failed this row
+            if faultinject.poison_decode_row(req.index, req.steps + 1):
+                row_probs = np.full_like(row_probs, np.nan)
+            if host_nonfinite(row_probs):
+                reg.counter(
+                    "serving_nonfinite_outputs_total",
+                    help="predictions refused because the model output "
+                         "carried NaN/Inf").inc()
+                req.fail(NonFiniteOutput(
+                    f"generation row turned NaN/Inf at token "
+                    f"{len(req.tokens) + 1}"))
+                self.slots[i] = None     # fails ALONE, mid-stream
+                continue
+            tok = int(row_probs.argmax())
+            req.tokens.append(tok)
+            req.steps += 1
+            self.tokens[i] = tok
+            self.positions[i] += 1
+            if len(req.tokens) >= req.max_new \
+                    or self.positions[i] >= self.max_len:
+                self._complete(i)
+        # evict_cache chaos: force one ring eviction, exactly what HBM
+        # pressure would do — the victim must re-prefill, never garbage
+        if faultinject.check_evict_cache():
+            victim = self.ring_victim()
+            if victim is not None:
+                self.evict_row(victim, reason="chaos")
+        # compact: a half-empty bucket shrinks to its pow2
+        target = max(1, next_pow_of_2(max(1, self.active())))
+        if target < self.rows:
+            self._resize(target)
+
+    def _caches_deleted(self) -> bool:
+        """True when the bucket's cache buffers were invalidated by a
+        donation that dispatched before the step failed."""
+        for kv in self.caches.values():
+            for v in kv.values():
+                deleted = getattr(v, "is_deleted", None)
+                if deleted is not None and deleted():
+                    return True
+        return False
+
+    def _singleton_fallback(self, live, x, positions):
+        """Re-run each live row in the 1-row decode bucket; rows that
+        fail alone surface their own error (and only those may charge
+        the caller's breaker). Successful rows' cache updates write
+        back into the bucket."""
+        probs = np.zeros((self.rows, self.vocab), np.float32)
+        import jax.numpy as jnp
+        for i in live:
+            req = self.slots[i]
+            try:
+                c1 = {n: {k: v[i:i + 1] for k, v in kv.items()}
+                      for n, kv in self.caches.items()}
+                runner = self._compiled("decode", 1)
+                with self.lock:
+                    p1, c1 = runner(self.model.params, self.model.states,
+                                    c1, x[i:i + 1], positions[i:i + 1])
+                probs[i] = np.asarray(p1)[0]
+                for n, kv in c1.items():
+                    for k, v in kv.items():
+                        self.caches[n][k] = \
+                            self.caches[n][k].at[i].set(jnp.asarray(v)[0])
+            except Exception as e:  # noqa: BLE001 — per-row verdict
+                req.fail(e)
+                self.slots[i] = None
+        return probs
+
+    def fail_all(self, error: BaseException) -> None:
+        for i, req in enumerate(self.slots):
+            if req is not None:
+                req.fail(error)
+                self.slots[i] = None
+
+
+class GenerationScheduler:
+    """Per-server token-level scheduler. ``submit()`` is called by an
+    admitted handler thread (holding its ServiceGuard slot) and blocks
+    until the generation completes; a per-model decode-loop thread owns
+    the engine. The caller resolves the model key ONCE at admission —
+    eviction or an LRU swap can never retarget a queued request."""
+
+    def __init__(self, max_rows: int = 8, max_wait_ms: float = 0.0,
+                 cache_budget_bytes: Optional[int] = None,
+                 idle_thread_s: float = 30.0,
+                 compile_cache: Optional[CompileCache] = None,
+                 prewarm_top: int = 3,
+                 prewarm_decode_ladder: bool = False):
+        if max_rows < 1:
+            raise ValueError("max_rows must be >= 1")
+        self.max_rows = next_pow_of_2(int(max_rows))
+        if self.max_rows > max_rows:
+            self.max_rows >>= 1
+        self.max_wait_s = max(0.0, float(max_wait_ms)) / 1000.0
+        self.cache_budget_bytes = cache_budget_bytes
+        self.idle_thread_s = idle_thread_s
+        self.prewarm_top = prewarm_top
+        # compile the whole pow2 decode-rows ladder at engine build:
+        # log2(max_rows)+1 small programs buy DETERMINISTIC zero-
+        # recompile steady state whatever row counts churn produces
+        self.prewarm_decode_ladder = prewarm_decode_ladder
+        self._cond = threading.Condition()
+        self._queues: Dict[str, collections.deque] = {}
+        self._backends: Dict[str, tuple] = {}
+        self._engines: Dict[str, _Engine] = {}
+        self._loops: Dict[str, threading.Thread] = {}
+        self._compiled = (compile_cache if compile_cache is not None
+                          else get_compile_cache())
+        self._cache_owner = next_cache_owner()
+        self._stopping = False
+        self._stats_lock = threading.Lock()
+        self.compile_s = 0.0
+        self.compiles = 0
+        self.tokens_out = 0
+        # _mix = OBSERVED traffic per (kind, bucket) — the speculative-
+        # prewarm ranking signal; _compiles_per_bucket = compiles per
+        # bucket — the zero-recompile gate surface (a value > 1 means a
+        # shape was re-traced, whatever the traffic was)
+        self._mix: collections.Counter = collections.Counter()
+        self._compiles_per_bucket: collections.Counter = \
+            collections.Counter()
+        self._submits = 0
+        self.ttft = _LatencyWindow(
+            hist_name="serving_ttft_seconds",
+            hist_help="time to first token (admission to the "
+                      "prefill's first greedy token)",
+            gauge_prefix="serving_ttft", gauge_what="time to first "
+                                                    "token")
+
+    # -------------------------------------------------------------- submit
+    def submit(self, key: str, model, lock: threading.Lock,
+               prompt, max_new_tokens: int, deadline: Deadline,
+               priority: str = "interactive") -> dict:
+        """Queue one generation and block until it completes. Returns
+        ``{"tokens": [...], "ttft_ms": ..., "reprefills": n}``; raises
+        the request's own structured error."""
+        prompt = np.asarray(prompt, np.int32).ravel()
+        vocab = model.decode_vocab()
+        max_len = model.decode_max_len()
+        if prompt.size < 1:
+            raise ValueError("generate needs a non-empty prompt")
+        if prompt.min() < 0 or prompt.max() >= vocab:
+            raise ValueError(f"prompt token out of range [0, {vocab})")
+        if prompt.size >= max_len:
+            raise ValueError(
+                f"prompt length {prompt.size} leaves no room to "
+                f"generate (max sequence length {max_len})")
+        max_new = min(int(max_new_tokens), max_len - prompt.size)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        deadline.check("generate enqueue")
+        with self._cond:
+            if self._stopping:
+                raise DrainingError("generation scheduler stopped")
+            self._submits += 1
+            req = _GenRequest(prompt, max_new, priority_rank(priority),
+                              deadline, faultinject.on_generate_submit())
+            self._backends[key] = (model, lock)
+            self._enqueue_locked(key, req)
+            loop = self._loops.get(key)
+            if loop is None or not loop.is_alive():
+                loop = threading.Thread(
+                    target=self._decode_loop, args=(key,), daemon=True,
+                    name=f"gen-decode-{len(self._loops)}")
+                self._loops[key] = loop
+                loop.start()
+            self._cond.notify_all()
+        while not req.event.is_set():
+            remaining = deadline.remaining()
+            timeout = 5.0 if remaining is None else max(0.0,
+                                                        remaining) + 0.05
+            if req.event.wait(timeout):
+                break
+            deadline.check("generate in flight")
+        if req.error is not None:
+            raise req.error
+        if not req.event.is_set() or (req.error is None
+                                      and not req.tokens):
+            raise DrainingError("generation scheduler stopped")
+        return {"tokens": list(req.tokens),
+                "ttft_ms": (None if req.ttft_s is None
+                            else round(req.ttft_s * 1000.0, 3)),
+                "reprefills": req.reprefills}
+
+    def _enqueue_locked(self, key: str, req: _GenRequest) -> None:
+        priority_insert(
+            self._queues.setdefault(key, collections.deque()), req)
+
+    def _requeue(self, key: str, req: _GenRequest) -> None:
+        """An evicted victim goes back FIRST within its priority class:
+        it already waited its turn once."""
+        with self._cond:
+            priority_insert(
+                self._queues.setdefault(key, collections.deque()), req,
+                front_of_class=True)
+            self._cond.notify_all()
+
+    def _abandon_loop(self, key: str, error: BaseException) -> None:
+        """Abnormal decode-loop exit: fail the queue AND deregister the
+        loop in ONE cond hold — a submit that lands after this hold
+        sees no (still-alive) loop entry and spawns a fresh one, so a
+        request can never be stranded behind a thread that is merely
+        unwinding."""
+        with self._cond:
+            for r in (self._queues.get(key) or ()):
+                r.fail(error)
+            self._queues.pop(key, None)
+            if self._loops.get(key) is threading.current_thread():
+                del self._loops[key]
+            if self._engines.pop(key, None) is not None:
+                self._publish_kv_gauge_locked()
+
+    def _publish_kv_gauge_locked(self) -> None:
+        """Publish resident KV bytes across live engines — callers hold
+        ``self._cond`` (every resize, retire, and swap republishes, so
+        freed caches never linger on the gauge)."""
+        get_registry().gauge(
+            "serving_kv_cache_bytes",
+            help="resident KV-cache bytes across decode buckets"
+        ).set(sum(e.rows * e.row_bytes for e in self._engines.values()))
+
+    # --------------------------------------------------------- decode loop
+    def _decode_loop(self, key: str) -> None:
+        engine: Optional[_Engine] = None
+        idle_until = time.monotonic() + self.idle_thread_s
+        while True:
+            admitted: List[_GenRequest] = []
+            with self._cond:
+                queue = self._queues.get(key)
+                active = engine.active() if engine is not None else 0
+                while not self._stopping and not queue and active == 0:
+                    left = idle_until - time.monotonic()
+                    if left <= 0:
+                        # retire the idle loop AND its engine: the
+                        # bucket's KV caches free with it (a later
+                        # submit rebuilds both)
+                        if self._loops.get(key) \
+                                is threading.current_thread():
+                            del self._loops[key]
+                            if self._engines.pop(key, None) is not None:
+                                self._publish_kv_gauge_locked()
+                            if not self._queues.get(key):
+                                self._queues.pop(key, None)
+                        return
+                    self._cond.wait(left)
+                    queue = self._queues.get(key)
+                if self._stopping:
+                    for r in (queue or ()):
+                        r.fail(DrainingError(
+                            "generation scheduler stopped"))
+                    if queue is not None:
+                        queue.clear()
+                    if engine is not None:
+                        engine.fail_all(DrainingError(
+                            "generation scheduler stopped"))
+                    if self._engines.pop(key, None) is not None:
+                        self._publish_kv_gauge_locked()
+                    return
+                backend = self._backends.get(key)
+            if backend is None:
+                # the LRU evicted the model with nothing pinning it:
+                # queued AND in-flight requests fail cleanly, and the
+                # engine (with its KV caches) must go with it — leaving
+                # it in _engines would leak the caches and pin the dead
+                # model object
+                if engine is not None:
+                    engine.fail_all(DrainingError(
+                        f"model {key!r} evicted mid-generation"))
+                self._abandon_loop(key, DrainingError(
+                    f"model {key!r} evicted with requests queued"))
+                return
+            admit_ok = True
+            if engine is not None and engine.model is not backend[0]:
+                # the server LRU evicted this model and a later request
+                # reloaded it as a NEW object: rows already decoding
+                # keep THEIR model (their KV caches were built from its
+                # weights — switching mid-stream would serve garbage),
+                # but nothing new may join; the engine rebuilds against
+                # the fresh object once its in-flight rows drain
+                if engine.active() == 0:
+                    with self._cond:
+                        self._engines.pop(key, None)
+                        self._publish_kv_gauge_locked()
+                    engine = None
+                else:
+                    admit_ok = False
+            if engine is None:
+                try:
+                    engine = _Engine(self, key, backend[0], backend[1])
+                except Exception as e:  # noqa: BLE001 — not a decoder
+                    self._abandon_loop(key, e)
+                    return
+                with self._stats_lock:
+                    mix = self._mix.most_common()
+                if self.prewarm_decode_ladder:
+                    rows, ladder = 1, []
+                    while rows <= self.max_rows:
+                        ladder.append((("decode", rows), 0))
+                        rows <<= 1
+                    engine.prewarm(ladder, len(ladder))
+                if mix:
+                    engine.prewarm(mix, self.prewarm_top)
+                with self._cond:
+                    self._engines[key] = engine
+            # JOIN: admit as many queued requests as capacity allows,
+            # priority first — this happens EVERY iteration, so
+            # requests join mid-flight of their batchmates
+            while admit_ok:
+                with self._cond:
+                    queue = self._queues.get(key)
+                    req = queue[0] if queue else None
+                    if req is not None:
+                        queue.popleft()
+                if req is None:
+                    break
+                if req.deadline.expired():
+                    req.fail(DeadlineExceeded(
+                        "generate: budget exhausted in queue"))
+                    continue
+                if not engine.try_admit(req):
+                    # no capacity: put it back at the FRONT OF ITS
+                    # CLASS (not the absolute front — a blocked bulk
+                    # head must not shadow an interactive arrival that
+                    # could preempt its way in)
+                    self._requeue(key, req)
+                    break
+                admitted.append(req)
+            if engine.active() == 0:
+                # nothing decodable (queue blocked on capacity is
+                # impossible with 0 active; queue empty otherwise)
+                idle_until = time.monotonic() + self.idle_thread_s
+                continue
+            # small join window at low occupancy: let concurrent
+            # arrivals coalesce into the same decode step
+            if self.max_wait_s > 0 and engine.active() < self.max_rows \
+                    and not admitted:
+                with self._cond:
+                    if not self._queues.get(key):
+                        self._cond.wait(self.max_wait_s)
+            try:
+                engine.decode_iteration()
+            except Exception as e:  # noqa: BLE001 — the loop survives
+                engine.fail_all(e)
+            idle_until = time.monotonic() + self.idle_thread_s
+
+    # ------------------------------------------------------------ lifecycle
+    def evict_model(self, key: str) -> None:
+        """Drop the compiled buckets and the backend registration for
+        an evicted model (the compile cache dies with the server LRU).
+        Any still-queued or in-flight generation for the key fails
+        cleanly with DRAINING at the next loop iteration — callers who
+        want in-flight work to finish must not evict while ops are in
+        flight (KerasServer's pinned-model LRU guarantees exactly
+        that, so over the gateway this only ever fires idle)."""
+        with self._cond:   # serialize purge+pop against compile puts
+            self._compiled.evict_model(self._cache_owner, key)
+            self._backends.pop(key, None)
+
+    def stop(self, grace_s: float = 5.0) -> None:
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+            loops = list(self._loops.values())
+        for w in loops:
+            w.join(grace_s)
+        # release this scheduler's slice of the global compile cache
+        self._compiled.evict_owner(self._cache_owner)
+
+    def stats(self) -> dict:
+        p50, p99 = self.ttft.quantiles()
+        with self._stats_lock:
+            return {
+                "compile_s": round(self.compile_s, 3),
+                "compiles": self.compiles,
+                "tokens_out": self.tokens_out,
+                "bucket_mix": {f"{k}:{b}": n for (k, b), n in
+                               sorted(self._mix.items())},
+                "bucket_compiles": {f"{m}:{k}:{b}": n
+                                    for (m, k, b), n in sorted(
+                                        self._compiles_per_bucket
+                                        .items())},
+                "ttft_p50_ms": (None if p50 is None
+                                else round(p50 * 1000, 2)),
+                "ttft_p99_ms": (None if p99 is None
+                                else round(p99 * 1000, 2)),
+            }

@@ -36,6 +36,20 @@ recompiles), and batch formation is deadline-aware. ``max_batch`` /
 ``max_wait_ms`` tune it; ``batching=False`` restores the one-request =
 one-dispatch path.
 
+Autoregressive decoders are served TOKEN-level (ISSUE 15)::
+
+    {"op": "generate", "model": <gpt .zip>, "tokens": [ids...],
+     "max_new_tokens": N, "priority": "interactive"|"bulk"}
+    -> {"ok": true, "tokens": [...], "ttft_ms": ...}
+
+``keras/generation.py`` schedules these iteration-level: requests join
+and leave the running decode batch every step, per-request KV caches
+ride the compiled step as donated carry state, prefill/decode compile
+as separate pow2 AOT buckets, and batched greedy decode is bitwise
+identical to singleton decode. Every request (predict AND generate)
+may carry ``priority`` — ``interactive`` (default) jumps every queued
+``bulk`` request in the batch queues.
+
 Batch files: ``.npy`` or ``.h5`` (one array per file, sorted order), the
 HDF5MiniBatchDataSetIterator layout.
 """
@@ -168,8 +182,12 @@ class KerasServer:
                  io_timeout: float = 60.0, batching: bool = True,
                  max_batch: int = 32, max_wait_ms: float = 5.0,
                  batch_deadline_margin_ms: float = 50.0,
+                 kv_cache_budget_bytes: Optional[int] = None,
+                 prewarm: bool = True,
                  tuned=None):
         from deeplearning4j_tpu.keras.batching import BatchScheduler
+        from deeplearning4j_tpu.keras.generation import (
+            GenerationScheduler)
         # tuned= (a TunedConfig from deeplearning4j_tpu.autotune): the
         # batching scheduler adopts the tuned serving bucket set — its
         # top bucket becomes max_batch, so the gateway's compiled-bucket
@@ -181,6 +199,14 @@ class KerasServer:
             max_batch=max_batch, max_wait_ms=max_wait_ms,
             deadline_margin_ms=batch_deadline_margin_ms)
             if batching and max_batch > 0 else None)
+        # token-level generation engine (ISSUE 15): decode row buckets
+        # cap at the same max_batch; kv_cache_budget_bytes bounds the
+        # resident KV caches (ring-buffer eviction past it)
+        self._gen = GenerationScheduler(
+            max_rows=max(1, max_batch),
+            cache_budget_bytes=kv_cache_budget_bytes,
+            prewarm_decode_ladder=prewarm)
+        self._prewarm = prewarm
         self._models = collections.OrderedDict()  # path -> model (LRU)
         self._model_locks = {}  # path -> per-model op lock
         self._model_pins = {}  # path -> in-flight ops (pinned != evictable)
@@ -277,6 +303,13 @@ class KerasServer:
                     model = (KerasModelImport
                              .import_keras_model_and_weights(key))
                 self._models[key] = model
+                if self._prewarm and self._batcher is not None:
+                    # speculative bucket prewarming: compile the
+                    # observed-mix buckets for the fresh model in the
+                    # background, so its first wave pays zero compiles
+                    threading.Thread(
+                        target=self._batcher.prewarm, args=(key, model),
+                        daemon=True, name="bucket-prewarm").start()
             self._models.move_to_end(key)
             self._model_pins[key] = self._model_pins.get(key, 0) + 1
             while len(self._models) > self._keep_models:
@@ -289,6 +322,7 @@ class KerasServer:
                 self._model_locks.pop(victim, None)
                 if self._batcher is not None:  # AOT cache dies with LRU
                     self._batcher.evict_model(victim)
+                self._gen.evict_model(victim)
                 get_registry().counter(
                     "serving_models_evicted_total",
                     help="models evicted from the KerasServer LRU "
@@ -315,7 +349,7 @@ class KerasServer:
                     "reasons": reasons, "draining": self._guard.draining}
         if op == "shutdown":
             return {"ok": True, "shutdown": True}
-        if op not in ("fit", "predict", "evaluate"):
+        if op not in ("fit", "predict", "evaluate", "generate"):
             raise ValueError(f"unknown op {op!r}")
         # resolve the model name ONCE, at admission — a predict without
         # 'model' must not re-read _last after queueing (an LRU swap or
@@ -356,14 +390,25 @@ class KerasServer:
             model, lock = self._get_model(key)
             pinned = True
             faultinject.on_backend_dispatch(op)
-            if op == "predict" and self._batcher is not None:
+            priority = str(req.get("priority", "interactive"))
+            if op == "generate":
+                # token-level continuous batching: this request joins
+                # the model's running decode batch and leaves when its
+                # generation completes; its verdict is its OWN (a
+                # poisoned row fails alone mid-stream)
+                out = self._gen.submit(
+                    key, model, lock, payload,
+                    int(req.get("max_new_tokens", 16)), deadline,
+                    priority=priority)
+                resp = {"ok": True, **out}
+            elif op == "predict" and self._batcher is not None:
                 # continuous batching: coalesce with concurrent
                 # predicts on this model; the scheduler runs one
                 # AOT-compiled step per bucket under the model lock
                 # and raises this request's OWN verdict (a batch-level
                 # failure is re-tried singleton first)
                 y = self._batcher.submit(key, model, lock, payload,
-                                         deadline)
+                                         deadline, priority=priority)
                 resp = {"ok": True, "predictions": y.tolist()}
             else:
                 with lock:
@@ -398,6 +443,13 @@ class KerasServer:
 
     def _prepare(self, op: str, req: dict, deadline: Deadline):
         """Load/validate the request's inputs (not the model)."""
+        if op == "generate":
+            # prompt token ids, inline in the request envelope (a
+            # prompt is tiny next to a feature batch)
+            tokens = req.get("tokens")
+            if not tokens or not isinstance(tokens, (list, tuple)):
+                raise ValueError("generate needs 'tokens': [ids...]")
+            return np.asarray(tokens, np.int32)
         if op == "predict":
             x = _load_array(Path(req["features"])).astype(np.float32)
             # poison_row chaos seam: NaN-poison ONE request's features
@@ -446,6 +498,7 @@ class KerasServer:
             # after wait_idle no admitted predict is waiting on a
             # future; fail any stragglers DRAINING and join dispatchers
             self._batcher.stop(grace_s)
+        self._gen.stop(grace_s)
         self._server.shutdown()
         self._server.server_close()
         unregister_guard(self._guard)
@@ -492,6 +545,16 @@ class KerasClient:
         resp = self.request(op="predict", features=features,
                             **({"model": model} if model else {}))
         return np.asarray(resp["predictions"])
+
+    def generate(self, tokens, max_new_tokens: int = 16,
+                 model: Optional[str] = None,
+                 priority: str = "interactive", **kw) -> dict:
+        """Token-level generation: returns the full response dict
+        (``tokens``, ``ttft_ms``, ``reprefills``)."""
+        return self.request(op="generate", tokens=list(tokens),
+                            max_new_tokens=max_new_tokens,
+                            priority=priority,
+                            **({"model": model} if model else {}), **kw)
 
     def close(self) -> None:
         self._sock.close()

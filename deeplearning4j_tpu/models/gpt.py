@@ -129,6 +129,56 @@ def gpt_tiny(vocab_size: int = 16, seq_len: int = 8, **kw
 
 
 # ---------------------------------------------------------------------------
+# decode entry point (ISSUE 15: the singleton reference path)
+# ---------------------------------------------------------------------------
+
+def greedy_generate(net, prompt: Sequence[int], max_new_tokens: int,
+                    ) -> List[int]:
+    """SINGLETON greedy decode through the SAME prefill/decode kernels
+    the serving engine batches (``net.decode_fns()``): prompt prefilled
+    at its pow2 length bucket, then one token per decode step in the
+    1-row bucket. This is the reference side of the batched ==
+    singleton bitwise gate — the serving engine must reproduce these
+    tokens exactly for every request, whatever its batchmates do.
+    ``net`` is an initialized ComputationGraph (e.g. ``gpt_decoder``).
+    """
+    import jax
+    from deeplearning4j_tpu.util.math_utils import next_pow_of_2
+
+    prompt = list(prompt)
+    V, max_len = net.decode_vocab(), net.decode_max_len()
+    if not 0 < len(prompt) < max_len:
+        raise ValueError(f"prompt length must be in (0, {max_len})")
+    max_new = min(int(max_new_tokens), max_len - len(prompt))
+    # cache the jitted pair on the net: jax.jit caches per WRAPPER
+    # object, so rebuilding the wrappers per call would retrace and
+    # recompile identical shapes every generation
+    jits = getattr(net, "_greedy_jits", None)
+    if jits is None:
+        prefill, decode = net.decode_fns()
+        jits = net._greedy_jits = (jax.jit(prefill),
+                                   jax.jit(decode, donate_argnums=(2,)))
+    prefill_jit, decode_jit = jits
+    eye = np.eye(V, dtype=np.float32)
+    bucket = min(next_pow_of_2(len(prompt)), max_len)
+    x = np.zeros((1, bucket, V), np.float32)
+    x[0, :len(prompt)] = eye[np.asarray(prompt)]
+    caches = net.init_decode_cache(1)
+    probs, caches = prefill_jit(
+        net.params, net.states, caches, x,
+        np.asarray([len(prompt)], np.int32))
+    out = [int(np.asarray(probs)[0].argmax())]
+    pos = len(prompt)
+    while len(out) < max_new:
+        xt = eye[np.asarray([out[-1]])][:, None, :]
+        probs, caches = decode_jit(net.params, net.states, caches, xt,
+                                   np.asarray([pos], np.int32))
+        out.append(int(np.asarray(probs)[0].argmax()))
+        pos += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
 # character data path (char_rnn's, shaped for the LM + streaming pipeline)
 # ---------------------------------------------------------------------------
 

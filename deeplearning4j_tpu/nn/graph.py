@@ -79,6 +79,7 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
         self._rng = jax.random.PRNGKey(conf.training.seed)
         self._rnn_carries: Optional[Dict[str, Any]] = None  # rnnTimeStep
         self._tbptt_step_fn = None
+        self._decode_fns = None         # (prefill, decode) pure fns
         # layer nodes in topological order (the trainable walk)
         self._layer_nodes = [n for n in conf.topological_order
                              if conf.nodes[n].kind == "layer"]
@@ -693,6 +694,185 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
         if squeeze:
             outs = [o[:, 0] if o.ndim == 3 else o for o in outs]
         return outs[0] if len(outs) == 1 else outs
+
+    # ----------------------------------------------------- incremental decode
+    # Token-level serving (ISSUE 15): an autoregressive decoder served
+    # token-at-a-time needs a STEP program whose shapes never depend on
+    # how far each request has generated — per-request KV caches of
+    # static [rows, H, max_len, D] shape are threaded through the step
+    # as carry state (the serving analog of the tBPTT scan carries),
+    # every row masks its own prefix, and the serving engine AOT-
+    # compiles one prefill program per pow2 prompt-length bucket and
+    # one decode program per pow2 row bucket (keras/generation.py).
+
+    def kv_cache_nodes(self) -> List[str]:
+        """Layer nodes that thread a KV cache (causal attention)."""
+        return [n for n in self._layer_nodes
+                if getattr(self.conf.nodes[n].layer,
+                           "supports_kv_cache", False)]
+
+    def decode_max_len(self) -> int:
+        """Static cache length: the learned position table's capacity
+        (every decode position must index it)."""
+        for n in self._layer_nodes:
+            ml = getattr(self.conf.nodes[n].layer, "max_timesteps", 0)
+            if ml:
+                return int(ml)
+        for t in self.conf.input_types.values():
+            if t is not None and t.kind == "rnn" and t.timesteps:
+                return int(t.timesteps)
+        raise ValueError(
+            "decode needs a static max sequence length (a "
+            "PositionalEmbeddingLayer max_timesteps or a recurrent "
+            "InputType with fixed timesteps)")
+
+    def decode_vocab(self) -> int:
+        t = self.conf.input_types.get(self.conf.network_inputs[0])
+        if t is None or t.kind != "rnn":
+            raise ValueError("decode needs a recurrent input type")
+        return int(t.size)
+
+    def _check_decodable(self) -> None:
+        """Fail loudly at engine-build time — not as a shape error deep
+        inside a traced step — when the graph is not an incremental
+        decoder: single input/output, every time-mixing layer either a
+        CAUSAL attention (KV cache) or the positional embedding, and
+        everything else per-timestep-local."""
+        from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
+        from deeplearning4j_tpu.nn.layers.attention import (
+            SelfAttentionLayer)
+        from deeplearning4j_tpu.nn.layers.normalization import (
+            LayerNormalization)
+        from deeplearning4j_tpu.nn.layers.shape import TimeDistributedLayer
+        if len(self.conf.network_inputs) != 1 \
+                or len(self.conf.network_outputs) != 1:
+            raise ValueError("incremental decode supports single-input/"
+                             "single-output graphs")
+        for name in self.conf.topological_order:
+            node = self.conf.nodes[name]
+            if node.kind == "vertex":
+                if not isinstance(node.vertex, ElementWiseVertex):
+                    raise ValueError(
+                        f"vertex {name!r} ({type(node.vertex).__name__}) "
+                        "is not per-timestep-local; cannot decode "
+                        "incrementally")
+                continue
+            if node.kind != "layer":
+                continue
+            layer = node.layer
+            if isinstance(layer, SelfAttentionLayer):
+                if not layer.supports_kv_cache:
+                    raise ValueError(
+                        f"attention node {name!r} is not causal — "
+                        "incremental decode would change its output")
+                continue
+            if getattr(layer, "supports_carry", False):
+                raise ValueError(
+                    f"recurrent node {name!r} "
+                    f"({type(layer).__name__}) has no decode path")
+            ok = (hasattr(layer, "decode_step")
+                  or isinstance(layer, (LayerNormalization,
+                                        TimeDistributedLayer))
+                  or hasattr(layer, "compute_loss"))
+            if not ok:
+                raise ValueError(
+                    f"node {name!r} ({type(layer).__name__}) is not "
+                    "known to be per-timestep-local; cannot decode "
+                    "incrementally")
+
+    def init_decode_cache(self, rows: int, max_len: Optional[int] = None
+                          ) -> Dict[str, Dict[str, Array]]:
+        """Fresh zeroed KV caches for a ``rows``-row decode bucket —
+        one {k, v} pair per causal-attention node, static shapes."""
+        if max_len is None:
+            max_len = self.decode_max_len()
+        dt = _dtype_of(self.conf.training.dtype)
+        return {n: {"k": jnp.zeros(self.conf.nodes[n].layer.cache_shape(
+                        rows, max_len), dt),
+                    "v": jnp.zeros(self.conf.nodes[n].layer.cache_shape(
+                        rows, max_len), dt)}
+                for n in self.kv_cache_nodes()}
+
+    def decode_cache_bytes(self, rows: int,
+                           max_len: Optional[int] = None) -> int:
+        """HBM footprint of a ``rows``-row bucket's KV caches — what the
+        serving engine budgets ring-buffer eviction against."""
+        if max_len is None:
+            max_len = self.decode_max_len()
+        dt = np.dtype(self.conf.training.dtype)
+        total = 0
+        for n in self.kv_cache_nodes():
+            shape = self.conf.nodes[n].layer.cache_shape(rows, max_len)
+            total += 2 * int(np.prod(shape)) * dt.itemsize
+        return total
+
+    def _incremental_forward(self, params, states, x, caches, positions,
+                             lengths=None):
+        """One DAG walk shared by prefill (``lengths`` given, x is the
+        padded [B, T, V] prompt block) and decode (x is the [B, 1, V]
+        current token, ``positions`` the per-row sequence position).
+        Returns (output activation, new caches)."""
+        acts: Dict[str, Array] = {self.conf.network_inputs[0]: x}
+        new_caches: Dict[str, Dict[str, Array]] = {}
+        for name in self.conf.topological_order:
+            node = self.conf.nodes[name]
+            if node.kind == "input":
+                continue
+            in_acts = [acts[i] for i in node.inputs]
+            if node.kind == "vertex":
+                acts[name] = node.vertex.apply(in_acts)
+                continue
+            layer = node.layer
+            h = in_acts[0]
+            if node.preprocessor is not None:
+                h = node.preprocessor.transform(h, None)
+            p = self._layer_params(params, name)
+            if getattr(layer, "supports_kv_cache", False):
+                cache = caches[name]
+                if lengths is not None:
+                    h, kc, vc = layer.prefill(p, h, cache["k"],
+                                              cache["v"], lengths)
+                else:
+                    h, kc, vc = layer.decode_step(p, h, cache["k"],
+                                                  cache["v"], positions)
+                new_caches[name] = {"k": kc, "v": vc}
+            elif lengths is None and hasattr(layer, "decode_step"):
+                h = layer.decode_step(p, h, positions)
+            else:
+                h, _ = layer.apply(p, h, state=states[name], train=False,
+                                   rng=None, mask=None)
+            acts[name] = h
+        return acts[self.conf.network_outputs[0]], new_caches
+
+    def decode_fns(self):
+        """The two PURE step functions token-level serving AOT-compiles
+        (params/states stay arguments — fit never invalidates a
+        compiled bucket; caches are donate-able carries):
+
+        - ``prefill(params, states, caches, x, lengths)`` -> ``(probs
+          [B, V] at each row's last prompt position, caches)`` — x is
+          the pow2-padded one-hot prompt block [B, T, V].
+        - ``decode(params, states, caches, x, positions)`` -> ``(probs
+          [B, V], caches)`` — x is the [B, 1, V] one-hot of each row's
+          current token.
+        """
+        if self._decode_fns is None:
+            self._check_decodable()
+
+            def prefill(params, states, caches, x, lengths):
+                out, new_caches = self._incremental_forward(
+                    params, states, x, caches, None, lengths=lengths)
+                probs = jnp.take_along_axis(
+                    out, (lengths - 1)[:, None, None], axis=1)[:, 0, :]
+                return probs, new_caches
+
+            def decode(params, states, caches, x, positions):
+                out, new_caches = self._incremental_forward(
+                    params, states, x, caches, positions)
+                return out[:, 0, :], new_caches
+
+            self._decode_fns = (prefill, decode)
+        return self._decode_fns
 
     # --------------------------------------------------------------- pretrain
     def _ancestors(self, target: str) -> set:

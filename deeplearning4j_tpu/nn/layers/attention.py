@@ -124,6 +124,14 @@ class SelfAttentionLayer(BaseLayerConf):
 
     supports_carry = False
 
+    @property
+    def supports_kv_cache(self) -> bool:
+        """Incremental (token-at-a-time) decode is only meaningful for
+        CAUSAL attention: position p's output depends on positions
+        <= p alone, so a per-request KV cache makes each decode step
+        O(p) instead of re-running the O(T^2) window."""
+        return self.causal
+
     def set_n_in(self, in_type: InputType) -> None:
         if in_type.kind != "rnn":
             raise ValueError(f"SelfAttentionLayer expects RNN input, got {in_type}")
@@ -207,3 +215,61 @@ class SelfAttentionLayer(BaseLayerConf):
         if mask is not None:
             out = out * mask[..., None]
         return out, state
+
+    # ------------------------------------------------- incremental decode
+    def cache_shape(self, rows: int, max_len: int) -> Tuple[int, ...]:
+        """Static per-bucket KV cache shape: [rows, H, max_len, D]."""
+        return (rows, self.n_heads, max_len, self.head_dim)
+
+    def prefill(self, params, x, k_cache, v_cache, lengths):
+        """Prompt-window forward that FILLS the KV cache: ``x`` is the
+        padded prompt block [B, T, F], ``lengths`` [B] the per-row
+        valid prompt lengths, caches [B, H, Tmax, D] (T <= Tmax). The
+        full window's K/V land in cache[:, :, :T]; padded positions
+        write garbage-but-finite values that incremental decode later
+        OVERWRITES (the first generated token decodes at position
+        ``length``) or masks (positions > pos are invalid), so they
+        are never attended. Returns (out [B, T, F], k_cache, v_cache).
+        """
+        if not self.causal:
+            raise ValueError("prefill/decode need causal attention")
+        q = self._split_heads(x @ params["Wq"])
+        k = self._split_heads(x @ params["Wk"])
+        v = self._split_heads(x @ params["Wv"])
+        kv_mask = (jnp.arange(x.shape[1])[None, :]
+                   < lengths[:, None]).astype(x.dtype)
+        out = attention_reference(q, k, v, causal=True, mask=kv_mask)
+        T = x.shape[1]
+        k_cache = k_cache.at[:, :, :T, :].set(k)
+        v_cache = v_cache.at[:, :, :T, :].set(v)
+        B, H, _, D = q.shape
+        out = out.transpose(0, 2, 1, 3).reshape(B, T, H * D)
+        return out @ params["Wo"], k_cache, v_cache
+
+    def decode_step(self, params, x, k_cache, v_cache, positions):
+        """ONE token per row: ``x`` [B, 1, F] is the current token's
+        activation, ``positions`` [B] its sequence position per row.
+        Writes this position's K/V into the cache and attends the
+        query over cache positions <= position (each row masks its own
+        prefix — rows are fully independent, which is what makes
+        batched decode bitwise equal to singleton decode). Returns
+        (out [B, 1, F], new_k_cache, new_v_cache)."""
+        if not self.causal:
+            raise ValueError("prefill/decode need causal attention")
+        q = self._split_heads(x @ params["Wq"])          # [B, H, 1, D]
+        k_new = self._split_heads(x @ params["Wk"])[:, :, 0, :]
+        v_new = self._split_heads(x @ params["Wv"])[:, :, 0, :]
+        B = x.shape[0]
+        rows = jnp.arange(B)
+        k_cache = k_cache.at[rows, :, positions, :].set(k_new)
+        v_cache = v_cache.at[rows, :, positions, :].set(v_new)
+        scale = 1.0 / math.sqrt(self.head_dim)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache) * scale
+        valid = (jnp.arange(k_cache.shape[2])[None, :]
+                 <= positions[:, None])                  # [B, Tmax]
+        logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
+        out = jnp.einsum("bhqk,bhkd->bhqd",
+                         jax.nn.softmax(logits, axis=-1), v_cache)
+        H, D = self.n_heads, self.head_dim
+        out = out.transpose(0, 2, 1, 3).reshape(B, 1, H * D)
+        return out @ params["Wo"], k_cache, v_cache

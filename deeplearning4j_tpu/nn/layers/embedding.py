@@ -96,6 +96,16 @@ class PositionalEmbeddingLayer(BaseLayerConf):
             out = out * mask[..., None]
         return out, state
 
+    def decode_step(self, params, x, positions):
+        """Incremental-decode embedding of ONE token per row: ``x``
+        [B, 1, V] one-hot, ``positions`` [B] the per-row sequence
+        position — each row indexes its own learned position, so rows
+        at different depths of their generations share one compiled
+        step. Returns [B, 1, D]."""
+        out = x @ params["W"] + params["b"] \
+            + params["P"][positions][:, None, :]
+        return get_activation(self.activation or "identity")(out)
+
 
 @register_layer
 @dataclass

@@ -51,6 +51,19 @@ Continuous-batching fault kinds (PR 6, the coalesced-batch seams):
   deadline-blown members must fail alone, the rest succeed late or on
   their own budget.
 
+Token-level decode fault kinds (ISSUE 15, the iteration-level seams):
+
+- ``poison_decode``    — NaN-poison the logits of the ``at_call``-th
+  generation request at its ``step``-th decode step. The per-row
+  sentinel must fail that request alone MID-STREAM (tokens already
+  generated are lost with the error, as a real NaN would lose them);
+  its decode batchmates must keep generating unharmed.
+- ``evict_cache``      — force a ring-buffer KV-cache eviction at the
+  engine's ``at_call``-th decode iteration: the oldest-admitted row is
+  evicted exactly as HBM pressure would evict it. The victim must
+  RE-PREFILL from its prompt + generated-so-far tokens and finish with
+  a coherent generation — never garbage from a stale or zeroed cache.
+
 Input-pipeline fault kinds (PR 7, the streaming-input seams):
 
 - ``slow_input``       — the Nth pipeline ``next()`` stalls ``duration``
@@ -130,7 +143,7 @@ _KINDS = ("raise", "nan", "truncate_checkpoint", "drop_connection",
           "slow_loris", "hang_backend", "burst", "corrupt_frame",
           "poison_row", "slow_batch", "slow_input", "io_error",
           "kill_host", "slow_host", "kill_coordinator", "rejoin_host",
-          "partition_host")
+          "partition_host", "poison_decode", "evict_cache")
 
 #: exit code of a ``kill_host`` hard exit — distinct so test drivers can
 #: assert the victim died BY the fault, not by a bug
@@ -196,6 +209,8 @@ _predict_loads = 0
 _batch_dispatches = 0
 _input_nexts = 0
 _reader_reads = 0
+_gen_submits = 0
+_decode_iters = 0
 #: monotonic deadline until which heartbeat writes are suppressed
 #: (``partition_host``); None = no partition in effect, inf = until the
 #: schedule is cleared
@@ -208,6 +223,7 @@ def set_schedule(schedule: Optional[FaultSchedule]) -> None:
     global _schedule, _commit_calls, _recv_calls, _pub_calls
     global _dispatch_calls, _frame_sends, _loris_sends
     global _predict_loads, _batch_dispatches, _input_nexts, _reader_reads
+    global _gen_submits, _decode_iters
     global _partition_until
     with _lock:
         _schedule = schedule
@@ -221,6 +237,8 @@ def set_schedule(schedule: Optional[FaultSchedule]) -> None:
         _batch_dispatches = 0
         _input_nexts = 0
         _reader_reads = 0
+        _gen_submits = 0
+        _decode_iters = 0
         _partition_until = None
 
 
@@ -487,6 +505,53 @@ def poison_predict(features: np.ndarray) -> np.ndarray:
         poisoned = poisoned.astype(np.float32)
     poisoned.flat[0] = np.nan
     return poisoned
+
+
+def on_generate_submit() -> int:
+    """Called by the generation scheduler per submitted request;
+    returns the request's 1-based index SINCE THE SCHEDULE WAS ARMED —
+    the ``at_call`` address of ``poison_decode``."""
+    global _gen_submits
+    with _lock:
+        _gen_submits += 1
+        return _gen_submits
+
+
+def poison_decode_row(request_index: int, step: int) -> bool:
+    """Called by the generation engine per live row per decode step
+    with the request's submit index (``at_call``, from
+    ``on_generate_submit``) and its own decode-step count (``step``,
+    1-based). True = the scheduled ``poison_decode`` fault fires: the
+    caller replaces that row's logits with NaN, and the per-row
+    sentinel must fail the request alone mid-stream while its
+    batchmates keep decoding."""
+    with _lock:
+        if _schedule is None:
+            return False
+        for f in _schedule.pending():
+            if (f.kind == "poison_decode" and f.at_call == request_index
+                    and f.step == step):
+                _fire(f, request=request_index, step=step)
+                return True
+        return False
+
+
+def check_evict_cache() -> bool:
+    """Called by the generation engine once per decode iteration; True
+    = a scheduled ``evict_cache`` fault fires on its ``at_call``-th
+    iteration since arming, and the engine must force one ring-buffer
+    KV eviction — the exact path HBM pressure takes, so the victim's
+    re-prefill contract is provable without a real memory squeeze."""
+    global _decode_iters
+    with _lock:
+        if _schedule is None:
+            return False
+        _decode_iters += 1
+        for f in _schedule.pending():
+            if f.kind == "evict_cache" and f.at_call == _decode_iters:
+                _fire(f, iteration=_decode_iters)
+                return True
+        return False
 
 
 def on_batch_dispatch(key: str = "") -> None:

@@ -196,8 +196,11 @@ def test_compile_cache_evicted_with_lru_model(tmp_path, mlp_zip):
         cli = KerasClient(srv.host, srv.port)
         for p in clones:
             cli.predict(x, model=p)
-        # clone0 was evicted: its compiled steps went with it
-        cached_keys = {k[0] for k in srv._batcher._compiled}
+        # clone0 was evicted: its compiled steps went with it (cache
+        # keys are (scheduler id, model key, bucket, shape) since the
+        # cross-model CompileCache landed)
+        cached_keys = {k[1] for k in srv._batcher._compiled.keys()
+                       if k[0] == srv._batcher._cache_owner}
         assert clones[0] not in cached_keys
         assert len(srv._models) <= 2
         # an evicted model transparently reloads AND recompiles

@@ -936,7 +936,8 @@ def test_batch_level_failure_falls_back_to_singletons(iris_zip, tmp_path):
         shape_key = ((4,), "float32")
         def boom(_x):
             raise RuntimeError("injected batch-step failure")
-        srv._batcher._compiled[(key, bucket, shape_key)] = boom
+        srv._batcher._compiled.put(
+            (srv._batcher._cache_owner, key, bucket, shape_key), boom)
         got = cli.predict(x, model=model)  # singleton fallback serves it
         assert got.shape == (4, 3)
         assert _counter("serving_batch_fallbacks_total") == 1

@@ -29,7 +29,8 @@ stage() {
 
 bench_smoke() {
     rm -f /tmp/_bench_smoke.jsonl
-    JAX_PLATFORMS=cpu BENCH_SMOKE=1 BENCH_RUNGS=lenet,input,serve,lm \
+    JAX_PLATFORMS=cpu BENCH_SMOKE=1 \
+        BENCH_RUNGS=lenet,input,serve,lm,lm_serve \
         BENCH_AUTOTUNE=1 BENCH_CHILD=1 \
         python bench.py | tee /tmp/_bench_smoke.jsonl || return 1
     # every successful rung record must carry the ISSUE-10 precision
@@ -72,9 +73,28 @@ for r in lm:
         v = r.get(fld)
         assert v is not None and math.isfinite(float(v)), \
             f"lm record {fld} missing or non-finite: {v!r}"
+# ISSUE 15: the lm_serve rung must carry the token-level serving
+# schema (tokens/sec-at-SLO + TTFT p50/p99), run its timed wave with
+# zero decode recompiles, and BEAT the whole-predict baseline on the
+# same mixed-length workload
+ls_ = [r for r in recs if r.get("rung") == "lm_serve"]
+assert ls_, "no lm_serve rung record emitted"
+for r in ls_:
+    for fld in ("tokens_per_sec_at_slo", "ttft_p50_ms", "ttft_p99_ms",
+                "whole_predict_tokens_per_sec", "vs_whole_predict"):
+        v = r.get(fld)
+        assert v is not None and math.isfinite(float(v)), \
+            f"lm_serve record {fld} missing or non-finite: {v!r}"
+    assert r["decode_recompiles_timed_wave"] == 0, \
+        f"lm_serve timed wave recompiled: {r['decode_recompiles_timed_wave']}"
+    assert r["vs_whole_predict"] > 1.0, \
+        f"token-level serving did not beat whole-predict: {r['vs_whole_predict']}"
 print(f"bench record schema: {len(recs)} records OK "
       f"({len(tuned)} autotuned, lm tokens/sec/chip "
-      f"{lm[0]['tokens_per_sec_per_chip']} @ seq {lm[0]['seq_len']})")
+      f"{lm[0]['tokens_per_sec_per_chip']} @ seq {lm[0]['seq_len']}, "
+      f"lm_serve {ls_[0]['tokens_per_sec_at_slo']} tok/s@SLO = "
+      f"{ls_[0]['vs_whole_predict']}x whole-predict, ttft p50 "
+      f"{ls_[0]['ttft_p50_ms']}ms)")
 PY
 }
 
@@ -107,7 +127,10 @@ if [ "${1:-}" != "--fast" ]; then
     stage "profiling smoke"  env JAX_PLATFORMS=cpu python tools/profiling_smoke.py
     stage "chaos smoke"      env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
     stage "serve smoke"      env JAX_PLATFORMS=cpu python tools/serve_smoke.py
-    stage "bench smoke (autotuned lenet + input + serve + lm)" bench_smoke
+    stage "lm serve smoke (token-level)" env JAX_PLATFORMS=cpu \
+        python tools/lm_serve_smoke.py
+    stage "bench smoke (autotuned lenet + input + serve + lm + lm_serve)" \
+        bench_smoke
     stage "zero1 smoke"      env JAX_PLATFORMS=cpu python tools/zero1_smoke.py
     stage "zero2 smoke"      env JAX_PLATFORMS=cpu python tools/zero2_smoke.py
     stage "lm composition smoke" env JAX_PLATFORMS=cpu \
