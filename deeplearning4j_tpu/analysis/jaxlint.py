@@ -56,14 +56,14 @@ config``. Every suppression in this repo must carry a reason; the CLI
 from __future__ import annotations
 
 import ast
-import io
 import re
-import tokenize
-from dataclasses import dataclass, field
-from pathlib import Path
 from typing import Dict, List, Optional, Set, Tuple, Union
 
 from deeplearning4j_tpu.analysis.findings import Finding, Severity
+from deeplearning4j_tpu.analysis.source_lint import (
+    LintContext, collect_suppressions, dotted as _dotted, iter_py_files,
+    make_suppress_re, sort_findings, stale_suppression_pass,
+)
 
 RULES: Dict[str, Tuple[str, str]] = {
     "JL000": ("reasonless-suppression",
@@ -145,25 +145,15 @@ _TRACED_ROOTS = ("jnp.", "jax.lax.", "jax.nn.", "jax.numpy.", "jax.random.",
 
 _STEP_NAME = re.compile(r"(^|_)(train_)?(step|update)$")
 
-_SUPPRESS_RE = re.compile(
-    r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,\s]+?)(?:\s*--\s*(.*))?$")
+# the suppression comment grammar and the stale/used bookkeeping live
+# in source_lint (shared with lockcheck); jaxlint keeps only its tool
+# name and meta-rule wiring
+_SUPPRESS_RE = make_suppress_re("jaxlint")
 
 
 # ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------
-
-def _dotted(node: ast.AST) -> Optional[str]:
-    """'jax.lax.scan' for an Attribute/Name chain, else None."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
 
 def _is_traced_call(node: ast.Call) -> bool:
     """Call whose target is rooted in jnp/jax.lax/jax.nn/... and is not a
@@ -208,27 +198,8 @@ def _collect_suppressions(source: str,
                           ) -> Dict[int, Set[str]]:
     """line -> suppressed rule ids ({'all'} suppresses everything).
     Reasonless suppressions produce JL000 findings."""
-    out: Dict[int, Set[str]] = {}
-    try:
-        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
-        for tok in tokens:
-            if tok.type != tokenize.COMMENT:
-                continue
-            m = _SUPPRESS_RE.search(tok.string)
-            if not m:
-                continue
-            ids = {s.strip().upper() if s.strip().lower() != "all" else "all"
-                   for s in m.group(1).split(",") if s.strip()}
-            out.setdefault(tok.start[0], set()).update(ids)
-            if not (m.group(2) or "").strip():
-                findings.append(Finding(
-                    "JL000", RULE_SEVERITY["JL000"],
-                    f"{path}:{tok.start[0]}",
-                    "suppression without a reason",
-                    "append '-- <why this is safe>' to the comment"))
-    except tokenize.TokenError:
-        pass
-    return out
+    return collect_suppressions(source, findings, path, _SUPPRESS_RE,
+                                "JL000", RULE_SEVERITY["JL000"])
 
 
 # ---------------------------------------------------------------------------
@@ -285,24 +256,9 @@ def _collect_traced_names(tree: ast.AST) -> Tuple[Set[str], Set[int]]:
 # per-file lint
 # ---------------------------------------------------------------------------
 
-@dataclass
-class _Ctx:
-    path: str
-    suppressed: Dict[int, Set[str]]
-    findings: List[Finding] = field(default_factory=list)
-    # line -> suppression ids that actually silenced a finding there;
-    # the JL008 post-pass reports the declared-but-unused remainder
-    used: Dict[int, Set[str]] = field(default_factory=dict)
-
-    def emit(self, rule: str, node: ast.AST, message: str, hint: str = ""):
-        line = getattr(node, "lineno", 0)
-        dis = self.suppressed.get(line, set())
-        if "all" in dis or rule in dis:
-            self.used.setdefault(line, set()).update(
-                dis & {"all", rule})
-            return
-        self.findings.append(Finding(
-            rule, RULE_SEVERITY[rule], f"{self.path}:{line}", message, hint))
+# per-file lint state (suppressions in, findings out, used-suppression
+# ledger for JL008) — the generic machinery, bound to jaxlint severities
+_Ctx = LintContext
 
 
 def _lint_traced_function(fn: FunctionNode, ctx: _Ctx) -> None:
@@ -544,42 +500,19 @@ def lint_source(source: str, path: str = "<string>") -> List[Finding]:
             "JL000", Severity.ERROR, f"{path}:{e.lineno or 0}",
             f"syntax error: {e.msg}", ""))
         return findings
-    ctx = _Ctx(path=path, suppressed=suppressed, findings=findings)
+    ctx = _Ctx(path=path, suppressed=suppressed, severity=RULE_SEVERITY,
+               findings=findings)
     _lint_module(tree, ctx)
-    # JL008: suppressions that silenced nothing on their line. A
-    # `disable=all` is live if ANY finding was swallowed there; explicit
-    # ids are checked one by one. `disable=JL008` on the line opts the
-    # line out (self-referential suppressions cannot be "used").
-    for line, ids in sorted(suppressed.items()):
-        if "JL008" in ids or "all" in ids and ctx.used.get(line):
-            continue
-        stale = sorted(
-            i for i in ids
-            if i not in ctx.used.get(line, set())
-            and (i != "all" or not ctx.used.get(line)))
-        if stale:
-            ctx.findings.append(Finding(
-                "JL008", RULE_SEVERITY["JL008"], f"{path}:{line}",
-                "suppression suppresses nothing on this line "
-                f"({', '.join('all' if s == 'all' else s for s in stale)}"
-                " never fired here)",
-                "delete the stale comment — it would silently swallow "
-                "a future finding of that rule"))
-    ctx.findings.sort(key=lambda f: (f.location.rsplit(":", 1)[0],
-                                     int(f.location.rsplit(":", 1)[1])))
+    # JL008: suppressions that silenced nothing on their line (see
+    # source_lint.stale_suppression_pass for the disable=all semantics)
+    stale_suppression_pass(ctx, "JL008")
+    sort_findings(ctx.findings)
     return ctx.findings
 
 
 def lint_paths(paths: List[str]) -> List[Finding]:
     """Lint .py files under the given files/directories."""
     findings: List[Finding] = []
-    files: List[Path] = []
-    for p in paths:
-        pp = Path(p)
-        if pp.is_dir():
-            files.extend(sorted(pp.rglob("*.py")))
-        else:
-            files.append(pp)
-    for f in files:
+    for f in iter_py_files(paths):
         findings.extend(lint_source(f.read_text(encoding="utf-8"), str(f)))
     return findings
