@@ -253,6 +253,9 @@ class RemoteStatsStorageRouter(StatsStorage):
     def close(self) -> None:
         self.flush()
         self._queue.put(None)
+        # reap the worker: it exits on the None poison, bounded by the
+        # in-flight POST's own timeout
+        self._worker.join(timeout=self.timeout + 1.0)
 
     def put_init_report(self, report):
         payload = json.dumps({

@@ -1,0 +1,76 @@
+"""Runtime thread hygiene: every threaded subsystem's teardown path
+must actually reap its workers — after stop()/drain()/close(),
+``threading.enumerate()`` returns to the pre-start baseline.
+
+These are the regression tests for the lockcheck LC005 sweep fixes:
+the static layer proves a join EXISTS on the teardown path; these
+prove the join WORKS — the thread is gone, not merely asked to leave.
+A daemon flag is not a teardown story (interpreter shutdown kills
+daemons mid-POST / mid-publish), which is why every fix joins rather
+than abandons.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (AsyncDataSetIterator,
+                                         ExistingDataSetIterator)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.pipeline import StreamingInputPipeline
+from deeplearning4j_tpu.keras.server import KerasServer
+from deeplearning4j_tpu.profiling.metrics import (MetricsRegistry,
+                                                  set_registry)
+from deeplearning4j_tpu.profiling.watchers import CompileWatcher
+from deeplearning4j_tpu.resilience import service
+from deeplearning4j_tpu.streaming import NDArrayServer, ServeRoute
+from deeplearning4j_tpu.ui.server import UIServer
+from deeplearning4j_tpu.ui.storage import RemoteStatsStorageRouter
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    prev = set_registry(MetricsRegistry())
+    yield
+    with service._guards_lock:
+        service._guards.clear()
+    set_registry(prev)
+
+
+def _baseline():
+    return set(threading.enumerate())
+
+
+def _assert_settled(baseline, timeout_s: float = 8.0):
+    """The set of live threads must shrink back to (a subset of) the
+    pre-start baseline. A short grace loop absorbs the instant between
+    a bounded join timing out on an already-exiting thread and the
+    thread actually vanishing from enumerate()."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        leaked = _baseline() - baseline
+        if not leaked:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"threads leaked past teardown: {[t.name for t in leaked]}")
+
+
+# ---------------------------------------------------------------- router
+
+def test_remote_router_close_joins_worker():
+    base = _baseline()
+    router = RemoteStatsStorageRouter("http://127.0.0.1:1", max_failures=1,
+                                      timeout=0.5)
+    assert _baseline() - base
+    router.close()
+    _assert_settled(base)
+    assert router._worker is None or not router._worker.is_alive()
+
+
+# ------------------------------------------------------------- pipelines
+
+def _tiny_batch():
+    return DataSet(np.zeros((4, 3), np.float32), np.ones((4, 2), np.float32))
