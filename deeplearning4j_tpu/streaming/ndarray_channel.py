@@ -423,6 +423,9 @@ class NDArrayServer:
                 topic.wake_all()  # unpark idle SUB handler threads
         self._server.shutdown()
         self._server.server_close()
+        # shutdown() already waited for serve_forever to exit; the join
+        # reaps the acceptor thread itself (bounded for safety)
+        self._thread.join(timeout=5.0)
         service.unregister_guard(self._guard)
 
 

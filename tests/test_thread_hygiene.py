@@ -58,6 +58,14 @@ def _assert_settled(baseline, timeout_s: float = 8.0):
         f"threads leaked past teardown: {[t.name for t in leaked]}")
 
 
+def test_ndarray_server_stop_reaps_broker():
+    base = _baseline()
+    srv = NDArrayServer()
+    assert _baseline() - base
+    srv.stop()
+    _assert_settled(base)
+
+
 # ---------------------------------------------------------------- router
 
 def test_remote_router_close_joins_worker():
