@@ -62,8 +62,13 @@ class ServeRoute:
 
     def stop(self) -> None:
         self._stop.set()
+        # closing the sockets unblocks the loop's get_array(); join is
+        # bounded in case a model.output call is mid-flight
         self._consumer.close()
         self._publisher.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
 
 
 class StreamingPipeline:

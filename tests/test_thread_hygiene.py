@@ -66,6 +66,22 @@ def test_ndarray_server_stop_reaps_broker():
     _assert_settled(base)
 
 
+def test_serve_route_stop_reaps_loop_thread():
+    class _NullModel:
+        def output(self, x):
+            return x
+
+    base = _baseline()
+    srv = NDArrayServer()
+    try:
+        route = ServeRoute(_NullModel(), srv.host, srv.port).start()
+        assert _baseline() - base
+        route.stop()
+    finally:
+        srv.stop()
+    _assert_settled(base)
+
+
 # ---------------------------------------------------------------- router
 
 def test_remote_router_close_joins_worker():
