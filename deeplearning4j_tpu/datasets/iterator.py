@@ -248,6 +248,24 @@ class AsyncDataSetIterator(DataSetIterator):
         self._base.reset()
         self._start()
 
+    def close(self):
+        """Release the producer thread — it may be parked on a full
+        queue — and join it. The iterator is exhausted afterwards; use
+        reset() instead to start another epoch."""
+        if self._thread is not None and self._thread.is_alive():
+            # drain until the terminal item UNLESS it was already pulled
+            # into _peek (then the producer is already exiting and the
+            # queue may be empty — draining would block forever)
+            if self._peek is None or self._peek[0] == "data":
+                while True:
+                    tag, _ = self._queue.get()
+                    if tag in ("end", "error"):
+                        break
+            self._thread.join()
+        self._thread = None
+        self._peek = None
+        self._done = True
+
     def _ensure(self):
         if self._peek is None and not self._done:
             self._peek = self._queue.get()

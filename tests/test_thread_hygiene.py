@@ -98,3 +98,28 @@ def test_remote_router_close_joins_worker():
 
 def _tiny_batch():
     return DataSet(np.zeros((4, 3), np.float32), np.ones((4, 2), np.float32))
+
+
+def test_async_iterator_close_releases_parked_producer():
+    """The producer may be PARKED on a full queue when close() arrives;
+    close() must drain it loose and join — not leave it blocked on
+    put() forever (the LC005 finding: no stop path at all)."""
+    many = [_tiny_batch() for _ in range(64)]
+    base = _baseline()
+    it = AsyncDataSetIterator(ExistingDataSetIterator(iter(many)),
+                              queue_size=2)
+    assert it.next() is not None  # producer running, queue refilling
+    it.close()
+    _assert_settled(base)
+    assert not it.has_next()  # exhausted afterwards, never blocks
+
+
+def test_async_iterator_close_after_full_consumption():
+    """Terminal item already pulled into the peek slot: close() must not
+    drain an empty queue (that get() would block forever)."""
+    it = AsyncDataSetIterator(
+        ExistingDataSetIterator(iter([_tiny_batch()])), queue_size=2)
+    while it.has_next():
+        it.next()
+    it.close()  # must return promptly, not hang
+    assert not it.has_next()
