@@ -501,6 +501,9 @@ class KerasServer:
         self._gen.stop(grace_s)
         self._server.shutdown()
         self._server.server_close()
+        # shutdown() already waited for serve_forever to exit; the join
+        # reaps the acceptor thread itself (bounded for safety)
+        self._thread.join(timeout=grace_s)
         unregister_guard(self._guard)
         return drained
 
@@ -557,4 +560,12 @@ class KerasClient:
                             **({"model": model} if model else {}), **kw)
 
     def close(self) -> None:
+        # close the makefile wrapper FIRST: the socket's real fd close
+        # is deferred until every makefile ref drops, and a live fd
+        # keeps the server's handler thread parked in readline until
+        # its idle timeout instead of seeing EOF now
+        try:
+            self._file.close()
+        except OSError:
+            pass
         self._sock.close()

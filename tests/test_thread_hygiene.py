@@ -58,6 +58,16 @@ def _assert_settled(baseline, timeout_s: float = 8.0):
         f"threads leaked past teardown: {[t.name for t in leaked]}")
 
 
+# --------------------------------------------------------------- servers
+
+def test_keras_server_drain_reaps_acceptor():
+    base = _baseline()
+    srv = KerasServer(max_batch=4, max_wait_ms=2.0)
+    assert _baseline() - base, "server should have started threads"
+    assert srv.drain(grace_s=5.0)
+    _assert_settled(base)
+
+
 def test_ndarray_server_stop_reaps_broker():
     base = _baseline()
     srv = NDArrayServer()
