@@ -757,6 +757,9 @@ class UIServer:
         drained = self._guard.wait_idle(grace_s)
         self._httpd.shutdown()
         self._httpd.server_close()
+        # shutdown() already waited for serve_forever to exit; the join
+        # reaps the acceptor thread itself (bounded for safety)
+        self._thread.join(timeout=grace_s)
         unregister_guard(self._guard)
         if UIServer._instance is self:
             UIServer._instance = None

@@ -68,6 +68,14 @@ def test_keras_server_drain_reaps_acceptor():
     _assert_settled(base)
 
 
+def test_ui_server_drain_reaps_acceptor():
+    base = _baseline()
+    srv = UIServer(port=0).start()
+    assert _baseline() - base
+    srv.drain(grace_s=5.0)
+    _assert_settled(base)
+
+
 def test_ndarray_server_stop_reaps_broker():
     base = _baseline()
     srv = NDArrayServer()
