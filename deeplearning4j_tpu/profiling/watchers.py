@@ -81,7 +81,8 @@ class CompileWatcher:
         return self
 
     def uninstall(self) -> None:
-        self._active = False
+        with self._lock:
+            self._active = False
 
     def _on_duration(self, event: str, duration: float, **_kw) -> None:
         if not self._active:

@@ -141,3 +141,24 @@ def test_async_iterator_close_after_full_consumption():
         it.next()
     it.close()  # must return promptly, not hang
     assert not it.has_next()
+
+
+# ---------------------------------------------------------------- watcher
+
+def test_compile_watcher_uninstall_synchronizes_on_lock():
+    """The LC004 fix: uninstall() flips ``_active`` under the same lock
+    install() holds, so an in-flight install can never resurrect a
+    watcher that was just deactivated. Observable: uninstall blocks
+    while another thread holds the lock."""
+    w = CompileWatcher()
+    w._active = True
+    done = threading.Event()
+    with w._lock:
+        t = threading.Thread(target=lambda: (w.uninstall(), done.set()))
+        t.start()
+        time.sleep(0.05)
+        assert not done.is_set(), "uninstall must wait for the lock"
+        assert w._active
+    assert done.wait(5.0)
+    t.join(5.0)
+    assert not w._active
