@@ -68,6 +68,38 @@ def test_keras_server_drain_reaps_acceptor():
     _assert_settled(base)
 
 
+def test_keras_server_drain_after_served_request(tmp_path):
+    """A served-and-closed connection must not park a handler thread
+    past drain. The client-side half of the contract: KerasClient.close
+    closes the makefile wrapper too — a socket close alone defers the
+    real fd close, and the handler then waits out its idle timeout
+    instead of seeing EOF."""
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.keras.server import KerasClient
+    from deeplearning4j_tpu.nn.layers import OutputLayer
+    from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+    conf = (NeuralNetConfiguration.builder().updater("sgd")
+            .learning_rate(0.1).seed(3).list()
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(3)).build())
+    zip_path = str(tmp_path / "m.zip")
+    ModelSerializer.write_model(MultiLayerNetwork(conf).init(), zip_path)
+    x_path = str(tmp_path / "x.npy")
+    np.save(x_path, np.zeros((2, 3), np.float32))
+
+    base = _baseline()
+    srv = KerasServer(max_batch=4, max_wait_ms=2.0)
+    cli = KerasClient(srv.host, srv.port)
+    got = cli.predict(x_path, model=zip_path)
+    assert np.asarray(got).shape == (2, 2)
+    cli.close()
+    assert srv.drain(grace_s=5.0)
+    _assert_settled(base)
+
+
 def test_ui_server_drain_reaps_acceptor():
     base = _baseline()
     srv = UIServer(port=0).start()
