@@ -1037,3 +1037,225 @@ SC_GOOD_FOR: Dict[str, str] = {
     "SC008": "sp-ring-step",          # sp claim with the ring present
     "SC009": "gpt-decode-step",       # cache donation landed as aliases
 }
+
+
+# ---------------------------------------------------------------------------
+# lockcheck fixtures: rule -> (bad snippet firing exactly it, clean twin)
+# ---------------------------------------------------------------------------
+#
+# Source-text pairs like the jaxlint family: the bad snippet is the
+# smallest class exhibiting exactly one concurrency hazard, the good
+# twin is the same class with the repo's canonical fix (consistent lock
+# order, block-outside-lock, predicate while loop, locked writes,
+# join-on-teardown, notify-under-lock, live suppressions).
+
+LC_FIXTURES: Dict[str, Tuple[str, str]] = {
+    # two methods take the same two locks in opposite orders
+    "LC001": ("""\
+import threading
+
+class Broker:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def put(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def get(self):
+        with self._b:
+            with self._a:
+                pass
+""", """\
+import threading
+
+class Broker:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def put(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def get(self):
+        with self._a:
+            with self._b:
+                pass
+"""),
+    # a sleep inside the held region stalls every waiter
+    "LC002": ("""\
+import threading
+import time
+
+class Refresher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = None
+
+    def refresh(self):
+        with self._lock:
+            time.sleep(0.5)
+""", """\
+import threading
+import time
+
+class Refresher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = None
+
+    def refresh(self):
+        time.sleep(0.5)
+        with self._lock:
+            pass
+"""),
+    # bare if+wait sees stale state on spurious/stolen wakeups
+    "LC003": ("""\
+import threading
+
+class Mailbox:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items = []
+
+    def get(self):
+        with self._cond:
+            if not self._items:
+                self._cond.wait()
+            return self._items.pop()
+""", """\
+import threading
+
+class Mailbox:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items = []
+
+    def get(self):
+        with self._cond:
+            while not self._items:
+                self._cond.wait()
+            return self._items.pop()
+"""),
+    # the counter is locked in add() but raced in reset()
+    "LC004": ("""\
+import threading
+
+class Tally:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def add(self, n):
+        with self._lock:
+            self.total += n
+
+    def reset(self):
+        self.total = 0
+""", """\
+import threading
+
+class Tally:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def add(self, n):
+        with self._lock:
+            self.total += n
+
+    def reset(self):
+        with self._lock:
+            self.total = 0
+"""),
+    # stop() signals the loop but never joins the thread
+    "LC005": ("""\
+import threading
+
+class Poller:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._stop.wait(0.1)
+
+    def stop(self):
+        self._stop.set()
+""", """\
+import threading
+
+class Poller:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._stop.wait(0.1)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join()
+"""),
+    # notify_all without holding the condition: RuntimeError at runtime
+    "LC006": ("""\
+import threading
+
+class Gate:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._open = False
+
+    def signal(self):
+        self._cond.notify_all()
+""", """\
+import threading
+
+class Gate:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._open = False
+
+    def signal(self):
+        with self._cond:
+            self._open = True
+            self._cond.notify_all()
+"""),
+    # LC007: the bad snippet's suppression silences nothing (the sleep
+    # it once excused is gone); the good twin's suppression is live, so
+    # neither LC002 (suppressed) nor LC007 (used) fires
+    "LC007": ("""\
+import threading
+
+class Idle:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poke(self):
+        with self._lock:
+            pass  # lockcheck: disable=LC002 -- the sleep was removed
+""", """\
+import threading
+import time
+
+class Napper:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def nap(self):
+        with self._lock:
+            time.sleep(0.01)  # lockcheck: disable=LC002 -- demo: bounded nap under a private lock
+"""),
+}

@@ -273,7 +273,7 @@ class _Engine:
         live = [i for i, s in enumerate(self.slots) if s is not None]
         assert len(live) <= new_rows
         if self.caches is None:
-            self.caches = self.model.init_decode_cache(new_rows)
+            self.caches = self.model.init_decode_cache(new_rows)  # lockcheck: disable=LC004 -- caches is decode-loop private; decode_iteration's lock guards the model op, not this field
         elif new_rows != self.rows:
             idx = np.asarray(live + [0] * (new_rows - len(live)),
                              np.int32)

@@ -1,5 +1,6 @@
 """Fixture-coverage meta-test (ISSUE 11 satellite): every registered
-analyzer rule — graphcheck GC*, jaxlint JL*, shardcheck SC* — must have
+analyzer rule — graphcheck GC*, jaxlint JL*, shardcheck SC*, lockcheck
+LC* — must have
 at least one KNOWN_BAD fixture that produces it and one KNOWN_GOOD
 fixture that exercises its trigger surface cleanly, all registered in
 ``analysis/fixtures.py``. The standing ROADMAP gate ("graphcheck
@@ -16,6 +17,7 @@ test only proves they EXIST for every rule).
 from deeplearning4j_tpu.analysis import fixtures
 from deeplearning4j_tpu.analysis.graphcheck import RULES as GC_RULES
 from deeplearning4j_tpu.analysis.jaxlint import RULES as JL_RULES
+from deeplearning4j_tpu.analysis.lockcheck import RULES as LC_RULES
 from deeplearning4j_tpu.analysis.shardcheck import RULES as SC_RULES
 
 
@@ -52,6 +54,19 @@ def test_every_jl_rule_has_a_bad_good_pair():
     assert not malformed, f"malformed JL fixture pairs: {sorted(malformed)}"
 
 
+def test_every_lc_rule_has_a_bad_good_pair():
+    # LC000 is the meta rule (reasonless suppression) — it fires FROM
+    # the suppression machinery, not on its own fixture
+    missing = set(LC_RULES) - set(fixtures.LC_FIXTURES) - {"LC000"}
+    assert not missing, (
+        f"lockcheck rules without a (bad, good) fixture pair: "
+        f"{sorted(missing)} — add one to analysis/fixtures.py LC_FIXTURES")
+    malformed = {r for r, pair in fixtures.LC_FIXTURES.items()
+                 if len(pair) != 2 or not all(
+                     isinstance(s, str) and s.strip() for s in pair)}
+    assert not malformed, f"malformed LC fixture pairs: {sorted(malformed)}"
+
+
 def test_every_sc_rule_has_a_known_bad_fixture():
     covered = {rule for _, rule, _ in fixtures.SC_KNOWN_BAD}
     missing = set(SC_RULES) - covered
@@ -81,6 +96,8 @@ def test_known_bad_rules_are_registered():
         assert rule in SC_RULES, f"SC_KNOWN_BAD {name!r} names unknown {rule}"
     for rule in fixtures.JL_FIXTURES:
         assert rule in JL_RULES, f"JL_FIXTURES names unknown {rule}"
+    for rule in fixtures.LC_FIXTURES:
+        assert rule in LC_RULES, f"LC_FIXTURES names unknown {rule}"
 
 
 def test_fixture_names_are_unique():

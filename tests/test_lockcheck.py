@@ -1,0 +1,647 @@
+"""lockcheck unit tests: each LC rule fires on its trigger shape, the
+inter-procedural (within-module) propagation catches hazards routed
+through helper calls, the suppression machinery behaves exactly like
+jaxlint's (reason mandatory, stale suppressions flagged), and the
+repository's own tree stays analysis-clean — the gate future threaded
+subsystems inherit."""
+
+import textwrap
+from pathlib import Path
+
+from deeplearning4j_tpu.analysis.lockcheck import (
+    RULES, lint_paths, lint_source,
+)
+
+
+def rules_of(src):
+    return [f.rule for f in lint_source(textwrap.dedent(src), "snippet.py")]
+
+
+# ------------------------------------------------------------- LC001
+
+def test_lc001_opposite_order_in_two_methods():
+    assert rules_of("""
+        import threading
+
+        class Broker:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def put(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def get(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """) == ["LC001"]
+
+
+def test_lc001_cycle_through_call_edge():
+    # put() holds _a and calls a helper that takes _b; get() nests the
+    # other way — the cycle only exists across the call edge
+    assert rules_of("""
+        import threading
+
+        class Broker:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def _bump(self):
+                with self._b:
+                    pass
+
+            def put(self):
+                with self._a:
+                    self._bump()
+
+            def get(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """) == ["LC001"]
+
+
+def test_lc001_self_reacquire_nonreentrant():
+    assert rules_of("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """) == ["LC001"]
+
+
+def test_lc001_reacquire_through_call_edge():
+    assert rules_of("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _inner(self):
+                with self._lock:
+                    pass
+
+            def outer(self):
+                with self._lock:
+                    self._inner()
+    """) == ["LC001"]
+
+
+def test_lc001_rlock_reentry_is_fine():
+    assert rules_of("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """) == []
+
+
+def test_lc001_consistent_order_is_fine():
+    assert rules_of("""
+        import threading
+
+        class Broker:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def put(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def get(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """) == []
+
+
+# ------------------------------------------------------------- LC002
+
+def test_lc002_sleep_under_lock():
+    assert rules_of("""
+        import threading, time
+
+        class R:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def refresh(self):
+                with self._lock:
+                    time.sleep(1.0)
+    """) == ["LC002"]
+
+
+def test_lc002_socket_recv_under_lock():
+    assert rules_of("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._sock = None
+
+            def pull(self):
+                with self._lock:
+                    return self._sock.recv(4096)
+    """) == ["LC002"]
+
+
+def test_lc002_compile_under_lock():
+    assert rules_of("""
+        import threading, jax
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def get(self, fn, x):
+                with self._lock:
+                    return jax.jit(fn).lower(x).compile()
+    """) == ["LC002"]
+
+
+def test_lc002_future_result_under_lock():
+    assert rules_of("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def wait_done(self, fut):
+                with self._lock:
+                    return fut.result()
+    """) == ["LC002"]
+
+
+def test_lc002_through_call_edge():
+    # the sleep lives in a helper; the lock is held at the call site
+    assert rules_of("""
+        import threading, time
+
+        class R:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _backoff(self):
+                time.sleep(0.5)
+
+            def refresh(self):
+                with self._lock:
+                    self._backoff()
+    """) == ["LC002"]
+
+
+def test_lc002_module_global_lock():
+    assert rules_of("""
+        import threading, time
+
+        _REG_LOCK = threading.Lock()
+
+        def register(x):
+            with _REG_LOCK:
+                time.sleep(0.1)
+    """) == ["LC002"]
+
+
+def test_lc002_bounded_ops_outside_lock_are_fine():
+    assert rules_of("""
+        import threading, time
+
+        class R:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def refresh(self):
+                time.sleep(0.5)
+                with self._lock:
+                    x = 1
+                return x
+    """) == []
+
+
+def test_lc002_timeout_queue_ops_under_lock_are_fine():
+    # bounded (timeout-carrying) queue ops are not the PR-7 class
+    assert rules_of("""
+        import threading
+
+        class P:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.out_q = None
+
+            def post(self, item):
+                with self._lock:
+                    self.out_q.put(item, timeout=0.1)
+    """) == []
+
+
+def test_lc002_unbounded_queue_put_under_lock():
+    assert rules_of("""
+        import threading
+
+        class P:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.out_q = None
+
+            def post(self, item):
+                with self._lock:
+                    self.out_q.put(item)
+    """) == ["LC002"]
+
+
+def test_lc002_event_wait_under_other_lock():
+    assert rules_of("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._done = threading.Event()
+
+            def await_done(self):
+                with self._lock:
+                    self._done.wait()
+    """) == ["LC002"]
+
+
+# ------------------------------------------------------------- LC003
+
+def test_lc003_wait_under_if():
+    assert rules_of("""
+        import threading
+
+        class M:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._items = []
+
+            def get(self):
+                with self._cond:
+                    if not self._items:
+                        self._cond.wait()
+                    return self._items.pop()
+    """) == ["LC003"]
+
+
+def test_lc003_wait_in_while_is_fine():
+    assert rules_of("""
+        import threading
+
+        class M:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._items = []
+
+            def get(self):
+                with self._cond:
+                    while not self._items:
+                        self._cond.wait()
+                    return self._items.pop()
+    """) == []
+
+
+def test_lc003_wait_for_is_fine():
+    # wait_for builds the predicate loop internally
+    assert rules_of("""
+        import threading
+
+        class M:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._items = []
+
+            def get(self):
+                with self._cond:
+                    self._cond.wait_for(lambda: self._items)
+                    return self._items.pop()
+    """) == []
+
+
+def test_lc003_foreign_condition_by_name_heuristic():
+    # a condition that arrives on another object (the pipeline's
+    # gen.ready_cv shape) is still held to the predicate-loop rule
+    assert rules_of("""
+        class Reader:
+            def pull(self, gen):
+                with gen.ready_cv:
+                    if not gen.ready:
+                        gen.ready_cv.wait(timeout=0.1)
+    """) == ["LC003"]
+
+
+# ------------------------------------------------------------- LC004
+
+def test_lc004_mixed_locked_unlocked_write():
+    assert rules_of("""
+        import threading
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+
+            def add(self, n):
+                with self._lock:
+                    self.total += n
+
+            def reset(self):
+                self.total = 0
+    """) == ["LC004"]
+
+
+def test_lc004_init_writes_do_not_count():
+    assert rules_of("""
+        import threading
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+
+            def add(self, n):
+                with self._lock:
+                    self.total += n
+    """) == []
+
+
+def test_lc004_locked_helper_suffix_convention():
+    # *_locked helpers run under the caller's lock by convention
+    assert rules_of("""
+        import threading
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+
+            def add(self, n):
+                with self._lock:
+                    self._bump_locked(n)
+
+            def _bump_locked(self, n):
+                self.total += n
+    """) == []
+
+
+def test_lc004_helper_called_only_under_lock():
+    # every in-module call site holds the lock -> locked context
+    assert rules_of("""
+        import threading
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+
+            def add(self, n):
+                with self._lock:
+                    self.total += n
+
+            def bulk(self, ns):
+                with self._lock:
+                    self._apply(ns)
+
+            def _apply(self, ns):
+                for n in ns:
+                    self.total += n
+    """) == []
+
+
+# ------------------------------------------------------------- LC005
+
+def test_lc005_stop_without_join():
+    assert rules_of("""
+        import threading
+
+        class P:
+            def __init__(self):
+                self._stop = threading.Event()
+                self._thread = threading.Thread(target=self._run)
+
+            def _run(self):
+                while not self._stop.is_set():
+                    self._stop.wait(0.1)
+
+            def stop(self):
+                self._stop.set()
+    """) == ["LC005"]
+
+
+def test_lc005_no_teardown_path_at_all():
+    findings = lint_source(textwrap.dedent("""
+        import threading
+
+        class P:
+            def __init__(self):
+                self._thread = threading.Thread(target=self._run)
+
+            def _run(self):
+                pass
+    """), "snippet.py")
+    assert [f.rule for f in findings] == ["LC005"]
+    assert "no stop()/drain()/close() path" in findings[0].message
+
+
+def test_lc005_join_on_stop_path_is_fine():
+    assert rules_of("""
+        import threading
+
+        class P:
+            def __init__(self):
+                self._stop = threading.Event()
+                self._thread = threading.Thread(target=self._run)
+
+            def _run(self):
+                while not self._stop.is_set():
+                    self._stop.wait(0.1)
+
+            def stop(self):
+                self._stop.set()
+                self._thread.join()
+    """) == []
+
+
+def test_lc005_join_reached_through_helper():
+    assert rules_of("""
+        import threading
+
+        class P:
+            def __init__(self):
+                self._thread = threading.Thread(target=self._run)
+
+            def _run(self):
+                pass
+
+            def _shutdown(self):
+                self._thread.join()
+
+            def close(self):
+                self._shutdown()
+    """) == []
+
+
+def test_lc005_container_of_workers_joined_by_loop():
+    # the BatchScheduler shape: dict of dispatchers, joined via a local
+    # snapshot list — the alias chain must be followed
+    assert rules_of("""
+        import threading
+
+        class Sched:
+            def __init__(self):
+                self._dispatchers = {}
+
+            def ensure(self, key):
+                worker = threading.Thread(target=self._loop)
+                self._dispatchers[key] = worker
+                worker.start()
+
+            def _loop(self):
+                pass
+
+            def stop(self):
+                workers = list(self._dispatchers.values())
+                for w in workers:
+                    w.join(2.0)
+    """) == []
+
+
+def test_lc005_suppression_with_reason_for_abandonable_thread():
+    assert rules_of("""
+        import threading
+
+        class P:
+            def __init__(self):
+                self._thread = threading.Thread(target=self._run, daemon=True)  # lockcheck: disable=LC005 -- abandonable by design: bounded step worker, see straggler policy
+
+            def _run(self):
+                pass
+
+            def stop(self):
+                pass
+    """) == []
+
+
+# ------------------------------------------------------------- LC006
+
+def test_lc006_notify_outside_lock():
+    assert rules_of("""
+        import threading
+
+        class G:
+            def __init__(self):
+                self._cond = threading.Condition()
+
+            def signal(self):
+                self._cond.notify_all()
+    """) == ["LC006"]
+
+
+def test_lc006_notify_under_lock_is_fine():
+    assert rules_of("""
+        import threading
+
+        class G:
+            def __init__(self):
+                self._cond = threading.Condition()
+
+            def signal(self):
+                with self._cond:
+                    self._cond.notify_all()
+    """) == []
+
+
+# ------------------------------------------- suppressions / meta rules
+
+def test_lc000_reasonless_suppression():
+    assert rules_of("""
+        import threading, time
+
+        class R:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def refresh(self):
+                with self._lock:
+                    time.sleep(1.0)  # lockcheck: disable=LC002
+    """) == ["LC000"]
+
+
+def test_lc007_stale_suppression():
+    assert rules_of("""
+        import threading
+
+        class R:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def refresh(self):
+                with self._lock:
+                    pass  # lockcheck: disable=LC002 -- the sleep moved out
+    """) == ["LC007"]
+
+
+def test_live_suppression_is_silent():
+    assert rules_of("""
+        import threading, time
+
+        class R:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def refresh(self):
+                with self._lock:
+                    time.sleep(1.0)  # lockcheck: disable=LC002 -- bounded nap under a private lock
+    """) == []
+
+
+def test_jaxlint_suppressions_are_a_different_namespace():
+    # a jaxlint disable comment must not silence a lockcheck finding
+    assert rules_of("""
+        import threading, time
+
+        class R:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def refresh(self):
+                with self._lock:
+                    time.sleep(1.0)  # jaxlint: disable=LC002 -- wrong tool
+    """) == ["LC002"]
+
+
+def test_rule_table_is_complete():
+    assert set(RULES) == {f"LC00{i}" for i in range(8)}
+
+
+# --------------------------------------------------------- repo sweep
+
+def test_repo_tree_is_lockcheck_clean():
+    """The package must stay at zero unsuppressed findings and zero
+    stale suppressions — the acceptance gate future threaded subsystems
+    inherit (run_checks.sh enforces the same via tools/lockcheck.py)."""
+    pkg = Path(__file__).resolve().parents[1] / "deeplearning4j_tpu"
+    findings = lint_paths([str(pkg)])
+    assert findings == [], "\n".join(
+        f"{f.location}: {f.rule} {f.message}" for f in findings)

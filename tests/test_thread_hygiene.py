@@ -150,6 +150,15 @@ def _tiny_batch():
     return DataSet(np.zeros((4, 3), np.float32), np.ones((4, 2), np.float32))
 
 
+def test_streaming_input_pipeline_close_reaps_workers():
+    base = _baseline()
+    pipe = StreamingInputPipeline([lambda: _tiny_batch()],
+                                  num_shards=1, shard_index=0)
+    assert pipe.has_next() and pipe.next() is not None  # spin the pool up
+    pipe.close()
+    _assert_settled(base)
+
+
 def test_async_iterator_close_releases_parked_producer():
     """The producer may be PARKED on a full queue when close() arrives;
     close() must drain it loose and join — not leave it blocked on

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""lockcheck CLI: AST concurrency analysis for the threaded host stack.
+
+Usage:
+    python tools/lockcheck.py <file-or-dir> [...]   # analyze (default: package)
+    python tools/lockcheck.py --list-rules          # print the rule table
+    python tools/lockcheck.py --self-check          # fixture gate (CI)
+
+``--self-check`` analyzes one bad/good fixture pair per rule: the bad
+snippet must fire exactly its rule, the good twin must be clean — the
+same fixture-gate shape as jaxlint's and graphcheck's. Run by
+tools/run_checks.sh.
+
+Exit status: 0 when no findings survive suppression, 1 otherwise.
+Suppress a finding inline with ``# lockcheck: disable=<RULE> -- <reason>``
+(the reason is mandatory — reasonless suppressions are LC000 findings,
+and suppressions that stop silencing anything are LC007 findings).
+
+No imports of the analyzed code, no execution: safe to run anywhere,
+fast enough for a pre-commit hook. Wired into tools/run_checks.sh as
+the fourth analyzer stage (after graphcheck, jaxlint, shardcheck).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.analysis.findings import format_findings  # noqa: E402
+from deeplearning4j_tpu.analysis.lockcheck import (  # noqa: E402
+    RULES, RULE_SEVERITY, lint_paths, lint_source,
+)
+
+
+def self_check() -> int:
+    """Every rule's bad fixture fires exactly that rule; every good
+    twin is clean. Nonzero exit on any drift. Fixtures live in
+    ``analysis/fixtures.py`` (``LC_FIXTURES``) next to the graphcheck,
+    jaxlint and shardcheck families, under the same coverage
+    meta-test."""
+    from deeplearning4j_tpu.analysis.fixtures import LC_FIXTURES
+    failures = []
+    for rule, (bad, good) in sorted(LC_FIXTURES.items()):
+        got = [f.rule for f in lint_source(bad, f"<{rule}-bad>")]
+        if got != [rule]:
+            failures.append(f"{rule}: bad fixture fired {got or 'nothing'}, "
+                            f"expected [{rule}]")
+        got = [f.rule for f in lint_source(good, f"<{rule}-good>")]
+        if got:
+            failures.append(f"{rule}: good fixture fired {got}")
+    missing = set(RULES) - set(LC_FIXTURES) - {"LC000"}  # LC000 = meta rule
+    if missing:
+        failures.append(f"rules without fixtures: {sorted(missing)}")
+    if failures:
+        print("lockcheck --self-check FAILED:")
+        for f in failures:
+            print("  " + f)
+        return 1
+    print(f"lockcheck --self-check: {len(LC_FIXTURES)} rule fixtures OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to analyze "
+                         "(default: deeplearning4j_tpu)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    ap.add_argument("--self-check", action="store_true",
+                    help="analyze the built-in per-rule fixtures and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, (slug, desc) in sorted(RULES.items()):
+            print(f"{rule}  {slug:<22} {RULE_SEVERITY[rule]:<8} {desc}")
+        return 0
+    if args.self_check:
+        return self_check()
+
+    paths = args.paths or [os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "deeplearning4j_tpu")]
+    findings = lint_paths(paths)
+    if findings:
+        print(format_findings(findings, header="lockcheck findings:"))
+        return 1
+    print("lockcheck: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
