@@ -1,0 +1,89 @@
+"""Umbrella analyzer CLI (tools/analyze.py): the four layers are
+registered, the unified exit-code lattice holds (self-check failure =
+2 outranks findings = 1 outranks clean = 0), and the real lockcheck
+layer runs clean end to end through it."""
+
+import importlib.util
+import json
+import subprocess
+import sys
+import types
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "analyze_cli", REPO / "tools" / "analyze.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_all_four_layers_registered():
+    mod = _load()
+    assert sorted(mod.LAYERS) == ["graphcheck", "jaxlint", "lockcheck",
+                                  "shardcheck"]
+    # the two source layers sweep the tree AND self-check; the config
+    # and compiled-program layers self-check only
+    for layer in ("jaxlint", "lockcheck"):
+        assert [s for s, _ in mod.LAYERS[layer]] == ["sweep", "self-check"]
+    for layer in ("graphcheck", "shardcheck"):
+        assert [s for s, _ in mod.LAYERS[layer]] == ["self-check"]
+
+
+def _fake_run(rc_by_script):
+    def run(argv, **kw):
+        script = Path(argv[1]).name
+        return types.SimpleNamespace(returncode=rc_by_script.get(script, 0),
+                                     stdout="", stderr="")
+    return run
+
+
+def test_exit_code_lattice(monkeypatch, capsys):
+    mod = _load()
+    # all clean -> 0
+    monkeypatch.setattr(mod.subprocess, "run", _fake_run({}))
+    assert mod.main([]) == 0
+    # sweep findings -> 1
+    monkeypatch.setattr(mod.subprocess, "run",
+                        _fake_run({"lockcheck.py": 1}))
+    assert mod.main(["--layer", "lockcheck"]) == 2  # self-check shares rc
+    # sweep-only failure (self-check passes) -> 1: fake per-step rcs
+    calls = []
+
+    def run(argv, **kw):
+        calls.append(argv)
+        rc = 1 if "--self-check" not in argv else 0
+        return types.SimpleNamespace(returncode=rc, stdout="", stderr="")
+    monkeypatch.setattr(mod.subprocess, "run", run)
+    assert mod.main(["--layer", "lockcheck"]) == 1
+    # broken self-check outranks findings -> 2 even when a sweep also fired
+    def run2(argv, **kw):
+        return types.SimpleNamespace(returncode=1, stdout="", stderr="")
+    monkeypatch.setattr(mod.subprocess, "run", run2)
+    assert mod.main(["--layer", "jaxlint"]) == 2
+    capsys.readouterr()
+
+
+def test_json_report_shape(monkeypatch, capsys):
+    mod = _load()
+    monkeypatch.setattr(mod.subprocess, "run", _fake_run({}))
+    assert mod.main(["--layer", "lockcheck", "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["verdict"] == "clean"
+    assert report["exit_code"] == 0
+    assert report["layers"] == ["lockcheck"]
+    assert [s["step"] for s in report["steps"]] == ["sweep", "self-check"]
+
+
+def test_lockcheck_layer_clean_end_to_end():
+    """The real thing: the repo passes its own concurrency gate through
+    the umbrella CLI (the exact invocation run_checks.sh stages use)."""
+    proc = subprocess.run(
+        [sys.executable, "tools/analyze.py", "--layer", "lockcheck"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "lockcheck: clean" in proc.stdout
+    assert "7 rule fixtures OK" in proc.stdout

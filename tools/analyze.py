@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""analyze: umbrella CLI over the four static-analysis layers.
+
+Usage:
+    python tools/analyze.py                      # all four layers
+    python tools/analyze.py --layer lockcheck    # one layer (repeatable)
+    python tools/analyze.py --json               # machine-readable report
+    python tools/analyze.py --list-layers
+
+The four layers, in dependency order of what they look at:
+
+    graphcheck  model CONFIGS     (pre-build)    self-check only
+    jaxlint     SOURCE, traced    (AST)          tree sweep + self-check
+    lockcheck   SOURCE, threaded  (AST)          tree sweep + self-check
+    shardcheck  COMPILED programs (HLO)          self-check only
+
+Each layer runs through its own CLI (tools/<layer>.py) in a
+subprocess, so per-tool environment setup (JAX_PLATFORMS, XLA_FLAGS
+host-device count) keeps working unchanged and a crash in one layer
+cannot take the others down.
+
+Unified exit codes:
+    0  every selected layer clean
+    1  findings survived suppression in at least one tree sweep
+    2  a self-check failed or a layer crashed (the ANALYZER is broken —
+       worse than findings: nothing it said this run can be trusted)
+
+``tools/run_checks.sh`` drives its analyzer stages through this CLI;
+``--json`` prints one report object (per-layer steps with rc + output)
+for dashboards and CI annotations.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "deeplearning4j_tpu")
+
+# layer -> [(step name, argv builder taking the sweep paths)]
+# sweep steps exit 1 on findings (-> unified 1); self-check steps exit
+# nonzero only when the analyzer itself is broken (-> unified 2)
+LAYERS = {
+    "graphcheck": [
+        ("self-check", lambda paths: ["tools/graphcheck.py", "--self-check"]),
+    ],
+    "jaxlint": [
+        ("sweep", lambda paths: ["tools/jaxlint.py"] + paths),
+        ("self-check", lambda paths: ["tools/jaxlint.py", "--self-check"]),
+    ],
+    "lockcheck": [
+        ("sweep", lambda paths: ["tools/lockcheck.py"] + paths),
+        ("self-check", lambda paths: ["tools/lockcheck.py", "--self-check"]),
+    ],
+    "shardcheck": [
+        ("self-check", lambda paths: ["tools/shardcheck.py", "--self-check"]),
+    ],
+}
+
+
+def run_layer(layer, paths, as_json):
+    """Run one layer's steps; returns (unified rc, step records)."""
+    rc = 0
+    steps = []
+    for step, build in LAYERS[layer]:
+        argv = [sys.executable] + build(paths)
+        proc = subprocess.run(argv, cwd=REPO, capture_output=True, text=True)
+        out = (proc.stdout + proc.stderr).rstrip()
+        steps.append({"layer": layer, "step": step, "rc": proc.returncode,
+                      "output": out})
+        if not as_json:
+            print(f"-- {layer} {step} --")
+            if out:
+                print(out)
+        if proc.returncode != 0:
+            # a broken self-check outranks findings everywhere
+            rc = max(rc, 2 if step == "self-check" else 1)
+    return rc, steps
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories for the source sweeps "
+                         "(default: deeplearning4j_tpu)")
+    ap.add_argument("--layer", action="append", choices=sorted(LAYERS),
+                    help="run only this layer (repeatable; default: all)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print one JSON report object instead of text")
+    ap.add_argument("--list-layers", action="store_true",
+                    help="print the layer table and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_layers:
+        for layer, steps in sorted(LAYERS.items()):
+            print(f"{layer:<12} {', '.join(step for step, _ in steps)}")
+        return 0
+
+    layers = args.layer or sorted(LAYERS)
+    paths = args.paths or [PKG]
+    rc = 0
+    records = []
+    for layer in layers:
+        layer_rc, steps = run_layer(layer, paths, args.as_json)
+        rc = max(rc, layer_rc)
+        records.extend(steps)
+
+    verdict = {0: "clean", 1: "findings", 2: "self-check-failure"}[rc]
+    if args.as_json:
+        print(json.dumps({"verdict": verdict, "exit_code": rc,
+                          "layers": layers, "steps": records}, indent=2))
+    else:
+        print(f"analyze: {verdict}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -110,17 +110,22 @@ tier1() {
     return "$rc"
 }
 
-stage "jaxlint (tree)"          python tools/jaxlint.py deeplearning4j_tpu
-stage "jaxlint --self-check"    python tools/jaxlint.py --self-check
-stage "graphcheck --self-check" env JAX_PLATFORMS=cpu \
-    python tools/graphcheck.py --self-check
+# the static-analysis layers route through the umbrella CLI
+# (tools/analyze.py): per-layer sweep + self-check, unified exit codes
+# (1 = findings, 2 = the analyzer itself is broken)
+stage "analyze: jaxlint (sweep + self-check)" \
+    python tools/analyze.py --layer jaxlint
+stage "analyze: lockcheck (sweep + self-check)" \
+    python tools/analyze.py --layer lockcheck
+stage "analyze: graphcheck (self-check)" env JAX_PLATFORMS=cpu \
+    python tools/analyze.py --layer graphcheck
 
 if [ "${1:-}" != "--fast" ]; then
     # shardcheck FIRST: the compiled-program contracts (reduce-scatter
     # layout, ga-scan anchor, bf16 boundary, fp32 identity, donation)
     # fail in seconds here instead of minutes in the bitwise smokes
-    stage "shardcheck --self-check" env JAX_PLATFORMS=cpu \
-        python tools/shardcheck.py --self-check
+    stage "analyze: shardcheck (self-check)" env JAX_PLATFORMS=cpu \
+        python tools/analyze.py --layer shardcheck
     stage "shardcheck --contracts"  env JAX_PLATFORMS=cpu \
         python tools/shardcheck.py --contracts
 
