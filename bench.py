@@ -64,9 +64,13 @@ import numpy as np
 
 # stdlib-only imports (no jax at module load): the process-global span
 # tracer every rung emits into (failure/timeout records carry its open-
-# span stack — the diagnosis r01-r05's dead rounds never had) and the
-# single peak-FLOPs table both MFU fields are computed against.
-from deeplearning4j_tpu.profiling import get_tracer, peak_flops
+# span stack — the diagnosis r01-r05's dead rounds never had), the
+# flight recorder + stall watchdog (ISSUE 17: a dead tunnel or wedged
+# rung leaves a diagnostic bundle on disk, not silence), and the single
+# peak-FLOPs table both MFU fields are computed against.
+from deeplearning4j_tpu.profiling import (StallWatchdog, get_flightrec,
+                                          get_tracer, peak_flops)
+from deeplearning4j_tpu.profiling.flightrec import record as flight_record
 
 # First-EVER recorded value per metric — the fixed vs_baseline
 # denominator. Do NOT update on later improvements (that would hide the
@@ -226,23 +230,29 @@ def _tuned_precision_fields(tuned) -> dict:
             "params_dtype": pol.params_dtype}
 
 
-def _failure_record(metric: str, detail: str, open_spans, kind: str
-                    ) -> dict:
+def _failure_record(metric: str, detail: str, open_spans, kind: str,
+                    bundle_path: str = None) -> dict:
     """A rung failure as a first-class JSON record: value 0, marked
     ``failed`` (the supervisor's headline selection skips it), the
-    open/error span stack naming the phase that hung or raised, and the
-    resilience counters (retries/rollbacks/skipped batches/injected
-    faults — plus the ``elastic_*`` family: resizes, elections,
-    scale-ups, fences, barrier timeouts) so the record carries the
-    run's fault history next to its diagnosis."""
+    open/error span stack naming the phase that hung or raised, the
+    flight-recorder tail (the last structured events every subsystem
+    emitted before the failure), and the resilience counters
+    (retries/rollbacks/skipped batches/injected faults — plus the
+    ``elastic_*`` family: resizes, elections, scale-ups, fences,
+    barrier timeouts) so the record carries the run's fault history
+    next to its diagnosis. ``bundle_path`` names the on-disk
+    diagnostic bundle when the stall watchdog wrote one."""
     from deeplearning4j_tpu.profiling.metrics import get_registry
     reg = get_registry()
+    err = {"kind": kind, "detail": detail,
+           "open_spans": list(open_spans),
+           "flight_tail": get_flightrec().tail(32),
+           "resilience": {**reg.snapshot("resilience_"),
+                          **reg.snapshot("elastic_")}}
+    if bundle_path:
+        err["bundle"] = bundle_path
     return {"metric": metric, "value": 0.0, "unit": "samples/sec/chip",
-            "vs_baseline": 0.0, "failed": True,
-            "error": {"kind": kind, "detail": detail,
-                      "open_spans": list(open_spans),
-                      "resilience": {**reg.snapshot("resilience_"),
-                                     **reg.snapshot("elastic_")}}}
+            "vs_baseline": 0.0, "failed": True, "error": err}
 
 
 class _RungWatchdog:
@@ -254,25 +264,36 @@ class _RungWatchdog:
     arrives diagnosed instead of silent. ``wall_s <= 0`` disables."""
 
     def __init__(self, metric: str, wall_s: float, tracer,
-                 emit=None):
+                 emit=None, stall_watchdog=None):
         self.metric = metric
         self.wall_s = wall_s
         self.tracer = tracer
         self.emit = emit or (lambda line: print(line, flush=True))
+        self.stall_watchdog = stall_watchdog
         self.fired = False
         self._timer = None
 
     def _fire(self):
         self.fired = True
         spans = self.tracer.open_span_stack()
+        bundle_path = None
+        if self.stall_watchdog is not None:
+            # full black box on disk: thread stacks, per-thread open
+            # spans, heartbeat ages, metrics, flight tail
+            try:
+                bundle_path = self.stall_watchdog.dump(
+                    reason=f"rung_timeout_{self.metric}")
+            except Exception:  # noqa: BLE001 — diagnosis must not kill
+                pass
         rec = _failure_record(
             self.metric,
             f"rung exceeded {self.wall_s:.0f}s (BENCH_RUNG_WALL); "
             "still running — open spans name the phase in flight",
-            spans, kind="timeout")
+            spans, kind="timeout", bundle_path=bundle_path)
         self.emit(json.dumps(rec))
         _stamp(f"RUNG WATCHDOG: {self.metric} over budget; open spans: "
-               f"{' > '.join(spans) or '(none)'}")
+               f"{' > '.join(spans) or '(none)'}"
+               + (f"; bundle -> {bundle_path}" if bundle_path else ""))
 
     def __enter__(self):
         if self.wall_s > 0:
@@ -285,6 +306,17 @@ class _RungWatchdog:
         if self._timer is not None:
             self._timer.cancel()
         return False
+
+
+def _make_stall_watchdog(exit_dump: bool) -> StallWatchdog:
+    """The run's stall watchdog: bundles land in BENCH_BUNDLE_DIR
+    (default ``bench_bundles/`` next to this file) so a wedged round
+    leaves its black box in a predictable place. ``exit_dump`` arms the
+    SIGTERM/atexit path (supervisor + child: an external kill still
+    writes a bundle when the signal is catchable)."""
+    bundle_dir = os.environ.get("BENCH_BUNDLE_DIR") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_bundles")
+    return StallWatchdog(bundle_dir, interval_s=5.0, exit_dump=exit_dump)
 
 
 # ---------------------------------------------------------------------------
@@ -1378,12 +1410,21 @@ def _run_child() -> int:
     banked = []
     tracer = get_tracer()
     rung_wall = float(os.environ.get("BENCH_RUNG_WALL", "600"))
+    # the child's stall watchdog: per-rung timeouts dump a full bundle
+    # through it, subsystem heartbeats (elastic step, input wait, decode
+    # loop) are monitored against the rung wall, and a catchable
+    # external kill still leaves a black box (exit_dump)
+    stall_wd = _make_stall_watchdog(exit_dump=True)
     for rung in rungs:
         metric = f"{rung}_samples_per_sec_per_chip"  # fallback name
         try:
             metric = _rung_config(rung, smoke)["metric"] + (
                 "" if on_accel and not smoke else "_SMOKE")
-            with _RungWatchdog(metric, rung_wall, tracer), \
+            stall_wd.watch("bench_rung", deadline_s=rung_wall)
+            flight_record("bench", "rung_started", rung=rung,
+                          metric=metric)
+            with _RungWatchdog(metric, rung_wall, tracer,
+                               stall_watchdog=stall_wd), \
                     tracer.span(f"rung:{rung}"):
                 if rung == "serve":
                     rec = _run_serve_rung(jax, smoke, on_accel,
@@ -1415,6 +1456,8 @@ def _run_child() -> int:
             print(json.dumps(_failure_record(
                 metric, tb.strip().splitlines()[-1][:300], spans,
                 kind="exception")), flush=True)
+    stall_wd.unwatch("bench_rung")
+    stall_wd.close()
     _stamp(f"ladder done: {len(banked)}/{len(rungs)} rungs banked")
     trace_path = os.environ.get("BENCH_TRACE")
     if trace_path:
@@ -1505,6 +1548,19 @@ def _launch_child(timeout_s: float):
 
 def _supervise() -> int:
     wall = float(os.environ.get("BENCH_WALL", "1350"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+    # the supervisor's stall watchdog: armed around the backend probe
+    # and the ladder child; SIGTERM/atexit dump so an external kill of
+    # the ROUND still leaves a black box
+    stall_wd = _make_stall_watchdog(exit_dump=True)
+    try:
+        return _supervise_inner(wall, probe_timeout, stall_wd)
+    finally:
+        stall_wd.close()
+
+
+def _supervise_inner(wall: float, probe_timeout: float,
+                     stall_wd) -> int:
     # Probe loop before spending the budget on a ladder child: always at
     # least ONE probe (do-while shape — a short BENCH_WALL must diagnose
     # the tunnel, not report a misleading 0-probe "hang"), then keep
@@ -1516,7 +1572,7 @@ def _supervise() -> int:
     while not probe_ok and (
             tries == 0 or wall - (time.perf_counter() - T0) > 560.0):
         tries += 1
-        probe_ok = _probe_backend(150.0)
+        probe_ok = _probe_backend(probe_timeout, watchdog=stall_wd)
         if not probe_ok and wall - (time.perf_counter() - T0) > 560.0:
             _stamp("waiting 30s before re-probing the tunnel")
             time.sleep(30.0)
@@ -1526,9 +1582,14 @@ def _supervise() -> int:
             "value": 0.0,
             "unit": "samples/sec/chip",
             "vs_baseline": 0.0,
-            "error": {"detail": f"TPU tunnel unreachable: jax.devices() "
+            "failed": True,
+            "error": {"kind": "backend_unreachable",
+                      "detail": f"TPU tunnel unreachable: jax.devices() "
                                 f"hung in {tries} fresh probe process(es) "
-                                "(150s each); ladder not attempted"},
+                                f"({probe_timeout:.0f}s each); ladder "
+                                "not attempted",
+                      "bundle": stall_wd.last_bundle_path,
+                      "flight_tail": get_flightrec().tail(32)},
         }), flush=True)
         return 1
     recs, note = _launch_child(wall - (time.perf_counter() - T0) - 20.0)
@@ -1583,30 +1644,69 @@ def _supervise() -> int:
     return 1
 
 
-def _probe_backend(timeout_s: float) -> bool:
-    """Fresh-process ``jax.devices()`` probe. The axon tunnel's failure
-    mode (observed r01-r03) is an indefinite hang that is TUNNEL-wide,
-    not per-process — so a cheap probe with its own small timeout decides
-    whether to commit the whole budget to a ladder child."""
+def _probe_backend(timeout_s: float, watchdog=None) -> bool:
+    """Fresh-process ``jax.devices()`` probe under a HARD deadline. The
+    axon tunnel's failure mode (observed r01-r05) is an indefinite hang
+    that is TUNNEL-wide, not per-process — so a cheap probe with its own
+    small timeout decides whether to commit the whole budget to a
+    ladder child. A hung probe records a structured
+    ``backend_unreachable`` failure record (open-span stack +
+    flight-recorder tail) and, when a stall watchdog is armed, dumps
+    the full diagnostic bundle to disk — never a silent timeout.
+
+    ``BENCH_PROBE_HANG_S`` makes the probe child sleep before touching
+    the backend: the deliberately-wedged-tunnel simulation the
+    acceptance test drives."""
     # mirror _acquire_backend's CPU override: sitecustomize pins
     # jax_platforms to the tunnel, so the env var alone is not enough
-    code = ("import os, jax\n"
+    hang_s = float(os.environ.get("BENCH_PROBE_HANG_S", "0") or 0.0)
+    code = ("import os, time\n"
+            "hang = float(os.environ.get('BENCH_PROBE_HANG_S', '0') or 0)\n"
+            "if hang > 0:\n"
+            "    time.sleep(hang)  # simulated dead tunnel\n"
+            "import jax\n"
             "if os.environ.get('JAX_PLATFORMS', '') == 'cpu':\n"
             "    jax.config.update('jax_platforms', 'cpu')\n"
             "d = jax.devices()\n"
             "print('PROBE_OK', len(d), d[0].platform)")
-    try:
-        proc = subprocess.run([sys.executable, "-c", code],
-                              stdout=subprocess.PIPE,
-                              stderr=subprocess.DEVNULL,
-                              text=True, timeout=timeout_s)
-        ok = "PROBE_OK" in (proc.stdout or "")
-        _stamp(f"backend probe: {(proc.stdout or '').strip() or 'failed'}")
-        return ok
-    except subprocess.TimeoutExpired:
-        _stamp(f"backend probe HUNG at {timeout_s:.0f}s (tunnel-wide "
-               "outage — a ladder child launched now would hang too)")
-        return False
+    tracer = get_tracer()
+    flight_record("bench", "probe_started", timeout_s=timeout_s,
+                  simulated_hang_s=hang_s)
+    with tracer.span("bench:probe_backend", timeout_s=timeout_s):
+        try:
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.DEVNULL,
+                                  text=True, timeout=timeout_s)
+            ok = "PROBE_OK" in (proc.stdout or "")
+            _stamp(f"backend probe: "
+                   f"{(proc.stdout or '').strip() or 'failed'}")
+            flight_record("bench", "probe_finished", ok=ok)
+            return ok
+        except subprocess.TimeoutExpired:
+            # the r03-r05 fix: the dead tunnel is now a STRUCTURED
+            # diagnosis. The record is emitted INSIDE the probe span so
+            # its open-span stack names bench:probe_backend.
+            flight_record("bench", "backend_unreachable",
+                          timeout_s=timeout_s)
+            bundle_path = None
+            if watchdog is not None:
+                try:
+                    bundle_path = watchdog.dump(
+                        reason="backend_unreachable")
+                except Exception:  # noqa: BLE001 — diagnosis only
+                    pass
+            print(json.dumps(_failure_record(
+                "backend_probe",
+                f"TPU tunnel unreachable: jax.devices() hung past the "
+                f"{timeout_s:.0f}s probe deadline",
+                tracer.open_span_stack(), kind="backend_unreachable",
+                bundle_path=bundle_path)), flush=True)
+            _stamp(f"backend probe HUNG at {timeout_s:.0f}s (tunnel-wide "
+                   "outage — a ladder child launched now would hang too)"
+                   + (f"; bundle -> {bundle_path}" if bundle_path
+                      else ""))
+            return False
 
 
 def main() -> int:

@@ -1258,4 +1258,32 @@ class Napper:
         with self._lock:
             time.sleep(0.01)  # lockcheck: disable=LC002 -- demo: bounded nap under a private lock
 """),
+    # close() forgets the armed one-shot Timer: it fires after teardown
+    "LC008": ("""\
+import threading
+
+class Debounce:
+    def __init__(self):
+        self._timer = threading.Timer(5.0, self._fire)
+        self._timer.start()
+
+    def _fire(self):
+        pass
+
+    def close(self):
+        self._fire()
+""", """\
+import threading
+
+class Debounce:
+    def __init__(self):
+        self._timer = threading.Timer(5.0, self._fire)
+        self._timer.start()
+
+    def _fire(self):
+        pass
+
+    def close(self):
+        self._timer.cancel()
+"""),
 }

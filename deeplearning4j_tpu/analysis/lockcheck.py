@@ -42,6 +42,11 @@ Rules (stable ids):
 - LC006 notify-outside-lock (error)  ``notify()``/``notify_all()`` on a
         Condition that is not held at the call site — RuntimeError at
         runtime, or a lost wakeup if the condition is re-derived.
+- LC008 timer-not-cancelled (error)  a ``threading.Timer`` stored on an
+        object is never ``cancel()``ed (or ``join()``ed) on the class's
+        teardown path — the armed timer fires after the object is
+        logically dead (LC005's one-shot sibling; Timer subclasses
+        Thread but the idiomatic teardown verb is ``cancel``).
 
 Meta rules: LC000 (warning) reasonless suppression; LC007 (warning)
 stale suppression — a ``# lockcheck: disable=<rule>`` comment that
@@ -101,6 +106,9 @@ RULES: Dict[str, Tuple[str, str]] = {
     "LC007": ("stale-suppression",
               "suppression comment that suppresses nothing on its line "
               "(rots silently and would swallow future findings)"),
+    "LC008": ("timer-not-cancelled",
+              "threading.Timer stored on an object but never cancelled "
+              "(or joined) on its stop()/drain()/close() path"),
 }
 
 RULE_SEVERITY = {
@@ -112,6 +120,7 @@ RULE_SEVERITY = {
     "LC005": Severity.ERROR,
     "LC006": Severity.ERROR,
     "LC007": Severity.WARNING,
+    "LC008": Severity.ERROR,
 }
 
 _SUPPRESS_RE = make_suppress_re("lockcheck")
@@ -120,6 +129,7 @@ _LOCK_CTORS = {"threading.Lock", "Lock"}
 _RLOCK_CTORS = {"threading.RLock", "RLock"}
 _COND_CTORS = {"threading.Condition", "Condition"}
 _THREAD_CTORS = {"threading.Thread", "Thread"}
+_TIMER_CTORS = {"threading.Timer", "Timer"}
 
 # naming heuristic for locks that arrive via parameters, tuple unpacks,
 # or foreign objects (gen.ready_cv, sched._cond): the last path segment
@@ -172,6 +182,11 @@ def _ctor_kind(value: ast.AST) -> Optional[str]:
 
 def _is_thread_expr(value: ast.AST) -> bool:
     return any(isinstance(n, ast.Call) and dotted(n.func) in _THREAD_CTORS
+               for n in ast.walk(value))
+
+
+def _is_timer_expr(value: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call) and dotted(n.func) in _TIMER_CTORS
                for n in ast.walk(value))
 
 
@@ -240,6 +255,7 @@ class _ClassReg:
     name: str
     lock_attrs: Dict[str, str] = field(default_factory=dict)   # attr->kind
     thread_attrs: Dict[str, int] = field(default_factory=dict)  # attr->line
+    timer_attrs: Dict[str, int] = field(default_factory=dict)   # attr->line
     method_names: Set[str] = field(default_factory=set)
 
 
@@ -260,6 +276,7 @@ class _Func:
     notifies: List[Tuple[_Lock, int, List[_Lock]]] = field(default_factory=list)
     writes: List[Tuple[str, int, bool]] = field(default_factory=list)
     joins: Set[str] = field(default_factory=set)
+    cancels: Set[str] = field(default_factory=set)
 
 
 class _ModuleScan:
@@ -302,8 +319,13 @@ class _ModuleScan:
         # locals bound to a Thread first, so `self._d[k] = worker`
         # and `self._threads.append(t)` resolve
         local_threads: Set[str] = set()
+        local_timers: Set[str] = set()
         for n in ast.walk(fn):
-            if isinstance(n, ast.Assign) and _is_thread_expr(n.value):
+            if isinstance(n, ast.Assign) and _is_timer_expr(n.value):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        local_timers.add(t.id)
+            elif isinstance(n, ast.Assign) and _is_thread_expr(n.value):
                 for t in n.targets:
                     if isinstance(t, ast.Name):
                         local_threads.add(t.id)
@@ -321,13 +343,20 @@ class _ModuleScan:
                             and t.value.id == "self":
                         if kind:
                             reg.lock_attrs[t.attr] = kind
+                        elif _is_timer_expr(value):
+                            reg.timer_attrs.setdefault(t.attr, n.lineno)
                         elif _is_thread_expr(value):
                             reg.thread_attrs.setdefault(t.attr, n.lineno)
                     elif isinstance(t, ast.Subscript) \
                             and isinstance(t.value, ast.Attribute) \
                             and isinstance(t.value.value, ast.Name) \
                             and t.value.value.id == "self":
-                        if _is_thread_expr(value) or (
+                        if _is_timer_expr(value) or (
+                                isinstance(value, ast.Name)
+                                and value.id in local_timers):
+                            reg.timer_attrs.setdefault(t.value.attr,
+                                                       n.lineno)
+                        elif _is_thread_expr(value) or (
                                 isinstance(value, ast.Name)
                                 and value.id in local_threads):
                             reg.thread_attrs.setdefault(t.value.attr,
@@ -339,8 +368,11 @@ class _ModuleScan:
                     and isinstance(n.func.value.value, ast.Name) \
                     and n.func.value.value.id == "self" and n.args:
                 arg = n.args[0]
-                if _is_thread_expr(arg) or (isinstance(arg, ast.Name)
-                                            and arg.id in local_threads):
+                if _is_timer_expr(arg) or (isinstance(arg, ast.Name)
+                                           and arg.id in local_timers):
+                    reg.timer_attrs.setdefault(n.func.value.attr, n.lineno)
+                elif _is_thread_expr(arg) or (isinstance(arg, ast.Name)
+                                              and arg.id in local_threads):
                     reg.thread_attrs.setdefault(n.func.value.attr, n.lineno)
 
     def _iter_defs(self, tree: ast.Module):
@@ -531,10 +563,11 @@ class _FunctionScan:
         for t in targets:
             if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
                     and t.value.id == "self":
-                # lock/thread attributes have their own rules; LC004
-                # watches the data attributes
+                # lock/thread/timer attributes have their own rules;
+                # LC004 watches the data attributes
                 if reg and (t.attr in reg.lock_attrs
-                            or t.attr in reg.thread_attrs):
+                            or t.attr in reg.thread_attrs
+                            or t.attr in reg.timer_attrs):
                     continue
                 self.func.writes.append((t.attr, stmt.lineno, bool(held)))
             elif isinstance(t, (ast.Tuple, ast.List)):
@@ -592,6 +625,14 @@ class _FunctionScan:
                 elif isinstance(target, ast.Name) \
                         and target.id in self.aliases:
                     func.joins |= self.aliases[target.id]
+            if attr == "cancel":
+                if isinstance(target, ast.Attribute) \
+                        and isinstance(target.value, ast.Name) \
+                        and target.value.id == "self":
+                    func.cancels.add(target.attr)
+                elif isinstance(target, ast.Name) \
+                        and target.id in self.aliases:
+                    func.cancels |= self.aliases[target.id]
             if isinstance(target, ast.Name) and target.id == "self":
                 func.calls.append((("self", attr), line, list(held)))
         elif isinstance(node.func, ast.Name):
@@ -678,6 +719,7 @@ class _Analysis:
         self._lc004()
         self._lc005()
         self._lc006()
+        self._lc008()
 
     def _held_names(self, held: List[_Lock]) -> str:
         return ", ".join(h.text for h in held)
@@ -842,29 +884,33 @@ class _Analysis:
                         "take the same lock here, or drop it there and "
                         "document the single-writer contract")
 
+    def _teardown_reach(self, cls: str,
+                        reg: _ClassReg) -> Tuple[List[str], Set[str]]:
+        """Stop roots plus everything they call on self, transitively."""
+        stop_roots = [m for m in reg.method_names if m in _STOP_NAMES]
+        reachable: Set[str] = set()
+        frontier = [f"{cls}.{m}" for m in stop_roots]
+        while frontier:
+            qual = frontier.pop()
+            if qual in reachable or qual not in self.funcs:
+                continue
+            reachable.add(qual)
+            func = self.funcs[qual]
+            for spec, _line, _held in func.calls:
+                callee = self._resolve_call(func, spec)
+                if callee:
+                    frontier.append(callee)
+            # nested defs inside a reachable method count too
+            for q in self.funcs:
+                if q.startswith(qual + "."):
+                    frontier.append(q)
+        return stop_roots, reachable
+
     def _lc005(self) -> None:
         for cls, reg in sorted(self.mod.classes.items()):
             if not reg.thread_attrs:
                 continue
-            stop_roots = [m for m in reg.method_names if m in _STOP_NAMES]
-            # teardown reachability: stop roots plus everything they
-            # call on self, transitively
-            reachable: Set[str] = set()
-            frontier = [f"{cls}.{m}" for m in stop_roots]
-            while frontier:
-                qual = frontier.pop()
-                if qual in reachable or qual not in self.funcs:
-                    continue
-                reachable.add(qual)
-                func = self.funcs[qual]
-                for spec, _line, _held in func.calls:
-                    callee = self._resolve_call(func, spec)
-                    if callee:
-                        frontier.append(callee)
-                # nested defs inside a reachable method count too
-                for q in self.funcs:
-                    if q.startswith(qual + "."):
-                        frontier.append(q)
+            stop_roots, reachable = self._teardown_reach(cls, reg)
             joined: Set[str] = set()
             for qual in reachable:
                 joined |= self.funcs[qual].joins
@@ -889,6 +935,37 @@ class _Analysis:
                         "interpreter shutdown and test isolation)",
                         "signal the thread to exit, then join() it on "
                         "the teardown path")
+
+    def _lc008(self) -> None:
+        for cls, reg in sorted(self.mod.classes.items()):
+            if not reg.timer_attrs:
+                continue
+            stop_roots, reachable = self._teardown_reach(cls, reg)
+            cancelled: Set[str] = set()
+            for qual in reachable:
+                cancelled |= self.funcs[qual].cancels
+                cancelled |= self.funcs[qual].joins
+            for attr, line in sorted(reg.timer_attrs.items(),
+                                     key=lambda kv: kv[1]):
+                if attr in cancelled:
+                    continue
+                if not stop_roots:
+                    self.ctx.emit(
+                        "LC008", _at(line),
+                        f"{cls} arms a threading.Timer on self.{attr} "
+                        "but has no stop()/drain()/close() path at all "
+                        "— the timer fires after the object is "
+                        "logically dead",
+                        "add a close() that cancel()s the timer")
+                else:
+                    self.ctx.emit(
+                        "LC008", _at(line),
+                        f"{cls}.{'/'.join(sorted(stop_roots))}() never "
+                        f"cancel()s self.{attr} — the armed Timer "
+                        "fires after teardown and races interpreter "
+                        "shutdown",
+                        "cancel() the timer (and join() it if the "
+                        "callback matters) on the teardown path")
 
     def _lc006(self) -> None:
         for func in self.funcs.values():

@@ -91,6 +91,7 @@ from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.datasets.iterator import DataSetIterator
 from deeplearning4j_tpu.profiling.metrics import get_registry
 from deeplearning4j_tpu.profiling.tracer import get_tracer
+from deeplearning4j_tpu.profiling.watchdog import beat as watchdog_beat
 
 __all__ = [
     "StreamingInputPipeline", "IdxPair", "shard_sources", "read_idx",
@@ -828,6 +829,9 @@ class StreamingInputPipeline(DataSetIterator):
         # the stall is measured AND attributed: while the consumer is
         # blocked here the open-span stack names input:wait — a starved
         # trainer diagnoses as input-bound, not as a mystery hang
+        # last beat BEFORE the blocking get(): a starved consumer goes
+        # stale with input:wait as its deepest open span
+        watchdog_beat("input_pipeline")
         with tracer.span("input:wait"):
             stall = faultinject.on_input_next()
             if stall > 0.0:

@@ -66,8 +66,10 @@ from deeplearning4j_tpu.keras.batching import (CompileCache, _LatencyWindow,
                                                next_cache_owner,
                                                priority_insert,
                                                priority_rank)
+from deeplearning4j_tpu.profiling.flightrec import record as flight_record
 from deeplearning4j_tpu.profiling.metrics import get_registry
 from deeplearning4j_tpu.profiling.tracer import get_tracer
+from deeplearning4j_tpu.profiling.watchdog import beat as watchdog_beat
 from deeplearning4j_tpu.resilience import faultinject
 from deeplearning4j_tpu.resilience.sentinel import host_nonfinite
 from deeplearning4j_tpu.resilience.service import (Deadline,
@@ -241,6 +243,8 @@ class _Engine:
         runner = self._compiled("prefill", bucket)
         with self.scheduler._stats_lock:   # traffic mix (prewarm signal)
             self.scheduler._mix[("prefill", bucket)] += 1
+        flight_record("serving", "prefill_dispatch", model=self.key,
+                      bucket=bucket, tokens=L)
         with get_tracer().span("serve:prefill", model=self.key,
                                bucket=bucket, tokens=L):
             with self.lock:
@@ -373,6 +377,8 @@ class _Engine:
                          "re-prefill").inc()
         get_tracer().instant("kv_evicted", model=self.key, row=row,
                              reason=reason)
+        flight_record("serving", "kv_evicted", model=self.key, row=row,
+                      reason=reason)
         self.scheduler._requeue(self.key, victim)
 
     def ring_victim(self) -> Optional[int]:
@@ -416,6 +422,10 @@ class _Engine:
         positions = np.asarray(self.positions, np.int32)
         runner = self._compiled("decode", self.rows)
         tracer = get_tracer()
+        watchdog_beat("serving_decode")
+        flight_record("serving", "decode_dispatch", model=self.key,
+                      rows=self.rows, live=len(live),
+                      iteration=self.iteration)
         with tracer.span("serve:decode", model=self.key, rows=self.rows,
                          live=len(live), iteration=self.iteration):
             try:

@@ -69,8 +69,10 @@ import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+from deeplearning4j_tpu.profiling.flightrec import record as flight_record
 from deeplearning4j_tpu.profiling.metrics import get_registry
 from deeplearning4j_tpu.profiling.tracer import get_tracer
+from deeplearning4j_tpu.profiling.watchdog import beat as watchdog_beat
 from deeplearning4j_tpu.resilience import faultinject
 from deeplearning4j_tpu.resilience.service import (BreakerOpen, Deadline,
                                                    DeadlineExceeded,
@@ -347,6 +349,13 @@ class KerasServer:
             ready, reasons = self._guard.ready()
             return {"ok": True, "live": True, "ready": ready,
                     "reasons": reasons, "draining": self._guard.draining}
+        if op == "debug":
+            # the live diagnostic bundle — like health, never admitted:
+            # the whole point is answering while the server is wedged
+            from deeplearning4j_tpu.profiling.watchdog import \
+                assemble_bundle
+            return {"ok": True, "bundle": json.loads(json.dumps(
+                assemble_bundle(reason="live"), default=repr))}
         if op == "shutdown":
             return {"ok": True, "shutdown": True}
         if op not in ("fit", "predict", "evaluate", "generate"):
@@ -359,6 +368,8 @@ class KerasServer:
         deadline = self._guard.deadline(req)
         t_req = time.perf_counter()
         with self._guard.admit(deadline):
+            watchdog_beat("keras_server")
+            flight_record("keras_server", "dispatch", op=op, model=key)
             with get_tracer().span(f"serve:{op}"):
                 resp = self._serve(op, req, deadline, key)
         if op == "predict" and self._batcher is not None:
@@ -505,6 +516,7 @@ class KerasServer:
         # reaps the acceptor thread itself (bounded for safety)
         self._thread.join(timeout=grace_s)
         unregister_guard(self._guard)
+        flight_record("keras_server", "drained", emptied=drained)
         return drained
 
     def stop(self, grace_s: float = 2.0) -> None:
@@ -538,6 +550,11 @@ class KerasClient:
 
     def health(self) -> dict:
         return self.request(op="health")
+
+    def debug(self) -> dict:
+        """The server's live diagnostic bundle (unadmitted, like
+        health — answers even while the server is wedged)."""
+        return self.request(op="debug")["bundle"]
 
     def fit(self, model: str, features_dir: str, labels_dir: str,
             nb_epoch: int = 1) -> dict:

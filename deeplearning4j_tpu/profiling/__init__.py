@@ -23,9 +23,16 @@ diagnostics) and for the question a TPU port actually asks (where did
   real train step: FLOPs + bytes-accessed per optimization step and an
   **analytic MFU** against a peak-FLOPs table — computable on CPU,
   no chip required (the µ-cuDNN cost-model-before-device-time idea).
+- ``flightrec`` / ``watchdog`` — the black box: a bounded ring of
+  structured events the subsystems emit at their seams, and a
+  heartbeat-fed stall watchdog that turns a hang (or an external kill)
+  into an atomic diagnostic bundle on disk — thread stacks, open
+  spans, metrics snapshot, flight tail. ``tools/postmortem.py`` reads
+  one back.
 
-No jax import at module load: the tracer/metrics legs are pure stdlib
-and must stay importable from the bench supervisor and lint tooling.
+No jax import at module load: the tracer/metrics/flightrec/watchdog
+legs are pure stdlib and must stay importable from the bench
+supervisor and lint tooling.
 """
 
 from deeplearning4j_tpu.profiling.tracer import (  # noqa: F401
@@ -33,6 +40,12 @@ from deeplearning4j_tpu.profiling.tracer import (  # noqa: F401
 )
 from deeplearning4j_tpu.profiling.metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, get_registry, set_registry,
+)
+from deeplearning4j_tpu.profiling.flightrec import (  # noqa: F401
+    FlightRecorder, get_flightrec, set_flightrec,
+)
+from deeplearning4j_tpu.profiling.watchdog import (  # noqa: F401
+    StallWatchdog, assemble_bundle, beat, heartbeat_ages,
 )
 from deeplearning4j_tpu.profiling.watchers import (  # noqa: F401
     CompileWatcher, DeviceMemoryWatermark, device_memory_stats,
@@ -45,6 +58,8 @@ __all__ = [
     "Tracer", "get_tracer", "set_tracer", "span",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "set_registry",
+    "FlightRecorder", "get_flightrec", "set_flightrec",
+    "StallWatchdog", "assemble_bundle", "beat", "heartbeat_ages",
     "CompileWatcher", "DeviceMemoryWatermark", "device_memory_stats",
     "PEAK_FLOPS_PER_CHIP", "analytic_mfu", "peak_flops", "train_step_cost",
 ]

@@ -149,6 +149,33 @@ class Histogram:
             out.append((math.inf, acc + self._counts[-1]))
         return out
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimate the q-quantile from the cumulative buckets — the
+        ``histogram_quantile`` convention: linear interpolation within
+        the bucket the rank falls in (lower bound 0 for the first
+        bucket), clamped to the highest finite edge when the rank lands
+        in the +Inf bucket. None while the histogram is empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1]: {q}")
+        cum = self.cumulative()
+        total = cum[-1][1]
+        if total == 0:
+            return None
+        rank = q * total
+        lo, prev_cum = 0.0, 0
+        for edge, c in cum:
+            if c >= rank and c > prev_cum:
+                if edge == math.inf:
+                    # observations past the last finite edge carry no
+                    # upper bound; report the last finite edge (or the
+                    # lower bound when there are no finite edges)
+                    return self.buckets[-1] if self.buckets else lo
+                return lo + (edge - lo) * ((rank - prev_cum)
+                                           / (c - prev_cum))
+            if edge != math.inf:
+                lo, prev_cum = edge, c
+        return self.buckets[-1] if self.buckets else None
+
     def _render(self) -> List[str]:
         lines = []
         for edge, cum in self.cumulative():
@@ -163,7 +190,8 @@ class Histogram:
     def _json(self):
         return {"buckets": [[e if e != math.inf else "+Inf", c]
                             for e, c in self.cumulative()],
-                "sum": self._sum, "count": self._count}
+                "sum": self._sum, "count": self._count,
+                "p50": self.quantile(0.5), "p99": self.quantile(0.99)}
 
 
 class LabeledCounter:

@@ -114,17 +114,37 @@ class Tracer:
                 stack.remove(handle)
                 if not stack:
                     del self._open[handle.tid]
-            self._append_locked(ev)
+            dropped = self._append_locked(ev)
+        self._count_dropped(dropped)
 
-    def _append_locked(self, ev: dict) -> None:
+    def _append_locked(self, ev: dict) -> int:
         """Bounded append (caller holds the lock): every event source —
-        end/instant/complete — shares the same drop-oldest-half trim."""
+        end/instant/complete — shares the same drop-oldest-half trim.
+        Returns how many events this append evicted so the caller can
+        publish the count AFTER releasing the lock (the registry has its
+        own locks; never nest them under the tracer's)."""
+        dropped = 0
         if len(self._events) >= self.max_events:
             # drop the OLDEST half in one go: per-event pop(0) would
             # make the full-buffer steady state quadratic
             self._events = self._events[self.max_events // 2:]
-            self._dropped += self.max_events - len(self._events)
+            dropped = self.max_events - len(self._events)
+            self._dropped += dropped
         self._events.append(ev)
+        return dropped
+
+    def _count_dropped(self, dropped: int) -> None:
+        """Publish buffer evictions as ``tracer_events_dropped`` so
+        bounded-buffer truncation shows up on the same ``/api/metrics``
+        surface as everything else (lazy import: keep this module free
+        of load-time dependencies)."""
+        if not dropped:
+            return
+        from deeplearning4j_tpu.profiling.metrics import get_registry
+        get_registry().counter(
+            "tracer_events_dropped",
+            help="trace events evicted from the bounded buffer"
+        ).inc(dropped)
 
     def span(self, name: str, **args) -> _SpanCtx:
         """``with tracer.span("shard"):`` — nested spans stack per
@@ -140,7 +160,8 @@ class Tracer:
         if args:
             ev["args"] = dict(args)
         with self._lock:
-            self._append_locked(ev)
+            dropped = self._append_locked(ev)
+        self._count_dropped(dropped)
 
     def complete(self, name: str, t0_us: float, dur_us: float,
                  **args) -> None:
@@ -153,7 +174,8 @@ class Tracer:
         if args:
             ev["args"] = dict(args)
         with self._lock:
-            self._append_locked(ev)
+            dropped = self._append_locked(ev)
+        self._count_dropped(dropped)
 
     def _note_error(self, handle: _SpanHandle, exc: BaseException) -> None:
         """Called by span contexts as an exception unwinds through them
@@ -178,6 +200,16 @@ class Tracer:
         with self._lock:
             live = [h for stack in self._open.values() for h in stack]
         return [h.name for h in sorted(live, key=lambda h: h.t0_us)]
+
+    def open_spans_by_thread(self) -> Dict[int, List[dict]]:
+        """Per-thread in-flight spans, outermost first: tid -> list of
+        ``{name, t0_us, args}``. The diagnostic-bundle form — the stall
+        culprit is the DEEPEST open span of the stale subsystem's
+        thread, which the flat ``open_span_stack`` cannot attribute."""
+        with self._lock:
+            return {tid: [{"name": h.name, "t0_us": h.t0_us,
+                           "args": dict(h.args)} for h in stack]
+                    for tid, stack in self._open.items() if stack}
 
     def event_count(self) -> int:
         with self._lock:

@@ -121,8 +121,10 @@ import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Union
 
+from deeplearning4j_tpu.profiling.flightrec import record as flight_record
 from deeplearning4j_tpu.profiling.metrics import get_registry
 from deeplearning4j_tpu.profiling.tracer import get_tracer
+from deeplearning4j_tpu.profiling.watchdog import beat as watchdog_beat
 from deeplearning4j_tpu.resilience import faultinject
 from deeplearning4j_tpu.resilience.atomic import CheckpointError
 from deeplearning4j_tpu.resilience.faultinject import (FaultInjected,
@@ -250,6 +252,9 @@ def write_lease(directory: Union[str, Path], epoch: int, world: List[int],
         "pending": sorted(int(r) for r in (pending or [])),
         "time": time.time()}))
     os.replace(tmp, path)
+    flight_record("elastic", "lease_written", epoch=int(epoch),
+                  coordinator=int(coordinator),
+                  world=",".join(str(int(r)) for r in sorted(world)))
 
 
 def request_join(directory: Union[str, Path], rank: int) -> Path:
@@ -681,6 +686,12 @@ class ElasticTrainer:
             request_join(self.heartbeat_dir, join_rank)
         self._check_fence(f"step {step_id}")
         self._hb.step = step_id
+        # watchdog liveness: last beat BEFORE the barrier, so a step
+        # wedged in straggle/dispatch/collective goes stale and the
+        # bundle's open spans name the stuck phase
+        watchdog_beat("elastic")
+        flight_record("elastic", "step", step=step_id,
+                      epoch=self.rdv_epoch)
         local = self._local_view(batch)
         box: Dict[str, Any] = {}
         done = threading.Event()
@@ -717,6 +728,8 @@ class ElasticTrainer:
                 self._c_barrier_timeouts.inc()
                 tracer.instant("barrier_timeout", step=step_id,
                                waits=waits)
+                flight_record("elastic", "barrier_timeout", step=step_id,
+                              waits=waits)
                 logger.warning(
                     "step %d barrier timed out (%.0fs, wait %d/%d) with "
                     "all hosts alive — straggler; continuing to wait",
@@ -758,6 +771,8 @@ class ElasticTrainer:
         self._c_fenced.inc()
         get_tracer().instant("elastic_fenced", where=where,
                              stale_s=round(stale, 3))
+        flight_record("elastic", "fenced", where=where,
+                      stale_s=round(stale, 3))
         raise ElasticFenced(
             f"this host's heartbeat has not been written for "
             f"{stale:.1f}s (> {self.heartbeat_timeout_s}s) at {where}: "
@@ -779,6 +794,8 @@ class ElasticTrainer:
         for r in sorted(set(lost.dead)):
             self._c_host_failures.inc()
             tracer.instant("host_failure", rank=r, where=lost.where)
+            flight_record("elastic", "host_failure", rank=r,
+                          where=lost.where)
         self._follow_newer_lease(f"host loss at {lost.where}")
         survivors = [r for r in self._world if r not in lost.dead]
         if self._rank not in survivors:
@@ -789,6 +806,9 @@ class ElasticTrainer:
         self._c_elections.inc()
         tracer.instant("elastic_election", epoch=new_epoch,
                        coordinator=elected, dead=sorted(set(lost.dead)))
+        flight_record("elastic", "election", epoch=new_epoch,
+                      coordinator=elected,
+                      dead=",".join(map(str, sorted(set(lost.dead)))))
         logger.warning(
             "host(s) %s lost at %s; surviving world %s elected rank %d "
             "coordinator at rendezvous epoch %d",
@@ -837,6 +857,8 @@ class ElasticTrainer:
             self._c_fenced.inc()
             get_tracer().instant("elastic_fenced", where=where,
                                  lease_epoch=lease["epoch"])
+            flight_record("elastic", "fenced", where=where,
+                          lease_epoch=lease["epoch"])
             raise ElasticFenced(
                 f"the rendezvous lease moved to epoch {lease['epoch']} "
                 f"(world {lease['world']}) without this rank "
@@ -877,6 +899,9 @@ class ElasticTrainer:
         self._c_scale_ups.inc()
         get_tracer().instant("elastic_scale_up", epoch=new_epoch,
                              joined=pending, world=new_world)
+        flight_record("elastic", "scale_up", epoch=new_epoch,
+                      joined=",".join(map(str, pending)),
+                      world=",".join(map(str, new_world)))
         logger.warning(
             "admitting replacement host(s) %s at epoch boundary: world "
             "%s -> %s, rendezvous epoch %d (restart required to grow "

@@ -136,6 +136,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.profiling.flightrec import record as flight_record
 from deeplearning4j_tpu.profiling.metrics import get_registry
 from deeplearning4j_tpu.profiling.tracer import get_tracer
 
@@ -256,6 +257,7 @@ def _fire(fault: Fault, **args) -> None:
         "resilience_faults_injected_total",
         help="faults injected by the chaos harness").inc()
     get_tracer().instant("fault_injected", kind=fault.kind, **args)
+    flight_record("faultinject", "fired", fault=fault.kind, **args)
 
 
 def check_raise(step: int) -> None:

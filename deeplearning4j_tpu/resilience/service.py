@@ -46,6 +46,7 @@ import time
 import weakref
 from typing import Callable, Dict, List, Optional, Tuple
 
+from deeplearning4j_tpu.profiling.flightrec import record as flight_record
 from deeplearning4j_tpu.profiling.metrics import get_registry
 from deeplearning4j_tpu.profiling.tracer import get_tracer
 
@@ -225,6 +226,8 @@ class CircuitBreaker:
             get_tracer().instant("breaker_transition", key=self.key,
                                  frm=_STATE_NAMES[old],
                                  to=_STATE_NAMES[new])
+            flight_record("service", "breaker_transition", key=self.key,
+                          frm=_STATE_NAMES[old], to=_STATE_NAMES[new])
             _update_breaker_gauge()
 
     def retry_after_ms(self) -> int:
@@ -368,6 +371,8 @@ class ServiceGuard:
             elif self._waiting >= self.queue_depth:
                 self._c("serving_shed_total",
                         "requests shed by admission control").inc()
+                flight_record("service", "shed", guard=self.name,
+                              inflight=self._active, queued=self._waiting)
                 raise ShedError(
                     f"{self.name}: at capacity "
                     f"({self.max_concurrency} in flight, "
@@ -467,6 +472,7 @@ class ServiceGuard:
             self._cond.notify_all()
         self._c("serving_drains_total", "drains initiated").inc()
         get_tracer().instant("drain_started", guard=self.name)
+        flight_record("service", "drain_started", guard=self.name)
 
     def wait_idle(self, grace_s: float = 10.0) -> bool:
         """Block until in-flight work finishes, up to ``grace_s``.

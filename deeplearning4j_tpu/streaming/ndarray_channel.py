@@ -31,6 +31,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.profiling.flightrec import record as flight_record
 from deeplearning4j_tpu.profiling.metrics import get_registry
 
 #: refuse frames claiming more than this many payload bytes (both
@@ -397,6 +398,7 @@ class NDArrayServer:
         arrays up to ``grace_s`` to flush to subscribers, then stop.
         Returns True when every topic emptied inside the grace."""
         self._guard.start_drain()
+        flight_record("streaming", "drain_started", port=self.port)
         t_end = time.monotonic() + max(0.0, grace_s)
         drained = True
         while True:
@@ -427,6 +429,7 @@ class NDArrayServer:
         # reaps the acceptor thread itself (bounded for safety)
         self._thread.join(timeout=5.0)
         service.unregister_guard(self._guard)
+        flight_record("streaming", "stopped", port=self.port)
 
 
 class _ReconnectingEndpoint:

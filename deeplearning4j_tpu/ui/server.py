@@ -392,7 +392,8 @@ _PROBE_PATHS = ("/healthz", "/readyz")
 #: routes exempt from ADMISSION only (auth still applies): the metrics
 #: scrape is the observability channel you need most exactly when
 #: everything else is shedding.
-_UNADMITTED_PATHS = _PROBE_PATHS + ("/api/metrics", "/api/metrics.json")
+_UNADMITTED_PATHS = _PROBE_PATHS + ("/api/metrics", "/api/metrics.json",
+                                    "/api/debug")
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -600,6 +601,14 @@ class _Handler(BaseHTTPRequestHandler):
         elif url.path == "/api/metrics.json":
             from deeplearning4j_tpu.profiling import get_registry
             self._send(200, json.dumps(get_registry().to_dict()).encode())
+        elif url.path == "/api/debug":
+            # the LIVE diagnostic bundle (thread stacks, open spans,
+            # heartbeats, flight tail) — unadmitted, because it answers
+            # the question "why is this server stuck" best while stuck
+            from deeplearning4j_tpu.profiling.watchdog import \
+                assemble_bundle
+            self._send(200, json.dumps(assemble_bundle(reason="live"),
+                                       default=repr).encode())
         else:
             self._send(404, b"{}")
 

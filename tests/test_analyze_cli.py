@@ -21,15 +21,15 @@ def _load():
     return mod
 
 
-def test_all_four_layers_registered():
+def test_all_layers_registered():
     mod = _load()
     assert sorted(mod.LAYERS) == ["graphcheck", "jaxlint", "lockcheck",
-                                  "shardcheck"]
-    # the two source layers sweep the tree AND self-check; the config
-    # and compiled-program layers self-check only
+                                  "postmortem", "shardcheck"]
+    # the two source layers sweep the tree AND self-check; the config,
+    # compiled-program, and runtime-pipeline layers self-check only
     for layer in ("jaxlint", "lockcheck"):
         assert [s for s, _ in mod.LAYERS[layer]] == ["sweep", "self-check"]
-    for layer in ("graphcheck", "shardcheck"):
+    for layer in ("graphcheck", "shardcheck", "postmortem"):
         assert [s for s, _ in mod.LAYERS[layer]] == ["self-check"]
 
 
@@ -86,4 +86,4 @@ def test_lockcheck_layer_clean_end_to_end():
         cwd=REPO, capture_output=True, text=True)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "lockcheck: clean" in proc.stdout
-    assert "7 rule fixtures OK" in proc.stdout
+    assert "8 rule fixtures OK" in proc.stdout

@@ -632,7 +632,85 @@ def test_jaxlint_suppressions_are_a_different_namespace():
 
 
 def test_rule_table_is_complete():
-    assert set(RULES) == {f"LC00{i}" for i in range(8)}
+    assert set(RULES) == {f"LC00{i}" for i in range(9)}
+
+
+# ------------------------------------------------------------- LC008
+
+def test_lc008_timer_never_cancelled():
+    assert rules_of("""
+        import threading
+
+        class Debounce:
+            def __init__(self):
+                self._timer = threading.Timer(5.0, self._flush)
+                self._timer.start()
+
+            def _flush(self):
+                pass
+
+            def close(self):
+                self._flush()
+    """) == ["LC008"]
+
+
+def test_lc008_cancel_on_teardown_is_clean():
+    assert rules_of("""
+        import threading
+
+        class Debounce:
+            def __init__(self):
+                self._timer = threading.Timer(5.0, self._flush)
+                self._timer.start()
+
+            def _flush(self):
+                pass
+
+            def close(self):
+                self._timer.cancel()
+    """) == []
+
+
+def test_lc008_no_teardown_path_at_all():
+    assert rules_of("""
+        import threading
+
+        class FireAndForget:
+            def __init__(self):
+                self._timer = threading.Timer(1.0, print)
+                self._timer.start()
+    """) == ["LC008"]
+
+
+def test_lc008_join_counts_as_cancel():
+    # join() waits the timer out — equally safe teardown
+    assert rules_of("""
+        import threading
+
+        class Waiter:
+            def __init__(self):
+                self._timer = threading.Timer(0.1, print)
+                self._timer.start()
+
+            def close(self):
+                self._timer.join()
+    """) == []
+
+
+def test_lc008_cancel_through_helper_reached_from_stop_root():
+    assert rules_of("""
+        import threading
+
+        class Rearm:
+            def __init__(self):
+                self._timer = threading.Timer(1.0, print)
+
+            def _disarm(self):
+                self._timer.cancel()
+
+            def stop(self):
+                self._disarm()
+    """) == []
 
 
 # --------------------------------------------------------- repo sweep

@@ -1,9 +1,14 @@
 """Profiling subsystem: span tracer (Chrome trace-event schema), metrics
 registry (JSON + Prometheus text), compile watcher, memory watermark,
 compiled-step cost analysis (analytic MFU vs a hand-computed LeNet FLOP
-count), and the bench failure-record/watchdog path."""
+count), the bench failure-record/watchdog path, and the black-box
+diagnostics leg — flight recorder ring, stall watchdog bundles (the
+ISSUE-17 acceptance gates: a wedged trainer step and a hung backend
+probe must both leave a bundle naming the stalled phase), and the
+postmortem reader."""
 
 import json
+import os
 import threading
 import time
 
@@ -11,10 +16,34 @@ import numpy as np
 import pytest
 
 from deeplearning4j_tpu.profiling import (
-    CompileWatcher, Counter, DeviceMemoryWatermark, Gauge, Histogram,
-    MetricsRegistry, Tracer, analytic_mfu, get_registry, get_tracer,
-    peak_flops, set_tracer, train_step_cost,
+    CompileWatcher, Counter, DeviceMemoryWatermark, FlightRecorder, Gauge,
+    Histogram, MetricsRegistry, StallWatchdog, Tracer, analytic_mfu,
+    assemble_bundle, get_flightrec, get_registry, get_tracer, peak_flops,
+    set_flightrec, set_tracer, train_step_cost,
 )
+from deeplearning4j_tpu.profiling import watchdog as watchdog_mod
+from deeplearning4j_tpu.profiling.metrics import set_registry
+from deeplearning4j_tpu.profiling.watchdog import (
+    BUNDLE_FORMAT, beat, clear_beats, heartbeat_ages,
+)
+
+
+@pytest.fixture
+def fresh_diag():
+    """Isolated tracer + flight recorder + registry + heartbeats for the
+    watchdog/bundle tests, restored afterwards."""
+    tr, rec, reg = Tracer(), FlightRecorder(), MetricsRegistry()
+    prev_tr = set_tracer(tr)
+    prev_rec = set_flightrec(rec)
+    prev_reg = set_registry(reg)
+    clear_beats()
+    try:
+        yield tr, rec, reg
+    finally:
+        set_tracer(prev_tr)
+        set_flightrec(prev_rec)
+        set_registry(prev_reg)
+        clear_beats()
 
 
 # ---------------------------------------------------------------- tracer
@@ -434,5 +463,346 @@ def test_ui_server_serves_metrics_endpoints():
         blob = json.loads(urllib.request.urlopen(
             f"{base}/api/metrics.json").read().decode())
         assert blob["bench_smoke_total"] == 7
+        # the live diagnostic-bundle endpoint: same shape as the
+        # watchdog's on-disk bundle, reason "live"
+        dbg = json.loads(urllib.request.urlopen(
+            f"{base}/api/debug").read().decode())
+        assert dbg["format"] == BUNDLE_FORMAT
+        assert dbg["reason"] == "live"
+        assert "heartbeats" in dbg and "threads" in dbg
     finally:
         srv.stop()
+
+
+# --------------------------------------------------- histogram quantiles
+
+def test_histogram_quantile_interpolation():
+    h = Histogram("lat", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0, 3.5, 9.0):   # cum: 1, 2, 4, inf->5
+        h.observe(v)
+    # rank 2.5 lands in the (2, 4] bucket: 2 + 2 * (0.5 / 2) = 2.5
+    assert h.quantile(0.5) == pytest.approx(2.5)
+    # rank 1.0 is exactly the first bucket's cum; lower bound is 0
+    assert h.quantile(0.2) == pytest.approx(1.0)
+    # the +Inf bucket clamps to the highest finite edge
+    assert h.quantile(1.0) == 4.0
+    assert h.quantile(0.99) == 4.0
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+    blob = h._json()
+    assert blob["p50"] == pytest.approx(2.5)
+    assert blob["p99"] == 4.0
+
+
+def test_histogram_quantile_empty_is_none():
+    h = Histogram("lat", buckets=(1.0, 2.0))
+    assert h.quantile(0.5) is None
+    assert h._json()["p50"] is None
+
+
+# ------------------------------------------------------- flight recorder
+
+def test_flightrec_ring_bounds_under_concurrent_emit():
+    rec = FlightRecorder(max_events=256)
+
+    def emit(n):
+        for i in range(1000):
+            rec.record("sub%d" % n, "tick", i=i)
+
+    threads = [threading.Thread(target=emit, args=(k,)) for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(rec) == 256              # bounded, never grows past cap
+    assert rec.total_recorded == 8000   # but every emit was counted
+    tail = rec.tail(16)
+    assert len(tail) == 16
+    for ev in tail:
+        assert set(ev) == {"ts", "subsystem", "kind", "detail"}
+    # oldest-first ordering within the tail
+    assert all(a["ts"] <= b["ts"] for a, b in zip(tail, tail[1:]))
+
+
+def test_flightrec_detail_is_json_clean():
+    rec = FlightRecorder(max_events=8)
+    rec.record("serving", "kv_evicted", row=3, reason="lru",
+               obj=object())           # non-JSON value -> repr()'d
+    ev = rec.tail()[-1]
+    assert ev["detail"]["row"] == 3
+    assert isinstance(ev["detail"]["obj"], str)
+    json.dumps(rec.tail())             # whole tail JSON-serializable
+    with pytest.raises(ValueError):
+        FlightRecorder(max_events=0)
+
+
+def test_flightrec_global_swap_and_module_record(fresh_diag):
+    from deeplearning4j_tpu.profiling import flightrec as fr
+    _tr, rec, _reg = fresh_diag
+    assert get_flightrec() is rec
+    fr.record("bench", "probe_started", timeout_s=5)
+    assert rec.tail()[-1]["kind"] == "probe_started"
+
+
+# --------------------------------------------- tracer drop accounting
+
+def test_tracer_dropped_events_feed_registry_counter(fresh_diag):
+    _tr, _rec, reg = fresh_diag
+    tr = Tracer(max_events=5)
+    for i in range(20):
+        with tr.span(f"s{i}"):
+            pass
+    assert tr.dropped >= 15
+    assert reg.counter("tracer_events_dropped").value == tr.dropped
+
+
+def test_tracer_open_spans_by_thread(fresh_diag):
+    tr, _rec, _reg = fresh_diag
+    h1 = tr.begin("outer")
+    h2 = tr.begin("inner")
+    spans = tr.open_spans_by_thread()
+    me = threading.get_ident()
+    assert [s["name"] for s in spans[me]] == ["outer", "inner"]
+    tr.end(h2)
+    tr.end(h1)
+    assert tr.open_spans_by_thread() == {}
+
+
+# ------------------------------------------------------- stall watchdog
+
+def test_watchdog_heartbeat_ages(fresh_diag):
+    beat("elastic")
+    ages = heartbeat_ages()
+    assert 0.0 <= ages["elastic"] < 5.0
+
+
+def test_watchdog_stale_heartbeat_writes_bundle(tmp_path, fresh_diag):
+    """A wedged thread (open spans + stale beat) must produce a bundle
+    on disk whose culprit names the deepest open span of THAT thread."""
+    tr, rec, _reg = fresh_diag
+    release = threading.Event()
+    armed = threading.Event()
+
+    def wedge():
+        h1 = tr.begin("train:step")
+        h2 = tr.begin("train:collective")
+        beat("trainer")               # last sign of life, then hang
+        rec.record("trainer", "dispatch", step=7)
+        armed.set()
+        release.wait(20)
+        tr.end(h2)
+        tr.end(h1)
+
+    wd = StallWatchdog(str(tmp_path), interval_s=0.05)
+    t = threading.Thread(target=wedge, name="wedged-trainer")
+    try:
+        wd.watch("trainer", deadline_s=0.25)
+        t.start()
+        assert armed.wait(5)
+        deadline = time.monotonic() + 8
+        while wd.last_bundle_path is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        path = wd.last_bundle_path
+        assert path is not None, "watchdog never fired on the stale beat"
+        with open(path) as f:
+            bundle = json.load(f)
+        assert bundle["format"] == BUNDLE_FORMAT
+        assert bundle["reason"] == "stalled_heartbeat"
+        assert bundle["stale"]["subsystem"] == "trainer"
+        assert bundle["stale"]["age_s"] > 0.25
+        assert "trainer" in bundle["heartbeats"]
+        # the culprit chain: stale beat -> its tid -> deepest open span
+        assert bundle["culprit"]["span"] == "train:collective"
+        assert bundle["culprit"]["via"] == "stale_thread"
+        spans = bundle["open_spans"][str(bundle["stale"]["tid"])]
+        assert [s["name"] for s in spans] == ["train:step",
+                                              "train:collective"]
+        # the wedged thread's Python stack is in the dump
+        names = {th["name"] for th in bundle["threads"]}
+        assert "wedged-trainer" in names
+        assert any(ev["kind"] == "dispatch"
+                   for ev in bundle["flight_tail"])
+        assert isinstance(bundle["metrics"], dict)
+        # one bundle per episode: no second dump while still stale
+        seq_before = wd.last_bundle_path
+        time.sleep(0.3)
+        assert wd.last_bundle_path == seq_before
+    finally:
+        release.set()
+        t.join(5)
+        wd.close()
+
+
+def test_watchdog_threads_return_to_baseline(tmp_path):
+    """Teardown hygiene: close() joins the monitor; enumerate() returns
+    to baseline (the contract test_thread_hygiene enforces stack-wide)."""
+    baseline = set(threading.enumerate())
+    wd = StallWatchdog(str(tmp_path), interval_s=0.05)
+    assert any(t.name == "stall-watchdog" for t in threading.enumerate())
+    wd.watch("x", 10.0)
+    wd.close()
+    wd.close()                         # idempotent
+    deadline = time.monotonic() + 8
+    while time.monotonic() < deadline:
+        if set(threading.enumerate()) <= baseline:
+            break
+        time.sleep(0.02)
+    leaked = [t.name for t in set(threading.enumerate()) - baseline]
+    assert not leaked, f"leaked threads: {leaked}"
+
+
+def test_watchdog_recovered_heartbeat_rearms(tmp_path, fresh_diag):
+    wd = StallWatchdog(str(tmp_path), interval_s=0.05)
+    try:
+        wd.watch("svc", deadline_s=0.15)
+        deadline = time.monotonic() + 8
+        while wd.last_bundle_path is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        first = wd.last_bundle_path
+        assert first is not None
+        beat("svc")                    # recovery re-arms the episode
+        time.sleep(0.1)
+        deadline = time.monotonic() + 8
+        while wd.last_bundle_path == first \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)           # goes stale again -> second dump
+        assert wd.last_bundle_path != first
+    finally:
+        wd.close()
+
+
+def test_assemble_bundle_without_watchdog(fresh_diag):
+    tr, _rec, _reg = fresh_diag
+    with tr.span("serve:decode"):
+        bundle = assemble_bundle(reason="live")
+    assert bundle["format"] == BUNDLE_FORMAT
+    assert bundle["stale"] is None
+    me = str(threading.get_ident())
+    # no stale heartbeat: falls back to the most recent open span
+    assert bundle["culprit"]["span"] == "serve:decode"
+    assert me in bundle["open_spans"]
+    json.dumps(bundle, default=repr)
+
+
+# ---------------------------------------------- acceptance: wedged runs
+
+def test_wedged_trainer_step_bundle_names_straggle(tmp_path, fresh_diag):
+    """ISSUE-17 acceptance, half 1: a faultinject stall inside a trainer
+    step goes stale against the elastic heartbeat and the bundle's
+    deepest open span names the stalled phase (elastic:straggle)."""
+    from deeplearning4j_tpu.resilience import faultinject
+    from deeplearning4j_tpu.resilience.elastic import ElasticTrainer
+    from deeplearning4j_tpu.resilience.faultinject import (Fault,
+                                                           FaultSchedule)
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    def net():
+        return MultiLayerNetwork(
+            NeuralNetConfiguration.builder().seed(7)
+            .updater("sgd", learning_rate=0.05).weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build()).init()
+
+    rng = np.random.default_rng(0)
+    batches = [DataSet(rng.normal(size=(8, 6)).astype(np.float32),
+                       np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)])
+               for _ in range(3)]
+    ckpt = tmp_path / "ckpt"
+    bundles = tmp_path / "bundles"
+    trainer = ElasticTrainer(net, ckpt, checkpoint_every=10,
+                             step_timeout_s=30.0,
+                             heartbeat_interval_s=0.05)
+    wd = StallWatchdog(str(bundles), interval_s=0.05)
+    try:
+        # step 1 warm-up OUTSIDE the watch: the jit compile is itself
+        # slower than the deadline and would fire first, and episode
+        # dedup would then swallow the straggle's dump
+        trainer.fit(batches[:1], epochs=1)
+        faultinject.set_schedule(FaultSchedule(
+            [Fault(kind="slow_host", step=3, duration=1.2)]))
+        wd.watch("elastic", deadline_s=0.3)
+        trainer.fit(batches, epochs=1)   # steps 2, 3 (straggles), 4
+        path = wd.last_bundle_path
+        assert path is not None, \
+            "the straggle never tripped the elastic heartbeat"
+        with open(path) as f:
+            bundle = json.load(f)
+        assert bundle["stale"]["subsystem"] == "elastic"
+        # the acceptance bar: the deepest open span names the phase
+        assert bundle["culprit"]["span"] == "elastic:straggle"
+        kinds = {ev["kind"] for ev in bundle["flight_tail"]
+                 if ev["subsystem"] == "elastic"}
+        assert "step" in kinds
+    finally:
+        faultinject.clear()
+        wd.close()
+        trainer.close()
+
+
+def test_hung_backend_probe_emits_bundle_and_record(tmp_path, fresh_diag,
+                                                    monkeypatch, capsys):
+    """ISSUE-17 acceptance, half 2: a simulated dead tunnel (the probe
+    child sleeps forever) yields a structured backend_unreachable
+    failure record AND an on-disk bundle naming bench:probe_backend."""
+    import bench
+
+    monkeypatch.setenv("BENCH_PROBE_HANG_S", "30")
+    wd = StallWatchdog(str(tmp_path), interval_s=0.2)
+    try:
+        ok = bench._probe_backend(1.0, watchdog=wd)
+    finally:
+        wd.close()
+    assert ok is False
+    rec = None
+    for line in capsys.readouterr().out.splitlines():
+        if line.startswith("{"):
+            rec = json.loads(line)
+    assert rec is not None, "no failure record printed"
+    assert rec["failed"] is True
+    assert rec["error"]["kind"] == "backend_unreachable"
+    assert "bench:probe_backend" in rec["error"]["open_spans"]
+    assert rec["error"]["flight_tail"], "flight tail missing"
+    path = rec["error"]["bundle"]
+    assert path and os.path.exists(path)
+    with open(path) as f:
+        bundle = json.load(f)
+    assert bundle["reason"] == "backend_unreachable"
+    assert bundle["culprit"]["span"] == "bench:probe_backend"
+
+
+# ----------------------------------------------------- postmortem reader
+
+def test_postmortem_summarize_names_culprit(tmp_path, fresh_diag):
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "postmortem_cli",
+        Path(__file__).resolve().parents[1] / "tools" / "postmortem.py")
+    pm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pm)
+
+    tr, rec, _reg = fresh_diag
+    h = tr.begin("serve:decode")
+    beat("serving_decode")
+    rec.record("serving", "decode_dispatch", rows=4)
+    bundle = assemble_bundle(
+        reason="stalled_heartbeat",
+        stale={"subsystem": "serving_decode", "age_s": 3.0,
+               "deadline_s": 1.0, "tid": threading.get_ident()})
+    tr.end(h)
+    path = tmp_path / "b.json"
+    path.write_text(json.dumps(bundle, default=repr))
+    loaded = pm.load_bundle(str(path))
+    text = pm.summarize(loaded)
+    assert "CULPRIT" in text and "serve:decode" in text
+    assert "serving_decode" in text
+    with pytest.raises(ValueError):
+        pm.load_bundle(__file__)       # not a bundle
+    assert pm.main(["--self-check"]) == 0

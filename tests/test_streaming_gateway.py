@@ -125,6 +125,11 @@ def test_keras_gateway_fit_predict(tmp_path, iris_net):
         assert ev["accuracy"] > 0.8
         with pytest.raises(RuntimeError, match="unknown op"):
             cli.request(op="nope")
+        # the live diagnostic bundle over the wire (unadmitted op)
+        dbg = cli.debug()
+        assert dbg["format"] == "dl4j-tpu-diagnostic-bundle/v1"
+        assert dbg["reason"] == "live"
+        assert "threads" in dbg and "heartbeats" in dbg
         cli.close()
     finally:
         srv.stop()

@@ -203,3 +203,21 @@ def test_compile_watcher_uninstall_synchronizes_on_lock():
     assert done.wait(5.0)
     t.join(5.0)
     assert not w._active
+
+
+# --------------------------------------------------------------- watchdog
+
+def test_stall_watchdog_close_joins_monitor(tmp_path):
+    """The stall watchdog practices what it preaches: close() signals
+    the monitor's Event and joins the thread — enumerate() returns to
+    baseline (the ISSUE-17 teardown gate; LC005/LC008 prove the static
+    half)."""
+    from deeplearning4j_tpu.profiling.watchdog import (StallWatchdog,
+                                                       clear_beats)
+    base = _baseline()
+    wd = StallWatchdog(str(tmp_path), interval_s=0.05)
+    assert _baseline() - base, "monitor thread should have started"
+    wd.watch("hygiene", deadline_s=30.0)
+    wd.close()
+    _assert_settled(base)
+    clear_beats()

@@ -14,6 +14,10 @@ The four layers, in dependency order of what they look at:
     lockcheck   SOURCE, threaded  (AST)          tree sweep + self-check
     shardcheck  COMPILED programs (HLO)          self-check only
 
+plus one runtime-pipeline layer:
+
+    postmortem  DIAGNOSTIC BUNDLES (watchdog)    self-check only
+
 Each layer runs through its own CLI (tools/<layer>.py) in a
 subprocess, so per-tool environment setup (JAX_PLATFORMS, XLA_FLAGS
 host-device count) keeps working unchanged and a crash in one layer
@@ -56,6 +60,12 @@ LAYERS = {
     ],
     "shardcheck": [
         ("self-check", lambda paths: ["tools/shardcheck.py", "--self-check"]),
+    ],
+    # not a source sweep: round-trips a synthetic diagnostic bundle
+    # through assemble -> atomic write -> load -> summarize, so a broken
+    # post-mortem pipeline fails CI before a real stall needs it
+    "postmortem": [
+        ("self-check", lambda paths: ["tools/postmortem.py", "--self-check"]),
     ],
 }
 

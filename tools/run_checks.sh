@@ -117,6 +117,8 @@ stage "analyze: jaxlint (sweep + self-check)" \
     python tools/analyze.py --layer jaxlint
 stage "analyze: lockcheck (sweep + self-check)" \
     python tools/analyze.py --layer lockcheck
+stage "analyze: postmortem (self-check)" \
+    python tools/analyze.py --layer postmortem
 stage "analyze: graphcheck (self-check)" env JAX_PLATFORMS=cpu \
     python tools/analyze.py --layer graphcheck
 
