@@ -324,7 +324,7 @@ def _make_stall_watchdog(exit_dump: bool) -> StallWatchdog:
 # ---------------------------------------------------------------------------
 
 _RUNGS = ("lenet", "small", "full", "vgg", "lstm", "lm", "xl", "input",
-          "serve", "lm_serve")
+          "serve", "lm_serve", "fleet")
 
 
 def _rung_config(rung: str, smoke: bool):
@@ -431,6 +431,21 @@ def _rung_config(rung: str, smoke: bool):
                     slo_ms=30_000 if smoke else 2_000,
                     max_rows=4 if smoke else 16,
                     metric="lm_serve_tokens_per_sec_at_slo")
+    if rung == "fleet":
+        # ISSUE 18: the multi-replica serving fleet — the serve rung's
+        # workload dispatched across R in-process replicas through the
+        # FleetRouter. Headline = aggregate requests/sec INSIDE the SLO;
+        # the record carries the single-server number measured on the
+        # same workload (vs_single_server — the scale-out ratio the
+        # fleet must eventually justify; not gated in smoke, where R
+        # replicas on one CPU just share it).
+        return dict(model="fleet_mlp", replicas=3,
+                    clients=4 if smoke else 12,
+                    requests=48 if smoke else 240,
+                    slo_ms=4000 if smoke else 250,
+                    max_batch=8 if smoke else 16,
+                    max_wait_ms=5.0, features=32, classes=8,
+                    metric="fleet_requests_per_sec_at_slo")
     raise ValueError(f"unknown rung {rung!r}; valid: {_RUNGS}")
 
 
@@ -1156,6 +1171,198 @@ def _run_serve_rung(jax, smoke: bool, on_accel: bool, device_kind: str,
     }
 
 
+def _run_fleet_rung(jax, smoke: bool, on_accel: bool, device_kind: str,
+                    platform: str) -> dict:
+    """The `fleet` rung (ISSUE 18): the serve rung's predict storm
+    dispatched across R in-process KerasServer replicas through the
+    FleetRouter (lease membership, power-of-two routing). The same
+    workload is first measured against ONE KerasServer so the record
+    carries the scale-out ratio (`vs_single_server`) alongside the
+    aggregate requests/sec-inside-SLO headline."""
+    import tempfile
+    import threading as _threading
+
+    cfg = _rung_config("fleet", smoke)
+    _stamp(f"rung 'fleet': {cfg}")
+    tracer = get_tracer()
+
+    from deeplearning4j_tpu import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.keras.batching import quantile
+    from deeplearning4j_tpu.keras.fleet import FleetReplica, FleetRouter
+    from deeplearning4j_tpu.keras.server import KerasClient, KerasServer
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+    F, K = cfg["features"], cfg["classes"]
+    t = time.perf_counter()
+    with tracer.span("fleet_build_model"):
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.builder().updater("adam")
+            .learning_rate(0.01).seed(7).list()
+            .layer(DenseLayer(n_out=64, activation="relu"))
+            .layer(OutputLayer(n_out=K, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(F)).build()).init()
+    _stamp(f"fleet model built in {time.perf_counter() - t:.1f}s")
+
+    rng = np.random.default_rng(3)
+    clients, n_requests = cfg["clients"], cfg["requests"]
+    slo_s = cfg["slo_ms"] / 1000.0
+    per_client = n_requests // clients
+
+    def storm(host, port, files, model):
+        """C clients, N requests, against whatever serves (host, port).
+        Returns (latencies, errors, wall_s)."""
+        latencies, errors = [], []
+        lock = _threading.Lock()
+        start = _threading.Barrier(clients + 1)
+
+        def client(idx: int) -> None:
+            cli = KerasClient(host, port)
+            start.wait(30.0)
+            for k in range(per_client):
+                p = files[(idx + k) % len(files)]
+                t0 = time.perf_counter()
+                try:
+                    cli.request(op="predict", features=p, model=model)
+                    with lock:
+                        latencies.append(time.perf_counter() - t0)
+                except Exception as e:  # noqa: BLE001 — recorded
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+            cli.close()
+
+        threads = [_threading.Thread(target=client, args=(i,),
+                                     daemon=True)
+                   for i in range(clients)]
+        for th in threads:
+            th.start()
+        start.wait(30.0)
+        t0 = time.perf_counter()
+        for th in threads:
+            th.join(300.0)
+        return latencies, errors, time.perf_counter() - t0
+
+    def rps_slo(latencies, wall):
+        return (sum(1 for s in latencies if s <= slo_s) / wall
+                if wall > 0 else 0.0)
+
+    with tempfile.TemporaryDirectory() as d:
+        model = os.path.join(d, "fleet.zip")
+        ModelSerializer.write_model(net, model)
+        row_choices = [r for r in (1, 2, 4, 8, 16)
+                       if r <= cfg["max_batch"]]
+        files = []
+        for rows in row_choices:
+            p = os.path.join(d, f"x{rows}.npy")
+            np.save(p, rng.normal(size=(rows, F)).astype(np.float32))
+            files.append(p)
+
+        # ---- single-server baseline on the identical workload
+        srv = KerasServer(max_concurrency=clients,
+                          queue_depth=2 * clients,
+                          max_batch=cfg["max_batch"],
+                          max_wait_ms=cfg["max_wait_ms"])
+        try:
+            with tracer.span("fleet_single_warmup"):
+                warm = KerasClient(srv.host, srv.port)
+                for p in files:
+                    warm.predict(p, model=model)
+                warm.close()
+            with tracer.span("fleet_single_storm"):
+                lat1, err1, wall1 = storm(srv.host, srv.port, files,
+                                          model)
+        finally:
+            srv.drain(grace_s=5.0)
+        single_rps = rps_slo(lat1, wall1)
+        _stamp(f"fleet baseline: single server {len(lat1)} served in "
+               f"{wall1:.2f}s -> {single_rps:.1f} rps inside SLO, "
+               f"{len(err1)} errors")
+
+        # ---- the fleet: R replicas behind the router, same storm
+        fdir = os.path.join(d, "members")
+        router = FleetRouter(fdir, poll_s=0.1,
+                             max_concurrency=2 * clients,
+                             queue_depth=4 * clients,
+                             metrics_port=None)
+        reps = []
+        try:
+            with tracer.span("fleet_form",
+                             replicas=cfg["replicas"]):
+                reps = [FleetReplica(fdir, r, model=model,
+                                     max_concurrency=clients,
+                                     queue_depth=2 * clients,
+                                     max_batch=cfg["max_batch"],
+                                     max_wait_ms=cfg["max_wait_ms"])
+                        for r in range(cfg["replicas"])]
+                if not router.wait_for_replicas(cfg["replicas"],
+                                                timeout_s=60.0):
+                    raise RuntimeError(
+                        f"fleet never formed: {router.replicas()} of "
+                        f"{cfg['replicas']} admitted")
+            with tracer.span("fleet_warmup"):
+                warm = KerasClient(router.host, router.port)
+                for p in files:  # per-replica buckets prewarm on load
+                    warm.predict(p, model=model)
+                warm.close()
+            with tracer.span("fleet_storm", clients=clients,
+                             requests=per_client * clients):
+                lat, errors, wall = storm(router.host, router.port,
+                                          files, model)
+            epoch = router.epoch
+        finally:
+            router.close()
+            for rep in reps:
+                rep.drain(grace_s=5.0)
+
+    fleet_rps = rps_slo(lat, wall)
+    n_done = len(lat)
+    ordered = sorted(lat) or [0.0]
+    p50, p99 = quantile(ordered, 0.5), quantile(ordered, 0.99)
+    vs_single = fleet_rps / single_rps if single_rps > 0 else 0.0
+    _stamp(f"fleet storm: {n_done}/{per_client * clients} served in "
+           f"{wall:.2f}s -> {fleet_rps:.1f} rps inside SLO "
+           f"({vs_single:.2f}x single server), p50={p50 * 1e3:.1f}ms "
+           f"p99={p99 * 1e3:.1f}ms, {len(errors)} errors")
+    base = (_banked_baseline(cfg["metric"])
+            if on_accel and not smoke else None)
+    return {
+        "metric": cfg["metric"] + ("" if on_accel and not smoke
+                                   else "_SMOKE"),
+        "value": round(fleet_rps, 2),
+        "unit": "requests/sec",
+        "vs_baseline": round(fleet_rps / base, 3) if base else 1.0,
+        "device_kind": device_kind,
+        "platform": platform,
+        "rung": "fleet",
+        # schema uniformity: inference buckets carry no gradient
+        # collectives to analyze
+        "comm_bytes_hlo": None,
+        "replicas": cfg["replicas"],
+        "epoch": epoch,
+        "clients": clients,
+        "requests": n_done,
+        "request_errors": errors[:5],
+        "slo_ms": cfg["slo_ms"],
+        # no training input feeds the fleet rung (schema, ISSUE 7)
+        "input_stall_s": 0.0,
+        "slo_attained": round(
+            sum(1 for s in lat if s <= slo_s) / max(1, n_done), 4),
+        "p50_ms": round(p50 * 1e3, 2),
+        "p99_ms": round(p99 * 1e3, 2),
+        "single_server_rps": round(single_rps, 2),
+        "vs_single_server": round(vs_single, 3),
+        "max_batch": cfg["max_batch"],
+        # schema uniformity (ISSUE 13): the fleet's bucket ladder is
+        # fixed by the rung config, not autotuned
+        "autotuned": False,
+        "predicted_step_s": None,
+        "measured_vs_predicted_gap": None,
+        **_precision_fields(),
+    }
+
+
 def _run_lm_serve_rung(jax, smoke: bool, on_accel: bool,
                        device_kind: str, platform: str) -> dict:
     """The `lm_serve` rung (ISSUE 15): token-level continuous batching
@@ -1432,6 +1639,9 @@ def _run_child() -> int:
                 elif rung == "lm_serve":
                     rec = _run_lm_serve_rung(jax, smoke, on_accel,
                                              device_kind, platform)
+                elif rung == "fleet":
+                    rec = _run_fleet_rung(jax, smoke, on_accel,
+                                          device_kind, platform)
                 elif rung == "input":
                     rec = _run_input_rung(jax, smoke, on_accel,
                                           device_kind, platform)

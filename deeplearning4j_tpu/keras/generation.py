@@ -89,11 +89,12 @@ class _GenRequest:
 
     __slots__ = ("prompt", "max_new", "priority", "deadline", "event",
                  "tokens", "error", "t0", "ttft_s", "index", "steps",
-                 "reprefills", "admit_seq", "model_obj")
+                 "reprefills", "admit_seq", "model_obj", "on_token")
 
     def __init__(self, prompt: np.ndarray, max_new: int, priority: int,
-                 deadline: Deadline, index: int):
+                 deadline: Deadline, index: int, on_token=None):
         self.prompt = prompt
+        self.on_token = on_token         # per-token stream hook
         self.max_new = max_new
         self.priority = priority
         self.deadline = deadline
@@ -107,6 +108,23 @@ class _GenRequest:
         self.reprefills = 0
         self.admit_seq = -1              # ring position (eviction order)
         self.model_obj = None            # the weights my tokens came from
+
+    def push_token(self, tok: int) -> None:
+        """Append one generated token and stream it to the submitter's
+        ``on_token`` hook (the gateway's partial-line writer). A hook
+        failure — the client hung up mid-stream — unhooks streaming but
+        never touches the generation itself: tokens keep accumulating
+        and the final result (or the handler's own write failure)
+        settles the request. Called only on the decode-loop thread, and
+        always BEFORE ``finish()`` sets the event, so every partial is
+        on the wire before the final response line."""
+        self.tokens.append(tok)
+        cb = self.on_token
+        if cb is not None:
+            try:
+                cb(tok)
+            except Exception:  # noqa: BLE001 — stream loss ≠ decode loss
+                self.on_token = None
 
     def history(self) -> np.ndarray:
         """prompt + generated tokens — what a re-prefill rebuilds from."""
@@ -330,7 +348,7 @@ class _Engine:
         if req.ttft_s is None:  # a re-prefilled victim keeps its first
             req.ttft_s = time.monotonic() - req.t0
             self.scheduler.ttft.observe(req.ttft_s)
-        req.tokens.append(first)
+        req.push_token(first)
         req.admit_seq = self._admit_seq
         self._admit_seq += 1
         self.slots[row] = req
@@ -482,7 +500,7 @@ class _Engine:
                 self.slots[i] = None     # fails ALONE, mid-stream
                 continue
             tok = int(row_probs.argmax())
-            req.tokens.append(tok)
+            req.push_token(tok)
             req.steps += 1
             self.tokens[i] = tok
             self.positions[i] += 1
@@ -600,10 +618,13 @@ class GenerationScheduler:
     # -------------------------------------------------------------- submit
     def submit(self, key: str, model, lock: threading.Lock,
                prompt, max_new_tokens: int, deadline: Deadline,
-               priority: str = "interactive") -> dict:
+               priority: str = "interactive", on_token=None) -> dict:
         """Queue one generation and block until it completes. Returns
         ``{"tokens": [...], "ttft_ms": ..., "reprefills": n}``; raises
-        the request's own structured error."""
+        the request's own structured error. ``on_token`` (optional) is
+        invoked on the decode-loop thread with each token the moment it
+        is generated — the streaming-gateway seam; exceptions it raises
+        only stop the streaming, never the generation."""
         prompt = np.asarray(prompt, np.int32).ravel()
         vocab = model.decode_vocab()
         max_len = model.decode_max_len()
@@ -624,7 +645,8 @@ class GenerationScheduler:
                 raise DrainingError("generation scheduler stopped")
             self._submits += 1
             req = _GenRequest(prompt, max_new, priority_rank(priority),
-                              deadline, faultinject.on_generate_submit())
+                              deadline, faultinject.on_generate_submit(),
+                              on_token=on_token)
             self._backends[key] = (model, lock)
             self._enqueue_locked(key, req)
             loop = self._loops.get(key)

@@ -15,7 +15,17 @@ newline-delimited JSON-over-TCP protocol:
     {"op": "predict", "features": <.npy path>}  -> {"predictions": [...]}
     {"op": "evaluate", "features_dir": ..., "labels_dir": ...}
     {"op": "health"}  -> {"live": true, "ready": ..., "reasons": [...]}
+    {"op": "readyz"}  -> structured readiness: guard state, model_loaded
+                         / prewarm_done checks, open breakers, inflight/
+                         queued depth, TTFT p99 (the fleet router's
+                         admission gate and load signal — ISSUE 18)
     {"op": "shutdown"}
+
+A ``generate`` request may add ``"stream": true``: each generated token
+is written as its own ``{"partial": true, "t": tok}`` line the moment
+the decode loop produces it, before the normal final envelope — the
+seam the fleet router uses to resume a generation mid-stream on a
+survivor when a replica dies (re-prefill from prompt + tokens-so-far).
 
 Every request may carry ``deadline_ms`` — its deadline budget (the
 server default applies otherwise; <= 0 disables). Requests admit
@@ -186,7 +196,9 @@ class KerasServer:
                  batch_deadline_margin_ms: float = 50.0,
                  kv_cache_budget_bytes: Optional[int] = None,
                  prewarm: bool = True,
-                 tuned=None):
+                 tuned=None,
+                 preload: Optional[List[str]] = None,
+                 replica_rank: Optional[int] = None):
         from deeplearning4j_tpu.keras.batching import BatchScheduler
         from deeplearning4j_tpu.keras.generation import (
             GenerationScheduler)
@@ -217,21 +229,87 @@ class KerasServer:
         # the lock a predict that omits 'model' could resolve _last mid-swap
         # from another connection and run against the wrong model
         self._state_lock = threading.Lock()
+        # fleet-replica identity (ISSUE 18): when set, admitted requests
+        # consult the kill/partition/slow_replica chaos kinds, and
+        # hard_kill() becomes reachable. None = standalone server.
+        self._replica_rank = (None if replica_rank is None
+                              else int(replica_rank))
+        #: optional hook invoked FIRST by hard_kill (the FleetReplica
+        #: wires its heartbeat stop here so liveness dies with the
+        #: listener, exactly as process death would take both)
+        self.on_hard_kill = None
+        self._kill_lock = threading.Lock()
+        self._killed = False
+        # established handler sockets — hard_kill() severs them so
+        # clients mid-request see a dead connection, not a late answer
+        self._conns_lock = threading.Lock()
+        self._conns: set = set()
+        # in-flight speculative prewarm threads; readiness ("prewarm"
+        # check) requires this back at zero, so a fleet router admits a
+        # joiner only after its buckets compiled
+        self._prewarm_inflight = 0
         outer = self
 
         class Handler(socketserver.StreamRequestHandler):
             timeout = io_timeout  # reclaims slow-loris/idle threads
 
+            def setup(self):
+                super().setup()
+                with outer._conns_lock:
+                    outer._conns.add(self.connection)
+
+            def finish(self):
+                with outer._conns_lock:
+                    outer._conns.discard(self.connection)
+                super().finish()
+
+            def _stream_writer(self):
+                """A per-request partial-line writer for streaming
+                generate: each generated token goes on the wire as
+                ``{"partial": true, "t": tok}`` the moment the decode
+                loop produces it. The lock serializes the decode-loop
+                writes against the handler's final response; close()
+                fences the stream shut (any later token raises into
+                ``push_token``, which just unhooks)."""
+                lock = threading.Lock()
+                state = {"open": True}
+
+                def on_token(tok):
+                    if outer._replica_rank is not None and \
+                            faultinject.check_kill_replica_token(
+                                outer._replica_rank):
+                        outer.hard_kill()  # mid-stream death, by schedule
+                    with lock:
+                        if not state["open"]:
+                            raise RuntimeError("stream closed")
+                        self.wfile.write((json.dumps(
+                            {"partial": True, "t": int(tok)})
+                            + "\n").encode())
+                        self.wfile.flush()
+
+                def close():
+                    with lock:
+                        state["open"] = False
+
+                return on_token, close
+
             def handle(self):
                 try:
                     for line in self.rfile:
+                        closer = None
                         try:
                             req = json.loads(line)
-                            resp = outer._dispatch(req)
+                            on_token = None
+                            if req.get("op") == "generate" \
+                                    and req.get("stream"):
+                                on_token, closer = self._stream_writer()
+                            resp = outer._dispatch(req, on_token=on_token)
                         except ServiceError as e:  # structured
                             resp = e.to_response()
                         except Exception as e:  # report, keep serving
                             resp = {"error": f"{type(e).__name__}: {e}"}
+                        if closer is not None:
+                            closer()  # no partial may trail the final line
                         self.wfile.write((json.dumps(resp) + "\n").encode())
                         self.wfile.flush()
                         if isinstance(resp, dict) and resp.get("shutdown"):
@@ -267,9 +345,18 @@ class KerasServer:
             breaker_slow_call_s=breaker_slow_call_s))
         self._guard.add_ready_check("model_loaded",
                                     lambda: bool(self._models))
+        self._guard.add_ready_check("prewarm",
+                                    lambda: self._prewarm_inflight == 0)
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
+        # preload= (fleet joiners): load + pin-warm the named models
+        # synchronously, so by the time the constructor returns only the
+        # background bucket prewarms separate this server from ready —
+        # and the "prewarm" check holds readiness until they land
+        for path in (preload or []):
+            self._get_model(path)
+            self._unpin(path)
 
     # -- ops ----------------------------------------------------------
     def _resolve_key(self, path: Optional[str]) -> str:
@@ -309,8 +396,10 @@ class KerasServer:
                     # speculative bucket prewarming: compile the
                     # observed-mix buckets for the fresh model in the
                     # background, so its first wave pays zero compiles
+                    # (counted in-flight — readiness waits for it)
+                    self._prewarm_inflight += 1
                     threading.Thread(
-                        target=self._batcher.prewarm, args=(key, model),
+                        target=self._prewarm_buckets, args=(key, model),
                         daemon=True, name="bucket-prewarm").start()
             self._models.move_to_end(key)
             self._model_pins[key] = self._model_pins.get(key, 0) + 1
@@ -333,6 +422,13 @@ class KerasServer:
             lock = self._model_locks.setdefault(key, threading.Lock())
             return self._models[key], lock
 
+    def _prewarm_buckets(self, key: str, model) -> None:
+        try:
+            self._batcher.prewarm(key, model)
+        finally:
+            with self._state_lock:
+                self._prewarm_inflight -= 1
+
     def _unpin(self, key: str) -> None:
         with self._state_lock:
             n = self._model_pins.get(key, 0) - 1
@@ -341,7 +437,7 @@ class KerasServer:
             else:
                 self._model_pins[key] = n
 
-    def _dispatch(self, req: dict) -> dict:
+    def _dispatch(self, req: dict, on_token=None) -> dict:
         op = req.get("op")
         if op == "health":
             # never admitted/queued: a health probe must answer even
@@ -349,6 +445,11 @@ class KerasServer:
             ready, reasons = self._guard.ready()
             return {"ok": True, "live": True, "ready": ready,
                     "reasons": reasons, "draining": self._guard.draining}
+        if op == "readyz":
+            # the structured readiness surface (ISSUE 18): everything a
+            # fleet router needs to gate admission and score dispatch —
+            # never admitted, so it answers while saturated or draining
+            return self._readyz()
         if op == "debug":
             # the live diagnostic bundle — like health, never admitted:
             # the whole point is answering while the server is wedged
@@ -360,6 +461,19 @@ class KerasServer:
             return {"ok": True, "shutdown": True}
         if op not in ("fit", "predict", "evaluate", "generate"):
             raise ValueError(f"unknown op {op!r}")
+        if self._replica_rank is not None:
+            # fleet chaos seams: slow_replica stalls this request,
+            # partition_replica opens this rank's heartbeat-suppression
+            # window, kill_replica hard-kills the whole server (probes
+            # above never reach here, so at_call stays predictable
+            # under router readyz polling)
+            stall, kill = faultinject.on_replica_request(
+                self._replica_rank)
+            if stall > 0:
+                time.sleep(stall)
+            if kill:
+                self.hard_kill()
+                raise OSError("replica hard-killed by fault schedule")
         # resolve the model name ONCE, at admission — a predict without
         # 'model' must not re-read _last after queueing (an LRU swap or
         # eviction mid-queue could silently retarget the request); the
@@ -371,15 +485,38 @@ class KerasServer:
             watchdog_beat("keras_server")
             flight_record("keras_server", "dispatch", op=op, model=key)
             with get_tracer().span(f"serve:{op}"):
-                resp = self._serve(op, req, deadline, key)
+                resp = self._serve(op, req, deadline, key,
+                                   on_token=on_token)
         if op == "predict" and self._batcher is not None:
             # p50/p99 over served predictions (admission queue included
             # — this is the latency a client actually observes)
             self._batcher.latency.observe(time.perf_counter() - t_req)
         return resp
 
+    def _readyz(self) -> dict:
+        """Aggregate ServiceGuard + model/prewarm state into one
+        machine-readable readiness record — the router's admission gate
+        AND its per-replica load signal (inflight/queued/TTFT), which
+        matters because in-process replicas share the global metrics
+        registry: per-replica numbers must come from HERE, not from
+        shared gauges."""
+        ready, reasons = self._guard.ready()
+        with self._state_lock:
+            models = list(self._models)
+            prewarm_done = self._prewarm_inflight == 0
+        stats = self._gen.stats()
+        return {"ok": True, "ready": ready, "reasons": reasons,
+                "draining": self._guard.draining,
+                "checks": {"model_loaded": bool(models),
+                           "prewarm_done": prewarm_done},
+                "open_breakers": self._guard.open_breakers(),
+                "inflight": self._guard.inflight,
+                "queued": self._guard.queued,
+                "ttft_p99_ms": stats.get("ttft_p99_ms"),
+                "models": models}
+
     def _serve(self, op: str, req: dict, deadline: Deadline,
-               key: str) -> dict:
+               key: str, on_token=None) -> dict:
         # a budget already blown in the admission queue says nothing
         # about the backend — and checking BEFORE _prepare avoids
         # loading the whole input from disk for a doomed request
@@ -410,7 +547,7 @@ class KerasServer:
                 out = self._gen.submit(
                     key, model, lock, payload,
                     int(req.get("max_new_tokens", 16)), deadline,
-                    priority=priority)
+                    priority=priority, on_token=on_token)
                 resp = {"ok": True, **out}
             elif op == "predict" and self._batcher is not None:
                 # continuous batching: coalesce with concurrent
@@ -499,10 +636,62 @@ class KerasServer:
     def draining(self) -> bool:
         return self._guard.draining
 
+    def hard_kill(self) -> None:
+        """Chaos-only abrupt death (``kill_replica``): the in-process
+        analog of SIGKILL. Every established connection is severed
+        FIRST (clients mid-request see a dead connection, never a late
+        answer), then the listener closes and a reaper thread retires
+        the schedulers so the zombie's threads wind down — nothing in
+        flight is finished, flushed, or answered. Callable from any
+        thread, including a handler or decode loop, and idempotent."""
+        with self._kill_lock:
+            if self._killed:
+                return
+            self._killed = True
+        flight_record("keras_server", "hard_killed", port=self.port)
+        cb = self.on_hard_kill
+        if cb is not None:
+            try:
+                cb()   # liveness (heartbeat) dies with the process
+            except Exception:  # noqa: BLE001 — death must not fail
+                pass
+        self._guard.start_drain()   # nothing new admits into the corpse
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._server.shutdown()
+        self._server.server_close()
+        # scheduler teardown joins decode loops — a decode loop may be
+        # the very thread that called us (mid-stream kill), so the
+        # reaping happens on a fresh thread; it is transient and exits
+        # as soon as the joins land
+        threading.Thread(target=self._reap_after_kill, daemon=True,
+                         name="replica-reap").start()
+
+    def _reap_after_kill(self) -> None:
+        if self._batcher is not None:
+            self._batcher.stop(2.0)
+        self._gen.stop(2.0)
+        self._thread.join(timeout=5.0)
+        unregister_guard(self._guard)
+
     def drain(self, grace_s: float = 10.0) -> bool:
         """Graceful shutdown: stop admitting (new ops get ``DRAINING``),
         let in-flight ops finish up to ``grace_s``, then close the
         listener. Returns True when the server emptied in time."""
+        with self._kill_lock:
+            if self._killed:
+                # hard-killed already: the reaper owns teardown; a
+                # belated drain (test finally blocks) is a no-op
+                return True
         self._guard.start_drain()
         drained = self._guard.wait_idle(grace_s)
         if self._batcher is not None:
@@ -534,10 +723,17 @@ class KerasClient:
     def request(self, **req) -> dict:
         self._file.write((json.dumps(req) + "\n").encode())
         self._file.flush()
-        line = self._file.readline()
-        if not line:
-            raise ConnectionError("server closed")
-        resp = json.loads(line)
+        while True:
+            line = self._file.readline()
+            if not line:
+                raise ConnectionError("server closed")
+            resp = json.loads(line)
+            if not (isinstance(resp, dict) and resp.get("partial")):
+                break
+            # streaming generate interleaves {"partial": true, "t": tok}
+            # lines before the final envelope; the blocking client just
+            # drains them (the fleet router is the consumer that acts on
+            # each one)
         if "error" in resp:
             # structured serving errors carry a machine-readable code in
             # "error" ("SHED", "DEADLINE", "BREAKER_OPEN", ...) plus a
@@ -550,6 +746,13 @@ class KerasClient:
 
     def health(self) -> dict:
         return self.request(op="health")
+
+    def readyz(self) -> dict:
+        """The structured readiness record (unadmitted): guard state,
+        model_loaded / prewarm_done checks, open breakers, inflight /
+        queued depth, TTFT p99 — the fleet router's admission gate and
+        load signal."""
+        return self.request(op="readyz")
 
     def debug(self) -> dict:
         """The server's live diagnostic bundle (unadmitted, like

@@ -324,11 +324,16 @@ class HostHeartbeat:
     sub-second), so readers never see partial JSON."""
 
     def __init__(self, directory: Union[str, Path], rank: int,
-                 interval_s: float = 0.5):
+                 interval_s: float = 0.5,
+                 payload: Optional[Dict[str, object]] = None):
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
         self.rank = int(rank)
         self.interval_s = float(interval_s)
+        # Static rendezvous payload merged into every beat — the serving
+        # fleet rides host/port here so a heartbeat doubles as the
+        # replica's registration record (rank/time/step keys win).
+        self.payload = dict(payload) if payload else {}
         self.step = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -348,17 +353,20 @@ class HostHeartbeat:
             self.beat()
 
     def beat(self) -> None:
-        if faultinject.heartbeat_suppressed():
-            # partition_host chaos: the process lives, its beats don't
-            # land — _last_written stalls, so the self-fencing contract
-            # (write_stale_s past the fleet timeout) engages naturally
+        if faultinject.heartbeat_suppressed(self.rank):
+            # partition_host / partition_replica chaos: the process
+            # lives, its beats don't land — _last_written stalls, so the
+            # self-fencing contract (write_stale_s past the fleet
+            # timeout) engages naturally
             return
         path = _heartbeat_path(self.directory, self.rank)
         tmp = path.with_name(path.name + ".tmp")
         try:
-            tmp.write_text(json.dumps({"rank": self.rank,
-                                       "time": time.time(),
-                                       "step": self.step}))
+            record = dict(self.payload)
+            record.update({"rank": self.rank,
+                           "time": time.time(),
+                           "step": self.step})
+            tmp.write_text(json.dumps(record))
             os.replace(tmp, path)
             self._last_written = time.monotonic()
             self._warned = False
@@ -382,6 +390,17 @@ class HostHeartbeat:
             self._thread.join(timeout=2 * self.interval_s + 1.0)
             self._thread = None
 
+    def retire(self) -> None:
+        """Orderly leave: stop beating and delete the heartbeat file, so
+        peers see the host as GONE (file absent) rather than merely
+        stale — the distinction a zero-drop drain wants to advertise.
+        A crash, by contrast, leaves a stale file behind."""
+        self.stop()
+        try:
+            _heartbeat_path(self.directory, self.rank).unlink()
+        except OSError:
+            pass
+
 
 def read_heartbeat_ages(directory: Union[str, Path]) -> Dict[int, float]:
     """{rank: seconds since last beat} for every heartbeat file in
@@ -396,6 +415,24 @@ def read_heartbeat_ages(directory: Union[str, Path]) -> Dict[int, float]:
         except (OSError, ValueError, KeyError):
             continue
     return ages
+
+
+def read_heartbeats(directory: Union[str, Path]) -> Dict[int, Dict[str, object]]:
+    """Full heartbeat records keyed by rank: the beat's payload plus an
+    ``age`` key (seconds since the beat landed). This is the serving
+    fleet's registration read — a fresh record carrying host/port IS the
+    replica's rendezvous announcement. Unreadable/partial files are
+    skipped (the next beat replaces them)."""
+    out: Dict[int, Dict[str, object]] = {}
+    now = time.time()
+    for p in Path(directory).glob("hb_p*.json"):
+        try:
+            d = json.loads(p.read_text())
+            d["age"] = max(0.0, now - float(d["time"]))
+            out[int(d["rank"])] = d
+        except (OSError, ValueError, KeyError):
+            continue
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -119,6 +119,26 @@ Fleet-coordination fault kinds (ISSUE 12, the lease/rendezvous seams):
   into a world that has re-formed without it (split brain / torn
   shard).
 
+Serving-fleet fault kinds (ISSUE 18, the multi-replica seams):
+
+- ``kill_replica``     — hard-kill serving replica ``rank`` (its fleet
+  rank, not a host rank) at its ``at_call``-th admitted request: the
+  replica's listener and every established connection close abruptly
+  and its heartbeat stops — clients mid-request see a dead connection,
+  the router must fail the work over to a survivor. With ``step`` > 0
+  the kill fires mid-STREAM instead: at the replica's ``step``-th
+  streamed generation token, so the router's re-prefill continuation
+  (prompt + tokens-so-far on a survivor) is provable bitwise.
+- ``partition_replica``— from replica ``rank``'s ``at_call``-th admitted
+  request, suppress ITS heartbeat writes for ``duration`` seconds
+  (0 = until the schedule is cleared) while it keeps serving: the
+  router must classify the stale heartbeat as a loss and remove the
+  replica at an epoch bump even though its TCP endpoint still answers.
+- ``slow_replica``     — replica ``rank``'s ``at_call``-th admitted
+  request stalls ``duration`` seconds before dispatch (a straggling
+  replica): deadline budgets and the router's hedged duplicates are the
+  defense under test.
+
 Faults are one-shot: each schedule entry fires once, is counted in the
 metrics registry (``resilience_faults_injected_total``) and stamped as a
 tracer instant event, then disarms. ``step`` indexing is 1-based and
@@ -132,7 +152,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -144,7 +164,8 @@ _KINDS = ("raise", "nan", "truncate_checkpoint", "drop_connection",
           "slow_loris", "hang_backend", "burst", "corrupt_frame",
           "poison_row", "slow_batch", "slow_input", "io_error",
           "kill_host", "slow_host", "kill_coordinator", "rejoin_host",
-          "partition_host", "poison_decode", "evict_cache")
+          "partition_host", "poison_decode", "evict_cache",
+          "kill_replica", "partition_replica", "slow_replica")
 
 #: exit code of a ``kill_host`` hard exit — distinct so test drivers can
 #: assert the victim died BY the fault, not by a bug
@@ -178,7 +199,8 @@ class Fault:
     #                      drop_connection: "sub" (default) | "pub"
     duration: float = 0.0
     count: int = 0
-    rank: int = -1   # rejoin_host: the joining rank (-1 = lowest free)
+    rank: int = -1   # rejoin_host: the joining rank (-1 = lowest free);
+    #                  kill/partition/slow_replica: the target fleet rank
     fired: bool = False
 
     def __post_init__(self):
@@ -216,6 +238,14 @@ _decode_iters = 0
 #: (``partition_host``); None = no partition in effect, inf = until the
 #: schedule is cleared
 _partition_until: Optional[float] = None
+#: per-replica-rank admitted-request counters (``kill_replica`` /
+#: ``partition_replica`` / ``slow_replica`` at_call addressing)
+_replica_requests: Dict[int, int] = {}
+#: per-replica-rank streamed-token counters (``kill_replica`` with
+#: ``step`` > 0 — the mid-stream kill address)
+_replica_tokens: Dict[int, int] = {}
+#: per-replica-rank heartbeat-suppression windows (``partition_replica``)
+_replica_partition_until: Dict[int, float] = {}
 
 
 def set_schedule(schedule: Optional[FaultSchedule]) -> None:
@@ -228,6 +258,9 @@ def set_schedule(schedule: Optional[FaultSchedule]) -> None:
     global _partition_until
     with _lock:
         _schedule = schedule
+        _replica_requests.clear()
+        _replica_tokens.clear()
+        _replica_partition_until.clear()
         _commit_calls = 0
         _recv_calls = 0
         _pub_calls = 0
@@ -385,15 +418,82 @@ def check_partition(step: int) -> None:
                 return
 
 
-def heartbeat_suppressed() -> bool:
+def heartbeat_suppressed(rank: Optional[int] = None) -> bool:
     """Consulted by ``HostHeartbeat.beat`` before every write: True
     while a ``partition_host`` window is open — the beat is silently
     dropped, the file on disk goes stale, and both sides of the
     partition contract engage (peer-side loss classification, victim's
-    self-fencing via ``write_stale_s``)."""
+    self-fencing via ``write_stale_s``). With ``rank`` given, a
+    ``partition_replica`` window for that rank suppresses the beat too
+    (the global ``partition_host`` window still applies — multiple
+    in-process replicas share one schedule)."""
     with _lock:
-        return (_partition_until is not None
-                and time.monotonic() < _partition_until)
+        if (_partition_until is not None
+                and time.monotonic() < _partition_until):
+            return True
+        if rank is not None:
+            until = _replica_partition_until.get(int(rank))
+            return until is not None and time.monotonic() < until
+        return False
+
+
+def on_replica_request(rank: int) -> Tuple[float, bool]:
+    """Called by a fleet replica's server per ADMITTED request (probes —
+    health/readyz/debug — don't count, so ``at_call`` stays predictable
+    under router polling). Increments the rank's request counter once
+    and fires every replica kind addressed at it:
+
+    - ``slow_replica``      → first element: stall seconds (caller
+      sleeps OUTSIDE the harness lock, before dispatch)
+    - ``partition_replica`` → opens the rank's heartbeat-suppression
+      window (``duration`` seconds, 0 = until cleared)
+    - ``kill_replica`` (``step`` == 0) → second element True: the caller
+      must hard-kill itself (close listener + connections, stop beats)
+
+    Returns ``(stall_s, kill)``."""
+    rank = int(rank)
+    stall = 0.0
+    kill = False
+    with _lock:
+        if _schedule is None:
+            return 0.0, False
+        n = _replica_requests.get(rank, 0) + 1
+        _replica_requests[rank] = n
+        for f in _schedule.pending():
+            if f.rank != rank or f.at_call != n:
+                continue
+            if f.kind == "slow_replica":
+                _fire(f, rank=rank, request=n, duration=f.duration)
+                stall = max(stall, f.duration)
+            elif f.kind == "partition_replica":
+                _fire(f, rank=rank, request=n, duration=f.duration)
+                _replica_partition_until[rank] = (
+                    float("inf") if f.duration <= 0
+                    else time.monotonic() + f.duration)
+            elif f.kind == "kill_replica" and f.step <= 0:
+                _fire(f, rank=rank, request=n)
+                kill = True
+    return stall, kill
+
+
+def check_kill_replica_token(rank: int) -> bool:
+    """Called by a fleet replica's server per streamed generation token
+    (before the partial hits the wire): True when a ``kill_replica``
+    fault with ``step`` > 0 is addressed at this rank's ``step``-th
+    token since arming — the caller hard-kills itself MID-STREAM, the
+    exact seam the router's re-prefill continuation defends."""
+    rank = int(rank)
+    with _lock:
+        if _schedule is None:
+            return False
+        n = _replica_tokens.get(rank, 0) + 1
+        _replica_tokens[rank] = n
+        for f in _schedule.pending():
+            if (f.kind == "kill_replica" and f.rank == rank
+                    and f.step > 0 and f.step == n):
+                _fire(f, rank=rank, token=n)
+                return True
+        return False
 
 
 def on_checkpoint_commit(tmp: Path, final: Path) -> None:

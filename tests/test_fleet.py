@@ -1,0 +1,335 @@
+"""The multi-replica serving fleet (ISSUE 18): lease-based membership
+behind the readyz gate, failover routing under the PR-4/6 failure
+taxonomy, hedged tail defense, and zero-drop leaves.
+
+The chaos kinds (``kill_replica`` / ``partition_replica`` /
+``slow_replica``) drive the failure paths; everything runs in-process
+over real sockets. The full storm/bitwise/rolling gates live in
+``tools/fleet_smoke.py`` — these tests pin the individual contracts."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.keras.fleet import (ROUTER_COORDINATOR,
+                                            FleetReplica, FleetRouter)
+from deeplearning4j_tpu.keras.server import KerasClient
+from deeplearning4j_tpu.nn.layers import OutputLayer
+from deeplearning4j_tpu.profiling.metrics import (MetricsRegistry,
+                                                  get_registry,
+                                                  set_registry)
+from deeplearning4j_tpu.resilience import faultinject, service
+from deeplearning4j_tpu.resilience.elastic import read_lease
+from deeplearning4j_tpu.resilience.faultinject import (Fault,
+                                                       FaultSchedule)
+from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    prev = set_registry(MetricsRegistry())
+    yield
+    faultinject.clear()
+    with service._guards_lock:
+        service._guards.clear()
+    set_registry(prev)
+
+
+@pytest.fixture()
+def workload(tmp_path):
+    """Tiny MLP zip + a features file — the smallest servable model."""
+    conf = (NeuralNetConfiguration.builder().updater("sgd")
+            .learning_rate(0.1).seed(3).list()
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(3)).build())
+    zip_path = str(tmp_path / "m.zip")
+    ModelSerializer.write_model(MultiLayerNetwork(conf).init(), zip_path)
+    x_path = str(tmp_path / "x.npy")
+    np.save(x_path, np.zeros((2, 3), np.float32))
+    return zip_path, x_path
+
+
+def _fleet(tmp_path, model, ranks, **router_kw):
+    fdir = str(tmp_path / "fleet")
+    kw = dict(poll_s=0.1, heartbeat_timeout_s=1.0, metrics_port=None,
+              default_deadline_ms=60_000)
+    kw.update(router_kw)
+    router = FleetRouter(fdir, **kw)
+    reps = {r: FleetReplica(fdir, r, model=model, max_batch=4,
+                            default_deadline_ms=30_000)
+            for r in ranks}
+    assert router.wait_for_replicas(len(ranks), timeout_s=30.0), \
+        f"fleet never formed: {router.replicas()}"
+    return fdir, router, reps
+
+
+def _teardown(router, reps):
+    faultinject.clear()
+    router.close()
+    for rep in reps.values():
+        rep.drain(grace_s=5.0)
+
+
+def _predict(router, x, model, **kw):
+    cli = KerasClient(router.host, router.port)
+    try:
+        return cli.request(op="predict", features=x, model=model, **kw)
+    finally:
+        cli.close()
+
+
+def _counter(name):
+    m = get_registry().get(name)
+    return 0 if m is None else m.value
+
+
+# ------------------------------------------------------------- membership
+
+def test_admission_is_readyz_gated_and_leased(tmp_path, workload):
+    """A heartbeat alone (even with a serving payload) does not admit:
+    membership requires the readyz probe to answer ready. Admission
+    lands in the shared-dir lease at a bumped epoch with the router as
+    coordinator."""
+    model, x = workload
+    fdir = str(tmp_path / "fleet")
+    router = FleetRouter(fdir, poll_s=0.1, heartbeat_timeout_s=1.0,
+                         metrics_port=None)
+    try:
+        # a liar: fresh heartbeat with a payload pointing at a dead
+        # port — readyz can never answer, so it must never join
+        hb = tmp_path / "fleet" / "hb_p99.json"
+        for _ in range(8):
+            hb.write_text(json.dumps(
+                {"rank": 99, "time": time.time(), "step": 0,
+                 "host": "127.0.0.1", "port": 1}))
+            time.sleep(0.1)
+        assert router.replicas() == []
+
+        rep = FleetReplica(fdir, 0, model=model)
+        try:
+            assert router.wait_for_replicas(1, timeout_s=30.0)
+            assert router.replicas() == [0]
+            lease = read_lease(fdir)
+            assert lease is not None
+            assert lease["coordinator"] == ROUTER_COORDINATOR
+            assert lease["world"] == [0]
+            assert lease["epoch"] >= 1
+            # the replica's own readyz agrees it is servable
+            rz = rep.readyz()
+            assert rz["ready"] and rz["checks"]["model_loaded"]
+        finally:
+            rep.drain(grace_s=5.0)
+    finally:
+        router.close()
+
+
+def test_partitioned_replica_removed_then_readmitted(tmp_path, workload):
+    """A partition (suppressed heartbeats) removes the replica at an
+    epoch bump while the survivor keeps serving; when the partition
+    heals, the replica re-admits through the readyz gate at a fresh
+    epoch — no operator involved."""
+    model, x = workload
+    fdir, router, reps = _fleet(tmp_path, model, (0, 1))
+    try:
+        epoch0 = router.epoch
+        faultinject.set_schedule(FaultSchedule(
+            [Fault("partition_replica", rank=0, at_call=1,
+                   duration=2.0),
+             Fault("partition_replica", rank=1, at_call=1,
+                   duration=2.0)]))
+        # serve through the partition window: at least one replica's
+        # beats go dark, the router drops it, requests keep completing
+        t_end = time.monotonic() + 6.0
+        dipped = False
+        while time.monotonic() < t_end:
+            r = _predict(router, x, model)
+            assert r.get("ok"), f"client-visible failure: {r}"
+            if len(router.replicas()) < 2:
+                dipped = True
+            if dipped and len(router.replicas()) == 2:
+                break
+            time.sleep(0.1)
+        assert dipped, "partition never removed a replica"
+        assert _counter("fleet_removals_total") >= 1
+        # healed: back to full strength at a strictly newer epoch
+        assert router.wait_for_replicas(2, timeout_s=20.0)
+        assert router.epoch > epoch0 + 1
+        assert read_lease(fdir)["world"] == [0, 1]
+    finally:
+        _teardown(router, reps)
+
+
+# --------------------------------------------------------------- failover
+
+def test_predict_failover_on_kill_zero_client_failures(tmp_path,
+                                                       workload):
+    """A replica hard-killed mid-storm costs zero client-visible
+    failures: in-flight and subsequent requests fail over to the
+    survivor, the corpse leaves the membership."""
+    model, x = workload
+    fdir, router, reps = _fleet(tmp_path, model, (0, 1))
+    try:
+        kill = Fault("kill_replica", rank=0, at_call=2)
+        faultinject.set_schedule(FaultSchedule([kill]))
+        failures, lock = [], threading.Lock()
+
+        def one(i):
+            try:
+                r = _predict(router, x, model)
+                if not r.get("ok"):
+                    raise RuntimeError(str(r))
+            except Exception as e:  # noqa: BLE001 — the assertion
+                with lock:
+                    failures.append(f"req {i}: {e}")
+
+        threads = [threading.Thread(target=one, args=(i,), daemon=True)
+                   for i in range(10)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+        assert not failures, failures
+        assert kill.fired, "kill_replica never fired"
+        assert _counter("fleet_failovers_total") >= 1
+        t_end = time.monotonic() + 10.0
+        while 0 in router.replicas() and time.monotonic() < t_end:
+            time.sleep(0.05)
+        assert router.replicas() == [1]
+    finally:
+        _teardown(router, reps)
+
+
+def test_client_errors_pass_through_uncharged(tmp_path, workload):
+    """A client-input failure (missing features file) is the CLIENT's
+    error: the envelope passes through unretried, the replica stays a
+    member, and its breaker is never charged — a poisoned request must
+    not bounce around the fleet opening circuits."""
+    model, x = workload
+    fdir, router, reps = _fleet(tmp_path, model, (0,),
+                                breaker_failures=2)
+    try:
+        for _ in range(4):  # enough repeats to open a 2-failure breaker
+            cli = KerasClient(router.host, router.port)
+            try:
+                with pytest.raises(RuntimeError):
+                    cli.request(op="predict",
+                                features=str(tmp_path / "nope.npy"),
+                                model=model)
+            finally:
+                cli.close()
+        assert router.replicas() == [0]
+        assert _counter("fleet_failovers_total") == 0
+        assert _counter("fleet_retries_total") == 0
+        r = _predict(router, x, model)  # the member still serves
+        assert r.get("ok")
+    finally:
+        _teardown(router, reps)
+
+
+def test_hedged_predict_beats_slow_replica(tmp_path, workload):
+    """With hedging armed, a predict stuck on a slow replica is
+    duplicated to the other member after the hedge delay and the fast
+    answer wins — tail latency bounded by the hedge, not the stall."""
+    model, x = workload
+    fdir, router, reps = _fleet(tmp_path, model, (0, 1),
+                                hedge_ms=100.0)
+    try:
+        # discover which member the idle tie-break dispatches to (the
+        # counters are per-rank; an empty schedule still counts)
+        faultinject.set_schedule(FaultSchedule([]))
+        assert _predict(router, x, model).get("ok")
+        primary = max(faultinject._replica_requests,
+                      key=faultinject._replica_requests.get)
+        # its NEXT request stalls well past the hedge delay
+        faultinject.set_schedule(FaultSchedule(
+            [Fault("slow_replica", rank=primary, at_call=1,
+                   duration=2.0)]))
+        t0 = time.monotonic()
+        r = _predict(router, x, model)
+        elapsed = time.monotonic() - t0
+        assert r.get("ok")
+        assert elapsed < 1.5, \
+            f"hedge never rescued the stalled predict ({elapsed:.2f}s)"
+        assert _counter("fleet_hedges_total") >= 1
+        assert _counter("fleet_hedge_wins_total") >= 1
+    finally:
+        _teardown(router, reps)
+
+
+# ------------------------------------------------------------ op surface
+
+def test_fit_is_unroutable_and_unknown_op_rejected(tmp_path, workload):
+    model, x = workload
+    fdir, router, reps = _fleet(tmp_path, model, (0,))
+    try:
+        cli = KerasClient(router.host, router.port)
+        try:
+            with pytest.raises(RuntimeError, match="UNROUTABLE"):
+                cli.request(op="fit", model=model)
+            with pytest.raises(RuntimeError, match="ValueError"):
+                cli.request(op="frobnicate")
+            # the connection survives structured rejections
+            assert cli.request(op="predict", features=x,
+                               model=model).get("ok")
+        finally:
+            cli.close()
+    finally:
+        _teardown(router, reps)
+
+
+def test_router_drain_rejects_new_work_structured(tmp_path, workload):
+    """A draining router answers DRAINING (structured, retryable
+    elsewhere), not a dropped connection."""
+    model, x = workload
+    fdir, router, reps = _fleet(tmp_path, model, (0,))
+    try:
+        assert _predict(router, x, model).get("ok")
+        router._guard.start_drain()
+        with pytest.raises(RuntimeError, match="DRAINING"):
+            _predict(router, x, model)
+    finally:
+        _teardown(router, reps)
+
+
+def test_replica_drain_is_zero_drop_leave(tmp_path, workload):
+    """Draining a member under light load never surfaces a failure:
+    the heartbeat retires first (routing moves within a poll), raced
+    requests reroute on DRAINING, in-flight work completes."""
+    model, x = workload
+    fdir, router, reps = _fleet(tmp_path, model, (0, 1))
+    try:
+        stop = threading.Event()
+        failures, lock = [], threading.Lock()
+
+        def load():
+            while not stop.is_set():
+                try:
+                    r = _predict(router, x, model)
+                    if not r.get("ok"):
+                        raise RuntimeError(str(r))
+                except Exception as e:  # noqa: BLE001 — the assertion
+                    with lock:
+                        failures.append(str(e))
+                    return
+                time.sleep(0.01)
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        assert reps[0].drain(grace_s=10.0)
+        t_end = time.monotonic() + 10.0
+        while 0 in router.replicas() and time.monotonic() < t_end:
+            time.sleep(0.05)
+        time.sleep(0.3)  # a little post-leave load on the survivor
+        stop.set()
+        t.join(30.0)
+        assert not failures, failures
+        assert router.replicas() == [1]
+    finally:
+        _teardown(router, reps)

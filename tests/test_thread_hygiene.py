@@ -100,6 +100,21 @@ def test_keras_server_drain_after_served_request(tmp_path):
     _assert_settled(base)
 
 
+def test_fleet_router_close_reaps_monitor_acceptor_http(tmp_path):
+    """FleetRouter owns three threads (membership monitor, TCP
+    acceptor, metrics HTTP) — close() joins all of them, enumerate()
+    returns to baseline. Idempotent: a second close is a no-op."""
+    from deeplearning4j_tpu.keras.fleet import FleetRouter
+
+    base = _baseline()
+    router = FleetRouter(str(tmp_path / "fleet"), poll_s=0.05,
+                         metrics_port=0)
+    assert _baseline() - base, "router should have started threads"
+    router.close()
+    router.close()
+    _assert_settled(base)
+
+
 def test_ui_server_drain_reaps_acceptor():
     base = _baseline()
     srv = UIServer(port=0).start()

@@ -1,0 +1,405 @@
+#!/usr/bin/env python
+"""Serving-fleet smoke stage (tools/run_checks.sh, ISSUE 18).
+
+Three in-process replicas behind a ``FleetRouter`` must prove, end to
+end over real sockets, the fleet's whole robustness contract:
+
+1. **Kill-replica mid-load** — with a predict storm in flight, a
+   ``kill_replica`` fault hard-kills one of three replicas (listener
+   closed, connections severed, heartbeat stopped cold). Every client
+   request still completes — zero client-visible failures — and
+   ``fleet_failovers_total`` shows the router actually rerouted.
+2. **Mid-stream generate failover** — a replica dies by schedule after
+   streaming its 3rd token; the router re-prefills on a survivor (which
+   joins late, behind the readyz gate) from prompt + tokens-so-far, and
+   the client's assembled token stream is BITWISE the singleton
+   ``greedy_generate`` sequence.
+3. **Rolling drain-restart** — every replica in the fleet is replaced
+   (admit successor, drain predecessor) under continuous client load
+   with zero dropped requests: the drained member retires its
+   heartbeat, finishes in-flight work, and raced requests reroute on
+   ``DRAINING`` without charging anyone's breaker.
+4. **Observability** — the ``fleet_*`` counter family is visible on the
+   router's ``/api/metrics`` (Prometheus text + JSON mirror) and its
+   ``/readyz`` answers 200 while members exist.
+
+Exit 0 = the fleet edge is wired end to end.
+"""
+
+import json
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from urllib.error import HTTPError
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _stream_generate(host, port, tokens, max_new, model):
+    """Raw streaming client: returns (partials, final response)."""
+    partials = []
+    with socket.create_connection((host, port), timeout=120) as s:
+        s.settimeout(120)
+        f = s.makefile("rwb")
+        f.write((json.dumps({"op": "generate", "tokens": tokens,
+                             "max_new_tokens": max_new, "model": model,
+                             "stream": True}) + "\n").encode())
+        f.flush()
+        while True:
+            line = f.readline()
+            if not line:
+                raise ConnectionError("router closed mid-stream")
+            resp = json.loads(line)
+            if resp.get("partial"):
+                partials.append(int(resp["t"]))
+                continue
+            f.close()
+            return partials, resp
+
+
+def _wait_removed(router, rank, timeout_s=15.0):
+    t_end = time.monotonic() + timeout_s
+    while time.monotonic() < t_end:
+        if rank not in router.replicas():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _counter(registry, name):
+    m = registry.get(name)
+    return 0 if m is None else m.value
+
+
+def main() -> int:
+    import numpy as np
+
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.datasets.iris import load_iris
+    from deeplearning4j_tpu.keras.fleet import FleetReplica, FleetRouter
+    from deeplearning4j_tpu.keras.server import KerasClient
+    from deeplearning4j_tpu.models.gpt import gpt_tiny, greedy_generate
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.profiling.metrics import (MetricsRegistry,
+                                                      set_registry)
+    from deeplearning4j_tpu.resilience import faultinject
+    from deeplearning4j_tpu.resilience.faultinject import (Fault,
+                                                           FaultSchedule)
+    from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+    registry = MetricsRegistry()
+    prev = set_registry(registry)
+    n0 = threading.active_count()
+    try:
+        conf = (NeuralNetConfiguration.builder().updater("adam")
+                .learning_rate(0.05).seed(7).list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        mlp = MultiLayerNetwork(conf).init()
+        gpt = ComputationGraph(gpt_tiny(vocab_size=13, seq_len=16)).init()
+        with tempfile.TemporaryDirectory() as d:
+            mlp_zip = os.path.join(d, "iris.zip")
+            gpt_zip = os.path.join(d, "gpt.zip")
+            ModelSerializer.write_model(mlp, mlp_zip)
+            ModelSerializer.write_model(gpt, gpt_zip)
+            x = os.path.join(d, "x.npy")
+            np.save(x, load_iris().features[:4])
+
+            for phase, fn in (("kill-under-load", _phase_kill),
+                              ("mid-stream failover", _phase_midstream),
+                              ("rolling drain", _phase_rolling)):
+                rc = fn(d, mlp_zip, gpt_zip, x, gpt, np, KerasClient,
+                        FleetReplica, FleetRouter, faultinject, Fault,
+                        FaultSchedule, registry, greedy_generate)
+                faultinject.clear()
+                if rc != 0:
+                    return rc
+                print(f"fleet_smoke: phase OK — {phase}")
+
+        t_end = time.monotonic() + 15.0
+        while threading.active_count() > n0 + 2:
+            if time.monotonic() > t_end:
+                print(f"fleet_smoke: FAIL thread leak "
+                      f"({threading.active_count()} vs baseline {n0})")
+                return 1
+            time.sleep(0.05)
+        print("fleet_smoke: OK — kill-under-load, mid-stream generate "
+              "failover (bitwise), rolling drain-restart (zero drops), "
+              "fleet_* metrics served")
+        return 0
+    finally:
+        faultinject.clear()
+        set_registry(prev)
+
+
+def _phase_kill(d, mlp_zip, gpt_zip, x, gpt, np, KerasClient,
+                FleetReplica, FleetRouter, faultinject, Fault,
+                FaultSchedule, registry, greedy_generate) -> int:
+    """Three replicas, 24-predict storm, one hard-killed by schedule on
+    its 3rd admitted request: zero client-visible failures."""
+    fdir = os.path.join(d, "fleet_a")
+    router = FleetRouter(fdir, poll_s=0.1, heartbeat_timeout_s=1.5,
+                         max_concurrency=24, queue_depth=64,
+                         default_deadline_ms=120_000)
+    reps = {r: FleetReplica(fdir, r, model=mlp_zip, max_concurrency=8,
+                            queue_depth=32, default_deadline_ms=60_000)
+            for r in (0, 1, 2)}
+    try:
+        if not router.wait_for_replicas(3, timeout_s=30.0):
+            print(f"fleet_smoke: FAIL fleet never formed "
+                  f"({router.replicas()})")
+            return 1
+        kill = Fault("kill_replica", rank=0, at_call=3)
+        faultinject.set_schedule(FaultSchedule([kill]))
+        ref = None
+        failures, lock = [], threading.Lock()
+
+        def one(i):
+            nonlocal ref
+            try:
+                cli = KerasClient(router.host, router.port)
+                try:
+                    got = cli.predict(x, model=mlp_zip)
+                finally:
+                    cli.close()
+                with lock:
+                    if ref is None:
+                        ref = got
+                    elif not np.array_equal(got, ref):
+                        failures.append(f"req {i}: prediction diverged")
+            except Exception as e:  # noqa: BLE001 — the gate itself
+                with lock:
+                    failures.append(f"req {i}: {type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=one, args=(i,), daemon=True)
+                   for i in range(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120.0)
+        if failures:
+            print(f"fleet_smoke: FAIL client-visible failures under "
+                  f"kill_replica: {failures}")
+            return 1
+        if not kill.fired:
+            print("fleet_smoke: FAIL kill_replica never fired")
+            return 1
+        if _counter(registry, "fleet_failovers_total") < 1:
+            print("fleet_smoke: FAIL no failover recorded despite kill")
+            return 1
+        if not _wait_removed(router, 0):
+            print("fleet_smoke: FAIL killed replica never removed "
+                  "from membership")
+            return 1
+        return 0
+    finally:
+        faultinject.clear()
+        router.close()
+        for rep in reps.values():
+            rep.drain(grace_s=5.0)
+
+
+def _phase_midstream(d, mlp_zip, gpt_zip, x, gpt, np, KerasClient,
+                     FleetReplica, FleetRouter, faultinject, Fault,
+                     FaultSchedule, registry, greedy_generate) -> int:
+    """A generate's replica dies after streaming 3 tokens; a survivor
+    joining late (readyz-gated) continues the stream bitwise."""
+    prompt = [3, 1, 4, 1, 5]
+    max_new = 10
+    ref = greedy_generate(gpt, prompt, max_new)
+    fdir = os.path.join(d, "fleet_b")
+    router = FleetRouter(fdir, poll_s=0.1, heartbeat_timeout_s=1.5,
+                         empty_pool_wait_s=60.0,
+                         default_deadline_ms=300_000)
+    victim = FleetReplica(fdir, 10, model=gpt_zip, max_batch=4,
+                          default_deadline_ms=120_000)
+    survivor = None
+    try:
+        if not router.wait_for_replicas(1, timeout_s=30.0):
+            print("fleet_smoke: FAIL victim replica never admitted")
+            return 1
+        kill = Fault("kill_replica", rank=10, step=3)
+        faultinject.set_schedule(FaultSchedule([kill]))
+        out, errs = {}, []
+
+        def gen():
+            try:
+                out["partials"], out["resp"] = _stream_generate(
+                    router.host, router.port, prompt, max_new, gpt_zip)
+            except Exception as e:  # noqa: BLE001 — the gate itself
+                errs.append(f"{type(e).__name__}: {e}")
+
+        t = threading.Thread(target=gen, daemon=True)
+        t.start()
+        # the survivor arrives only AFTER the stream is already running
+        # — admission rides the readyz gate while the router waits
+        survivor = FleetReplica(fdir, 11, model=gpt_zip, max_batch=4,
+                                default_deadline_ms=120_000)
+        t.join(240.0)
+        if errs or "resp" not in out:
+            print(f"fleet_smoke: FAIL mid-stream generate errored "
+                  f"({errs or 'timed out'})")
+            return 1
+        resp = out["resp"]
+        if not resp.get("ok"):
+            print(f"fleet_smoke: FAIL generate response {resp}")
+            return 1
+        if not kill.fired:
+            print("fleet_smoke: FAIL mid-stream kill never fired")
+            return 1
+        if resp["tokens"] != ref or out["partials"] != ref:
+            print(f"fleet_smoke: FAIL failover stream diverged from "
+                  f"singleton (final {resp['tokens']}, streamed "
+                  f"{out['partials']}, ref {ref})")
+            return 1
+        if resp.get("failovers", 0) < 1 \
+                or _counter(registry, "fleet_generate_resumes_total") < 1:
+            print(f"fleet_smoke: FAIL no mid-stream resume recorded "
+                  f"({resp})")
+            return 1
+        return 0
+    finally:
+        faultinject.clear()
+        router.close()
+        victim.drain(grace_s=5.0)
+        if survivor is not None:
+            survivor.drain(grace_s=5.0)
+
+
+def _phase_rolling(d, mlp_zip, gpt_zip, x, gpt, np, KerasClient,
+                   FleetReplica, FleetRouter, faultinject, Fault,
+                   FaultSchedule, registry, greedy_generate) -> int:
+    """Replace every replica (admit successor, drain predecessor) under
+    continuous load: zero dropped requests."""
+    fdir = os.path.join(d, "fleet_c")
+    adm0 = _counter(registry, "fleet_admissions_total")
+    rem0 = _counter(registry, "fleet_removals_total")
+    router = FleetRouter(fdir, poll_s=0.1, heartbeat_timeout_s=1.5,
+                         max_concurrency=16, queue_depth=64,
+                         default_deadline_ms=120_000)
+    reps = {r: FleetReplica(fdir, r, model=mlp_zip, max_concurrency=8,
+                            queue_depth=32, default_deadline_ms=60_000)
+            for r in (0, 1, 2)}
+    stop = threading.Event()
+    counts = {"ok": 0}
+    failures, lock = [], threading.Lock()
+
+    def load(i):
+        while not stop.is_set():
+            try:
+                cli = KerasClient(router.host, router.port)
+                try:
+                    cli.predict(x, model=mlp_zip)
+                finally:
+                    cli.close()
+                with lock:
+                    counts["ok"] += 1
+            except Exception as e:  # noqa: BLE001 — the gate itself
+                with lock:
+                    failures.append(f"loader {i}: "
+                                    f"{type(e).__name__}: {e}")
+                return
+            time.sleep(0.01)
+
+    loaders = []
+    try:
+        if not router.wait_for_replicas(3, timeout_s=30.0):
+            print("fleet_smoke: FAIL rolling fleet never formed")
+            return 1
+        loaders = [threading.Thread(target=load, args=(i,), daemon=True)
+                   for i in range(4)]
+        for t in loaders:
+            t.start()
+        for old in (0, 1, 2):
+            new = old + 10
+            reps[new] = FleetReplica(fdir, new, model=mlp_zip,
+                                     max_concurrency=8, queue_depth=32,
+                                     default_deadline_ms=60_000)
+            if not router.wait_for_replicas(4, timeout_s=30.0):
+                print(f"fleet_smoke: FAIL replacement {new} never "
+                      f"admitted")
+                return 1
+            if not reps[old].drain(grace_s=15.0):
+                print(f"fleet_smoke: FAIL replica {old} drain grace "
+                      f"expired with work in flight")
+                return 1
+            if not _wait_removed(router, old):
+                print(f"fleet_smoke: FAIL drained replica {old} never "
+                      f"left membership")
+                return 1
+        time.sleep(0.3)  # a little post-roll load on the new fleet
+        stop.set()
+        for t in loaders:
+            t.join(60.0)
+        if failures:
+            print(f"fleet_smoke: FAIL dropped requests during rolling "
+                  f"drain: {failures}")
+            return 1
+        if counts["ok"] < 50:
+            print(f"fleet_smoke: FAIL implausibly little load survived "
+                  f"the roll ({counts['ok']} requests)")
+            return 1
+        if sorted(router.replicas()) != [10, 11, 12]:
+            print(f"fleet_smoke: FAIL post-roll membership "
+                  f"{router.replicas()}")
+            return 1
+        adm = _counter(registry, "fleet_admissions_total") - adm0
+        rem = _counter(registry, "fleet_removals_total") - rem0
+        if adm < 6 or rem < 3:
+            print(f"fleet_smoke: FAIL membership accounting "
+                  f"(admissions {adm}, removals {rem})")
+            return 1
+        # ---- observability: fleet_* on the router's /api/metrics
+        base = f"http://127.0.0.1:{router.metrics_port}"
+        with urllib.request.urlopen(f"{base}/api/metrics",
+                                    timeout=10) as r:
+            text = r.read().decode()
+        needed = ("fleet_replicas", "fleet_epoch",
+                  "fleet_dispatches_total", "fleet_failovers_total",
+                  "fleet_admissions_total", "fleet_removals_total",
+                  "fleet_generate_resumes_total")
+        missing = [n for n in needed if f"\n{n} " not in "\n" + text
+                   and not text.startswith(f"{n} ")]
+        if missing:
+            print(f"fleet_smoke: FAIL /api/metrics missing {missing}")
+            return 1
+        with urllib.request.urlopen(f"{base}/api/metrics.json",
+                                    timeout=10) as r:
+            as_json = json.loads(r.read())
+        if "fleet_replicas" not in as_json:
+            print("fleet_smoke: FAIL /api/metrics.json missing "
+                  "fleet_replicas")
+            return 1
+        try:
+            with urllib.request.urlopen(f"{base}/readyz",
+                                        timeout=10) as r:
+                code = r.status
+        except HTTPError as e:
+            code = e.code
+        if code != 200:
+            print(f"fleet_smoke: FAIL router /readyz {code} with "
+                  f"members present")
+            return 1
+        print(f"fleet_smoke: rolling — {counts['ok']} requests, zero "
+              f"drops, admissions {adm}, removals {rem}")
+        return 0
+    finally:
+        stop.set()
+        for t in loaders:
+            t.join(10.0)
+        router.close()
+        for rep in reps.values():
+            rep.drain(grace_s=5.0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

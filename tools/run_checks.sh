@@ -30,7 +30,7 @@ stage() {
 bench_smoke() {
     rm -f /tmp/_bench_smoke.jsonl
     JAX_PLATFORMS=cpu BENCH_SMOKE=1 \
-        BENCH_RUNGS=lenet,input,serve,lm,lm_serve \
+        BENCH_RUNGS=lenet,input,serve,lm,lm_serve,fleet \
         BENCH_AUTOTUNE=1 BENCH_CHILD=1 \
         python bench.py | tee /tmp/_bench_smoke.jsonl || return 1
     # every successful rung record must carry the ISSUE-10 precision
@@ -89,6 +89,25 @@ for r in ls_:
         f"lm_serve timed wave recompiled: {r['decode_recompiles_timed_wave']}"
     assert r["vs_whole_predict"] > 1.0, \
         f"token-level serving did not beat whole-predict: {r['vs_whole_predict']}"
+# ISSUE 18: the fleet rung must carry the multi-replica serving schema
+# (aggregate rps-at-SLO + the single-server ratio measured on the same
+# workload) with R >= 2 replicas and zero request errors.
+# vs_single_server itself is not gated in smoke: R replicas share one
+# CPU there, so the ratio only means something on real parallel hardware
+fl = [r for r in recs if r.get("rung") == "fleet"]
+assert fl, "no fleet rung record emitted"
+for r in fl:
+    for fld in ("value", "single_server_rps", "vs_single_server",
+                "p50_ms", "p99_ms", "slo_attained"):
+        v = r.get(fld)
+        assert v is not None and math.isfinite(float(v)), \
+            f"fleet record {fld} missing or non-finite: {v!r}"
+    assert r.get("replicas", 0) >= 2, \
+        f"fleet rung ran with {r.get('replicas')} replica(s)"
+    assert r.get("comm_bytes_hlo", "MISSING") is None, \
+        "fleet record comm_bytes_hlo convention broken"
+    assert not r.get("request_errors"), \
+        f"fleet rung dropped requests: {r['request_errors']}"
 print(f"bench record schema: {len(recs)} records OK "
       f"({len(tuned)} autotuned, lm tokens/sec/chip "
       f"{lm[0]['tokens_per_sec_per_chip']} @ seq {lm[0]['seq_len']}, "
@@ -136,7 +155,9 @@ if [ "${1:-}" != "--fast" ]; then
     stage "serve smoke"      env JAX_PLATFORMS=cpu python tools/serve_smoke.py
     stage "lm serve smoke (token-level)" env JAX_PLATFORMS=cpu \
         python tools/lm_serve_smoke.py
-    stage "bench smoke (autotuned lenet + input + serve + lm + lm_serve)" \
+    stage "fleet smoke (kill/failover/rolling drain)" env JAX_PLATFORMS=cpu \
+        python tools/fleet_smoke.py
+    stage "bench smoke (autotuned lenet + input + serve + lm + lm_serve + fleet)" \
         bench_smoke
     stage "zero1 smoke"      env JAX_PLATFORMS=cpu python tools/zero1_smoke.py
     stage "zero2 smoke"      env JAX_PLATFORMS=cpu python tools/zero2_smoke.py
