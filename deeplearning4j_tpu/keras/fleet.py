@@ -58,6 +58,22 @@ so the client's final token stream is BITWISE the singleton
 ``greedy_generate`` stream (same weights, deterministic CPU decode;
 ``fleet_generate_resumes_total`` counts the seam).
 
+**Overload degradation (ISSUE 19).** Failover retries and hedges are
+load *amplifiers* — they add traffic exactly when the pool is sickest —
+so both are gated by a shared SRE-style :class:`RetryBudget` (refilled
+as a fraction of successful dispatches): when the budget is dry a
+failed dispatch gets at most ONE free reroute then surfaces the
+structured error, and hedges are skipped entirely
+(``fleet_retry_budget_exhausted_total``). Under sustained overload at
+max capacity the :class:`~deeplearning4j_tpu.keras.autoscale.
+FleetAutoscaler` flips the router into **brownout**: bulk-class
+requests (the PR-14 priority taxonomy) shed with a structured
+``{"error": "SHED", "retry_after_ms": ...}`` while interactive traffic
+keeps its SLO. And a replica that repeatedly joins and dies within a
+window is **flap-quarantined** (``autoscale.FlapTracker``): the
+membership scan skips it for an exponentially growing, bounded delay
+instead of letting a crash-looper keep eating mid-stream generates.
+
 The router itself admits through its own ``ServiceGuard`` (bounded
 queue, deadlines, drain, ``/readyz``) and serves Prometheus metrics at
 ``http://host:metrics_port/api/metrics``.
@@ -77,18 +93,22 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from typing import Dict, List, Optional, Set, Tuple, Union
 
+from deeplearning4j_tpu.keras.autoscale import FlapTracker
+from deeplearning4j_tpu.keras.batching import priority_rank
 from deeplearning4j_tpu.keras.server import KerasServer
 from deeplearning4j_tpu.profiling.flightrec import record as flight_record
 from deeplearning4j_tpu.profiling.metrics import get_registry
 from deeplearning4j_tpu.profiling.tracer import get_tracer
+from deeplearning4j_tpu.resilience import faultinject
 from deeplearning4j_tpu.resilience.elastic import (HostHeartbeat,
                                                    clear_join_requests,
                                                    pending_join_ranks,
                                                    read_heartbeats,
                                                    read_lease, request_join,
                                                    write_lease)
-from deeplearning4j_tpu.resilience.service import (Deadline, ServiceError,
-                                                   ServiceGuard,
+from deeplearning4j_tpu.resilience.service import (Deadline, RetryBudget,
+                                                   ServiceError,
+                                                   ServiceGuard, ShedError,
                                                    CircuitBreaker,
                                                    backoff_delay,
                                                    register_guard,
@@ -208,17 +228,53 @@ class FleetReplica:
         self.server.on_hard_kill = self._hb.stop
         request_join(self._dir, self.rank)
         self._hb.start()
+        # flap_replica chaos: this incarnation dies shortly after the
+        # router admits it — the crash-looper the flap quarantine exists
+        # for. The watcher thread is joined on drain (LC005)
+        self._flap_stop = threading.Event()
+        self._flap_thread: Optional[threading.Thread] = None
+        flap_delay = faultinject.check_flap_spawn(self.rank)
+        if flap_delay is not None:
+            self._flap_thread = threading.Thread(
+                target=self._flap_loop, args=(float(flap_delay),),
+                daemon=True, name=f"flap-replica-{self.rank}")
+            self._flap_thread.start()
         flight_record("fleet", "replica_up", rank=self.rank,
                       port=self.port)
+
+    def _flap_loop(self, delay_s: float) -> None:
+        """Wait until this rank shows up in the lease world (admitted),
+        then hard-kill ``delay_s`` later — join-then-die, the shape a
+        crash-looping launcher produces."""
+        while not self._flap_stop.is_set():
+            lease = read_lease(self._dir)
+            if lease and self.rank in (lease.get("world") or []):
+                break
+            if self._flap_stop.wait(0.05):
+                return
+        if self._flap_stop.wait(delay_s):
+            return
+        flight_record("faultinject", "flap_kill", rank=self.rank)
+        self.kill()
 
     @property
     def draining(self) -> bool:
         return self.server.draining
 
+    @property
+    def alive(self) -> bool:
+        """False once the server was hard-killed (chaos drivers use
+        this to decide when to respawn a flapping incarnation)."""
+        return not self.server.killed
+
     def readyz(self) -> dict:
         return self.server._readyz()
 
     def drain(self, grace_s: float = 10.0) -> bool:
+        self._flap_stop.set()
+        if self._flap_thread is not None:
+            self._flap_thread.join(timeout=5.0)
+            self._flap_thread = None
         self._hb.retire()
         clear_join_requests(self._dir, [self.rank])
         drained = self.server.drain(grace_s)
@@ -255,6 +311,12 @@ class FleetRouter:
                  empty_pool_wait_s: float = 15.0,
                  connect_timeout_s: float = 2.0,
                  io_timeout_s: float = 120.0,
+                 retry_budget_capacity: float = 10.0,
+                 retry_budget_ratio: float = 0.1,
+                 flap_window_s: float = 5.0,
+                 flap_strikes: int = 2,
+                 flap_quarantine_base_s: float = 2.0,
+                 flap_quarantine_max_s: float = 60.0,
                  metrics_port: Optional[int] = 0):
         self._dir = Path(fleet_dir)
         self._dir.mkdir(parents=True, exist_ok=True)
@@ -270,6 +332,18 @@ class FleetRouter:
         self._breaker_kw = dict(failures=breaker_failures,
                                 cooldown_base=breaker_cooldown_base,
                                 cooldown_max=breaker_cooldown_max)
+        # one budget gates EVERY amplifier (failover retries + hedges):
+        # retries stay a bounded fraction of successful traffic
+        self._retry_budget = RetryBudget(capacity=retry_budget_capacity,
+                                         refill_ratio=retry_budget_ratio)
+        self._flaps = FlapTracker(window_s=flap_window_s,
+                                  strikes_to_quarantine=flap_strikes,
+                                  base_s=flap_quarantine_base_s,
+                                  max_s=flap_quarantine_max_s)
+        # flipped by the autoscaler's brownout state machine; read
+        # lock-free on the hot path (a bool write is atomic under the
+        # GIL and a one-request stale read is harmless)
+        self._brownout = False
         self._rng = random.Random()
         self._lock = threading.Lock()
         self._replicas: Dict[int, _Replica] = {}
@@ -365,12 +439,36 @@ class FleetRouter:
             "fleet_generate_resumes_total",
             help="mid-stream generations resumed on a survivor via "
                  "re-prefill from prompt + tokens-so-far")
+        self._m_budget_exhausted = reg.counter(
+            "fleet_retry_budget_exhausted_total",
+            help="retries/hedges suppressed because the retry budget "
+                 "was dry")
+        self._m_brownout_sheds = reg.counter(
+            "fleet_brownout_sheds_total",
+            help="bulk-class requests shed (structured SHED) while the "
+                 "router was in brownout")
+        self._m_quarantines = reg.counter(
+            "fleet_quarantines_total",
+            help="flap-quarantine episodes (a crash-looping replica "
+                 "put on probation)")
         self._g_replicas = reg.gauge(
             "fleet_replicas", help="current fleet membership size")
         self._g_epoch = reg.gauge(
             "fleet_epoch", help="current membership lease epoch")
+        self._g_brownout = reg.gauge(
+            "fleet_brownout",
+            help="1 while the router sheds bulk-class requests")
+        self._g_budget = reg.gauge(
+            "fleet_retry_budget_tokens",
+            help="retry-budget tokens currently available")
+        self._g_score = reg.labeled_gauge(
+            "fleet_replica_score",
+            help="per-replica dispatch score (lower routes sooner): "
+                 "2*inflight + queued + min(ttft_p99,1000)/1000")
         self._g_replicas.set(0)
         self._g_epoch.set(self._epoch)
+        self._g_brownout.set(0)
+        self._g_budget.set(self._retry_budget.tokens)
         # optional Prometheus sidecar: GET /api/metrics[.json], /readyz
         self._http = None
         self._http_thread: Optional[threading.Thread] = None
@@ -418,6 +516,8 @@ class FleetRouter:
         # through the same readyz gate, at a fresh epoch)
         candidates = set(pending_join_ranks(self._dir)) | set(hbs)
         for rank in sorted(candidates - set(members)):
+            if self._flaps.blocked(rank):
+                continue  # on probation: re-admission delay still runs
             hb = hbs.get(rank)
             if hb is None or float(hb["age"]) > self.heartbeat_timeout_s:
                 continue
@@ -462,6 +562,11 @@ class FleetRouter:
                 if rep is not None:
                     rep.queued = int(rz.get("queued") or 0)
                     rep.ttft_p99_ms = float(rz.get("ttft_p99_ms") or 0.0)
+                    score = self._score_locked(rep)
+            if rep is not None:
+                # the dispatch score itself, per replica, so autoscaler
+                # decisions are explainable from /api/metrics alone
+                self._g_score.labels(rank=str(rank)).set(score)
 
     def _admit_replica(self, rank: int, host: str, port: int) -> None:
         with self._lock:
@@ -474,9 +579,11 @@ class FleetRouter:
             epoch, world = self._epoch, sorted(self._replicas)
         clear_join_requests(self._dir, [rank])
         self._publish_lease(epoch, world)
+        self._flaps.on_admit(rank)
         self._m_admissions.inc()
         self._g_replicas.set(len(world))
         self._g_epoch.set(epoch)
+        self._g_score.labels(rank=str(rank)).set(0.0)
         get_tracer().instant("fleet_admit", rank=rank, epoch=epoch)
         flight_record("fleet", "replica_admitted", rank=rank,
                       epoch=epoch, world=world)
@@ -491,10 +598,19 @@ class FleetRouter:
         self._m_removals.inc()
         self._g_replicas.set(len(world))
         self._g_epoch.set(epoch)
+        self._g_score.remove(rank=str(rank))
         get_tracer().instant("fleet_remove", rank=rank, epoch=epoch,
                              reason=reason)
         flight_record("fleet", "replica_removed", rank=rank,
                       epoch=epoch, reason=reason, world=world)
+        quarantine_s = self._flaps.on_remove(rank, reason)
+        if quarantine_s is not None:
+            self._m_quarantines.inc()
+            get_tracer().instant("fleet_quarantine", rank=rank,
+                                 delay_s=round(quarantine_s, 3))
+            flight_record("fleet", "replica_quarantined", rank=rank,
+                          delay_s=round(quarantine_s, 3),
+                          strikes=self._flaps.strikes(rank))
 
     def _publish_lease(self, epoch: int, world: List[int]) -> None:
         """Serialized, monotonic lease writes: a racing older epoch
@@ -633,11 +749,31 @@ class FleetRouter:
         if failure.dead_connection:
             self._remove_replica(failure.rep.rank, "dead_connection")
 
+    # --------------------------------------------------------- retry budget
+    def _budget_success(self) -> None:
+        """A replica answered: earn back a fraction of a retry token."""
+        self._retry_budget.on_success()
+        self._g_budget.set(self._retry_budget.tokens)
+
+    def _spend_retry(self, what: str) -> bool:
+        """Spend one budget token for a retry/hedge; False = dry (the
+        caller must stop amplifying)."""
+        if self._retry_budget.try_spend():
+            self._g_budget.set(self._retry_budget.tokens)
+            return True
+        self._m_budget_exhausted.inc()
+        flight_record("fleet", "retry_budget_exhausted", what=what)
+        return False
+
     # ------------------------------------------------------------- predict
     def _dispatch_predict(self, req: dict, deadline: Deadline) -> dict:
         attempt = 0
         tried: Set[int] = set()
         last_resp: Optional[dict] = None
+        # with a dry budget a failed dispatch still gets ONE reroute
+        # (a single replica death must not fail clients outright), but
+        # never a storm
+        free_reroute_used = False
         while True:
             deadline.check("fleet predict")
             rep = self._pick_for_dispatch(tried, deadline)
@@ -661,6 +797,14 @@ class FleetRouter:
                         f"predict: {attempt} attempts exhausted; last "
                         f"failure on replica {failure.rep.rank}: "
                         f"{failure.cause}") from failure.cause
+                if not self._spend_retry("predict"):
+                    if free_reroute_used:
+                        raise NoReplicaAvailable(
+                            f"predict: retry budget exhausted after "
+                            f"{attempt} attempt(s); last failure on "
+                            f"replica {failure.rep.rank}: "
+                            f"{failure.cause}") from failure.cause
+                    free_reroute_used = True
                 self._m_retries.inc()
                 self._m_failovers.inc()
                 flight_record("fleet", "failover", op="predict",
@@ -669,10 +813,12 @@ class FleetRouter:
                 continue
             if resp.get("error") is None:
                 used.breaker.record_success()
+                self._budget_success()
                 return resp
             verdict = _classify(resp)
             if verdict == "client":
                 used.breaker.record_success()
+                self._budget_success()
                 return resp
             if verdict == "replica":
                 used.breaker.record_failure()
@@ -681,6 +827,10 @@ class FleetRouter:
             attempt += 1
             if attempt > self.retries:
                 return resp
+            if not self._spend_retry("predict"):
+                if free_reroute_used:
+                    return resp  # surface the fleet's structured verdict
+                free_reroute_used = True
             self._m_retries.inc()
             if verdict == "replica":
                 self._m_failovers.inc()
@@ -730,9 +880,12 @@ class FleetRouter:
             first = None
         if first is None:
             # opportunistic: a hedge with nowhere to go just waits for
-            # the primary (never block on an empty pool here)
+            # the primary (never block on an empty pool here). A hedge
+            # is a duplicate — pure amplification — so it spends a
+            # retry-budget token; dry budget = no hedge, period
             hedge = self._try_pick(tried | {rep.rank})
-            if hedge is not None and hedge.rank != rep.rank:
+            if (hedge is not None and hedge.rank != rep.rank
+                    and self._spend_retry("hedge")):
                 self._m_hedges.inc()
                 flight_record("fleet", "hedge", primary=rep.rank,
                               hedge=hedge.rank)
@@ -790,6 +943,7 @@ class FleetRouter:
         failovers = 0
         attempt = 0
         tried: Set[int] = set()
+        free_reroute_used = False
         t0 = time.monotonic()
         first_token_s: Optional[float] = None
         final: Optional[dict] = None
@@ -838,6 +992,15 @@ class FleetRouter:
                         f"{len(sofar)} tokens streamed; last failure "
                         f"on replica {rep.rank}: {failure.cause}"
                     ) from failure.cause
+                if not self._spend_retry("generate"):
+                    if free_reroute_used:
+                        raise NoReplicaAvailable(
+                            f"generate: retry budget exhausted after "
+                            f"{attempt} attempt(s) with {len(sofar)} "
+                            f"tokens streamed; last failure on replica "
+                            f"{rep.rank}: {failure.cause}"
+                        ) from failure.cause
+                    free_reroute_used = True
                 self._m_retries.inc()
                 self._m_failovers.inc()
                 if sofar:
@@ -855,6 +1018,7 @@ class FleetRouter:
                 self._note_inflight(rep, -1)
             if resp.get("error") is None:
                 rep.breaker.record_success()
+                self._budget_success()
                 # reconcile: the final envelope carries this attempt's
                 # complete token list; partials lost to a transient
                 # stream-write failure on the replica still count
@@ -870,6 +1034,7 @@ class FleetRouter:
             verdict = _classify(resp)
             if verdict == "client":
                 rep.breaker.record_success()
+                self._budget_success()
                 return resp
             if verdict == "replica":
                 rep.breaker.record_failure()
@@ -877,6 +1042,10 @@ class FleetRouter:
             attempt += 1
             if attempt > self.retries:
                 return resp
+            if not self._spend_retry("generate"):
+                if free_reroute_used:
+                    return resp  # surface the fleet's structured verdict
+                free_reroute_used = True
             self._m_retries.inc()
             if verdict == "replica":
                 self._m_failovers.inc()
@@ -918,6 +1087,16 @@ class FleetRouter:
                 f"inference (predict/generate)")
         if op not in ("predict", "generate"):
             raise ValueError(f"unknown op {op!r}")
+        if self._brownout and priority_rank(
+                str(req.get("priority", "interactive"))) > 0:
+            # brownout: degrade by priority class, not for everyone —
+            # bulk sheds (structured, connection stays up) so
+            # interactive keeps its SLO
+            self._m_brownout_sheds.inc()
+            flight_record("fleet", "brownout_shed", op=op)
+            raise ShedError(
+                "fleet brownout: shedding bulk-class requests",
+                retry_after_ms=int(self._guard.max_queue_wait_s * 1000))
         deadline = self._guard.deadline(req)
         with self._guard.admit(deadline):
             flight_record("fleet", "dispatch", op=op)
@@ -930,16 +1109,66 @@ class FleetRouter:
         ready, reasons = self._guard.ready()
         with self._lock:
             epoch = self._epoch
+            brownout = self._brownout
             replicas = {
                 str(r.rank): {"host": r.host, "port": r.port,
                               "inflight": r.inflight,
                               "queued": r.queued,
                               "ttft_p99_ms": r.ttft_p99_ms,
-                              "breaker": r.breaker.state}
+                              "breaker": r.breaker.state,
+                              "score": self._score_locked(r)}
                 for r in self._replicas.values()}
+        if brownout:
+            # honest readiness: ready (interactive still serves), but
+            # the degradation is visible to anything that probes
+            reasons = list(reasons) + ["brownout: shedding bulk"]
         return {"ok": True, "ready": ready, "reasons": reasons,
                 "draining": self._guard.draining, "epoch": epoch,
+                "brownout": brownout,
+                "retry_budget_tokens": self._retry_budget.tokens,
                 "replicas": replicas}
+
+    # ------------------------------------------------------------- overload
+    @property
+    def brownout(self) -> bool:
+        return self._brownout
+
+    def set_brownout(self, active: bool, reason: str = "") -> None:
+        """Flip brownout shedding (the autoscaler's state machine owns
+        the transitions; operators can force it too). Idempotent."""
+        active = bool(active)
+        with self._lock:
+            if self._brownout == active:
+                return
+            self._brownout = active
+        self._g_brownout.set(1 if active else 0)
+        kind = "brownout_enter" if active else "brownout_exit"
+        get_tracer().instant(f"fleet_{kind}", reason=reason)
+        flight_record("fleet", kind, reason=reason)
+
+    def load_snapshot(self) -> dict:
+        """One coherent view of the load signals the autoscaler ticks
+        on: router queue/inflight, lease epoch, and per-member polled
+        stats (queued, TTFT p99, breaker state, dispatch score)."""
+        with self._lock:
+            replicas = {
+                r.rank: {"inflight": r.inflight, "queued": r.queued,
+                         "ttft_p99_ms": r.ttft_p99_ms,
+                         "breaker": r.breaker.state,
+                         "score": self._score_locked(r)}
+                for r in self._replicas.values()}
+            epoch, brownout = self._epoch, self._brownout
+        return {"queued": self._guard.queued,
+                "inflight": self._guard.inflight,
+                "max_concurrency": self._guard.max_concurrency,
+                "epoch": epoch, "brownout": brownout,
+                "replicas": replicas}
+
+    def quarantined(self, rank: int) -> bool:
+        """True while a flapping rank's re-admission delay is running
+        (drivers/tests observe probation without reaching into the
+        tracker)."""
+        return self._flaps.blocked(rank)
 
     def replicas(self) -> List[int]:
         with self._lock:

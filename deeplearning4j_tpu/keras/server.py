@@ -636,6 +636,12 @@ class KerasServer:
     def draining(self) -> bool:
         return self._guard.draining
 
+    @property
+    def killed(self) -> bool:
+        """True once ``hard_kill`` ran (chaos drivers poll this to
+        respawn a flapping replica's next incarnation)."""
+        return self._killed
+
     def hard_kill(self) -> None:
         """Chaos-only abrupt death (``kill_replica``): the in-process
         analog of SIGKILL. Every established connection is severed

@@ -237,10 +237,62 @@ class LabeledCounter:
                 for key, child in items}
 
 
+class LabeledGauge:
+    """A gauge *family*: one metric name, one child ``Gauge`` per label
+    set (``family.labels(rank="3").set(score)``). Same rendering
+    contract as ``LabeledCounter``; ``remove()`` drops a child so a
+    departed member (a drained fleet replica) stops exporting a stale
+    sample forever. ``value`` is the sum over children so prefix
+    ``snapshot()`` views keep working on families."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[Tuple[str, str], ...], Gauge] = {}
+
+    @staticmethod
+    def _key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+        return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+    def labels(self, **labels: str) -> Gauge:
+        key = self._key(labels)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = Gauge(self.name + _fmt_labels(dict(key)),
+                              help=self.help)
+                self._children[key] = child
+            return child
+
+    def remove(self, **labels: str) -> None:
+        with self._lock:
+            self._children.pop(self._key(labels), None)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return sum(c.value for c in self._children.values())
+
+    def _render(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._children.items())
+        return [f"{self.name}{_fmt_labels(dict(key))} "
+                f"{_fmt_value(child.value)}" for key, child in items]
+
+    _prom_type = "gauge"
+
+    def _json(self):
+        with self._lock:
+            items = sorted(self._children.items())
+        return {_fmt_labels(dict(key)): child.value
+                for key, child in items}
+
+
 class MetricsRegistry:
     """Named instrument store. ``counter``/``gauge``/``histogram``/
-    ``labeled_counter`` are get-or-create (same name returns the same
-    instrument; a kind clash raises)."""
+    ``labeled_counter``/``labeled_gauge`` are get-or-create (same name
+    returns the same instrument; a kind clash raises)."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -271,6 +323,9 @@ class MetricsRegistry:
 
     def labeled_counter(self, name: str, help: str = "") -> LabeledCounter:
         return self._get_or_create(LabeledCounter, name, help)
+
+    def labeled_gauge(self, name: str, help: str = "") -> LabeledGauge:
+        return self._get_or_create(LabeledGauge, name, help)
 
     def get(self, name: str):
         return self._metrics.get(name)

@@ -139,7 +139,24 @@ Serving-fleet fault kinds (ISSUE 18, the multi-replica seams):
   replica): deadline budgets and the router's hedged duplicates are the
   defense under test.
 
-Faults are one-shot: each schedule entry fires once, is counted in the
+Overload / autoscale fault kinds (ISSUE 19, the elasticity seams):
+
+- ``flap_replica``     — replica ``rank`` becomes a crash-looper: each
+  of its next ``count`` incarnations (spawns since arming, starting at
+  the ``at_call``-th) hard-kills itself ``duration`` seconds AFTER the
+  router admits it (join-then-die — the shape a broken launcher
+  produces). ``check_flap_spawn(rank)`` is the per-spawn hook
+  ``FleetReplica`` consults at construction; the router's flap
+  quarantine (strike window + exponential re-admission delay) is the
+  defense under test.
+- ``load_spike``       — declarative synthetic burst against the
+  ROUTER: ``load_spike_spec()`` hands the scheduled ``count`` (and
+  ``duration``, the window to spread it over) to the chaos driver,
+  which fires that many concurrent requests. The retry budget, brownout
+  shedding, and autoscaler scale-up are the defenses under test.
+
+Faults are one-shot (``flap_replica`` consumes one fire per spawn until
+its ``count`` is spent): each schedule entry fires, is counted in the
 metrics registry (``resilience_faults_injected_total``) and stamped as a
 tracer instant event, then disarms. ``step`` indexing is 1-based and
 matches ``net.iteration_count + 1`` (the step about to run).
@@ -165,7 +182,8 @@ _KINDS = ("raise", "nan", "truncate_checkpoint", "drop_connection",
           "poison_row", "slow_batch", "slow_input", "io_error",
           "kill_host", "slow_host", "kill_coordinator", "rejoin_host",
           "partition_host", "poison_decode", "evict_cache",
-          "kill_replica", "partition_replica", "slow_replica")
+          "kill_replica", "partition_replica", "slow_replica",
+          "flap_replica", "load_spike")
 
 #: exit code of a ``kill_host`` hard exit — distinct so test drivers can
 #: assert the victim died BY the fault, not by a bug
@@ -200,8 +218,9 @@ class Fault:
     duration: float = 0.0
     count: int = 0
     rank: int = -1   # rejoin_host: the joining rank (-1 = lowest free);
-    #                  kill/partition/slow_replica: the target fleet rank
+    #                  kill/partition/slow/flap_replica: the target rank
     fired: bool = False
+    fires: int = 0   # flap_replica: incarnations consumed (of ``count``)
 
     def __post_init__(self):
         if self.kind not in _KINDS:
@@ -246,6 +265,9 @@ _replica_requests: Dict[int, int] = {}
 _replica_tokens: Dict[int, int] = {}
 #: per-replica-rank heartbeat-suppression windows (``partition_replica``)
 _replica_partition_until: Dict[int, float] = {}
+#: per-replica-rank spawn counters since arming (``flap_replica``
+#: at_call addressing: the Nth incarnation of that rank)
+_replica_spawns: Dict[int, int] = {}
 
 
 def set_schedule(schedule: Optional[FaultSchedule]) -> None:
@@ -261,6 +283,7 @@ def set_schedule(schedule: Optional[FaultSchedule]) -> None:
         _replica_requests.clear()
         _replica_tokens.clear()
         _replica_partition_until.clear()
+        _replica_spawns.clear()
         _commit_calls = 0
         _recv_calls = 0
         _pub_calls = 0
@@ -494,6 +517,64 @@ def check_kill_replica_token(rank: int) -> bool:
                 _fire(f, rank=rank, token=n)
                 return True
         return False
+
+
+def check_flap_spawn(rank: int) -> Optional[float]:
+    """Called by ``FleetReplica`` at construction: when a
+    ``flap_replica`` fault targets this rank, this incarnation is the
+    ``at_call``-th-or-later spawn since arming, and fires remain (of
+    ``count``, default 1), returns the post-ADMISSION kill delay
+    (``duration`` seconds) — the replica arms a watcher that hard-kills
+    it that long after the router admits it. None = live normally.
+
+    Counts once per spawn; the fault disarms (``fired``) when its last
+    incarnation is consumed, so the rank's NEXT spawn comes up healthy —
+    exactly the crash-loop-then-recover shape the quarantine's release
+    path needs."""
+    rank = int(rank)
+    with _lock:
+        if _schedule is None:
+            return None
+        n = _replica_spawns.get(rank, 0) + 1
+        _replica_spawns[rank] = n
+        for f in _schedule.faults:
+            if f.kind != "flap_replica" or f.rank != rank or f.fired:
+                continue
+            if n < f.at_call:
+                continue
+            total = max(1, int(f.count) or 1)
+            f.fires += 1
+            last = f.fires >= total
+            # multi-fire accounting: every incarnation counts/stamps,
+            # fired flips only when the loop is spent
+            get_registry().counter(
+                "resilience_faults_injected_total",
+                help="faults injected by the chaos harness").inc()
+            get_tracer().instant("fault_injected", kind="flap_replica",
+                                 rank=rank, spawn=n, fire=f.fires)
+            flight_record("faultinject", "fired", fault="flap_replica",
+                          rank=rank, spawn=n, fire=f.fires)
+            if last:
+                f.fired = True
+            return max(0.0, f.duration)
+        return None
+
+
+def load_spike_spec() -> Optional[dict]:
+    """Hand a chaos driver the scheduled ``load_spike`` burst: a
+    ``{"count": N, "duration": seconds}`` spec (fires once; None when
+    nothing is armed). The driver fires ``count`` concurrent requests
+    at the ROUTER, spread over ``duration`` — the overload the retry
+    budget / brownout / autoscaler stack must degrade through."""
+    with _lock:
+        if _schedule is None:
+            return None
+        for f in _schedule.pending():
+            if f.kind == "load_spike":
+                _fire(f, count=f.count, duration=f.duration)
+                return {"count": int(f.count),
+                        "duration": max(0.0, float(f.duration))}
+        return None
 
 
 def on_checkpoint_commit(tmp: Path, final: Path) -> None:

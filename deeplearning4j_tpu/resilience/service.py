@@ -282,6 +282,53 @@ class CircuitBreaker:
 
 
 # ---------------------------------------------------------------------------
+# retry budget (SRE-style token bucket)
+# ---------------------------------------------------------------------------
+
+
+class RetryBudget:
+    """Token bucket gating retry *amplification*: every retry (and every
+    hedged duplicate) spends one token; every successful dispatch
+    refills ``refill_ratio`` tokens, capped at ``capacity``.
+
+    The SRE framing: retries are only safe while they stay a bounded
+    fraction of successful traffic. When a backend is merely blipping,
+    successes keep the bucket full and retries flow; when the whole
+    pool is sick, successes dry up, the bucket drains, and retry storms
+    stop amplifying the outage — callers fail fast with the structured
+    error instead. Thread-safe; the bucket is shared across every
+    dispatcher thread on the router."""
+
+    def __init__(self, capacity: float = 10.0, refill_ratio: float = 0.1,
+                 initial: Optional[float] = None):
+        self.capacity = max(0.0, float(capacity))
+        self.refill_ratio = max(0.0, float(refill_ratio))
+        self._lock = threading.Lock()
+        self._tokens = (self.capacity if initial is None
+                        else min(self.capacity, max(0.0, float(initial))))
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def on_success(self) -> None:
+        """One successful dispatch earns back a fraction of a token."""
+        with self._lock:
+            self._tokens = min(self.capacity,
+                               self._tokens + self.refill_ratio)
+
+    def try_spend(self, cost: float = 1.0) -> bool:
+        """Spend ``cost`` tokens for one retry/hedge; False = budget
+        dry, the caller must not amplify."""
+        with self._lock:
+            if self._tokens >= cost:
+                self._tokens -= cost
+                return True
+            return False
+
+
+# ---------------------------------------------------------------------------
 # the guard: admission + breakers + drain + readiness
 # ---------------------------------------------------------------------------
 

@@ -333,3 +333,103 @@ def test_replica_drain_is_zero_drop_leave(tmp_path, workload):
         assert router.replicas() == [1]
     finally:
         _teardown(router, reps)
+
+
+def test_flap_replica_chaos_quarantines_then_releases(tmp_path,
+                                                      workload):
+    """The ``flap_replica`` chaos kind crash-loops a rank: each
+    incarnation is admitted then killed moments later. Two strikes
+    inside the flap window quarantine the rank (probation delay, no
+    re-admission) while the stable member keeps serving; the next —
+    healthy — incarnation is admitted once the delay elapses."""
+    model, x = workload
+    fdir, router, reps = _fleet(
+        tmp_path, model, (0,), flap_window_s=10.0, flap_strikes=2,
+        flap_quarantine_base_s=1.5, flap_quarantine_max_s=6.0)
+    flapper = None
+    try:
+        faultinject.set_schedule(FaultSchedule(faults=[
+            Fault("flap_replica", rank=5, count=2, duration=0.2)]))
+        spawns = 0
+        flapper = FleetReplica(fdir, 5, model=model, max_batch=4)
+        spawns += 1
+        # incarnation driver: respawn rank 5 whenever its current body
+        # dies, until the router puts the rank on probation
+        t_end = time.monotonic() + 40.0
+        while (_counter("fleet_quarantines_total") < 1
+               and time.monotonic() < t_end):
+            if not flapper.alive:
+                flapper = FleetReplica(fdir, 5, model=model,
+                                       max_batch=4)
+                spawns += 1
+            time.sleep(0.1)
+        assert _counter("fleet_quarantines_total") >= 1
+        assert router.quarantined(5)
+        assert _counter("resilience_faults_injected_total") >= 2
+        # the pool keeps serving on the stable member throughout
+        assert _predict(router, x, model).get("ok")
+        # the fault spent its incarnations: the next spawn is healthy
+        if not flapper.alive:
+            flapper = FleetReplica(fdir, 5, model=model, max_batch=4)
+            spawns += 1
+        assert spawns >= 3
+        # quarantine release: the healthy incarnation is re-admitted
+        assert router.wait_for_replicas(2, timeout_s=30.0), \
+            router.replicas()
+        assert 5 in router.replicas()
+        assert not flapper.server.killed
+        assert _predict(router, x, model).get("ok")
+    finally:
+        if flapper is not None:
+            flapper.drain(grace_s=5.0)
+        _teardown(router, reps)
+
+
+def test_load_spike_chaos_degrades_structurally(tmp_path, workload):
+    """The ``load_spike`` chaos kind hands the driver a concurrent
+    burst spec; fired at an undersized router every request either
+    succeeds or gets a *structured* envelope (SHED/DEADLINE) on a live
+    connection — never a dropped socket."""
+    model, x = workload
+    fdir, router, reps = _fleet(tmp_path, model, (0,),
+                                max_concurrency=2, queue_depth=2,
+                                max_queue_wait_s=0.3)
+    try:
+        faultinject.set_schedule(FaultSchedule(faults=[
+            Fault("load_spike", count=12, duration=0.0)] + [
+            Fault("slow_replica", rank=0, at_call=i, duration=0.3)
+            for i in range(1, 13)]))
+        spec = faultinject.load_spike_spec()
+        assert spec == {"count": 12, "duration": 0.0}
+        assert faultinject.load_spike_spec() is None  # one-shot
+        outcomes, hard_failures = [], []
+        lock = threading.Lock()
+
+        def one():
+            try:
+                r = _predict(router, x, model, priority="bulk")
+                with lock:
+                    outcomes.append("ok" if r.get("ok") else str(r))
+            except RuntimeError as e:  # structured error envelope
+                with lock:
+                    outcomes.append(str(e).split(":", 1)[0])
+            except Exception as e:  # noqa: BLE001 — dropped socket
+                with lock:
+                    hard_failures.append(repr(e))
+
+        threads = [threading.Thread(target=one, daemon=True)
+                   for _ in range(spec["count"])]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+        assert not hard_failures, hard_failures
+        assert len(outcomes) == 12
+        assert outcomes.count("ok") >= 1          # the pool still serves
+        shed = [o for o in outcomes if o in ("SHED", "DEADLINE")]
+        assert shed, outcomes                     # overload sheds...
+        assert all(o == "ok" or o in ("SHED", "DEADLINE")
+                   for o in outcomes), outcomes   # ...and only sheds
+        assert _counter("resilience_faults_injected_total") >= 1
+    finally:
+        _teardown(router, reps)

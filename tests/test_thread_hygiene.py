@@ -236,3 +236,22 @@ def test_stall_watchdog_close_joins_monitor(tmp_path):
     wd.close()
     _assert_settled(base)
     clear_beats()
+
+
+def test_fleet_autoscaler_drain_joins_controller(tmp_path):
+    """FleetAutoscaler owns one controller thread; drain() stops AND
+    joins it (the LC005 contract), enumerate() returns to baseline.
+    Idempotent: a second drain is a no-op."""
+    from deeplearning4j_tpu.keras.autoscale import FleetAutoscaler
+    from deeplearning4j_tpu.keras.fleet import FleetRouter
+
+    base = _baseline()
+    router = FleetRouter(str(tmp_path / "fleet"), poll_s=0.05,
+                         metrics_port=None)
+    auto = FleetAutoscaler(router, spawn_fn=lambda rank: None,
+                           tick_s=0.05)
+    assert _baseline() - base, "controller thread should be live"
+    auto.drain()
+    auto.drain()
+    router.close()
+    _assert_settled(base)

@@ -1,0 +1,605 @@
+#!/usr/bin/env python
+"""Autoscaling + overload-degradation smoke stage (tools/run_checks.sh,
+ISSUE 19).
+
+An in-process fleet behind a ``FleetRouter`` with a ``FleetAutoscaler``
+controller must prove, end to end over real sockets, the elasticity
+and graceful-degradation contract:
+
+1. **Ramp 1→3→1** — a predict storm against a deliberately slowed
+   replica breaches the queue SLO; the controller spawns to
+   ``max_replicas`` (readyz-gated admission), the storm ends, and the
+   pool drains back to the floor through the zero-drop seam. Zero
+   client-visible failures across the whole ramp, and every decision
+   is in the flight recorder.
+2. **Kill during ramp + budget-capped amplification** — a replica is
+   hard-killed mid-ramp: clients still see zero failures (failover +
+   respawn), and a separate dry-budget microcheck proves a dispatch
+   against a dying pool is amplified at most once (initial + one free
+   reroute) before the structured error surfaces.
+3. **Brownout** — sustained overload at ``max_replicas`` flips the
+   router into brownout: bulk-class requests shed with a structured
+   ``SHED`` (retry_after_ms, connection stays up) while interactive
+   requests keep serving inside their SLO; calm exits brownout.
+4. **Flap quarantine** — a crash-looping replica (``flap_replica``
+   chaos) is quarantined after two strikes while the stable pool keeps
+   serving; its next healthy incarnation is re-admitted after the
+   probation delay.
+
+Exit 0 = the elasticity/overload edge is wired end to end.
+"""
+
+import json
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _counter(registry, name):
+    m = registry.get(name)
+    return 0 if m is None else m.value
+
+
+def _wait(pred, timeout_s, poll_s=0.05):
+    t_end = time.monotonic() + timeout_s
+    while time.monotonic() < t_end:
+        if pred():
+            return True
+        time.sleep(poll_s)
+    return pred()
+
+
+def _stall_schedule(Fault, FaultSchedule, ranks, per_rank, duration):
+    """Arm ``per_rank`` consecutive slow_replica stalls on each rank —
+    the sustained overload that backs the storm up into the router's
+    admission queue."""
+    return FaultSchedule(faults=[
+        Fault("slow_replica", rank=r, at_call=i, duration=duration)
+        for r in ranks for i in range(1, per_rank + 1)])
+
+
+def main() -> int:
+    import numpy as np
+
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.datasets.iris import load_iris
+    from deeplearning4j_tpu.keras.autoscale import FleetAutoscaler
+    from deeplearning4j_tpu.keras.fleet import FleetReplica, FleetRouter
+    from deeplearning4j_tpu.keras.server import KerasClient
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.profiling.flightrec import (FlightRecorder,
+                                                        set_flightrec)
+    from deeplearning4j_tpu.profiling.metrics import (MetricsRegistry,
+                                                      set_registry)
+    from deeplearning4j_tpu.resilience import faultinject
+    from deeplearning4j_tpu.resilience.faultinject import (Fault,
+                                                           FaultSchedule)
+    from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+    registry = MetricsRegistry()
+    prev = set_registry(registry)
+    prev_rec = set_flightrec(FlightRecorder())
+    n0 = threading.active_count()
+    try:
+        conf = (NeuralNetConfiguration.builder().updater("adam")
+                .learning_rate(0.05).seed(7).list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        mlp = MultiLayerNetwork(conf).init()
+        with tempfile.TemporaryDirectory() as d:
+            mlp_zip = os.path.join(d, "iris.zip")
+            ModelSerializer.write_model(mlp, mlp_zip)
+            x = os.path.join(d, "x.npy")
+            np.save(x, load_iris().features[:4])
+            ctx = (d, mlp_zip, x, KerasClient, FleetReplica,
+                   FleetRouter, FleetAutoscaler, faultinject, Fault,
+                   FaultSchedule, registry)
+            for phase, fn in (("ramp 1→3→1", _phase_ramp),
+                              ("kill during ramp + capped "
+                               "amplification", _phase_kill_and_budget),
+                              ("brownout sheds bulk only",
+                               _phase_brownout),
+                              ("flap quarantine", _phase_quarantine)):
+                rc = fn(*ctx)
+                faultinject.clear()
+                if rc != 0:
+                    return rc
+                print(f"autoscale_smoke: phase OK — {phase}")
+
+        t_end = time.monotonic() + 15.0
+        while threading.active_count() > n0 + 2:
+            if time.monotonic() > t_end:
+                print(f"autoscale_smoke: FAIL thread leak "
+                      f"({threading.active_count()} vs baseline {n0})")
+                return 1
+            time.sleep(0.05)
+        print("autoscale_smoke: OK — ramp 1→3→1 (zero failures), "
+              "kill-under-ramp (budget-capped amplification), brownout "
+              "(bulk shed, interactive in SLO), flap quarantine + "
+              "release")
+        return 0
+    finally:
+        faultinject.clear()
+        set_registry(prev)
+        set_flightrec(prev_rec)
+
+
+def _spawn_fn(fdir, mlp_zip, FleetReplica):
+    def spawn(rank):
+        return FleetReplica(fdir, rank, model=mlp_zip,
+                            max_concurrency=8, queue_depth=32,
+                            default_deadline_ms=60_000)
+    return spawn
+
+
+def _start_loaders(n, router, x, mlp_zip, KerasClient, stop, failures,
+                   lock, counts, pause=0.02):
+    def load(i):
+        while not stop.is_set():
+            try:
+                cli = KerasClient(router.host, router.port)
+                try:
+                    cli.predict(x, model=mlp_zip)
+                finally:
+                    cli.close()
+                with lock:
+                    counts["ok"] += 1
+            except Exception as e:  # noqa: BLE001 — the gate itself
+                with lock:
+                    failures.append(f"loader {i}: "
+                                    f"{type(e).__name__}: {e}")
+                return
+            time.sleep(pause)
+
+    loaders = [threading.Thread(target=load, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in loaders:
+        t.start()
+    return loaders
+
+
+def _phase_ramp(d, mlp_zip, x, KerasClient, FleetReplica, FleetRouter,
+                FleetAutoscaler, faultinject, Fault, FaultSchedule,
+                registry) -> int:
+    """Storm against a slowed pool: the controller ramps 1→3, the storm
+    ends, the pool drains back to 1 — zero client failures end to end."""
+    from deeplearning4j_tpu.profiling.flightrec import get_flightrec
+
+    fdir = os.path.join(d, "fleet_ramp")
+    router = FleetRouter(fdir, poll_s=0.1, heartbeat_timeout_s=1.5,
+                         max_concurrency=4, queue_depth=64,
+                         max_queue_wait_s=15.0,
+                         default_deadline_ms=120_000)
+    rep0 = FleetReplica(fdir, 0, model=mlp_zip, max_concurrency=8,
+                        queue_depth=32, default_deadline_ms=60_000)
+    auto = FleetAutoscaler(router, _spawn_fn(fdir, mlp_zip, FleetReplica),
+                           min_replicas=1, max_replicas=3, queue_high=2,
+                           up_ticks=2, down_ticks=4, up_cooldown_s=1.0,
+                           down_cooldown_s=1.0, tick_s=0.25,
+                           brownout=False, drain_grace_s=15.0)
+    stop = threading.Event()
+    failures, lock, counts = [], threading.Lock(), {"ok": 0}
+    loaders = []
+    try:
+        if not router.wait_for_replicas(1, timeout_s=30.0):
+            print("autoscale_smoke: FAIL seed replica never admitted")
+            return 1
+        # every rank the controller may spawn is pre-slowed: the breach
+        # persists until the pool is actually wider
+        faultinject.set_schedule(_stall_schedule(
+            Fault, FaultSchedule, ranks=range(0, 6), per_rank=400,
+            duration=0.15))
+        loaders = _start_loaders(8, router, x, mlp_zip, KerasClient,
+                                 stop, failures, lock, counts)
+        if not _wait(lambda: len(router.replicas()) >= 3, 60.0):
+            print(f"autoscale_smoke: FAIL never ramped to 3 "
+                  f"(members {router.replicas()}, "
+                  f"ups {_counter(registry, 'fleet_autoscale_up_total')})")
+            return 1
+        # storm over: stalls off, load down to a trickle that proves
+        # the scale-down drains are zero-drop under live traffic
+        faultinject.clear()
+        stop.set()
+        for t in loaders:
+            t.join(60.0)
+        stop = threading.Event()
+        loaders = _start_loaders(1, router, x, mlp_zip, KerasClient,
+                                 stop, failures, lock, counts,
+                                 pause=0.05)
+        if not _wait(lambda: router.replicas() == [0], 60.0):
+            print(f"autoscale_smoke: FAIL never drained back to floor "
+                  f"(members {router.replicas()})")
+            return 1
+        time.sleep(0.3)  # post-drain load lands on the survivor
+        stop.set()
+        for t in loaders:
+            t.join(30.0)
+        if failures:
+            print(f"autoscale_smoke: FAIL client failures during ramp: "
+                  f"{failures[:3]}")
+            return 1
+        ups = _counter(registry, "fleet_autoscale_up_total")
+        downs = _counter(registry, "fleet_autoscale_down_total")
+        if ups < 2 or downs < 2:
+            print(f"autoscale_smoke: FAIL decision accounting "
+                  f"(ups {ups}, downs {downs})")
+            return 1
+        if counts["ok"] < 50:
+            print(f"autoscale_smoke: FAIL implausibly little load "
+                  f"survived the ramp ({counts['ok']})")
+            return 1
+        kinds = {(e["subsystem"], e["kind"])
+                 for e in get_flightrec().tail(2000)}
+        needed = {("autoscale", "scale_up"),
+                  ("autoscale", "scale_down"),
+                  ("autoscale", "scale_down_drained")}
+        if not needed <= kinds:
+            print(f"autoscale_smoke: FAIL flight recorder missing "
+                  f"{needed - kinds}")
+            return 1
+        print(f"autoscale_smoke: ramp — {counts['ok']} requests, "
+              f"zero failures, ups {ups}, downs {downs}")
+        return 0
+    finally:
+        stop.set()
+        for t in loaders:
+            t.join(10.0)
+        faultinject.clear()
+        auto.drain(drain_owned=True)
+        router.close()
+        rep0.drain(grace_s=5.0)
+
+
+def _phase_kill_and_budget(d, mlp_zip, x, KerasClient, FleetReplica,
+                           FleetRouter, FleetAutoscaler, faultinject,
+                           Fault, FaultSchedule, registry) -> int:
+    """A controller-spawned replica is hard-killed mid-ramp: zero
+    client failures (failover + the controller replaces it). Then a
+    dry-budget microcheck pins the amplification cap: a dying pool
+    costs one dispatch plus ONE free reroute, never a retry storm."""
+    fdir = os.path.join(d, "fleet_kill")
+    router = FleetRouter(fdir, poll_s=0.1, heartbeat_timeout_s=1.5,
+                         max_concurrency=4, queue_depth=64,
+                         max_queue_wait_s=15.0,
+                         default_deadline_ms=120_000)
+    rep0 = FleetReplica(fdir, 0, model=mlp_zip, max_concurrency=8,
+                        queue_depth=32, default_deadline_ms=60_000)
+    auto = FleetAutoscaler(router, _spawn_fn(fdir, mlp_zip, FleetReplica),
+                           min_replicas=1, max_replicas=3, queue_high=2,
+                           up_ticks=2, down_ticks=1000,
+                           up_cooldown_s=1.0, tick_s=0.25,
+                           brownout=False)
+    stop = threading.Event()
+    failures, lock, counts = [], threading.Lock(), {"ok": 0}
+    loaders = []
+    try:
+        if not router.wait_for_replicas(1, timeout_s=30.0):
+            print("autoscale_smoke: FAIL seed replica never admitted")
+            return 1
+        kill = Fault("kill_replica", rank=1, at_call=2)
+        faultinject.set_schedule(FaultSchedule(faults=(
+            _stall_schedule(Fault, FaultSchedule, ranks=range(0, 6),
+                            per_rank=400, duration=0.15).faults
+            + [kill])))
+        loaders = _start_loaders(8, router, x, mlp_zip, KerasClient,
+                                 stop, failures, lock, counts)
+        # rank 1 (the first spawn) dies on its 2nd admitted request;
+        # the ramp must still reach a wider, working pool
+        if not _wait(lambda: kill.fired, 60.0):
+            print("autoscale_smoke: FAIL kill_replica never fired")
+            return 1
+        if not _wait(lambda: len(router.replicas()) >= 2
+                     and 1 not in router.replicas(), 60.0):
+            print(f"autoscale_smoke: FAIL pool never recovered past "
+                  f"the kill (members {router.replicas()})")
+            return 1
+        stop.set()
+        for t in loaders:
+            t.join(60.0)
+        if failures:
+            print(f"autoscale_smoke: FAIL client failures across the "
+                  f"mid-ramp kill: {failures[:3]}")
+            return 1
+        if _counter(registry, "fleet_failovers_total") < 1:
+            print("autoscale_smoke: FAIL no failover recorded "
+                  "despite kill")
+            return 1
+    finally:
+        stop.set()
+        for t in loaders:
+            t.join(10.0)
+        faultinject.clear()
+        auto.drain(drain_owned=True)
+        router.close()
+        rep0.drain(grace_s=5.0)
+
+    # ---- dry-budget amplification cap (fresh, tiny, deterministic)
+    fdir = os.path.join(d, "fleet_budget")
+    router = FleetRouter(fdir, poll_s=0.1, heartbeat_timeout_s=1.5,
+                         retries=4, retry_budget_capacity=0.0,
+                         retry_budget_ratio=0.0, empty_pool_wait_s=1.0,
+                         default_deadline_ms=30_000)
+    reps = {r: FleetReplica(fdir, r, model=mlp_zip,
+                            default_deadline_ms=30_000)
+            for r in (0, 1)}
+    try:
+        if not router.wait_for_replicas(2, timeout_s=30.0):
+            print("autoscale_smoke: FAIL budget fleet never formed")
+            return 1
+        faultinject.set_schedule(FaultSchedule(faults=[
+            Fault("kill_replica", rank=0, at_call=1),
+            Fault("kill_replica", rank=1, at_call=1)]))
+        d0 = _counter(registry, "fleet_dispatches_total")
+        cli = KerasClient(router.host, router.port)
+        err = None
+        try:
+            cli.predict(x, model=mlp_zip)
+        except RuntimeError as e:
+            err = str(e)
+        finally:
+            cli.close()
+        dispatches = _counter(registry, "fleet_dispatches_total") - d0
+        if err is None or "retry budget exhausted" not in err:
+            print(f"autoscale_smoke: FAIL dry-budget dispatch should "
+                  f"surface the structured exhaustion error, got "
+                  f"{err!r}")
+            return 1
+        if dispatches != 2:
+            print(f"autoscale_smoke: FAIL amplification not capped "
+                  f"({dispatches} dispatches; want initial + one free "
+                  f"reroute = 2)")
+            return 1
+        if _counter(registry, "fleet_retry_budget_exhausted_total") < 1:
+            print("autoscale_smoke: FAIL budget exhaustion never "
+                  "counted")
+            return 1
+        print(f"autoscale_smoke: kill+budget — zero failures across "
+              f"kill, dry-budget amplification {dispatches} dispatches")
+        return 0
+    finally:
+        faultinject.clear()
+        router.close()
+        for rep in reps.values():
+            rep.drain(grace_s=5.0)
+
+
+def _phase_brownout(d, mlp_zip, x, KerasClient, FleetReplica,
+                    FleetRouter, FleetAutoscaler, faultinject, Fault,
+                    FaultSchedule, registry) -> int:
+    """Sustained overload with nothing left to spawn: the controller
+    flips brownout; bulk sheds structurally (live connection,
+    retry_after_ms) while interactive latency stays inside the SLO."""
+    slo_s = 2.5
+    fdir = os.path.join(d, "fleet_brownout")
+    router = FleetRouter(fdir, poll_s=0.1, heartbeat_timeout_s=1.5,
+                         max_concurrency=2, queue_depth=24,
+                         max_queue_wait_s=10.0,
+                         default_deadline_ms=60_000)
+    rep0 = FleetReplica(fdir, 0, model=mlp_zip, max_concurrency=8,
+                        queue_depth=32, default_deadline_ms=30_000)
+    auto = FleetAutoscaler(router, _spawn_fn(fdir, mlp_zip, FleetReplica),
+                           min_replicas=1, max_replicas=1, queue_high=3,
+                           up_ticks=2, down_ticks=1000, tick_s=0.25,
+                           brownout=True, brownout_enter_ticks=3,
+                           brownout_exit_ticks=6)
+    stop = threading.Event()
+    lock = threading.Lock()
+    failures, lat_after = [], []
+    sheds = {"n": 0, "structured": True}
+    loaders = []
+    try:
+        if not router.wait_for_replicas(1, timeout_s=30.0):
+            print("autoscale_smoke: FAIL seed replica never admitted")
+            return 1
+        faultinject.set_schedule(_stall_schedule(
+            Fault, FaultSchedule, ranks=(0,), per_rank=3000,
+            duration=0.15))
+
+        def interactive(i):
+            while not stop.is_set():
+                t0 = time.monotonic()
+                try:
+                    cli = KerasClient(router.host, router.port)
+                    try:
+                        cli.predict(x, model=mlp_zip)
+                    finally:
+                        cli.close()
+                except Exception as e:  # noqa: BLE001 — the gate
+                    with lock:
+                        failures.append(f"interactive {i}: "
+                                        f"{type(e).__name__}: {e}")
+                    return
+                if router.brownout:
+                    with lock:
+                        lat_after.append(time.monotonic() - t0)
+                time.sleep(0.02)
+
+        def bulk(i):
+            # one persistent raw connection per loader: a shed must be
+            # an envelope on a LIVE socket (the next request on the
+            # same connection still answers), never a hangup
+            try:
+                with socket.create_connection(
+                        (router.host, router.port), timeout=60) as s:
+                    s.settimeout(60)
+                    f = s.makefile("rwb")
+                    while not stop.is_set():
+                        f.write((json.dumps(
+                            {"op": "predict", "features": x,
+                             "model": mlp_zip, "priority": "bulk"})
+                            + "\n").encode())
+                        f.flush()
+                        line = f.readline()
+                        if not line:
+                            raise ConnectionError("hangup on shed")
+                        resp = json.loads(line)
+                        if resp.get("error") == "SHED":
+                            with lock:
+                                sheds["n"] += 1
+                                if resp.get("retry_after_ms") is None:
+                                    sheds["structured"] = False
+                        elif resp.get("error") is not None \
+                                and resp["error"] != "DEADLINE":
+                            raise RuntimeError(str(resp))
+                        time.sleep(0.05)
+                    f.close()
+            except Exception as e:  # noqa: BLE001 — the gate itself
+                with lock:
+                    failures.append(f"bulk {i}: "
+                                    f"{type(e).__name__}: {e}")
+
+        loaders = [threading.Thread(target=interactive, args=(i,),
+                                    daemon=True) for i in range(6)]
+        loaders += [threading.Thread(target=bulk, args=(i,),
+                                     daemon=True) for i in range(3)]
+        for t in loaders:
+            t.start()
+        if not _wait(lambda: router.brownout, 45.0):
+            print(f"autoscale_smoke: FAIL brownout never entered "
+                  f"(queued {router.load_snapshot()['queued']})")
+            return 1
+        rz = router._readyz()
+        if not rz.get("brownout") or not rz.get("ready"):
+            print(f"autoscale_smoke: FAIL readyz during brownout "
+                  f"(brownout {rz.get('brownout')}, ready "
+                  f"{rz.get('ready')})")
+            return 1
+        time.sleep(3.0)  # serve a while inside brownout
+        with lock:
+            if not sheds["n"] or not sheds["structured"]:
+                print(f"autoscale_smoke: FAIL sheds during brownout "
+                      f"(n {sheds['n']}, structured "
+                      f"{sheds['structured']})")
+                return 1
+        # storm over: stalls off, loaders stopped, calm exits brownout
+        faultinject.clear()
+        stop.set()
+        for t in loaders:
+            t.join(60.0)
+        if failures:
+            print(f"autoscale_smoke: FAIL hard failures during "
+                  f"brownout: {failures[:3]}")
+            return 1
+        with lock:
+            lat = sorted(lat_after)
+        if not lat:
+            print("autoscale_smoke: FAIL no interactive requests "
+                  "completed inside brownout")
+            return 1
+        p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+        if p99 > slo_s:
+            print(f"autoscale_smoke: FAIL interactive p99 {p99:.2f}s "
+                  f"breached the {slo_s}s SLO inside brownout "
+                  f"({len(lat)} samples)")
+            return 1
+        if not _wait(lambda: not router.brownout, 30.0):
+            print("autoscale_smoke: FAIL brownout never exited after "
+                  "the storm")
+            return 1
+        # degraded mode over: bulk serves again
+        cli = KerasClient(router.host, router.port)
+        try:
+            cli.request(op="predict", features=x, model=mlp_zip,
+                        priority="bulk")
+        finally:
+            cli.close()
+        entries = _counter(registry, "fleet_brownout_entries_total")
+        shed_total = _counter(registry, "fleet_brownout_sheds_total")
+        if entries < 1 or shed_total < 1:
+            print(f"autoscale_smoke: FAIL brownout accounting "
+                  f"(entries {entries}, sheds {shed_total})")
+            return 1
+        print(f"autoscale_smoke: brownout — {sheds['n']} bulk sheds "
+              f"(structured), interactive p99 {p99:.2f}s over "
+              f"{len(lat)} in-brownout requests")
+        return 0
+    finally:
+        stop.set()
+        for t in loaders:
+            t.join(10.0)
+        faultinject.clear()
+        auto.drain(drain_owned=True)
+        router.close()
+        rep0.drain(grace_s=5.0)
+
+
+def _phase_quarantine(d, mlp_zip, x, KerasClient, FleetReplica,
+                      FleetRouter, FleetAutoscaler, faultinject, Fault,
+                      FaultSchedule, registry) -> int:
+    """A crash-looping rank is quarantined after two strikes while the
+    stable member keeps serving; the next healthy incarnation is
+    re-admitted once the probation delay elapses."""
+    fdir = os.path.join(d, "fleet_flap")
+    router = FleetRouter(fdir, poll_s=0.1, heartbeat_timeout_s=1.0,
+                         flap_window_s=10.0, flap_strikes=2,
+                         flap_quarantine_base_s=1.5,
+                         flap_quarantine_max_s=6.0,
+                         default_deadline_ms=60_000)
+    rep0 = FleetReplica(fdir, 0, model=mlp_zip,
+                        default_deadline_ms=30_000)
+    flapper = None
+    try:
+        if not router.wait_for_replicas(1, timeout_s=30.0):
+            print("autoscale_smoke: FAIL stable replica never admitted")
+            return 1
+        faultinject.set_schedule(FaultSchedule(faults=[
+            Fault("flap_replica", rank=5, count=2, duration=0.2)]))
+        flapper = FleetReplica(fdir, 5, model=mlp_zip,
+                               default_deadline_ms=30_000)
+        t_end = time.monotonic() + 60.0
+        while (_counter(registry, "fleet_quarantines_total") < 1
+               and time.monotonic() < t_end):
+            if not flapper.alive:
+                flapper = FleetReplica(fdir, 5, model=mlp_zip,
+                                       default_deadline_ms=30_000)
+            time.sleep(0.1)
+        if _counter(registry, "fleet_quarantines_total") < 1:
+            print("autoscale_smoke: FAIL flapping rank never "
+                  "quarantined")
+            return 1
+        if not router.quarantined(5):
+            print("autoscale_smoke: FAIL quarantine not visible on "
+                  "the router")
+            return 1
+        # the pool serves on the stable member throughout probation
+        cli = KerasClient(router.host, router.port)
+        try:
+            cli.predict(x, model=mlp_zip)
+        finally:
+            cli.close()
+        # the fault spent its incarnations: the next spawn is healthy
+        if not flapper.alive:
+            flapper = FleetReplica(fdir, 5, model=mlp_zip,
+                                   default_deadline_ms=30_000)
+        if not router.wait_for_replicas(2, timeout_s=30.0) \
+                or 5 not in router.replicas():
+            print(f"autoscale_smoke: FAIL healthy incarnation never "
+                  f"re-admitted after probation "
+                  f"(members {router.replicas()})")
+            return 1
+        if not flapper.alive:
+            print("autoscale_smoke: FAIL re-admitted incarnation died "
+                  "(fault should be spent)")
+            return 1
+        print("autoscale_smoke: quarantine — 2 strikes, probation, "
+              "healthy incarnation re-admitted")
+        return 0
+    finally:
+        faultinject.clear()
+        router.close()
+        if flapper is not None:
+            flapper.drain(grace_s=5.0)
+        rep0.drain(grace_s=5.0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -157,6 +157,8 @@ if [ "${1:-}" != "--fast" ]; then
         python tools/lm_serve_smoke.py
     stage "fleet smoke (kill/failover/rolling drain)" env JAX_PLATFORMS=cpu \
         python tools/fleet_smoke.py
+    stage "autoscale smoke (ramp/brownout/quarantine)" env JAX_PLATFORMS=cpu \
+        python tools/autoscale_smoke.py
     stage "bench smoke (autotuned lenet + input + serve + lm + lm_serve + fleet)" \
         bench_smoke
     stage "zero1 smoke"      env JAX_PLATFORMS=cpu python tools/zero1_smoke.py
