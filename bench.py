@@ -1396,9 +1396,23 @@ def _run_lm_serve_rung(jax, smoke: bool, on_accel: bool,
     clients, n_requests = cfg["clients"], cfg["requests"]
     max_new, slo_s = cfg["max_new_tokens"], cfg["slo_ms"] / 1000.0
     per_client = max(1, n_requests // clients)
-    # mixed prompt lengths spanning several pow2 prefill buckets
+    # mixed prompt lengths spanning several pow2 prefill buckets, all
+    # opening with the SAME page-aligned system prefix (ISSUE 20): the
+    # paged engine dedupes that page's KV across the fleet and repeat
+    # prompts hit the full-prompt registry — the record reports the
+    # resulting prefix_cache_hit_rate / kv_pages_shared
+    from deeplearning4j_tpu.analysis.memory import default_kv_page_len
+    page_len = default_kv_page_len(L)
+    sys_prefix = rng.integers(0, V, page_len).tolist()
     lengths = [max(1, L // 8), max(2, L // 4), max(3, L // 2 - 1)]
-    prompts = [rng.integers(0, V, lengths[k % len(lengths)]).tolist()
+
+    def _prompt(target: int) -> list:
+        if target <= page_len:
+            return sys_prefix[:target]
+        return sys_prefix + rng.integers(0, V,
+                                         target - page_len).tolist()
+
+    prompts = [_prompt(lengths[k % len(lengths)])
                for k in range(per_client * clients)]
 
     with tempfile.TemporaryDirectory() as d:
@@ -1567,6 +1581,11 @@ def _run_lm_serve_rung(jax, smoke: bool, on_accel: bool,
         "max_rows": cfg["max_rows"],
         "bucket_mix": stats["bucket_mix"],
         "compile_s": stats["compile_s"],
+        # block-paged KV pool (ISSUE 20): how much of the workload's
+        # prefill the prefix caches absorbed, and the pool census
+        "prefix_cache_hit_rate": stats["prefix_cache_hit_rate"],
+        "kv_pages_total": stats["kv_pages_total"],
+        "kv_pages_shared": stats["kv_pages_shared"],
         # schema uniformity (ISSUE 13): the decode bucket ladder is
         # fixed by the rung config, not chosen by the autotuner
         "autotuned": False,

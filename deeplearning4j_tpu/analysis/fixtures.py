@@ -909,6 +909,52 @@ def sc_bad_decode_cache_not_donated():
     return program, dict(ctx)
 
 
+@lru_cache(maxsize=None)
+def _sc_gpt_paged_decode_program(donate: bool = True):
+    """The REAL block-paged decode step (ISSUE 20): the GPT tiny
+    model's decode program reading KV state through a page-table
+    indirection over a shared page pool — donated (the serving
+    engine's contract, SC010's KNOWN_GOOD) or not (the defect)."""
+    import jax
+    import numpy as np
+    from deeplearning4j_tpu.analysis.shardcheck import lower_step_program
+    from deeplearning4j_tpu.models.gpt import gpt_tiny
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    net = ComputationGraph(gpt_tiny(vocab_size=8, seq_len=8)).init()
+    page_len = net.kv_page_len(2)
+    rows = 2
+    pages_per_row = net.decode_max_len() // page_len
+    pool = net.init_kv_page_pool(rows * pages_per_row + 1, page_len)
+    fn = net.paged_decode_fn(page_len)
+    n_pool_leaves = 2 * len(net.kv_cache_nodes())
+    x = jax.ShapeDtypeStruct((rows, 1, 8), np.float32)
+    pos = jax.ShapeDtypeStruct((rows,), np.int32)
+    tbl = jax.ShapeDtypeStruct((rows, pages_per_row), np.int32)
+    jitted = (jax.jit(fn, donate_argnums=(2,)) if donate
+              else jax.jit(fn))
+    program = lower_step_program(jitted, net.params, net.states, pool,
+                                 x, pos, tbl)
+    return program, dict(expect_paged_gather=n_pool_leaves)
+
+
+def sc_bad_paged_pool_not_donated():
+    """A paged decode step jitted WITHOUT donate_argnums on the pool:
+    the gathers are all there but no input_output_alias lands — the
+    pool is resident twice and copied per token (SC010's defect)."""
+    program, ctx = _sc_gpt_paged_decode_program(False)
+    return program, dict(ctx)
+
+
+def sc_bad_paged_gather_missing():
+    """The DENSE decode program checked against a paged claim: the
+    page-table indirection's gathers never formed, so eviction and
+    prefix sharing cannot be in effect (SC010's other defect). Reuses
+    the real dense decode program — which is exactly what a paged
+    engine accidentally wired to decode_fns() would compile."""
+    program, _ = _sc_gpt_decode_program(True)
+    return program, dict(expect_paged_gather=4)
+
+
 def sc_bad_sp_ring_absent():
     """Claims sp=2 sequence parallelism over a program compiled WITHOUT
     an sp axis — no collective-permute exists, so the ring the claim
@@ -930,6 +976,8 @@ SC_KNOWN_BAD: List[Tuple[str, str, Callable]] = [
     ("comm-model-mismatch", "SC007", sc_bad_comm_model_mismatch),
     ("sp-ring-absent", "SC008", sc_bad_sp_ring_absent),
     ("decode-cache-not-donated", "SC009", sc_bad_decode_cache_not_donated),
+    ("paged-decode-pool-not-donated", "SC010", sc_bad_paged_pool_not_donated),
+    ("paged-decode-gather-missing", "SC010", sc_bad_paged_gather_missing),
 ]
 
 
@@ -1000,6 +1048,14 @@ def sc_good_gpt_decode():
     return program, dict(ctx)
 
 
+def sc_good_gpt_paged_decode():
+    """The serving engine's ACTUAL block-paged decode program
+    (donate_argnums on the pool): SC010 must find a page-table gather
+    per pool leaf AND every pool buffer aliased."""
+    program, ctx = _sc_gpt_paged_decode_program(True)
+    return program, dict(ctx)
+
+
 
 
 def sc_good_fp32_preset_identity():
@@ -1022,6 +1078,7 @@ SC_KNOWN_GOOD: List[Tuple[str, Callable]] = [
     ("replicated-step", sc_good_replicated),
     ("sp-ring-step", sc_good_sp_ring),
     ("gpt-decode-step", sc_good_gpt_decode),
+    ("gpt-paged-decode-step", sc_good_gpt_paged_decode),
 ]
 
 #: rule id -> the SC_KNOWN_GOOD fixture exercising that rule's trigger
@@ -1036,6 +1093,7 @@ SC_GOOD_FOR: Dict[str, str] = {
     "SC007": "zero1-step",            # HLO == model within tolerance
     "SC008": "sp-ring-step",          # sp claim with the ring present
     "SC009": "gpt-decode-step",       # cache donation landed as aliases
+    "SC010": "gpt-paged-decode-step",  # gathers formed, pool aliased
 }
 
 

@@ -104,11 +104,15 @@ class MemoryReport:
     remat: bool = False
     weight_update_sharding: str = "off"
     dp: int = 1
-    # token-level serving (ISSUE 15): resident KV-cache bytes for a
-    # ``decode_rows``-row decode bucket — the number the generation
-    # engine's ring-buffer eviction budget is set against
+    # token-level serving (ISSUE 20): the block-paged KV pool a
+    # ``decode_rows``-row engine allocates — pool bytes, page length,
+    # and page count match ``kv_pool_plan`` (ONE sizing rule with the
+    # live engine, so this number IS the serving_kv_cache_bytes gauge)
     decode_rows: int = 0
     kv_cache_total_bytes: int = 0
+    kv_page_len: int = 0
+    kv_pages_total: int = 0
+    kv_pages_per_row: int = 0
 
     # ------------------------------------------------------------ aggregates
     @property
@@ -210,46 +214,138 @@ class MemoryReport:
         if self.decode_rows:
             lines.append(
                 f"  KV cache (serve):    {mb(self.kv_cache_total_bytes)}"
-                f"  ({self.decode_rows} decode rows — the ring-buffer "
-                "eviction budget surface)")
+                f"  page pool ({self.kv_pages_total} pages x "
+                f"{self.kv_page_len} tok, {self.kv_pages_per_row} "
+                f"pages/row, {self.decode_rows} decode rows — the "
+                "page-granular eviction budget surface; shared prefix "
+                "pages dedup BELOW this ceiling)")
         return "\n".join(lines)
 
 
-def kv_cache_bytes(conf, rows: int, max_len: Optional[int] = None
-                   ) -> int:
-    """Config-only estimate of a ``rows``-row decode bucket's resident
-    KV caches (2 x [rows, H, max_len, D] per CAUSAL attention layer in
-    the config's dtype) — the serving twin of the training HBM terms,
-    and what ``memory_report(..., decode_rows=N)`` folds in. Returns 0
-    for configs with no causal attention (nothing decodes
-    incrementally)."""
+def default_kv_page_len(max_len: int) -> int:
+    """Default KV page length for a ``max_len``-position decode row:
+    the largest divisor of ``max_len`` no bigger than ``max_len // 4``
+    (4+ pages per row keeps page-granular eviction meaningful), floor
+    1. Pages must DIVIDE ``max_len`` so a row's page chain gathers back
+    into the exact dense cache shape."""
+    p = max(1, int(max_len) // 4)
+    while int(max_len) % p:
+        p -= 1
+    return p
+
+
+def _decode_max_len(conf, layers) -> int:
+    """The GRAPH-WIDE static cache length, exactly as the container's
+    ``decode_max_len`` resolves it: any layer's position-table capacity
+    (PositionalEmbeddingLayer.max_timesteps may exceed the input
+    window) wins over the input-type timesteps. 0 = not a decoder."""
+    for _name, layer, _out in layers:
+        if getattr(layer, "max_timesteps", 0):
+            return int(layer.max_timesteps)
+    for t in getattr(conf, "input_types", {}).values():
+        if t is not None and t.kind == "rnn" and t.timesteps:
+            return int(t.timesteps)
+    return 0
+
+
+def kv_page_group_bytes(conf, page_len: Optional[int] = None) -> int:
+    """Config-only bytes of ONE KV page group: k + v over ``page_len``
+    positions across every CAUSAL attention layer — the allocation and
+    eviction granularity of the paged serving pool (ISSUE 20). Returns
+    0 for configs with no causal attention."""
     from deeplearning4j_tpu.analysis.graphcheck import iter_config_layers
     db = _dtype_bytes(conf.training.dtype)
     layers = list(iter_config_layers(conf))
-    ml = max_len
-    if ml is None:
-        # the GRAPH-WIDE static cache length, exactly as the container's
-        # decode_max_len resolves it: any layer's position-table
-        # capacity (PositionalEmbeddingLayer.max_timesteps may exceed
-        # the input window) wins over the input-type timesteps
-        for _name, layer, _out in layers:
-            if getattr(layer, "max_timesteps", 0):
-                ml = int(layer.max_timesteps)
-                break
-        if not ml:
-            for t in getattr(conf, "input_types", {}).values():
-                if t is not None and t.kind == "rnn" and t.timesteps:
-                    ml = int(t.timesteps)
-                    break
+    ml = _decode_max_len(conf, layers)
     if not ml:
         return 0
+    pl = default_kv_page_len(ml) if page_len is None else int(page_len)
     total = 0
     for _name, layer, _out in layers:
         if not getattr(layer, "causal", False) \
                 or not hasattr(layer, "cache_shape"):
             continue
-        total += 2 * int(np.prod(layer.cache_shape(rows, ml))) * db
+        total += 2 * int(np.prod(layer.cache_shape(1, pl))) * db
     return total
+
+
+@dataclass
+class KVPoolPlan:
+    """The paged KV pool the serving engine actually allocates for a
+    config — ONE sizing rule shared by ``memory_report`` and the live
+    engine, so the report's number IS the engine's gauge.
+
+    ``pages``: usable pages = ``min(max_rows * pages_per_row,
+    budget_bytes // page_group_bytes)``. ``total_pages`` adds the one
+    reserved scratch page (physical page 0 — unmapped page-table slots
+    alias it so a stalled/free row's scatter never lands in a live
+    page). ``total_bytes`` is the resident pool footprint the
+    ``serving_kv_cache_bytes`` gauge publishes."""
+    page_len: int
+    pages_per_row: int
+    page_group_bytes: int
+    pages: int
+
+    @property
+    def total_pages(self) -> int:
+        return self.pages + 1
+
+    @property
+    def total_bytes(self) -> int:
+        return self.total_pages * self.page_group_bytes
+
+
+def kv_pool_plan(conf, max_rows: int,
+                 budget_bytes: Optional[int] = None,
+                 page_len: Optional[int] = None) -> KVPoolPlan:
+    """Size the block-paged KV pool for ``max_rows`` decode rows under
+    an optional byte budget. Raises for configs with no causal
+    attention (nothing decodes incrementally) and for budgets that
+    cannot hold even one page group — the engine fails loudly at build
+    time with the same rule."""
+    from deeplearning4j_tpu.analysis.graphcheck import iter_config_layers
+    layers = list(iter_config_layers(conf))
+    ml = _decode_max_len(conf, layers)
+    if not ml:
+        raise ValueError("config has no causal attention — no KV pool")
+    pl = default_kv_page_len(ml) if page_len is None else int(page_len)
+    if pl < 1 or ml % pl:
+        raise ValueError(f"kv page_len {pl} must divide max_len {ml}")
+    pgb = kv_page_group_bytes(conf, pl)
+    ppr = ml // pl
+    pages = max(1, int(max_rows)) * ppr
+    if budget_bytes is not None:
+        pages = min(pages, int(budget_bytes) // pgb)
+    if pages < 1:
+        raise ValueError(
+            f"cache_budget_bytes={budget_bytes} cannot hold even one "
+            f"KV page group ({pgb} bytes/page-group)")
+    return KVPoolPlan(page_len=pl, pages_per_row=ppr,
+                      page_group_bytes=pgb, pages=pages)
+
+
+def kv_cache_bytes(conf, rows: int, max_len: Optional[int] = None,
+                   page_len: Optional[int] = None,
+                   pages: Optional[int] = None) -> int:
+    """Config-only estimate of the serving KV residency — PAGE-
+    granular (ISSUE 20): a row resident to position p holds
+    ``ceil((p+1) / page_len)`` page groups, not a whole ``max_len``
+    row. ``pages`` given: exactly that many page groups (what a live
+    pool gauge reports). Otherwise ``rows`` FULL rows, i.e. ``rows *
+    (max_len / page_len)`` pages — numerically the old whole-row
+    estimate when ``page_len`` divides ``max_len``, but derived
+    through the page-group term the pool actually allocates in.
+    Returns 0 for configs with no causal attention."""
+    from deeplearning4j_tpu.analysis.graphcheck import iter_config_layers
+    layers = list(iter_config_layers(conf))
+    ml = max_len if max_len is not None else _decode_max_len(conf, layers)
+    if not ml:
+        return 0
+    pl = default_kv_page_len(ml) if page_len is None else int(page_len)
+    pgb = kv_page_group_bytes(conf, pl)
+    if pages is None:
+        pages = rows * (-(-int(ml) // pl))
+    return int(pages) * pgb
 
 
 def memory_report(conf, batch_size: int = 32, layers=None,
@@ -263,7 +359,11 @@ def memory_report(conf, batch_size: int = 32, layers=None,
     re-walking shapes. ``weight_update_sharding``/``dp``: model the
     ZeRO-1 updater-state layout (see :class:`MemoryReport`).
     ``decode_rows``: additionally estimate the token-level serving
-    engine's resident KV caches at that decode-bucket width."""
+    engine's block-paged KV pool at that decode-bucket width —
+    ``kv_pool_plan(conf, decode_rows)``'s pool bytes, page length and
+    page count (the same sizing rule the live engine allocates with,
+    so the reported bytes equal the engine's
+    ``serving_kv_cache_bytes`` gauge at ``max_rows=decode_rows``)."""
     from deeplearning4j_tpu.analysis.graphcheck import iter_config_layers
     training = conf.training
     rep = MemoryReport(batch_size=batch_size, dtype=training.dtype,
@@ -273,7 +373,15 @@ def memory_report(conf, batch_size: int = 32, layers=None,
                        dp=max(1, int(dp)),
                        decode_rows=max(0, int(decode_rows)))
     if rep.decode_rows:
-        rep.kv_cache_total_bytes = kv_cache_bytes(conf, rep.decode_rows)
+        try:
+            plan = kv_pool_plan(conf, rep.decode_rows)
+        except ValueError:   # no causal attention: nothing decodes
+            plan = None
+        if plan is not None:
+            rep.kv_cache_total_bytes = plan.total_bytes
+            rep.kv_page_len = plan.page_len
+            rep.kv_pages_total = plan.total_pages
+            rep.kv_pages_per_row = plan.pages_per_row
     for name, layer, out_type in (layers if layers is not None
                                   else iter_config_layers(conf)):
         try:

@@ -119,6 +119,12 @@ RULES: Dict[str, Tuple[str, str]] = {
               "not show the cache buffers in input_output_alias — "
               "every decode step copies the whole cache instead of "
               "updating it in place"),
+    "SC010": ("paged-kv-indirection",
+              "decode-step program claiming a block-paged KV pool "
+              "either lowered no page-table gather (the indirection "
+              "never formed — a dense cache path compiled instead) or "
+              "dropped the pool's donation through the indirection "
+              "(2x resident pool HBM plus a full-pool copy per token)"),
 }
 
 #: severity when the rule FIRES as a defect (SC002/SC007 also emit
@@ -133,6 +139,7 @@ RULE_SEVERITY = {
     "SC007": Severity.WARNING,
     "SC008": Severity.ERROR,
     "SC009": Severity.ERROR,
+    "SC010": Severity.ERROR,
 }
 
 #: default SC007 gate: |HLO - predicted| / predicted above this warns
@@ -819,6 +826,64 @@ def _check_sc009(findings, program: StepProgram,
             "or a backend that cannot alias"))
 
 
+_GATHER_OP_RE = re.compile(r"\bstablehlo\.(?:dynamic_)?gather\b")
+
+
+def _check_sc010(findings, program: StepProgram,
+                 expect_paged_gather: Optional[int]) -> None:
+    """SC010 (ISSUE 20): a block-paged decode step reads its KV state
+    through a page-table indirection — ``pool[page_table]`` — so the
+    lowered program must carry at least one ``stablehlo.gather`` (or
+    ``dynamic_gather``) PER POOL LEAF (2 per attention node: k and v).
+    The claim is that leaf count. Fewer gathers means the indirection
+    never formed and a dense whole-row cache path compiled instead —
+    page eviction and prefix sharing silently stop meaning anything.
+    The pool must also stay donated THROUGH the indirection: at least
+    as many ``input_output_alias`` pairs as pool leaves, else every
+    token pays a full-pool copy on top of 2x resident pool HBM (the
+    SC009 cliff, scaled up to the whole pool)."""
+    if not expect_paged_gather or expect_paged_gather < 1:
+        return
+    gathers = len(_GATHER_OP_RE.findall(program.stablehlo))
+    if gathers < expect_paged_gather:
+        findings.append(Finding(
+            "SC010", Severity.ERROR, "<entry>",
+            f"decode step claims a block-paged KV pool with "
+            f"{expect_paged_gather} leaf buffers but the lowered "
+            f"program carries only {gathers} gather op(s) — the "
+            "page-table indirection never formed; this is a dense "
+            "cache program wearing a paged signature, so page-level "
+            "eviction and prefix sharing cannot be in effect",
+            "build the step via paged_decode_fn (nn/graph.py): the "
+            "cache read must be gather_kv_pages(pool, page_table), "
+            "not a direct dense-cache read"))
+        return
+    landed = program.module.alias_pairs
+    if landed >= expect_paged_gather:
+        return
+    if program.stablehlo and not program.donation_requested:
+        findings.append(Finding(
+            "SC010", Severity.ERROR, "<entry>",
+            f"paged decode step claims {expect_paged_gather} donated "
+            "pool buffers but the lowered program requests no "
+            "donation (no donate_argnums reached jit) — every decode "
+            "step copies the FULL page pool instead of updating it in "
+            "place",
+            "jit the paged decode step with donate_argnums on the "
+            "pool argument (keras/generation.py donates argnum 2)"))
+    else:
+        findings.append(Finding(
+            "SC010", Severity.ERROR, "<entry>",
+            f"paged decode step claims {expect_paged_gather} donated "
+            f"pool buffers but only {landed} input_output_alias "
+            "pair(s) survived compilation — the donation did not make "
+            "it through the page-table indirection, so the pool is "
+            "resident twice and copied once per token",
+            "check the pool leaf dtypes/shapes are unchanged through "
+            "the step (aliasing needs identical shapes) and that the "
+            "scatter writes back into the SAME pool leaves"))
+
+
 def _check_sc007(findings, program: StepProgram, wus: str, dp: int,
                  gradient_accumulation: int,
                  param_count: Optional[int],
@@ -869,6 +934,7 @@ def check_step_program(program: StepProgram, *,
                        check_scan: Optional[bool] = None,
                        check_cost: bool = True,
                        expect_cache_alias: Optional[int] = None,
+                       expect_paged_gather: Optional[int] = None,
                        ) -> List[Finding]:
     """Run every SC rule over one captured step program.
 
@@ -907,6 +973,7 @@ def check_step_program(program: StepProgram, *,
     _check_sc006(findings, mod)
     _check_sc008(findings, mod, sp)
     _check_sc009(findings, program, expect_cache_alias)
+    _check_sc010(findings, program, expect_paged_gather)
     # gate the calibration only where the ring model applies: the
     # ga-scan path hides per-microbatch traffic in loop bodies whose
     # trip counts the text dump does not carry, and callers whose comm

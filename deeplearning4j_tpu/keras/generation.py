@@ -18,23 +18,55 @@ decoder (``models/gpt.py``) iteration-level:
   and states stay arguments, so ``fit`` never invalidates a bucket);
   decode compiles per pow2 row count. Steady state runs with ZERO
   recompiles: a second wave of identical bucket shapes adds no traces.
-- **KV caches are carry-threaded state** (the serving analog of the
-  tBPTT scan carries in ``nn/graph.py``): static ``[rows, H, max_len,
-  D]`` shapes per attention node, donated to the decode step every
-  iteration (shardcheck SC009 statically verifies the donation landed
-  as ``input_output_alias``), each row masking its own prefix — which
-  is what makes batched greedy decode BITWISE equal to singleton
-  decode on CPU, join/leave churn included.
-- **Ring-buffer cache eviction under HBM pressure.** The bucket grows
-  on demand until ``cache_budget_bytes`` (or ``max_rows``) stops it;
-  past that, an INTERACTIVE arrival evicts the oldest-admitted BULK
-  row (ring order) instead of waiting behind it — the victim's prompt
-  + generated-so-far tokens re-queue and RE-PREFILL when capacity
-  returns (never garbage: the re-prefilled cache is rebuilt from the
-  tokens, not salvaged). ``evict_cache`` chaos forces the same path.
+- **KV state lives in a BLOCK-PAGED pool** (ISSUE 20, vLLM-style):
+  one fixed ``[n_pages, H, page_len, D]`` array pair per attention
+  node, all nodes sharing ONE physical page-id space (a "page group"
+  = the same slot across every node's k and v). Each decode row owns
+  a host-side page table mapping logical page slots to physical
+  pages; the compiled paged step gathers the row's chain back into
+  the exact dense ``[rows, H, max_len, D]`` cache shape, runs the
+  UNCHANGED attention math, and scatters the one new K/V token back
+  to the row's write page. The pool is donated every iteration
+  (shardcheck SC010 statically verifies both the page-table gather
+  and that donation survived the indirection; SC009 still covers the
+  dense step) — which is what keeps batched greedy decode BITWISE
+  equal to singleton decode on CPU: join/leave churn, page eviction,
+  and prefix sharing included. Physical page 0 is reserved scratch:
+  unmapped table slots alias it so a free or stalled row's scatter
+  never lands in a live page.
+- **Refcounted prefix sharing.** Prompt prefixes are content-hashed
+  at page granularity (key = prefill bucket + exact token prefix):
+  a full page whose prefix matches one already resident is MAPPED,
+  not rewritten — refcount++ and the pool write is skipped; a page
+  frees only at refcount zero. A shared page is read-only by
+  construction (decode writes only ever land in a row's EXCLUSIVE
+  write page — host validation asserts refcount==1 on it every
+  step). On top rides a full-prompt registry (LRU): an identical
+  prompt skips prefill entirely — retained pages are mapped, the
+  partial tail page restored from host copies, and the first token
+  re-selected from the cached prefill probs, so TTFT collapses for
+  shared-system-prompt traffic.
+- **Page-granular eviction under pool pressure.** When allocation
+  fails the allocator walks a pressure ladder: registry LRU entries
+  drop their retained refs first, then the oldest-admitted BULK row
+  loses its COLDEST entirely-decode-written page — the victim rolls
+  its position back to the lost page's first token and REPLAYS its
+  own recorded tokens through the normal decode step (emission
+  suppressed), re-deriving the lost K/V bitwise; only what was lost
+  re-computes, never the whole row. If every live row stalls on
+  allocation, the oldest row falls back to whole-ROW eviction (the
+  ISSUE 15 requeue + re-prefill path), so progress is guaranteed.
+  ``evict_page`` / ``corrupt_page_table`` chaos force these paths.
+- **Sampling v0** rides the per-row probs seam: an op-level
+  ``{"sampling": {"temperature": t, "seed": s}}`` switches a request
+  from greedy argmax to seeded temperature sampling whose draw index
+  is the tokens-generated count — the token stream is bitwise
+  reproducible for a fixed seed, whatever churn, replay, or
+  re-prefill the request lived through. Greedy stays the default.
 - **Priority classes**: the admission queue orders ``interactive``
   ahead of ``bulk`` (stable FIFO within a class) — same discipline as
-  the predict scheduler's queue.
+  the predict scheduler's queue; interactive arrivals may still evict
+  a whole bulk row (ring order) when row slots run out.
 
 Every PR 6 invariant carries over: admission only through the server's
 ServiceGuard, the nonfinite sentinel runs PER ROW per step (a poisoned
@@ -47,9 +79,15 @@ Observability: ``serving_generated_tokens_total``,
 ``serving_decode_steps_total``, ``serving_decode_batch_rows``
 histogram, ``serving_ttft_seconds`` + ``serving_ttft_p50/p99_ms``
 (time-to-first-token = admission to the prefill's first token),
-``serving_kv_cache_bytes`` gauge, ``serving_kv_evictions_total`` /
-``serving_reprefills_total``, and ``serve:prefill`` / ``serve:decode``
-tracer spans.
+``serving_kv_cache_bytes`` gauge (now the resident page-pool bytes),
+``serving_kv_evictions_total`` / ``serving_reprefills_total``,
+``serving_kv_page_evictions_total``, ``serving_prefill_steps_total``,
+``serving_prefix_cache_lookups_total`` /
+``serving_prefix_cache_hits_total``,
+``serving_page_table_corruptions_total``, and ``serve:prefill`` /
+``serve:decode`` tracer spans. ``stats()`` surfaces
+``prefix_cache_hit_rate`` and the ``kv_pages_*`` pool occupancy the
+bench's ``lm_serve`` record carries.
 """
 
 from __future__ import annotations
@@ -75,11 +113,35 @@ from deeplearning4j_tpu.resilience.sentinel import host_nonfinite
 from deeplearning4j_tpu.resilience.service import (Deadline,
                                                    DeadlineExceeded,
                                                    DrainingError,
-                                                   NonFiniteOutput)
+                                                   NonFiniteOutput,
+                                                   PageTableCorruption)
 from deeplearning4j_tpu.util.math_utils import next_pow_of_2
 
 #: row-count edges for the serving_decode_batch_rows histogram
 DECODE_ROWS_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+
+def sample_token(probs, temperature: float = 0.0, seed: int = 0,
+                 draw_index: int = 0) -> int:
+    """Seeded temperature sampling over one probability row (sampling
+    v0). ``temperature <= 0`` degrades to greedy argmax. The draw is
+    ``default_rng([seed, draw_index]).random()`` — a COUNTER-KEYED
+    stream: the i-th generated token of a request depends only on
+    (seed, i), never on batching, page eviction, replay, or re-prefill
+    history, so a fixed seed pins a bitwise-reproducible token stream.
+    Inverse-CDF over the temperature-rescaled distribution, float64 on
+    host: one deterministic code path, no accelerator variance.
+    ``models/gpt.py``'s singleton ``sample_generate`` reference uses
+    this same function, which is what makes batched sampling == the
+    singleton stream provable token-for-token."""
+    p = np.asarray(probs, np.float64).ravel()
+    if temperature <= 0.0:
+        return int(p.argmax())
+    z = np.log(np.maximum(p, 1e-38)) / float(temperature)
+    z = np.exp(z - z.max())
+    z /= z.sum()
+    u = np.random.default_rng([int(seed), int(draw_index)]).random()
+    return int(min(np.searchsorted(np.cumsum(z), u), p.size - 1))
 
 
 class _GenRequest:
@@ -89,12 +151,15 @@ class _GenRequest:
 
     __slots__ = ("prompt", "max_new", "priority", "deadline", "event",
                  "tokens", "error", "t0", "ttft_s", "index", "steps",
-                 "reprefills", "admit_seq", "model_obj", "on_token")
+                 "reprefills", "admit_seq", "model_obj", "on_token",
+                 "sampling")
 
     def __init__(self, prompt: np.ndarray, max_new: int, priority: int,
-                 deadline: Deadline, index: int, on_token=None):
+                 deadline: Deadline, index: int, on_token=None,
+                 sampling: Optional[dict] = None):
         self.prompt = prompt
         self.on_token = on_token         # per-token stream hook
+        self.sampling = sampling         # None = greedy argmax
         self.max_new = max_new
         self.priority = priority
         self.deadline = deadline
@@ -155,20 +220,54 @@ class _Engine:
         self.model = model
         self.lock = lock
         self._prefill_fn = prefill
-        self._decode_fn = decode
+        self._decode_fn = decode               # dense step (SC009 seam)
         self.vocab = model.decode_vocab()
         self.max_len = model.decode_max_len()
-        self.row_bytes = model.decode_cache_bytes(1)
+        # ---- block-paged KV pool sizing (ISSUE 20). Mirrors
+        # analysis.memory.kv_pool_plan exactly so memory_report's
+        # number IS this engine's gauge: usable pages = max_rows full
+        # rows, capped by the byte budget; +1 physical page 0 reserved
+        # as SCRATCH (unmapped table slots alias it).
+        self.page_len = model.kv_page_len(scheduler.kv_page_len)
+        self.pages_per_row = self.max_len // self.page_len
+        self.page_group_bytes = model.kv_page_group_bytes(self.page_len)
+        self._paged_decode_fn = model.paged_decode_fn(self.page_len)
+        usable = scheduler.max_rows * self.pages_per_row
         budget = scheduler.cache_budget_bytes
-        if budget is not None and budget < self.row_bytes:
-            raise ValueError(
-                f"cache_budget_bytes={budget} cannot hold even one "
-                f"decode row ({self.row_bytes} bytes/row)")
+        if budget is not None:
+            usable = min(usable, budget // self.page_group_bytes)
+            if usable < 1:
+                raise ValueError(
+                    f"cache_budget_bytes={budget} cannot hold even one "
+                    f"KV page group ({self.page_group_bytes} "
+                    f"bytes/page-group)")
+        self.usable_pages = usable
+        self.total_pages = usable + 1
+        self.pool = model.init_kv_page_pool(self.total_pages,
+                                            self.page_len)
+        self.pool_bytes = self.total_pages * self.page_group_bytes
+        # ---- page allocator state: HOST truth. ``row_pages`` is the
+        # authoritative ownership map (slot -> physical page id, one
+        # dict per row) mirroring every table write; validation and
+        # release go through IT, never through the (derived, possibly
+        # corrupted) numpy table.
+        self.page_ref = [0] * self.total_pages
+        self.page_ref[0] = 1               # scratch: never allocatable
+        self.free_pages = list(range(1, self.total_pages))
+        self.page_key: Dict[int, tuple] = {}      # pid -> prefix key
+        self.prefix_pages: Dict[tuple, int] = {}  # prefix key -> pid
+        #: full-prompt LRU registry: (bucket, tokens) -> retained full
+        #: pages + host tail copies + prefill probs — a hit skips
+        #: prefill entirely
+        self.prompt_registry: "collections.OrderedDict[tuple, dict]" = \
+            collections.OrderedDict()
         self.rows = 0
-        self.caches = None
+        self.table = np.full((0, self.pages_per_row), -1, np.int32)
+        self.row_pages: List[Dict[int, int]] = []
         self.slots: List[Optional[_GenRequest]] = []
         self.tokens: List[int] = []      # next token to feed, per slot
         self.positions: List[int] = []   # next decode position, per slot
+        self.prefill_lens: List[int] = []  # prefill coverage, per slot
         self.iteration = 0
         self._admit_seq = 0
         self._eye = np.eye(self.vocab, dtype=np.float32)
@@ -177,9 +276,13 @@ class _Engine:
     def _compiled(self, kind: str, bucket: int):
         """The AOT executable for one (kind, bucket): ``("prefill",
         pow2 prompt len)`` or ``("decode", pow2 rows)`` — cached in the
-        budgeted cross-model cache, compiled once. Caches are DONATED
-        (argnums 2): each call consumes the previous iteration's cache
-        buffers in place of allocating a second copy."""
+        budgeted cross-model cache, compiled once. KV state is DONATED
+        (argnums 2): the prefill consumes its fresh 1-row cache, and
+        the PAGED decode step consumes the page pool — the page table
+        rides the compiled step as a plain int32 gather index, so the
+        pool shapes (hence the executables) are identical for every
+        row bucket and the zero-recompile steady state survives the
+        indirection (SC010 proves the donation landed)."""
         sched = self.scheduler
         cache_key = (sched._cache_owner, self.key, kind, bucket)
         runner = sched._compiled.get(cache_key)
@@ -187,18 +290,26 @@ class _Engine:
             return runner
         import jax
         t0 = time.perf_counter()
-        fn = self._prefill_fn if kind == "prefill" else self._decode_fn
-        caches = self.model.init_decode_cache(
-            bucket if kind == "decode" else 1)
         if kind == "prefill":
+            fn = self._prefill_fn
+            caches = self.model.init_decode_cache(1)
             x = jax.ShapeDtypeStruct((1, bucket, self.vocab), np.float32)
             aux = jax.ShapeDtypeStruct((1,), np.int32)
+            compiled = jax.jit(fn, donate_argnums=(2,)).lower(
+                self.model.params, self.model.states, caches, x, aux
+            ).compile()
         else:
+            fn = self._paged_decode_fn
+            pool = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                self.pool)
             x = jax.ShapeDtypeStruct((bucket, 1, self.vocab), np.float32)
             aux = jax.ShapeDtypeStruct((bucket,), np.int32)
-        compiled = jax.jit(fn, donate_argnums=(2,)).lower(
-            self.model.params, self.model.states, caches, x, aux
-        ).compile()
+            tbl = jax.ShapeDtypeStruct((bucket, self.pages_per_row),
+                                       np.int32)
+            compiled = jax.jit(fn, donate_argnums=(2,)).lower(
+                self.model.params, self.model.states, pool, x, aux, tbl
+            ).compile()
         elapsed = time.perf_counter() - t0
         get_registry().counter(
             "serving_compile_seconds_total",
@@ -209,8 +320,12 @@ class _Engine:
             sched.compiles += 1
             sched._compiles_per_bucket[(self.key, kind, bucket)] += 1
 
-        def runner(params, states, c, xv, av, _c=compiled):
-            return _c(params, states, c, xv, av)
+        if kind == "prefill":
+            def runner(params, states, c, xv, av, _c=compiled):
+                return _c(params, states, c, xv, av)
+        else:
+            def runner(params, states, c, xv, av, tbl, _c=compiled):
+                return _c(params, states, c, xv, av, tbl)
 
         with sched._cond:
             cur = sched._backends.get(self.key)
@@ -252,15 +367,22 @@ class _Engine:
 
     def _prefill(self, req: _GenRequest):
         """Run the request's prompt (or re-prefill history) through its
-        pow2 length bucket; returns (first token, 1-row caches)."""
+        pow2 length bucket; returns (probs row ``[V]``, 1-row caches).
+        Every call counts a prefill STEP — the number a prefix-cache
+        hit provably keeps flat."""
         history = req.history()
         L = len(history)
         bucket = self.prefill_bucket(L)
         x = np.zeros((1, bucket, self.vocab), np.float32)
         x[0, :L] = self._eye[history]
         runner = self._compiled("prefill", bucket)
+        get_registry().counter(
+            "serving_prefill_steps_total",
+            help="prefill steps executed (a prefix-cache hit skips "
+                 "one)").inc()
         with self.scheduler._stats_lock:   # traffic mix (prewarm signal)
             self.scheduler._mix[("prefill", bucket)] += 1
+            self.scheduler.prefill_steps += 1
         flight_record("serving", "prefill_dispatch", model=self.key,
                       bucket=bucket, tokens=L)
         with get_tracer().span("serve:prefill", model=self.key,
@@ -270,7 +392,140 @@ class _Engine:
                     self.model.params, self.model.states,
                     self.model.init_decode_cache(1), x,
                     np.asarray([L], np.int32))
-        return int(np.asarray(probs)[0].argmax()), caches
+        return np.asarray(probs)[0], caches
+
+    def _select(self, req: _GenRequest, probs_vec) -> int:
+        """Next-token selection for one row: greedy argmax unless the
+        request carries a sampling config — then seeded temperature
+        sampling whose draw index is the tokens-generated-so-far
+        count, so page eviction, replay, and re-prefill never shift
+        the stream (the same (seed, index) always yields the same
+        draw, and a replayed step consumes NO draw)."""
+        s = req.sampling
+        if not s:
+            return int(np.asarray(probs_vec).argmax())
+        return sample_token(probs_vec,
+                            temperature=float(s.get("temperature", 0.0)),
+                            seed=int(s.get("seed", 0)),
+                            draw_index=len(req.tokens))
+
+    # ------------------------------------------------------ page allocator
+    def _map_page(self, row: int, slot: int, pid: int) -> None:
+        """Map one physical page into a row's chain: host ownership
+        map, device-table mirror, and refcount move together — the
+        invariant ``_validate_page_table`` re-checks every step."""
+        self.row_pages[row][slot] = pid
+        self.table[row, slot] = pid
+        self.page_ref[pid] += 1
+
+    def _unref_page(self, pid: int) -> None:
+        """Drop one reference; at zero the page returns to the free
+        list and leaves the prefix index (a later identical prefix
+        re-prefills — never maps a freed page)."""
+        self.page_ref[pid] -= 1
+        if self.page_ref[pid] == 0:
+            self.free_pages.append(pid)
+            key = self.page_key.pop(pid, None)
+            if key is not None:
+                self.prefix_pages.pop(key, None)
+
+    def _registry_evict_one(self) -> None:
+        """Drop the LRU full-prompt registry entry: its retained refs
+        release (pages still mapped by live rows survive — only the
+        registry's own holds go)."""
+        _, entry = self.prompt_registry.popitem(last=False)
+        for pid in entry["pages"]:
+            self._unref_page(pid)
+
+    def _alloc_page(self, exclude_row: Optional[int] = None
+                    ) -> Optional[int]:
+        """One physical page, walking the pressure ladder: free list ->
+        drop LRU prefix-registry retentions -> steal the COLDEST
+        droppable page from the oldest-admitted BULK row (never from
+        ``exclude_row`` — stealing from the requester frees nothing
+        net). ``None`` = genuinely out of pages; the caller stalls or
+        falls back to whole-row eviction."""
+        if self.free_pages:
+            return self.free_pages.pop()
+        while self.prompt_registry:
+            self._registry_evict_one()
+            if self.free_pages:
+                return self.free_pages.pop()
+        victims = sorted((s.admit_seq, i)
+                         for i, s in enumerate(self.slots)
+                         if s is not None and s.priority > 0
+                         and i != exclude_row)
+        for _, i in victims:
+            j = self._coldest_droppable(i)
+            if j is None:
+                continue
+            self._drop_page(i, j, reason="pressure")
+            if self.free_pages:
+                return self.free_pages.pop()
+        return None
+
+    def _coldest_droppable(self, row: int) -> Optional[int]:
+        """Lowest page slot of ``row`` that is ENTIRELY decode-written
+        (``slot*page_len >= prefill_len`` — replay can only re-derive
+        decode content; prefill content needs the whole-row path) and
+        fully behind the write position (never the page being
+        written). Such pages are exclusive by construction."""
+        pf, pos, pl = (self.prefill_lens[row], self.positions[row],
+                       self.page_len)
+        for j in sorted(self.row_pages[row]):
+            if j * pl >= pf and (j + 1) * pl <= pos:
+                return j
+        return None
+
+    def _drop_page(self, row: int, slot: int, reason: str) -> None:
+        """Page-granular eviction: unmap + unref ONE page and roll the
+        victim's position back to that page's first token. Subsequent
+        normal decode steps REPLAY its recorded tokens from there —
+        the identical computation re-derives the lost K/V bitwise,
+        with emission suppressed until the row catches back up, so
+        only what was lost re-computes."""
+        req = self.slots[row]
+        pid = self.row_pages[row].pop(slot)
+        self.table[row, slot] = -1
+        self._unref_page(pid)
+        self.positions[row] = slot * self.page_len
+        hist = req.history()
+        self.tokens[row] = int(hist[self.positions[row]])
+        get_registry().counter(
+            "serving_kv_page_evictions_total",
+            help="KV pages dropped under pool pressure or chaos (the "
+                 "victim replays only the lost page)").inc()
+        get_tracer().instant("kv_page_evicted", model=self.key, row=row,
+                             slot=slot, reason=reason)
+        flight_record("serving", "kv_page_evicted", model=self.key,
+                      row=row, slot=slot, page=pid, reason=reason)
+
+    def _release_row(self, row: int) -> None:
+        """Free a row's slot and every page reference it holds — via
+        the authoritative host ownership map, NEVER via the device
+        table (a corrupted table must not steer releases)."""
+        self.slots[row] = None
+        for pid in self.row_pages[row].values():
+            self._unref_page(pid)
+        self.row_pages[row] = {}
+        if self.rows:
+            self.table[row, :] = -1
+        self.tokens[row] = 0
+        self.positions[row] = 0
+        self.prefill_lens[row] = 0
+
+    def _write_page(self, pid: int, cache1, start: int,
+                    count: int) -> None:
+        """Copy prefill K/V positions ``[start, start+count)`` into
+        pool page ``pid`` across every attention node (one page
+        group). Stale content past ``count`` is harmless: attention
+        masks it to an EXACT-zero softmax contribution, and the write
+        position's slot is rewritten in-step before being read."""
+        for n, kv in cache1.items():
+            for k, v in kv.items():
+                self.pool[n][k] = self.pool[n][k].at[
+                    pid, :, :count, :].set(
+                        v[0, :, start:start + count, :])
 
     # ----------------------------------------------------- slot lifecycle
     def active(self) -> int:
@@ -281,41 +536,46 @@ class _Engine:
             self.scheduler._publish_kv_gauge_locked()
 
     def _grow_allowed(self, new_rows: int) -> bool:
-        if new_rows > self.scheduler.max_rows:
-            return False
-        budget = self.scheduler.cache_budget_bytes
-        return budget is None or new_rows * self.row_bytes <= budget
+        # row slots are free under paging — MEMORY admission control
+        # moved to the page allocator (a request that cannot get pages
+        # re-queues; the pool bytes are fixed at engine build)
+        return new_rows <= self.scheduler.max_rows
 
     def _resize(self, new_rows: int) -> None:
-        """Re-bucket the decode batch: live rows keep their cache
-        contents (row gather — values untouched, so parity is
-        unaffected); free rows' contents are irrelevant because a JOIN
-        always overwrites its whole cache row."""
-        import jax.numpy as jnp
+        """Re-bucket the decode batch. Under paging this is PURE HOST
+        bookkeeping: the pool never moves, rows keep their page
+        mappings, and only the per-row table/slot arrays re-index — no
+        device gather, no cache copy, so parity is trivially
+        unaffected and resize costs nothing on the accelerator."""
         live = [i for i, s in enumerate(self.slots) if s is not None]
         assert len(live) <= new_rows
-        if self.caches is None:
-            self.caches = self.model.init_decode_cache(new_rows)  # lockcheck: disable=LC004 -- caches is decode-loop private; decode_iteration's lock guards the model op, not this field
-        elif new_rows != self.rows:
-            idx = np.asarray(live + [0] * (new_rows - len(live)),
-                             np.int32)
-            self.caches = {n: {k: jnp.take(v, idx, axis=0)
-                               for k, v in kv.items()}
-                           for n, kv in self.caches.items()}
+        new_table = np.full((new_rows, self.pages_per_row), -1, np.int32)
+        new_row_pages: List[Dict[int, int]] = [
+            {} for _ in range(new_rows)]
         new_slots: List[Optional[_GenRequest]] = [None] * new_rows
         new_tokens, new_positions = [0] * new_rows, [0] * new_rows
+        new_prefill = [0] * new_rows
         for j, i in enumerate(live):
             new_slots[j] = self.slots[i]
             new_tokens[j] = self.tokens[i]
             new_positions[j] = self.positions[i]
+            new_prefill[j] = self.prefill_lens[i]
+            new_table[j] = self.table[i]
+            new_row_pages[j] = self.row_pages[i]
         self.slots, self.tokens, self.positions = (new_slots, new_tokens,
                                                    new_positions)
+        self.prefill_lens = new_prefill
+        self.table, self.row_pages = new_table, new_row_pages
         self.rows = new_rows
         self._publish_cache_gauge()
 
     def try_admit(self, req: _GenRequest) -> bool:
-        """JOIN: prefill the request and insert its cache row. Returns
-        False when no capacity exists (caller re-queues)."""
+        """JOIN: admit one request — a full-prompt prefix-registry hit
+        maps the retained pages and skips prefill ENTIRELY (TTFT
+        collapses to page-mapping cost); the cold path prefills, then
+        maps the prompt's pages with content-addressed FULL-page dedup
+        against the pool. Returns False when no row slot or no pages
+        are available (caller re-queues)."""
         row = next((i for i, s in enumerate(self.slots) if s is None),
                    None)
         if row is None:
@@ -339,12 +599,113 @@ class _Engine:
                 "re-prefill after a cache eviction; retry"))
             return True
         req.model_obj = self.model
-        history_len = len(req.history())
-        try:
-            first, cache1 = self._prefill(req)
-        except Exception as e:  # noqa: BLE001 — fail THIS request alone
-            req.fail(e)
+        history = req.history()
+        L = len(history)
+        pl = self.page_len
+        # feasibility: the request's WORST-CASE page chain must fit the
+        # pool outright, else it could never finish however long it
+        # waits — fail loudly now instead of queueing forever
+        remaining = max(req.max_new - len(req.tokens), 0)
+        highest = (L - 1 if remaining <= 1
+                   else min(L + remaining - 2, self.max_len - 1))
+        need = highest // pl + 1
+        if need > self.usable_pages:
+            req.fail(ValueError(
+                f"generation needs {need} KV pages ({L} prompt tokens "
+                f"+ {remaining} new at page_len {pl}) but the pool "
+                f"budget cannot hold more than {self.usable_pages}"))
             return True
+        bucket = self.prefill_bucket(L)
+        hist_t = tuple(int(t) for t in history)
+        reg_key = (bucket, hist_t)
+        with self.scheduler._stats_lock:
+            self.scheduler.prefix_lookups += 1
+        reg = get_registry()
+        reg.counter("serving_prefix_cache_lookups_total",
+                    help="full-prompt prefix-registry lookups at "
+                         "admission").inc()
+        entry = self.prompt_registry.get(reg_key)
+        n_full, tail_len = L // pl, L % pl
+        if entry is not None:
+            # FULL-PROMPT HIT: an identical prompt prefilled earlier —
+            # map its retained pages (refcount++, read-only by
+            # construction), restore the partial tail page from host
+            # copies into a fresh EXCLUSIVE write page, and re-select
+            # the first token from the cached prefill probs per THIS
+            # request's sampling config. No prefill step runs.
+            self.prompt_registry.move_to_end(reg_key)
+            wp = None
+            if tail_len:
+                wp = self._alloc_page(exclude_row=row)
+                if wp is None:
+                    return False
+            for j, pid in enumerate(entry["pages"]):
+                self._map_page(row, j, pid)
+            if wp is not None:
+                for n, kv in entry["tail"].items():
+                    for k, v in kv.items():
+                        self.pool[n][k] = self.pool[n][k].at[
+                            wp, :, :tail_len, :].set(v)
+                self._map_page(row, n_full, wp)
+            first = self._select(req, entry["probs"])
+            with self.scheduler._stats_lock:
+                self.scheduler.prefix_hits += 1
+            reg.counter("serving_prefix_cache_hits_total",
+                        help="admissions that skipped prefill via the "
+                             "full-prompt prefix registry").inc()
+            get_tracer().instant("prefix_cache_hit", model=self.key,
+                                 tokens=L)
+            flight_record("serving", "prefix_cache_hit", model=self.key,
+                          tokens=L, row=row)
+        else:
+            try:
+                probs_vec, cache1 = self._prefill(req)
+            except Exception as e:  # noqa: BLE001 — fail THIS alone
+                req.fail(e)
+                return True
+            # map + fill the prompt's page chain, deduping FULL pages
+            # content-addressed: same prefill bucket + same exact token
+            # prefix => bitwise-identical K/V (row-independent matmuls;
+            # suffix tokens contribute EXACTLY zero through the causal
+            # mask), so the page is shared and the pool write skipped
+            new_refs = []
+            ok = True
+            for j in range(n_full):
+                pkey = (bucket, hist_t[:(j + 1) * pl])
+                pid = self.prefix_pages.get(pkey)
+                if pid is not None:
+                    self._map_page(row, j, pid)     # dedup: no write
+                    new_refs.append((j, pid))
+                    continue
+                pid = self._alloc_page(exclude_row=row)
+                if pid is None:
+                    ok = False
+                    break
+                self._write_page(pid, cache1, j * pl, pl)
+                self._map_page(row, j, pid)
+                self.prefix_pages[pkey] = pid
+                self.page_key[pid] = pkey
+                new_refs.append((j, pid))
+            if ok and tail_len:
+                wp = self._alloc_page(exclude_row=row)
+                if wp is None:
+                    ok = False
+                else:
+                    self._write_page(wp, cache1, n_full * pl, tail_len)
+                    self._map_page(row, n_full, wp)
+                    new_refs.append((n_full, wp))
+            if not ok:
+                # pages ran out mid-mapping: undo the refs taken and
+                # re-queue (the wasted prefill is the price of not
+                # holding pages hostage across the queue)
+                for j, pid in new_refs:
+                    del self.row_pages[row][j]
+                    self.table[row, j] = -1
+                    self._unref_page(pid)
+                return False
+            first = self._select(req, probs_vec)
+            self._registry_insert(reg_key, row, n_full, cache1, L,
+                                  tail_len, probs_vec)
         if req.ttft_s is None:  # a re-prefilled victim keeps its first
             req.ttft_s = time.monotonic() - req.t0
             self.scheduler.ttft.observe(req.ttft_s)
@@ -354,15 +715,41 @@ class _Engine:
         self.slots[row] = req
         self.tokens[row] = first
         # next decode writes `first`'s K/V at position = history length
-        self.positions[row] = history_len
-        for name, kv in cache1.items():
-            for k, v in kv.items():
-                self.caches[name][k] = self.caches[name][k].at[row].set(
-                    v[0])
+        self.positions[row] = L
+        self.prefill_lens[row] = L
         if len(req.tokens) >= req.max_new \
                 or self.positions[row] >= self.max_len:
             self._complete(row)      # prompt-only TTFT request
         return True
+
+    def _registry_insert(self, reg_key, row: int, n_full: int, cache1,
+                         L: int, tail_len: int, probs_vec) -> None:
+        """Retain this prompt's prefill for later identical prompts:
+        refcount++ on its FULL pages (they outlive the row), host
+        copies of the partial tail page (a hit restores them into a
+        fresh exclusive write page — shared pages stay read-only), and
+        the prefill probs row (a hit re-selects its first token per
+        request). LRU-capped; eviction only drops the registry's own
+        refs, so pages still mapped by live rows survive it."""
+        if reg_key in self.prompt_registry:
+            self.prompt_registry.move_to_end(reg_key)
+            return
+        pages = [self.row_pages[row][j] for j in range(n_full)]
+        for pid in pages:
+            self.page_ref[pid] += 1
+        pl = self.page_len
+        tail = {}
+        if tail_len:
+            tail = {n: {k: np.asarray(v[0, :, n_full * pl:L, :])
+                        for k, v in kv.items()}
+                    for n, kv in cache1.items()}
+        self.prompt_registry[reg_key] = {
+            "pages": pages, "tail": tail, "tail_len": tail_len,
+            "probs": np.asarray(probs_vec, np.float32).copy(),
+            "prefill_len": L}
+        while len(self.prompt_registry) > \
+                self.scheduler.prefix_registry_cap:
+            self._registry_evict_one()
 
     def _preempt_for(self, req: _GenRequest) -> bool:
         """Ring-buffer eviction under pressure: an INTERACTIVE arrival
@@ -379,13 +766,13 @@ class _Engine:
 
     def evict_row(self, row: int, reason: str = "pressure") -> None:
         """LEAVE (involuntary): push the victim back onto the queue;
-        its history re-prefills when capacity returns — the cache row
-        is abandoned, never reused."""
+        its history re-prefills when capacity returns — its pages free
+        immediately through the host ownership map, never salvaged."""
         victim = self.slots[row]
         if victim is None:
             return
         victim.reprefills += 1
-        self.slots[row] = None
+        self._release_row(row)
         reg = get_registry()
         reg.counter("serving_kv_evictions_total",
                     help="KV-cache rows evicted (ring-buffer pressure "
@@ -407,7 +794,7 @@ class _Engine:
 
     def _complete(self, row: int) -> None:
         req = self.slots[row]
-        self.slots[row] = None
+        self._release_row(row)
         get_registry().counter(
             "serving_generated_tokens_total",
             help="tokens generated by the decode engine").inc(
@@ -417,6 +804,68 @@ class _Engine:
         req.finish()
 
     # ------------------------------------------------------------- decode
+    def _nth_oldest(self, live, rank: int) -> Optional[int]:
+        """The ``rank``-th oldest-admitted live row (chaos targeting);
+        clamps to the oldest available."""
+        if not live:
+            return None
+        ordered = sorted((self.slots[i].admit_seq, i) for i in live)
+        return ordered[min(max(rank, 0), len(ordered) - 1)][1]
+
+    def _validate_page_table(self, live):
+        """Host-side page-table validation, every iteration BEFORE the
+        table reaches a compiled step: each live row's device table
+        must mirror the authoritative ``row_pages`` ownership map
+        (in-pool, un-freed pages only), and the row's WRITE page must
+        be exclusive (refcount 1) — the 'shared prefix pages are
+        read-only by construction' assert. A corrupt row fails ALONE
+        with a structured PAGE_TABLE error; its pages release via the
+        ownership map, never via the corrupted table — so cross-row
+        cache garbage is structurally impossible."""
+        ok = []
+        for i in live:
+            req = self.slots[i]
+            mapped = self.row_pages[i]
+            bad = None
+            for j in range(self.pages_per_row):
+                want = mapped.get(j, -1)
+                got = int(self.table[i, j])
+                if got != want:
+                    bad = (f"slot {j} maps page {got}, host ownership "
+                           f"says {want}")
+                    break
+                if want >= 0 and not 0 < want < self.total_pages:
+                    bad = f"slot {j} maps out-of-pool page {want}"
+                    break
+                if want >= 0 and self.page_ref[want] < 1:
+                    bad = f"slot {j} maps freed page {want}"
+                    break
+            if bad is None:
+                wslot = self.positions[i] // self.page_len
+                wpid = mapped.get(wslot)
+                if wpid is not None and self.page_ref[wpid] != 1:
+                    bad = (f"write page {wpid} (slot {wslot}) is "
+                           f"SHARED (refcount {self.page_ref[wpid]}) — "
+                           f"shared prefix pages are read-only by "
+                           f"construction")
+            if bad is None:
+                ok.append(i)
+                continue
+            get_registry().counter(
+                "serving_page_table_corruptions_total",
+                help="decode rows failed by host-side page-table "
+                     "validation before any compiled step ran").inc()
+            get_tracer().instant("page_table_corrupt", model=self.key,
+                                 row=i)
+            flight_record("serving", "page_table_corrupt",
+                          model=self.key, row=i, detail=bad)
+            req.fail(PageTableCorruption(
+                f"decode row {i}: {bad}; failing this row alone (its "
+                f"pages release via the host ownership map — the "
+                f"corrupt table never reached a compiled step)"))
+            self._release_row(i)
+        return ok
+
     def decode_iteration(self) -> None:
         """One engine step: decode ONE token for every live row."""
         self.iteration += 1
@@ -430,14 +879,67 @@ class _Engine:
                 req.fail(DeadlineExceeded(
                     "generate: budget exhausted mid-stream at "
                     f"token {len(req.tokens)}"))
-                self.slots[i] = None
+                self._release_row(i)
                 live.remove(i)
         if not live:
+            return
+        # corrupt_page_table chaos scribbles BEFORE validation — the
+        # validator must provably catch it
+        rank = faultinject.check_corrupt_page_table()
+        if rank is not None:
+            t = self._nth_oldest(live, rank)
+            if t is not None:
+                self.table[t, self.positions[t] // self.page_len] = \
+                    self.total_pages + 7
+        live = self._validate_page_table(live)
+        if not live:
+            return
+        # evict_page chaos: drop the target's coldest droppable page —
+        # the exact path pool pressure takes (no droppable page =>
+        # whole-row fallback, same as the real pressure ladder)
+        rank = faultinject.check_evict_page()
+        if rank is not None:
+            t = self._nth_oldest(live, rank)
+            if t is not None:
+                j = self._coldest_droppable(t)
+                if j is not None:
+                    self._drop_page(t, j, reason="chaos")
+                else:
+                    self.evict_row(t, reason="chaos")
+                    live.remove(t)
+        if not live:
+            return
+        # every live row needs its WRITE page mapped before dispatch;
+        # a row that cannot get one STALLS this step (its scatter
+        # would otherwise land on scratch and lose the token)
+        stalled = []
+        for i in list(live):
+            wslot = self.positions[i] // self.page_len
+            if wslot not in self.row_pages[i]:
+                pid = self._alloc_page(exclude_row=i)
+                if pid is None:
+                    stalled.append(i)
+                    live.remove(i)
+                else:
+                    self._map_page(i, wslot, pid)
+        if not live:
+            if stalled:
+                # EVERY live row is stalled on allocation: page-level
+                # pressure has nothing left to give, so fall back to
+                # whole-ROW eviction of the oldest — the pool drains
+                # and the rest make progress (eventual serialization,
+                # never deadlock)
+                victim = self.ring_victim()
+                if victim is not None:
+                    self.evict_row(victim, reason="page-pressure")
             return
         x = np.zeros((self.rows, 1, self.vocab), np.float32)
         for i in live:
             x[i, 0] = self._eye[self.tokens[i]]
         positions = np.asarray(self.positions, np.int32)
+        # derived DEVICE table: unmapped slots alias scratch page 0,
+        # so free/stalled rows' scatters never touch a live page
+        table = np.where(self.table < 0, 0, self.table).astype(np.int32)
         runner = self._compiled("decode", self.rows)
         tracer = get_tracer()
         watchdog_beat("serving_decode")
@@ -448,9 +950,9 @@ class _Engine:
                          live=len(live), iteration=self.iteration):
             try:
                 with self.lock:
-                    probs, self.caches = runner(
+                    probs, self.pool = runner(
                         self.model.params, self.model.states,
-                        self.caches, x, positions)
+                        self.pool, x, positions, table)
                 probs = np.asarray(probs)
             except Exception:  # noqa: BLE001 — isolate batchmates
                 # batch-level decode failure: re-run each live row ALONE
@@ -462,16 +964,19 @@ class _Engine:
                          "batch-level failure").inc()
                 if self._caches_deleted():
                     # the failed call had already CONSUMED the donated
-                    # cache buffers (a runtime fault after dispatch):
-                    # the singleton fallback has nothing to slice.
-                    # Rebuild instead of failing everyone — every live
-                    # row re-queues for RE-PREFILL from its tokens,
-                    # the same never-garbage path eviction uses.
+                    # page pool (a runtime fault after dispatch): the
+                    # singleton fallback has nothing to read — and the
+                    # shared prefix pages died with the pool. Rebuild
+                    # the allocator from zero instead of failing
+                    # everyone: every live row re-queues for RE-PREFILL
+                    # from its tokens, the same never-garbage path
+                    # eviction uses.
                     for i in list(live):
                         self.evict_row(i, reason="donated-cache-lost")
-                    self.caches = self.model.init_decode_cache(self.rows)
+                    self._rebuild_pool()
                     return
-                probs = self._singleton_fallback(live, x, positions)
+                probs = self._singleton_fallback(live, x, positions,
+                                                 table)
         reg = get_registry()
         reg.counter("serving_decode_steps_total",
                     help="batched decode steps executed").inc()
@@ -484,26 +989,40 @@ class _Engine:
             req = self.slots[i]
             if req is None:
                 continue
-            row_probs = probs[i] if probs is not None else None
-            if row_probs is None:
-                continue  # fallback already failed this row
-            if faultinject.poison_decode_row(req.index, req.steps + 1):
-                row_probs = np.full_like(row_probs, np.nan)
-            if host_nonfinite(row_probs):
-                reg.counter(
-                    "serving_nonfinite_outputs_total",
-                    help="predictions refused because the model output "
-                         "carried NaN/Inf").inc()
-                req.fail(NonFiniteOutput(
-                    f"generation row turned NaN/Inf at token "
-                    f"{len(req.tokens) + 1}"))
-                self.slots[i] = None     # fails ALONE, mid-stream
-                continue
-            tok = int(row_probs.argmax())
-            req.push_token(tok)
+            if probs is None:
+                continue  # fallback rebuilt the pool; rows re-queued
+            row_probs = probs[i]
+            # a row is REPLAYING (rebuilding a dropped page) while its
+            # position has not caught back up to its recorded history:
+            # the step's K/V write is the point, the probs re-derive
+            # tokens the request already holds
+            hist_len = len(req.prompt) + len(req.tokens)
+            replaying = self.positions[i] + 1 < hist_len
+            if not replaying:
+                if faultinject.poison_decode_row(req.index,
+                                                 req.steps + 1):
+                    row_probs = np.full_like(row_probs, np.nan)
+                if host_nonfinite(row_probs):
+                    reg.counter(
+                        "serving_nonfinite_outputs_total",
+                        help="predictions refused because the model "
+                             "output carried NaN/Inf").inc()
+                    req.fail(NonFiniteOutput(
+                        f"generation row turned NaN/Inf at token "
+                        f"{len(req.tokens) + 1}"))
+                    self._release_row(i)  # fails ALONE, mid-stream
+                    continue
             req.steps += 1
-            self.tokens[i] = tok
             self.positions[i] += 1
+            if replaying:
+                # emission suppressed: feed the NEXT recorded token —
+                # identical computation re-derives the lost K/V bitwise
+                hist = req.history()
+                self.tokens[i] = int(hist[self.positions[i]])
+                continue
+            tok = self._select(req, row_probs)
+            req.push_token(tok)
+            self.tokens[i] = tok
             if len(req.tokens) >= req.max_new \
                     or self.positions[i] >= self.max_len:
                 self._complete(i)
@@ -519,46 +1038,73 @@ class _Engine:
             self._resize(target)
 
     def _caches_deleted(self) -> bool:
-        """True when the bucket's cache buffers were invalidated by a
+        """True when the page pool's buffers were invalidated by a
         donation that dispatched before the step failed."""
-        for kv in self.caches.values():
+        for kv in self.pool.values():
             for v in kv.values():
                 deleted = getattr(v, "is_deleted", None)
                 if deleted is not None and deleted():
                     return True
         return False
 
-    def _singleton_fallback(self, live, x, positions):
+    def _rebuild_pool(self) -> None:
+        """The donated pool was consumed by a step that then died:
+        every device page is gone — including shared prefix pages and
+        registry-retained ones, so the whole allocator resets with it
+        (host metadata pointing at dead device pages would serve
+        garbage on the next prefix hit). Callers evict live rows to
+        the re-prefill path FIRST."""
+        self.pool = self.model.init_kv_page_pool(  # lockcheck: disable=LC004 -- the pool is only touched from the engine's single scheduler thread; decode_iteration's lock guards the model op during dispatch, not this field
+            self.total_pages, self.page_len)
+        self.page_ref = [0] * self.total_pages
+        self.page_ref[0] = 1
+        self.free_pages = list(range(1, self.total_pages))
+        self.prefix_pages.clear()
+        self.page_key.clear()
+        self.prompt_registry.clear()
+        self.table = np.full((self.rows, self.pages_per_row), -1,
+                             np.int32)
+        self.row_pages = [{} for _ in range(self.rows)]
+
+    def _singleton_fallback(self, live, x, positions, table):
         """Re-run each live row in the 1-row decode bucket; rows that
         fail alone surface their own error (and only those may charge
-        the caller's breaker). Successful rows' cache updates write
-        back into the bucket."""
+        the caller's breaker). The POOL threads through every 1-row
+        call (donated each time), so successful rows' page writes land
+        exactly where the batched step would have put them — no
+        write-back pass. Returns None when a singleton call consumed
+        the pool and then died (callers see rows already re-queued)."""
         probs = np.zeros((self.rows, self.vocab), np.float32)
-        import jax.numpy as jnp
-        for i in live:
+        for i in list(live):
             req = self.slots[i]
             try:
-                c1 = {n: {k: v[i:i + 1] for k, v in kv.items()}
-                      for n, kv in self.caches.items()}
                 runner = self._compiled("decode", 1)
                 with self.lock:
-                    p1, c1 = runner(self.model.params, self.model.states,
-                                    c1, x[i:i + 1], positions[i:i + 1])
+                    p1, self.pool = runner(
+                        self.model.params, self.model.states,
+                        self.pool, x[i:i + 1], positions[i:i + 1],
+                        table[i:i + 1])
                 probs[i] = np.asarray(p1)[0]
-                for n, kv in c1.items():
-                    for k, v in kv.items():
-                        self.caches[n][k] = \
-                            self.caches[n][k].at[i].set(jnp.asarray(v)[0])
             except Exception as e:  # noqa: BLE001 — per-row verdict
+                if self._caches_deleted():
+                    # the 1-row step consumed the pool then died:
+                    # nothing left for the remaining rows either —
+                    # evict them all to the re-prefill path and rebuild
+                    for j in list(live):
+                        if self.slots[j] is not None:
+                            self.evict_row(j, reason="donated-cache-"
+                                                     "lost")
+                    self._rebuild_pool()
+                    return None
                 req.fail(e)
-                self.slots[i] = None
+                self._release_row(i)
         return probs
 
     def fail_all(self, error: BaseException) -> None:
         for i, req in enumerate(self.slots):
             if req is not None:
                 req.fail(error)
-                self.slots[i] = None
+                self._release_row(i)
 
 
 class GenerationScheduler:
@@ -573,7 +1119,9 @@ class GenerationScheduler:
                  idle_thread_s: float = 30.0,
                  compile_cache: Optional[CompileCache] = None,
                  prewarm_top: int = 3,
-                 prewarm_decode_ladder: bool = False):
+                 prewarm_decode_ladder: bool = False,
+                 kv_page_len: Optional[int] = None,
+                 prefix_registry_cap: int = 32):
         if max_rows < 1:
             raise ValueError("max_rows must be >= 1")
         self.max_rows = next_pow_of_2(int(max_rows))
@@ -581,6 +1129,9 @@ class GenerationScheduler:
             self.max_rows >>= 1
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1000.0
         self.cache_budget_bytes = cache_budget_bytes
+        # None = per-model default (analysis.memory.default_kv_page_len)
+        self.kv_page_len = kv_page_len
+        self.prefix_registry_cap = max(0, int(prefix_registry_cap))
         self.idle_thread_s = idle_thread_s
         self.prewarm_top = prewarm_top
         # compile the whole pow2 decode-rows ladder at engine build:
@@ -600,6 +1151,9 @@ class GenerationScheduler:
         self.compile_s = 0.0
         self.compiles = 0
         self.tokens_out = 0
+        self.prefill_steps = 0
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
         # _mix = OBSERVED traffic per (kind, bucket) — the speculative-
         # prewarm ranking signal; _compiles_per_bucket = compiles per
         # bucket — the zero-recompile gate surface (a value > 1 means a
@@ -618,13 +1172,18 @@ class GenerationScheduler:
     # -------------------------------------------------------------- submit
     def submit(self, key: str, model, lock: threading.Lock,
                prompt, max_new_tokens: int, deadline: Deadline,
-               priority: str = "interactive", on_token=None) -> dict:
+               priority: str = "interactive", on_token=None,
+               sampling: Optional[dict] = None) -> dict:
         """Queue one generation and block until it completes. Returns
         ``{"tokens": [...], "ttft_ms": ..., "reprefills": n}``; raises
         the request's own structured error. ``on_token`` (optional) is
         invoked on the decode-loop thread with each token the moment it
         is generated — the streaming-gateway seam; exceptions it raises
-        only stop the streaming, never the generation."""
+        only stop the streaming, never the generation. ``sampling``
+        (optional) is ``{"temperature": t, "seed": s}`` — seeded
+        temperature sampling instead of the default greedy argmax;
+        ``temperature`` 0 stays greedy, and a fixed seed pins a
+        bitwise-reproducible token stream."""
         prompt = np.asarray(prompt, np.int32).ravel()
         vocab = model.decode_vocab()
         max_len = model.decode_max_len()
@@ -639,6 +1198,21 @@ class GenerationScheduler:
         max_new = min(int(max_new_tokens), max_len - prompt.size)
         if max_new < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if sampling is not None:
+            if not isinstance(sampling, dict):
+                raise ValueError(
+                    'sampling must be an object like '
+                    '{"temperature": t, "seed": s}')
+            try:
+                t = float(sampling.get("temperature", 0.0))
+                s = int(sampling.get("seed", 0))
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "sampling.temperature must be a number and "
+                    "sampling.seed an integer") from None
+            if t < 0:
+                raise ValueError("sampling.temperature must be >= 0")
+            sampling = {"temperature": t, "seed": s}
         deadline.check("generate enqueue")
         with self._cond:
             if self._stopping:
@@ -646,7 +1220,7 @@ class GenerationScheduler:
             self._submits += 1
             req = _GenRequest(prompt, max_new, priority_rank(priority),
                               deadline, faultinject.on_generate_submit(),
-                              on_token=on_token)
+                              on_token=on_token, sampling=sampling)
             self._backends[key] = (model, lock)
             self._enqueue_locked(key, req)
             loop = self._loops.get(key)
@@ -705,11 +1279,14 @@ class GenerationScheduler:
     def _publish_kv_gauge_locked(self) -> None:
         """Publish resident KV bytes across live engines — callers hold
         ``self._cond`` (every resize, retire, and swap republishes, so
-        freed caches never linger on the gauge)."""
+        freed pools never linger on the gauge). Under paging the pool
+        is FIXED at engine build: the gauge is the page-granular
+        eviction budget surface, and prefix sharing dedups occupancy
+        BELOW it (see ``kv_pages_*`` in ``stats()``)."""
         get_registry().gauge(
             "serving_kv_cache_bytes",
-            help="resident KV-cache bytes across decode buckets"
-        ).set(sum(e.rows * e.row_bytes for e in self._engines.values()))
+            help="resident KV page-pool bytes across decode engines"
+        ).set(sum(e.pool_bytes for e in self._engines.values()))
 
     # --------------------------------------------------------- decode loop
     def _decode_loop(self, key: str) -> None:
@@ -860,11 +1437,30 @@ class GenerationScheduler:
 
     def stats(self) -> dict:
         p50, p99 = self.ttft.quantiles()
+        with self._cond:
+            engines = list(self._engines.values())
+        # pool occupancy: used = allocated page groups, shared = pages
+        # with refcount > 1 (prefix dedup across rows / the registry) —
+        # the dedup savings the page pool buys below its fixed ceiling
+        pages_total = sum(e.usable_pages for e in engines)
+        pages_used = sum(e.total_pages - 1 - len(e.free_pages)
+                        for e in engines)
+        pages_shared = sum(
+            sum(1 for pid in range(1, e.total_pages)
+                if e.page_ref[pid] > 1) for e in engines)
         with self._stats_lock:
             return {
                 "compile_s": round(self.compile_s, 3),
                 "compiles": self.compiles,
                 "tokens_out": self.tokens_out,
+                "prefill_steps": self.prefill_steps,
+                "prefix_lookups": self.prefix_lookups,
+                "prefix_hits": self.prefix_hits,
+                "prefix_cache_hit_rate": round(
+                    self.prefix_hits / max(1, self.prefix_lookups), 4),
+                "kv_pages_total": pages_total,
+                "kv_pages_used": pages_used,
+                "kv_pages_shared": pages_shared,
                 "bucket_mix": {f"{k}:{b}": n for (k, b), n in
                                sorted(self._mix.items())},
                 "bucket_compiles": {f"{m}:{k}:{b}": n

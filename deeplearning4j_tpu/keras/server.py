@@ -49,14 +49,19 @@ one-dispatch path.
 Autoregressive decoders are served TOKEN-level (ISSUE 15)::
 
     {"op": "generate", "model": <gpt .zip>, "tokens": [ids...],
-     "max_new_tokens": N, "priority": "interactive"|"bulk"}
+     "max_new_tokens": N, "priority": "interactive"|"bulk",
+     "sampling": {"temperature": 0.8, "seed": 7}}   # optional
     -> {"ok": true, "tokens": [...], "ttft_ms": ...}
 
 ``keras/generation.py`` schedules these iteration-level: requests join
-and leave the running decode batch every step, per-request KV caches
-ride the compiled step as donated carry state, prefill/decode compile
-as separate pow2 AOT buckets, and batched greedy decode is bitwise
-identical to singleton decode. Every request (predict AND generate)
+and leave the running decode batch every step, per-request KV state
+lives in a block-paged page pool (ISSUE 20) that rides the compiled
+step as donated carry state, prompt prefixes are content-hash deduped
+across requests (repeat prompts skip prefill entirely), prefill/decode
+compile as separate pow2 AOT buckets, and batched greedy decode is
+bitwise identical to singleton decode. ``sampling`` switches greedy
+argmax to seeded temperature sampling (bitwise reproducible for a
+fixed seed). Every request (predict AND generate)
 may carry ``priority`` — ``interactive`` (default) jumps every queued
 ``bulk`` request in the batch queues.
 
@@ -195,6 +200,7 @@ class KerasServer:
                  max_batch: int = 32, max_wait_ms: float = 5.0,
                  batch_deadline_margin_ms: float = 50.0,
                  kv_cache_budget_bytes: Optional[int] = None,
+                 kv_page_len: Optional[int] = None,
                  prewarm: bool = True,
                  tuned=None,
                  preload: Optional[List[str]] = None,
@@ -213,12 +219,14 @@ class KerasServer:
             max_batch=max_batch, max_wait_ms=max_wait_ms,
             deadline_margin_ms=batch_deadline_margin_ms)
             if batching and max_batch > 0 else None)
-        # token-level generation engine (ISSUE 15): decode row buckets
-        # cap at the same max_batch; kv_cache_budget_bytes bounds the
-        # resident KV caches (ring-buffer eviction past it)
+        # token-level generation engine (ISSUE 15/20): decode row
+        # buckets cap at the same max_batch; kv_cache_budget_bytes now
+        # bounds the block-paged KV POOL (page-granular eviction past
+        # it), kv_page_len overrides the per-model page size
         self._gen = GenerationScheduler(
             max_rows=max(1, max_batch),
             cache_budget_bytes=kv_cache_budget_bytes,
+            kv_page_len=kv_page_len,
             prewarm_decode_ladder=prewarm)
         self._prewarm = prewarm
         self._models = collections.OrderedDict()  # path -> model (LRU)
@@ -547,7 +555,8 @@ class KerasServer:
                 out = self._gen.submit(
                     key, model, lock, payload,
                     int(req.get("max_new_tokens", 16)), deadline,
-                    priority=priority, on_token=on_token)
+                    priority=priority, on_token=on_token,
+                    sampling=req.get("sampling"))
                 resp = {"ok": True, **out}
             elif op == "predict" and self._batcher is not None:
                 # continuous batching: coalesce with concurrent

@@ -178,6 +178,50 @@ def greedy_generate(net, prompt: Sequence[int], max_new_tokens: int,
     return out
 
 
+def sample_generate(net, prompt: Sequence[int], max_new_tokens: int,
+                    temperature: float, seed: int) -> List[int]:
+    """SINGLETON seeded-sampling decode (sampling v0) — the reference
+    side of the batched == singleton bitwise gate for temperature
+    sampling: the same kernels as ``greedy_generate``, with next-token
+    selection through the engine's own ``sample_token`` at draw index
+    = tokens generated so far. A fixed seed pins the exact token
+    stream the serving engine must reproduce under batching, churn,
+    page eviction, and replay."""
+    import jax
+    from deeplearning4j_tpu.keras.generation import sample_token
+    from deeplearning4j_tpu.util.math_utils import next_pow_of_2
+
+    prompt = list(prompt)
+    V, max_len = net.decode_vocab(), net.decode_max_len()
+    if not 0 < len(prompt) < max_len:
+        raise ValueError(f"prompt length must be in (0, {max_len})")
+    max_new = min(int(max_new_tokens), max_len - len(prompt))
+    jits = getattr(net, "_greedy_jits", None)
+    if jits is None:
+        prefill, decode = net.decode_fns()
+        jits = net._greedy_jits = (jax.jit(prefill),
+                                   jax.jit(decode, donate_argnums=(2,)))
+    prefill_jit, decode_jit = jits
+    eye = np.eye(V, dtype=np.float32)
+    bucket = min(next_pow_of_2(len(prompt)), max_len)
+    x = np.zeros((1, bucket, V), np.float32)
+    x[0, :len(prompt)] = eye[np.asarray(prompt)]
+    caches = net.init_decode_cache(1)
+    probs, caches = prefill_jit(
+        net.params, net.states, caches, x,
+        np.asarray([len(prompt)], np.int32))
+    out = [sample_token(np.asarray(probs)[0], temperature, seed, 0)]
+    pos = len(prompt)
+    while len(out) < max_new:
+        xt = eye[np.asarray([out[-1]])][:, None, :]
+        probs, caches = decode_jit(net.params, net.states, caches, xt,
+                                   np.asarray([pos], np.int32))
+        out.append(sample_token(np.asarray(probs)[0], temperature,
+                                seed, len(out)))
+        pos += 1
+    return out
+
+
 # ---------------------------------------------------------------------------
 # character data path (char_rnn's, shaped for the LM + streaming pipeline)
 # ---------------------------------------------------------------------------

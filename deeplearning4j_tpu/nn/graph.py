@@ -80,6 +80,7 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
         self._rnn_carries: Optional[Dict[str, Any]] = None  # rnnTimeStep
         self._tbptt_step_fn = None
         self._decode_fns = None         # (prefill, decode) pure fns
+        self._paged_decode_fns: Dict[int, Any] = {}  # page_len -> step fn
         # layer nodes in topological order (the trainable walk)
         self._layer_nodes = [n for n in conf.topological_order
                              if conf.nodes[n].kind == "layer"]
@@ -873,6 +874,87 @@ class ComputationGraph(LazyScoreMixin, EvalMixin, ScanFitMixin,
 
             self._decode_fns = (prefill, decode)
         return self._decode_fns
+
+    # ------------------------------------------------- block-paged decode
+    # ISSUE 20: the serving engine stores KV state as a fixed pool of
+    # [n_pages, H, page_len, D] pages per attention node plus a per-row
+    # page table. The paged step gathers each row's pages into the
+    # EXACT dense [rows, H, max_len, D] shape the unmodified decode
+    # path expects (page_len must divide max_len), runs it, and
+    # scatters the one new K/V token per row back into its write page —
+    # values and shapes are identical to the dense step, so batched
+    # paged decode stays bitwise equal to singleton dense decode.
+
+    def kv_page_len(self, page_len: Optional[int] = None) -> int:
+        """Resolve (and validate) the KV page length: must divide the
+        static ``decode_max_len`` so pages tile a row exactly."""
+        ml = self.decode_max_len()
+        if page_len is None:
+            from deeplearning4j_tpu.analysis.memory import (
+                default_kv_page_len)
+            return default_kv_page_len(ml)
+        page_len = int(page_len)
+        if page_len < 1 or ml % page_len:
+            raise ValueError(
+                f"kv_page_len={page_len} must divide the static decode "
+                f"max_len {ml} (pages must tile a cache row exactly)")
+        return page_len
+
+    def init_kv_page_pool(self, n_pages: int, page_len: int
+                          ) -> Dict[str, Dict[str, Array]]:
+        """Fresh zeroed page pool — one {k, v} pair of
+        ``[n_pages, H, page_len, D]`` arrays per causal-attention node.
+        A physical page id addresses ONE page group: the same slot
+        across every node's k and v arrays."""
+        dt = _dtype_of(self.conf.training.dtype)
+        return {n: {"k": jnp.zeros(self.conf.nodes[n].layer.cache_shape(
+                        n_pages, page_len), dt),
+                    "v": jnp.zeros(self.conf.nodes[n].layer.cache_shape(
+                        n_pages, page_len), dt)}
+                for n in self.kv_cache_nodes()}
+
+    def kv_page_group_bytes(self, page_len: int) -> int:
+        """HBM footprint of ONE page group (k + v, ``page_len``
+        positions, across every causal-attention node) — the eviction
+        granularity the paged serving engine budgets against."""
+        return self.decode_cache_bytes(1, page_len)
+
+    def paged_decode_fn(self, page_len: Optional[int] = None):
+        """The PURE paged decode step the serving engine AOT-compiles:
+
+        ``paged_decode(params, states, pool, x, positions, page_table)
+        -> (probs [rows, V], new_pool)`` — ``pool`` is the donate-able
+        page-pool pytree, ``page_table`` ``[rows, max_len // page_len]``
+        int32. Gather -> dense decode -> scatter-back keeps the
+        attention math untouched; shardcheck SC010 statically proves
+        both the gather indirection and that the pool pages stayed
+        donated through it."""
+        page_len = self.kv_page_len(page_len)
+        cached = self._paged_decode_fns.get(page_len)
+        if cached is not None:
+            return cached
+        _, decode = self.decode_fns()   # validates decodability
+        from deeplearning4j_tpu.nn.layers.attention import (
+            gather_kv_pages, scatter_kv_token)
+
+        def paged_decode(params, states, pool, x, positions, page_table):
+            caches = {n: {k: gather_kv_pages(v, page_table)
+                          for k, v in kv.items()}
+                      for n, kv in pool.items()}
+            probs, new_caches = decode(params, states, caches, x,
+                                       positions)
+            rows = jnp.arange(x.shape[0])
+            new_pool = {}
+            for n, kv in pool.items():
+                new_pool[n] = {}
+                for k, v in kv.items():
+                    tok_kv = new_caches[n][k][rows, :, positions, :]
+                    new_pool[n][k] = scatter_kv_token(
+                        v, tok_kv, page_table, positions)
+            return probs, new_pool
+
+        self._paged_decode_fns[page_len] = paged_decode
+        return paged_decode
 
     # --------------------------------------------------------------- pretrain
     def _ancestors(self, target: str) -> set:

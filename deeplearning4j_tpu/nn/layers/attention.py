@@ -107,6 +107,45 @@ def finalize_attention(out: Array, lse: Array) -> Array:
     return out / jnp.maximum(lse[..., None], 1e-30)
 
 
+# ---------------------------------------------------------------------------
+# block-paged KV caches (ISSUE 20): the page-table indirection seam
+# ---------------------------------------------------------------------------
+
+def gather_kv_pages(pages: Array, page_table: Array) -> Array:
+    """Materialize per-row dense KV state from a block-paged pool.
+
+    ``pages``: the pool, ``[n_pages, H, page_len, D]``. ``page_table``:
+    ``[rows, pages_per_row]`` int32 physical page ids per row. Returns
+    the dense ``[rows, H, pages_per_row * page_len, D]`` cache view the
+    unmodified attention ``decode_step`` expects — when ``page_len``
+    divides ``max_len`` this is shape- and VALUE-identical to the
+    whole-row cache, so the paged decode step stays bitwise equal to
+    the dense one (garbage in unmapped/stale pages is finite and sits
+    only at masked positions, where softmax contributes exact zeros).
+    """
+    rows, ppr = page_table.shape
+    _, H, page_len, D = pages.shape
+    g = pages[page_table]                       # [rows, ppr, H, pl, D]
+    g = g.transpose(0, 2, 1, 3, 4)              # [rows, H, ppr, pl, D]
+    return g.reshape(rows, H, ppr * page_len, D)
+
+
+def scatter_kv_token(pages: Array, new_kv: Array, page_table: Array,
+                     positions: Array) -> Array:
+    """Write one decode step's K (or V) back into the paged pool.
+
+    ``new_kv``: ``[rows, H, D]`` — each row's K/V at its current write
+    position. The write lands in page ``page_table[row, pos // pl]`` at
+    offset ``pos % pl``. Write pages are EXCLUSIVE per row by
+    construction (the engine only shares fully-prefilled prompt pages),
+    so the scatter indices of live rows never collide — which is what
+    keeps shared pages read-only through the compiled step."""
+    page_len = pages.shape[2]
+    rows = jnp.arange(page_table.shape[0])
+    phys = page_table[rows, positions // page_len]
+    return pages.at[phys, :, positions % page_len, :].set(new_kv)
+
+
 @register_layer
 @dataclass
 class SelfAttentionLayer(BaseLayerConf):

@@ -63,6 +63,20 @@ Token-level decode fault kinds (ISSUE 15, the iteration-level seams):
   evicted exactly as HBM pressure would evict it. The victim must
   RE-PREFILL from its prompt + generated-so-far tokens and finish with
   a coherent generation — never garbage from a stale or zeroed cache.
+- ``evict_page``       — force PAGE-granular eviction (ISSUE 20) at
+  the engine's ``at_call``-th decode iteration: the ``rank``-th
+  oldest-admitted row (default 0) loses its COLDEST droppable KV page
+  exactly as pool pressure would drop it. The victim must rebuild only
+  the lost page — a decode REPLAY of its recorded tokens from the page
+  boundary, emission suppressed — and resume a BITWISE-identical token
+  stream (rows with no droppable page fall back to the whole-row
+  eviction path, the same pressure ladder the real allocator walks).
+- ``corrupt_page_table`` — scribble an out-of-pool physical page id
+  into the ``rank``-th oldest row's page-table write slot at the
+  ``at_call``-th decode iteration. The engine's host-side validation
+  must fail THAT row with a structured ``PAGE_TABLE`` error before the
+  mapping reaches a compiled step — never decode through the bogus
+  mapping, never cross-row cache garbage, batchmates unharmed.
 
 Input-pipeline fault kinds (PR 7, the streaming-input seams):
 
@@ -182,6 +196,7 @@ _KINDS = ("raise", "nan", "truncate_checkpoint", "drop_connection",
           "poison_row", "slow_batch", "slow_input", "io_error",
           "kill_host", "slow_host", "kill_coordinator", "rejoin_host",
           "partition_host", "poison_decode", "evict_cache",
+          "evict_page", "corrupt_page_table",
           "kill_replica", "partition_replica", "slow_replica",
           "flap_replica", "load_spike")
 
@@ -253,6 +268,8 @@ _input_nexts = 0
 _reader_reads = 0
 _gen_submits = 0
 _decode_iters = 0
+_page_iters = 0
+_pt_iters = 0
 #: monotonic deadline until which heartbeat writes are suppressed
 #: (``partition_host``); None = no partition in effect, inf = until the
 #: schedule is cleared
@@ -276,7 +293,7 @@ def set_schedule(schedule: Optional[FaultSchedule]) -> None:
     global _schedule, _commit_calls, _recv_calls, _pub_calls
     global _dispatch_calls, _frame_sends, _loris_sends
     global _predict_loads, _batch_dispatches, _input_nexts, _reader_reads
-    global _gen_submits, _decode_iters
+    global _gen_submits, _decode_iters, _page_iters, _pt_iters
     global _partition_until
     with _lock:
         _schedule = schedule
@@ -296,6 +313,8 @@ def set_schedule(schedule: Optional[FaultSchedule]) -> None:
         _reader_reads = 0
         _gen_submits = 0
         _decode_iters = 0
+        _page_iters = 0
+        _pt_iters = 0
         _partition_until = None
 
 
@@ -735,6 +754,46 @@ def check_evict_cache() -> bool:
                 _fire(f, iteration=_decode_iters)
                 return True
         return False
+
+
+def check_evict_page() -> Optional[int]:
+    """Called by the generation engine once per decode iteration; a
+    scheduled ``evict_page`` fault fires on its ``at_call``-th iteration
+    since arming (own counter — independent of ``evict_cache``) and
+    returns the target row ordinal (``rank``-th oldest-admitted row,
+    default 0): the engine must drop that row's coldest droppable KV
+    page — the exact path pool pressure takes — and the victim must
+    replay-rebuild it and resume bitwise. ``None`` = no fault due."""
+    global _page_iters
+    with _lock:
+        if _schedule is None:
+            return None
+        _page_iters += 1
+        for f in _schedule.pending():
+            if f.kind == "evict_page" and f.at_call == _page_iters:
+                _fire(f, iteration=_page_iters, rank=f.rank)
+                return max(0, f.rank)
+        return None
+
+
+def check_corrupt_page_table() -> Optional[int]:
+    """Called by the generation engine once per decode iteration; a
+    scheduled ``corrupt_page_table`` fault fires on its ``at_call``-th
+    iteration since arming (own counter) and returns the target row
+    ordinal (``rank``-th oldest-admitted row, default 0): the engine
+    must scribble an out-of-pool page id into that row's table so its
+    host-side validation provably catches the corruption BEFORE the
+    mapping reaches a compiled step. ``None`` = no fault due."""
+    global _pt_iters
+    with _lock:
+        if _schedule is None:
+            return None
+        _pt_iters += 1
+        for f in _schedule.pending():
+            if f.kind == "corrupt_page_table" and f.at_call == _pt_iters:
+                _fire(f, iteration=_pt_iters, rank=f.rank)
+                return max(0, f.rank)
+        return None
 
 
 def on_batch_dispatch(key: str = "") -> None:

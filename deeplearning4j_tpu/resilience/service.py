@@ -104,6 +104,16 @@ class NonFiniteOutput(ServiceError):
     code = "NONFINITE"
 
 
+class PageTableCorruption(ServiceError):
+    """A decode row's KV page table failed host-side validation (ISSUE
+    20): an entry pointed outside the pool, at a freed page, or at
+    another row's exclusive write page. The corrupted row fails with
+    THIS structured error — it is never decoded against the bogus
+    mapping, so cross-row cache garbage cannot be served."""
+
+    code = "PAGE_TABLE"
+
+
 # ---------------------------------------------------------------------------
 # backoff (the FaultTolerantTrainer retry policy, reused)
 # ---------------------------------------------------------------------------

@@ -30,7 +30,8 @@ import pytest
 from deeplearning4j_tpu.keras.batching import (CompileCache,
                                                set_compile_cache)
 from deeplearning4j_tpu.keras.generation import GenerationScheduler
-from deeplearning4j_tpu.models.gpt import gpt_tiny, greedy_generate
+from deeplearning4j_tpu.models.gpt import (gpt_tiny, greedy_generate,
+                                           sample_generate)
 from deeplearning4j_tpu.nn.graph import ComputationGraph
 from deeplearning4j_tpu.profiling.metrics import (MetricsRegistry,
                                                   get_registry,
@@ -39,7 +40,8 @@ from deeplearning4j_tpu.resilience import faultinject, service
 from deeplearning4j_tpu.resilience.faultinject import (Fault,
                                                        FaultSchedule)
 from deeplearning4j_tpu.resilience.service import (Deadline,
-                                                   NonFiniteOutput)
+                                                   NonFiniteOutput,
+                                                   PageTableCorruption)
 
 VOCAB, SEQ_LEN, MAX_NEW = 13, 16, 6
 
@@ -184,10 +186,17 @@ def test_zero_recompiles_on_identical_second_wave(net, prompts, refs):
         # and no (kind, bucket) shape ever compiled twice
         assert all(n == 1
                    for n in sched.stats()["bucket_compiles"].values())
-        # the traffic mix counts OBSERVATIONS, not compiles: two waves
-        # of 6 prompts observed >> 1 prefill per bucket
-        assert sum(n for k, n in sched.stats()["bucket_mix"].items()
-                   if k.startswith("prefill")) >= 12
+        # the second IDENTICAL wave hits the full-prompt prefix
+        # registry: no prefill dispatches at all (the mix counts
+        # observations — it stays at wave one's 6), every admission
+        # after the first wave is a hit, and the tokens above are
+        # still bitwise the singleton references
+        st = sched.stats()
+        assert sum(n for k, n in st["bucket_mix"].items()
+                   if k.startswith("prefill")) == 6
+        assert st["prefill_steps"] == 6
+        assert st["prefix_hits"] >= 6
+        assert st["prefix_cache_hit_rate"] > 0
     finally:
         sched.stop()
 
@@ -437,7 +446,7 @@ def test_decode_failure_with_consumed_caches_reprefills(net, prompts,
     try:
         fired = []
 
-        def boom_once(params, states, c, x, pos):
+        def boom_once(params, states, c, x, pos, tbl):
             fired.append(True)
             jax.tree.map(lambda a: a.delete(), c)   # donation consumed
             raise RuntimeError("runtime fault after dispatch")
@@ -468,17 +477,28 @@ def test_decode_failure_with_consumed_caches_reprefills(net, prompts,
 # ---------------------------------------------------------------------------
 
 def test_kv_cache_budget_serializes_admission(net, prompts, refs):
-    """A budget of exactly one row's cache: concurrent bulk requests
-    serialize through the single slot (no growth past the budget) and
-    every generation still matches its reference."""
-    budget = net.decode_cache_bytes(1)
-    sched = GenerationScheduler(max_rows=4, cache_budget_bytes=budget)
+    """A pool budget of three page GROUPS (page_len 4 => at most three
+    resident pages — LESS than one whole 16-token row): the three bulk
+    requests' page chains cannot all fit, so admission and decode
+    serialize through page pressure — allocation stalls, whole-row
+    fallback evictions, re-prefills — and every generation still
+    matches its bitwise reference. A request whose worst-case chain
+    could NEVER fit fails loudly instead of queueing forever."""
+    pgb = net.kv_page_group_bytes(net.kv_page_len())
+    sched = GenerationScheduler(max_rows=4, cache_budget_bytes=3 * pgb)
     try:
         results = _submit_all(sched, net, prompts[:3], priority="bulk")
         for i, r in results.items():
             assert not isinstance(r, Exception), (i, r)
             assert r["tokens"] == refs[i]
-        assert sched._engines["m"].rows == 1   # never grew past budget
+        eng = sched._engines["m"]
+        assert eng.usable_pages == 3           # the budget cap held
+        assert len(eng.free_pages) >= 2        # pages released at idle
+        with pytest.raises(ValueError, match="KV pages"):
+            # 7 prompt tokens + 9 new needs 4 pages — infeasible under
+            # this pool, surfaced at admission rather than queued
+            sched.submit("m", net, threading.Lock(), list(prompts[1]),
+                         9, Deadline(10_000), priority="bulk")
     finally:
         sched.stop()
 
@@ -495,14 +515,48 @@ def test_kv_cache_budget_too_small_fails_loudly(net):
 
 def test_memory_report_kv_term(net):
     from deeplearning4j_tpu.analysis.memory import (kv_cache_bytes,
+                                                    kv_pool_plan,
                                                     memory_report)
     conf = net.conf
+    # page-granular accounting degrades to the old whole-row number
+    # for full rows (page_len divides max_len), but is now derived
+    # through the page-group term the pool actually allocates in
     assert kv_cache_bytes(conf, 8) == net.decode_cache_bytes(8)
+    plan = kv_pool_plan(conf, 8)
+    assert plan.page_len == net.kv_page_len()
+    assert plan.pages_per_row * plan.page_len == net.decode_max_len()
+    assert kv_cache_bytes(conf, 0, pages=plan.pages) \
+        == net.decode_cache_bytes(8)
     rep = memory_report(conf, batch_size=4, decode_rows=8)
-    assert rep.kv_cache_total_bytes == net.decode_cache_bytes(8)
-    assert "KV cache" in rep.to_text()
+    # the report now carries the POOL plan: 8 rows of usable pages
+    # plus the one reserved scratch page group
+    assert rep.kv_cache_total_bytes == plan.total_bytes
+    assert rep.kv_page_len == plan.page_len
+    assert rep.kv_pages_total == plan.total_pages
+    assert "page pool" in rep.to_text()
     # non-attention configs decode nothing
     assert memory_report(conf, batch_size=4).kv_cache_total_bytes == 0
+
+
+def test_live_engine_pool_matches_report(net, prompts, refs):
+    """The engine's published pool gauge IS the config-only
+    ``kv_pool_plan`` number — ONE sizing rule, so ``memory_report``
+    predicts exactly what a live engine holds."""
+    from deeplearning4j_tpu.analysis.memory import kv_pool_plan
+    sched = GenerationScheduler(max_rows=4)
+    try:
+        results = _submit_all(sched, net, prompts[:2])
+        for i, r in results.items():
+            assert r["tokens"] == refs[i]
+        plan = kv_pool_plan(net.conf, sched.max_rows)
+        eng = sched._engines["m"]
+        assert eng.page_len == plan.page_len
+        assert eng.usable_pages == plan.pages
+        assert eng.pool_bytes == plan.total_bytes
+        gauge = get_registry().get("serving_kv_cache_bytes")
+        assert gauge is not None and gauge.value == plan.total_bytes
+    finally:
+        sched.stop()
 
 
 def test_generate_op_over_socket(net, prompts, refs, tmp_path):
@@ -547,3 +601,200 @@ def test_decode_step_program_donates_caches(net):
                                            expect_cache_alias=n_leaves)
              if f.rule == "SC009"]
     assert fired and fired[0].severity == Severity.ERROR
+
+
+def test_paged_decode_step_program_sc010(net):
+    """The serving engine's PAGED decode program passes SC010 (page-
+    table gathers formed, pool donation landed); the same program
+    without donation fires it, and the DENSE program checked against a
+    paged claim fires the gather-missing arm."""
+    import jax
+    from deeplearning4j_tpu.analysis.findings import Severity
+    from deeplearning4j_tpu.analysis.shardcheck import (
+        check_step_program, lower_step_program)
+    pl = net.kv_page_len()
+    ppr = net.decode_max_len() // pl
+    pool = net.init_kv_page_pool(2 * ppr + 1, pl)
+    fn = net.paged_decode_fn(pl)
+    n_leaves = 2 * len(net.kv_cache_nodes())
+    x = jax.ShapeDtypeStruct((2, 1, VOCAB), np.float32)
+    pos = jax.ShapeDtypeStruct((2,), np.int32)
+    tbl = jax.ShapeDtypeStruct((2, ppr), np.int32)
+    good = lower_step_program(
+        jax.jit(fn, donate_argnums=(2,)), net.params, net.states,
+        pool, x, pos, tbl)
+    assert not [f for f in check_step_program(
+        good, expect_paged_gather=n_leaves) if f.rule == "SC010"]
+    bad = lower_step_program(jax.jit(fn), net.params, net.states,
+                             pool, x, pos, tbl)
+    fired = [f for f in check_step_program(
+        bad, expect_paged_gather=n_leaves) if f.rule == "SC010"]
+    assert fired and fired[0].severity == Severity.ERROR
+    assert "donat" in fired[0].message
+    # the dense program wearing a paged claim: the indirection's
+    # gathers never formed
+    _, decode = net.decode_fns()
+    caches = net.init_decode_cache(2)
+    dense = lower_step_program(
+        jax.jit(decode, donate_argnums=(2,)), net.params, net.states,
+        caches, x, pos)
+    fired = [f for f in check_step_program(
+        dense, expect_paged_gather=n_leaves) if f.rule == "SC010"]
+    assert fired and "indirection never formed" in fired[0].message
+
+
+# ---------------------------------------------------------------------------
+# (f) ISSUE 20: page eviction, page-table corruption, sampling, sharing
+# ---------------------------------------------------------------------------
+
+def test_evict_page_replays_bitwise(net, prompts):
+    """Chaos drops ONE cold page from the oldest row mid-decode: the
+    victim rolls back to the page boundary, REPLAYS the lost span
+    through normal decode steps (no re-prefill, emission suppressed)
+    and still emits its exact greedy reference; the batchmate never
+    notices."""
+    max_new = 10
+    refs10 = [greedy_generate(net, p, max_new)
+              for p in (prompts[2], prompts[3])]
+    sched = GenerationScheduler(max_rows=4)
+    try:
+        # by iteration 8 the oldest row (prompt len 2) has written past
+        # page 1 (pos >= 10 > 8), so slot 1 is cold and droppable
+        faultinject.set_schedule(FaultSchedule(
+            [Fault("evict_page", at_call=8)]))
+        results = _submit_all(sched, net, [prompts[2], prompts[3]],
+                              max_new=max_new, stagger_s=0.05)
+        faultinject.clear()
+        for i, r in results.items():
+            assert not isinstance(r, Exception), (i, r)
+            assert r["tokens"] == refs10[i], (i, r["tokens"], refs10[i])
+        evictions = get_registry().get("serving_kv_page_evictions_total")
+        assert evictions is not None and evictions.value >= 1
+        # page-granular recovery: nobody paid a whole-row re-prefill
+        assert all(r["reprefills"] == 0 for r in results.values())
+    finally:
+        sched.stop()
+
+
+def test_corrupt_page_table_fails_row_alone(net, prompts, refs):
+    """A chaos-scribbled out-of-pool page id in the oldest row's write
+    slot: host-side validation catches it BEFORE dispatch, that row
+    alone fails with the structured PAGE_TABLE error, and the
+    batchmate's stream stays bitwise."""
+    sched = GenerationScheduler(max_rows=4)
+    try:
+        faultinject.set_schedule(FaultSchedule(
+            [Fault("corrupt_page_table", at_call=2)]))
+        res = {}
+
+        def go(i, p):
+            try:
+                res[i] = sched.submit("m", net, threading.Lock(), p,
+                                      MAX_NEW, Deadline(60_000))
+            except Exception as e:  # noqa: BLE001
+                res[i] = e
+
+        t1 = threading.Thread(target=go, args=(1, prompts[0]),
+                              daemon=True)
+        t1.start()
+        time.sleep(0.15)
+        t2 = threading.Thread(target=go, args=(2, prompts[1]),
+                              daemon=True)
+        t2.start()
+        t1.join(60.0)
+        t2.join(60.0)
+        faultinject.clear()
+        assert isinstance(res[1], PageTableCorruption), res[1]
+        assert res[1].code == "PAGE_TABLE"
+        assert res[2]["tokens"] == refs[1]     # batchmate unharmed
+        assert get_registry().get(
+            "serving_page_table_corruptions_total").value == 1
+    finally:
+        sched.stop()
+
+
+def test_seeded_sampling_reproducible_and_matches_singleton(net,
+                                                            prompts):
+    """Temperature sampling is seeded and bitwise-reproducible: the
+    batched engine's sampled stream equals the singleton
+    ``sample_generate`` reference, and resubmitting the same seed
+    yields the identical stream. Greedy stays the default."""
+    temp, seeds = 0.8, [5, 11, 23]
+    srefs = [sample_generate(net, prompts[i], MAX_NEW, temp, seeds[i])
+             for i in range(3)]
+    sched = GenerationScheduler(max_rows=4)
+    try:
+        results, lock = {}, threading.Lock()
+
+        def one(i):
+            r = sched.submit(
+                "m", net, threading.Lock(), prompts[i], MAX_NEW,
+                Deadline(120_000),
+                sampling={"temperature": temp, "seed": seeds[i]})
+            with lock:
+                results[i] = r
+        threads = [threading.Thread(target=one, args=(i,), daemon=True)
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120.0)
+        for i in range(3):
+            assert results[i]["tokens"] == srefs[i], (
+                i, results[i]["tokens"], srefs[i])
+        # same seed, second run: identical stream (and it rides the
+        # full-prompt registry only when sampling-independent — the
+        # first token is re-drawn per request, so parity must hold
+        # through BOTH the cold and the registry-hit path)
+        again = sched.submit(
+            "m", net, threading.Lock(), prompts[0], MAX_NEW,
+            Deadline(120_000),
+            sampling={"temperature": temp, "seed": seeds[0]})
+        assert again["tokens"] == srefs[0]
+        # temperature 0 degrades to greedy
+        zero = sched.submit(
+            "m", net, threading.Lock(), prompts[1], MAX_NEW,
+            Deadline(120_000),
+            sampling={"temperature": 0.0, "seed": 99})
+        assert zero["tokens"] == greedy_generate(net, prompts[1],
+                                                 MAX_NEW)
+        with pytest.raises(ValueError, match="sampling"):
+            sched.submit("m", net, threading.Lock(), prompts[0], 2,
+                         Deadline(10_000), sampling="hot")
+        with pytest.raises(ValueError, match="temperature"):
+            sched.submit("m", net, threading.Lock(), prompts[0], 2,
+                         Deadline(10_000),
+                         sampling={"temperature": -1.0, "seed": 0})
+    finally:
+        sched.stop()
+
+
+def test_shared_prefix_pages_deduped_and_refcounted(net):
+    """Two DIFFERENT prompts sharing a page-aligned 8-token prefix: the
+    second admission maps the first's prefix pages instead of
+    rewriting them (refcount > 1 — ``kv_pages_shared``), and both
+    streams stay bitwise equal to their singleton references (shared
+    pages are read-only by construction)."""
+    rng = np.random.default_rng(77)
+    common = rng.integers(0, VOCAB, 8).tolist()
+    a, b = common + [1], common + [2, 3]
+    ref_a = greedy_generate(net, a, MAX_NEW)
+    ref_b = greedy_generate(net, b, MAX_NEW)
+    sched = GenerationScheduler(max_rows=4)
+    try:
+        ra = sched.submit("m", net, threading.Lock(), a, MAX_NEW,
+                          Deadline(120_000))
+        rb = sched.submit("m", net, threading.Lock(), b, MAX_NEW,
+                          Deadline(120_000))
+        assert ra["tokens"] == ref_a
+        assert rb["tokens"] == ref_b
+        st = sched.stats()
+        # the two full prefix pages are held by both prompt-registry
+        # entries: refcount 2, visible as shared pages
+        assert st["kv_pages_shared"] >= 2, st
+        eng = sched._engines["m"]
+        shared = [pid for pid in range(1, eng.total_pages)
+                  if eng.page_ref[pid] > 1]
+        assert len(shared) >= 2
+    finally:
+        sched.stop()

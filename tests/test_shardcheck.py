@@ -353,7 +353,7 @@ def test_cli_file_mode(tmp_path):
 
 def test_rule_table_is_complete():
     assert set(RULES) == {"SC001", "SC002", "SC003", "SC004", "SC005",
-                          "SC006", "SC007", "SC008", "SC009"}
+                          "SC006", "SC007", "SC008", "SC009", "SC010"}
 
 
 def test_parse_hlo_module_tolerates_garbage():

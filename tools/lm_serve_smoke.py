@@ -18,6 +18,15 @@ must prove, end to end over the socket:
    preempts (ring-buffer eviction) and completes while bulk work is
    still running; the evicted victim re-prefills and still finishes
    with its exact reference tokens.
+5. **Shared prefixes collapse TTFT** (ISSUE 20) — a common system
+   prompt with distinct tails shares its full KV pages (refcounted,
+   ``kv_pages_shared`` > 0), and an identical re-run hits the
+   full-prompt registry: STRICTLY fewer prefill steps, a nonzero
+   ``prefix_cache_hit_rate``, and every stream still bitwise singleton.
+6. **Page eviction is survivable bitwise** — chaos drops a cold KV
+   page mid-decode; the victim rolls back to the page boundary and
+   REPLAYS the lost span through normal decode steps (no whole-row
+   re-prefill) to the exact singleton tokens.
 
 Exit 0 = the token-level serving edge is wired end to end.
 """
@@ -66,7 +75,7 @@ def main() -> int:
                               max_batch=4, default_deadline_ms=120_000)
             try:
                 rc = _phases(srv, model, prompts, refs, refs_bulk,
-                             max_new, np, KerasClient, registry)
+                             max_new, np, KerasClient, registry, net)
             finally:
                 srv.drain(grace_s=5.0)
         return rc
@@ -75,7 +84,7 @@ def main() -> int:
 
 
 def _phases(srv, model, prompts, refs, refs_bulk, max_new, np,
-            KerasClient, registry) -> int:
+            KerasClient, registry, net) -> int:
     results, failures = {}, []
     lock = threading.Lock()
 
@@ -207,13 +216,116 @@ def _phases(srv, model, prompts, refs, refs_bulk, max_new, np,
                   f"preemption ({r['tokens']} vs {refs_bulk[idx]})")
             return 1
         reprefilled += r.get("reprefills", 0)
+
+    # ---- shared-prefix phase (ISSUE 20): a common 8-token system
+    # prompt (two full KV pages at page_len 4) with distinct tails, run
+    # twice. Wave A prefills cold but DEDUPES the prefix pages across
+    # the wave (staggered so the first admission registers them);
+    # the identical wave B hits the full-prompt registry — strictly
+    # fewer prefill steps, nonzero hit rate — and every stream stays
+    # bitwise equal to its singleton reference.
+    from deeplearning4j_tpu.models.gpt import greedy_generate
+    rng = np.random.default_rng(41)
+    common = rng.integers(0, 13, 8).tolist()
+    sys_prompts = [common + [i] for i in range(4)]
+    sys_refs = [greedy_generate(net, p, max_new) for p in sys_prompts]
+    sp_results = {}
+
+    def sp_one(wave, idx, stagger_s):
+        try:
+            time.sleep(stagger_s)
+            cli = KerasClient(srv.host, srv.port)
+            try:
+                r = cli.generate(sys_prompts[idx], max_new, model=model)
+                with lock:
+                    sp_results[(wave, idx)] = r
+            finally:
+                cli.close()
+        except Exception as e:  # noqa: BLE001 — reported below
+            with lock:
+                failures.append(f"{type(e).__name__}: {e}")
+
+    prefill_per_wave = []
+    for wave in range(2):
+        before = srv._gen.stats()["prefill_steps"]
+        threads = [threading.Thread(
+            target=sp_one, args=(wave, i, 0.1 * i if wave == 0 else 0.0),
+            daemon=True) for i in range(len(sys_prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120.0)
+        prefill_per_wave.append(
+            srv._gen.stats()["prefill_steps"] - before)
+    if failures:
+        print(f"lm_serve_smoke: FAIL shared-prefix errors {failures}")
+        return 1
+    for (wave, idx), r in sp_results.items():
+        if r["tokens"] != sys_refs[idx]:
+            print(f"lm_serve_smoke: FAIL shared-prefix decode diverged "
+                  f"(wave {wave}, req {idx}: {r['tokens']} vs "
+                  f"{sys_refs[idx]})")
+            return 1
+    if prefill_per_wave[1] >= prefill_per_wave[0]:
+        print(f"lm_serve_smoke: FAIL identical shared-prefix wave did "
+              f"not save prefill steps ({prefill_per_wave[0]} -> "
+              f"{prefill_per_wave[1]})")
+        return 1
+    st = srv._gen.stats()
+    if not st["prefix_cache_hit_rate"] > 0:
+        print(f"lm_serve_smoke: FAIL prefix_cache_hit_rate is zero "
+              f"({st['prefix_lookups']} lookups, {st['prefix_hits']} "
+              "hits)")
+        return 1
+    if st["kv_pages_shared"] < 2:
+        print(f"lm_serve_smoke: FAIL system-prompt pages not shared "
+              f"(kv_pages_shared={st['kv_pages_shared']})")
+        return 1
+
+    # ---- page-eviction chaos phase: drop a cold KV page mid-decode;
+    # the victim replays the lost span through normal decode steps (no
+    # whole-row re-prefill) and still emits its exact singleton tokens
+    from deeplearning4j_tpu.resilience import faultinject
+    from deeplearning4j_tpu.resilience.faultinject import (Fault,
+                                                           FaultSchedule)
+    chaos_prompt = [3, 5]
+    chaos_ref = greedy_generate(net, chaos_prompt, 12)
+    faultinject.set_schedule(FaultSchedule(
+        [Fault("evict_page", at_call=8)]))
+    try:
+        cli = KerasClient(srv.host, srv.port)
+        try:
+            chaos_r = cli.generate(chaos_prompt, 12, model=model)
+        finally:
+            cli.close()
+    finally:
+        faultinject.clear()
+    if chaos_r["tokens"] != chaos_ref:
+        print(f"lm_serve_smoke: FAIL page-evicted stream diverged "
+              f"({chaos_r['tokens']} vs {chaos_ref})")
+        return 1
+    if chaos_r.get("reprefills", 0) != 0:
+        print("lm_serve_smoke: FAIL page eviction escalated to a "
+              "whole-row re-prefill (recovery should be replay-only)")
+        return 1
+    page_ev = registry.get("serving_kv_page_evictions_total")
+    if page_ev is None or page_ev.value < 1:
+        print("lm_serve_smoke: FAIL evict_page chaos never dropped a "
+              "page")
+        return 1
+
     print(f"lm_serve_smoke: OK — {n_req} generations bitwise == "
           f"singleton across join/leave churn (avg {avg_rows:.2f} "
           f"rows/decode step over {hist.count} steps); compile count "
           f"flat at {compiles[0]} across wave 2; interactive preempted "
           f"{int(evictions.value) if evictions else 0} bulk row(s) "
           f"({reprefilled} re-prefilled, all bitwise) and finished "
-          f"before {n_bulk_after} bulk request(s)")
+          f"before {n_bulk_after} bulk request(s); shared-prefix "
+          f"re-run cut prefill steps {prefill_per_wave[0]} -> "
+          f"{prefill_per_wave[1]} (hit rate "
+          f"{st['prefix_cache_hit_rate']}, {st['kv_pages_shared']} "
+          f"shared pages); page-evicted stream replayed bitwise "
+          f"({int(page_ev.value)} page(s) dropped)")
     return 0
 
 

@@ -81,7 +81,10 @@ ls_ = [r for r in recs if r.get("rung") == "lm_serve"]
 assert ls_, "no lm_serve rung record emitted"
 for r in ls_:
     for fld in ("tokens_per_sec_at_slo", "ttft_p50_ms", "ttft_p99_ms",
-                "whole_predict_tokens_per_sec", "vs_whole_predict"):
+                "whole_predict_tokens_per_sec", "vs_whole_predict",
+                # ISSUE 20: block-paged KV pool + prefix-cache census
+                "prefix_cache_hit_rate", "kv_pages_total",
+                "kv_pages_shared"):
         v = r.get(fld)
         assert v is not None and math.isfinite(float(v)), \
             f"lm_serve record {fld} missing or non-finite: {v!r}"
@@ -153,8 +156,8 @@ if [ "${1:-}" != "--fast" ]; then
     stage "profiling smoke"  env JAX_PLATFORMS=cpu python tools/profiling_smoke.py
     stage "chaos smoke"      env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
     stage "serve smoke"      env JAX_PLATFORMS=cpu python tools/serve_smoke.py
-    stage "lm serve smoke (token-level)" env JAX_PLATFORMS=cpu \
-        python tools/lm_serve_smoke.py
+    stage "lm serve smoke (token-level + shared-prefix + page chaos)" \
+        env JAX_PLATFORMS=cpu python tools/lm_serve_smoke.py
     stage "fleet smoke (kill/failover/rolling drain)" env JAX_PLATFORMS=cpu \
         python tools/fleet_smoke.py
     stage "autoscale smoke (ramp/brownout/quarantine)" env JAX_PLATFORMS=cpu \
